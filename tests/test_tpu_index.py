@@ -1,0 +1,156 @@
+"""TpuVectorIndex: exact-recall search, tombstones, allowLists, persistence.
+
+Models the reference's hnsw test tiers: recall fixtures (recall_test.go),
+delete/tombstone behavior (delete.go tests), persistence round-trip
+(persistence_integration_test.go)."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.entities import vectorindex as vi
+from weaviate_tpu.index.tpu import TpuVectorIndex
+from weaviate_tpu.storage.bitmap import Bitmap
+
+
+def make_index(tmp_path, metric=vi.DISTANCE_L2, **kw):
+    cfg = vi.HnswUserConfig.from_dict({"distance": metric, **kw}, "hnsw_tpu")
+    return TpuVectorIndex(cfg, str(tmp_path))
+
+
+def brute_force(vectors, q, k, metric):
+    from weaviate_tpu.ops.distances import single_distance
+
+    d = np.array([single_distance(q, v, metric) for v in vectors])
+    order = np.argsort(d, kind="stable")[:k]
+    return order, d[order]
+
+
+@pytest.mark.parametrize("metric", [vi.DISTANCE_L2, vi.DISTANCE_COSINE, vi.DISTANCE_DOT])
+def test_exact_recall(tmp_path, rng, metric):
+    idx = make_index(tmp_path / metric, metric)
+    vecs = rng.standard_normal((500, 24)).astype(np.float32)
+    idx.add_batch(np.arange(500), vecs)
+    q = rng.standard_normal(24).astype(np.float32)
+    ids, dists = idx.search_by_vector(q, 10)
+    want_ids, want_d = brute_force(vecs, q, 10, metric)
+    assert set(ids.tolist()) == set(want_ids.tolist())
+    np.testing.assert_allclose(np.sort(dists), np.sort(want_d), rtol=1e-3, atol=1e-3)
+
+
+def test_batched_search(tmp_path, rng):
+    idx = make_index(tmp_path)
+    vecs = rng.standard_normal((300, 16)).astype(np.float32)
+    idx.add_batch(np.arange(300), vecs)
+    qs = rng.standard_normal((7, 16)).astype(np.float32)
+    ids, dists = idx.search_by_vectors(qs, 5)
+    assert ids.shape == (7, 5)
+    for bi in range(7):
+        want_ids, _ = brute_force(vecs, qs[bi], 5, vi.DISTANCE_L2)
+        assert set(ids[bi].tolist()) == set(want_ids.tolist())
+
+
+def test_delete_tombstones(tmp_path, rng):
+    idx = make_index(tmp_path)
+    vecs = rng.standard_normal((100, 8)).astype(np.float32)
+    idx.add_batch(np.arange(100), vecs)
+    q = vecs[7]
+    ids, _ = idx.search_by_vector(q, 1)
+    assert ids[0] == 7
+    idx.delete(7)
+    ids, _ = idx.search_by_vector(q, 3)
+    assert 7 not in ids.tolist()
+    assert len(idx) == 99
+    assert not idx.contains(7)
+
+
+def test_update_same_doc_id(tmp_path, rng):
+    idx = make_index(tmp_path)
+    v1 = np.ones(8, np.float32)
+    v2 = -np.ones(8, np.float32)
+    idx.add(1, v1)
+    idx.add(1, v2)  # re-add = replace (reference deletes old docID first)
+    ids, dists = idx.search_by_vector(v2, 2)
+    assert ids[0] == 1
+    assert len(idx) == 1
+    np.testing.assert_allclose(dists[0], 0.0, atol=1e-4)
+
+
+def test_allowlist_filtering(tmp_path, rng):
+    idx = make_index(tmp_path)
+    vecs = rng.standard_normal((200, 8)).astype(np.float32)
+    idx.add_batch(np.arange(200), vecs)
+    allow = Bitmap([5, 50, 150])
+    q = vecs[7]  # closest overall is 7, but it's not allowed
+    ids, _ = idx.search_by_vector(q, 10, allow)
+    assert set(ids.tolist()) <= {5, 50, 150}
+    assert len(ids) == 3
+
+
+def test_allowlist_large_path(tmp_path, rng):
+    # force the full-scan masked path by setting the cutoff to 0
+    idx = make_index(tmp_path, flatSearchCutoff=0)
+    vecs = rng.standard_normal((100, 8)).astype(np.float32)
+    idx.add_batch(np.arange(100), vecs)
+    allow = Bitmap(np.arange(0, 100, 2))
+    q = rng.standard_normal(8).astype(np.float32)
+    ids, _ = idx.search_by_vector(q, 10, allow)
+    assert all(i % 2 == 0 for i in ids.tolist())
+    assert len(ids) == 10
+
+
+def test_search_by_vector_distance(tmp_path, rng):
+    idx = make_index(tmp_path)
+    vecs = rng.standard_normal((100, 4)).astype(np.float32)
+    idx.add_batch(np.arange(100), vecs)
+    q = vecs[0]
+    ids, dists = idx.search_by_vector_distance(q, 1.0, 100)
+    assert (dists <= 1.0).all()
+    # cross-check against brute force count
+    from weaviate_tpu.ops.distances import single_distance
+
+    want = sum(1 for v in vecs if single_distance(q, v, vi.DISTANCE_L2) <= 1.0)
+    assert len(ids) == want
+
+
+def test_persistence_roundtrip(tmp_path, rng):
+    p = tmp_path / "shard"
+    idx = make_index(p)
+    vecs = rng.standard_normal((50, 8)).astype(np.float32)
+    idx.add_batch(np.arange(50), vecs)
+    idx.delete(3, 4)
+    idx.shutdown()
+
+    idx2 = make_index(p)
+    idx2.post_startup()
+    assert len(idx2) == 48
+    q = vecs[10]
+    ids, _ = idx2.search_by_vector(q, 1)
+    assert ids[0] == 10
+    ids, _ = idx2.search_by_vector(vecs[3], 5)
+    assert 3 not in ids.tolist()
+
+
+def test_compaction(tmp_path, rng):
+    p = tmp_path / "shard"
+    idx = make_index(p)
+    vecs = rng.standard_normal((60, 8)).astype(np.float32)
+    idx.add_batch(np.arange(60), vecs)
+    idx.delete(*range(0, 30))
+    idx.compact()
+    assert len(idx) == 30
+    ids, _ = idx.search_by_vector(vecs[45], 1)
+    assert ids[0] == 45
+    # compacted log replays correctly
+    idx.shutdown()
+    idx3 = make_index(p)
+    assert len(idx3) == 30
+
+
+def test_growth_past_min_capacity(tmp_path, rng):
+    idx = make_index(tmp_path)
+    n = 20000  # > _MIN_CAPACITY forces geometric growth
+    vecs = rng.standard_normal((n, 8)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    ids, _ = idx.search_by_vector(vecs[n - 1], 1)
+    assert ids[0] == n - 1
+    assert len(idx) == n

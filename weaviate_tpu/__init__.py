@@ -1,0 +1,25 @@
+"""weaviate_tpu — a TPU-native vector database framework.
+
+A ground-up re-design of the capabilities of Weaviate v1.19 (reference:
+/root/reference, pure Go + AVX2 asm) for TPU hardware:
+
+- The vector-search hot path (batched distance evaluation, PQ LUT scans,
+  filtered-search allowList masking, top-k) runs on TPU via JAX/XLA and
+  Pallas kernels, operating on HBM-resident per-shard vector stores.
+- Graph-based ANN (HNSW) runs in a native C++ engine with a batched,
+  TPU-friendly re-ranking path; the default TPU index is a brute-force /
+  IVF device index that exceeds HNSW recall at far higher QPS for
+  HBM-resident shards.
+- Multi-chip scaling uses jax.sharding Mesh + shard_map collectives
+  (shard-per-device residency, on-device top-k merge over ICI), replacing
+  the reference's goroutine scatter-gather for the device data plane.
+- The control plane (schema, LSM storage, inverted index, cluster
+  membership, replication) is Python with binary on-disk formats, mirroring
+  the reference's layer map (SURVEY.md §1).
+
+Layer map parity: see SURVEY.md §2 component inventory.
+"""
+
+from weaviate_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,9 @@
+"""Shared vocabulary used by every layer (reference: entities/).
+
+Submodules:
+- schema: class/property data model + data types + tokenizations
+- filters: where-filter clause tree + operators
+- vectorindex: per-class vector-index user configs (hnsw, hnsw_tpu, flat, noop)
+- storobj: versioned binary object codec
+- dto: search params / results passed between layers
+"""

@@ -1,0 +1,182 @@
+"""Versioned binary object codec.
+
+Reference: entities/storobj/storage_object.go (MarshallerVersion 1: docID,
+timestamps, UUID, vector as float32 LE, props as JSON; partial decode via
+FromBinaryUUIDOnly / FromBinaryOptional :83,111; batched hydration
+ObjectsByDocID :211).
+
+Our layout (version 1, little-endian):
+
+    u8  version
+    u64 doc_id
+    i64 creation_time_unix_ms
+    i64 last_update_time_unix_ms
+    16B uuid
+    u16 len(class_name) | class_name utf-8
+    u32 dim            | dim * f32 vector
+    u32 len(props_json)| props json utf-8 (includes refs under their prop name)
+    u32 len(meta_json) | additional meta json (vector-weights etc.)
+
+Partial decodes read only the fixed prefix (uuid-only) or skip the vector
+(no-vector hydration for keyword-only queries).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+MARSHALLER_VERSION = 1
+
+_FIXED = struct.Struct("<BQqq16s")  # version, doc_id, created, updated, uuid
+
+
+class StorObjError(ValueError):
+    pass
+
+
+@dataclass
+class StorObj:
+    """One stored object: identity + vector + properties."""
+
+    class_name: str
+    uuid: str
+    properties: dict = field(default_factory=dict)
+    vector: Optional[np.ndarray] = None
+    doc_id: int = 0
+    creation_time_unix: int = 0  # ms
+    last_update_time_unix: int = 0  # ms
+    meta: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.creation_time_unix == 0:
+            now = int(time.time() * 1000)
+            self.creation_time_unix = now
+            self.last_update_time_unix = now
+        if self.vector is not None and not isinstance(self.vector, np.ndarray):
+            self.vector = np.asarray(self.vector, dtype=np.float32)
+
+    # -- codec ---------------------------------------------------------------
+
+    def to_binary(self) -> bytes:
+        u = uuidlib.UUID(self.uuid).bytes
+        cls_b = self.class_name.encode("utf-8")
+        props_b = json.dumps(self.properties, separators=(",", ":"), default=str).encode("utf-8")
+        meta_b = json.dumps(self.meta, separators=(",", ":")).encode("utf-8") if self.meta else b""
+        if self.vector is not None:
+            vec = np.ascontiguousarray(self.vector, dtype=np.float32)
+            vec_b = vec.tobytes()
+            dim = vec.shape[0]
+        else:
+            vec_b = b""
+            dim = 0
+        parts = [
+            _FIXED.pack(
+                MARSHALLER_VERSION,
+                self.doc_id,
+                self.creation_time_unix,
+                self.last_update_time_unix,
+                u,
+            ),
+            struct.pack("<H", len(cls_b)),
+            cls_b,
+            struct.pack("<I", dim),
+            vec_b,
+            struct.pack("<I", len(props_b)),
+            props_b,
+            struct.pack("<I", len(meta_b)),
+            meta_b,
+        ]
+        return b"".join(parts)
+
+    @classmethod
+    def from_binary(cls, data: bytes, include_vector: bool = True) -> "StorObj":
+        version, doc_id, created, updated, u = _FIXED.unpack_from(data, 0)
+        if version != MARSHALLER_VERSION:
+            raise StorObjError(f"unsupported marshaller version {version}")
+        off = _FIXED.size
+        (cls_len,) = struct.unpack_from("<H", data, off)
+        off += 2
+        class_name = data[off : off + cls_len].decode("utf-8")
+        off += cls_len
+        (dim,) = struct.unpack_from("<I", data, off)
+        off += 4
+        vector = None
+        if dim:
+            if include_vector:
+                vector = np.frombuffer(data, dtype="<f4", count=dim, offset=off).copy()
+            off += dim * 4
+        (plen,) = struct.unpack_from("<I", data, off)
+        off += 4
+        properties = json.loads(data[off : off + plen]) if plen else {}
+        off += plen
+        (mlen,) = struct.unpack_from("<I", data, off)
+        off += 4
+        meta = json.loads(data[off : off + mlen]) if mlen else {}
+        return cls(
+            class_name=class_name,
+            uuid=str(uuidlib.UUID(bytes=u)),
+            properties=properties,
+            vector=vector,
+            doc_id=doc_id,
+            creation_time_unix=created,
+            last_update_time_unix=updated,
+            meta=meta,
+        )
+
+    @staticmethod
+    def uuid_from_binary(data: bytes) -> str:
+        """Partial decode of only the UUID (reference FromBinaryUUIDOnly :83)."""
+        _, _, _, _, u = _FIXED.unpack_from(data, 0)
+        return str(uuidlib.UUID(bytes=u))
+
+    @staticmethod
+    def doc_id_from_binary(data: bytes) -> int:
+        _, doc_id, _, _, _ = _FIXED.unpack_from(data, 0)
+        return doc_id
+
+    @staticmethod
+    def vector_from_binary(data: bytes) -> Optional[np.ndarray]:
+        """Decode only the vector (skips identity + class name)."""
+        off = _FIXED.size
+        (cls_len,) = struct.unpack_from("<H", data, off)
+        off += 2 + cls_len
+        (dim,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if not dim:
+            return None
+        return np.frombuffer(data, dtype="<f4", count=dim, offset=off).copy()
+
+    # -- API shape -----------------------------------------------------------
+
+    def to_rest(self, include_vector: bool = False, additional: Optional[dict] = None) -> dict:
+        d = {
+            "class": self.class_name,
+            "id": self.uuid,
+            "properties": self.properties,
+            "creationTimeUnix": self.creation_time_unix,
+            "lastUpdateTimeUnix": self.last_update_time_unix,
+        }
+        if include_vector and self.vector is not None:
+            d["vector"] = [float(x) for x in self.vector]
+        if additional:
+            d["additional"] = additional
+        return d
+
+
+def objects_by_doc_id(
+    getter, doc_ids: Sequence[int], include_vector: bool = True
+) -> list[Optional[StorObj]]:
+    """Batched hydration of winners by docID (reference storage_object.go:211).
+    `getter(doc_id) -> Optional[bytes]`."""
+    out: list[Optional[StorObj]] = []
+    for d in doc_ids:
+        raw = getter(d)
+        out.append(StorObj.from_binary(raw, include_vector) if raw is not None else None)
+    return out

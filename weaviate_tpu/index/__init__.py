@@ -1,0 +1,39 @@
+"""Vector indexes behind the VectorIndex seam.
+
+Reference: adapters/repos/db/vector_index.go:23-40 — the interface through
+which shard search reaches any index implementation. Implementations here:
+
+- tpu.TpuVectorIndex  ("hnsw_tpu"/"flat"): HBM-resident batched exact / IVF
+- hnsw.HnswIndex      ("hnsw"): native C++ graph engine (CPU parity index)
+- noop.NoopIndex      ("noop"/skip=true)
+- geo.GeoIndex        (per-geo-property haversine index)
+"""
+
+from weaviate_tpu.index.interface import VectorIndex
+
+__all__ = ["VectorIndex", "new_vector_index"]
+
+
+def new_vector_index(config, shard_path: str, shard_name: str = "", metrics=None):
+    """Factory keyed on UserConfig.IndexType() (the discriminator,
+    entities/vectorindex/hnsw/config.go:69-71; selection happens in
+    shard.go:134 initVectorIndex in the reference)."""
+    t = config.IndexType()
+    if config.skip or t == "noop":
+        from weaviate_tpu.index.noop import NoopIndex
+
+        return NoopIndex(config)
+    if t in ("hnsw_tpu", "flat"):
+        from weaviate_tpu.index.tpu import TpuVectorIndex
+
+        return TpuVectorIndex(config, shard_path, shard_name, metrics=metrics)
+    if t == "hnsw":
+        try:
+            from weaviate_tpu.index.hnsw import HnswIndex
+        except ImportError as e:
+            raise ValueError(
+                "vectorIndexType 'hnsw' requires the native graph engine "
+                f"(weaviate_tpu.index.hnsw): {e}"
+            ) from e
+        return HnswIndex(config, shard_path, shard_name, metrics=metrics)
+    raise ValueError(f"unknown vector index type {t!r}")

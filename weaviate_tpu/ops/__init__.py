@@ -1,0 +1,23 @@
+"""TPU compute ops: batched distances, masked top-k, PQ LUT kernels.
+
+These replace the reference's native distance kernels
+(adapters/repos/db/vector/hnsw/distancer/asm/{l2,dot}_amd64.s — AVX2 FMA loops)
+and the scalar PQ LUT scan (ssdhelpers/product_quantization.go:56-75) with
+MXU-batched XLA ops and Pallas kernels.
+"""
+
+from weaviate_tpu.ops.distances import (
+    pairwise_distances,
+    single_distance,
+    normalize_rows,
+    DISTANCE_FNS,
+)
+from weaviate_tpu.ops.topk import masked_top_k
+
+__all__ = [
+    "pairwise_distances",
+    "single_distance",
+    "normalize_rows",
+    "DISTANCE_FNS",
+    "masked_top_k",
+]

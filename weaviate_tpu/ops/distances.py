@@ -1,0 +1,145 @@
+"""Batched distance kernels, designed for the MXU.
+
+Reference semantics (adapters/repos/db/vector/hnsw/distancer/):
+- l2-squared: sum((a-b)^2)                       (l2_squared.go / asm/l2_amd64.s)
+- dot: -dot(a,b)  (negative so that smaller = closer)    (dot_product.go)
+- cosine: 1 - dot(a_norm, b_norm); vectors are normalized once at insert and
+  at query time, then treated as dot (cosine_dist.go, hnsw/search.go:64
+  normalization)
+- manhattan: sum(|a-b|)                          (manhattan.go)
+- hamming: count(a[i] != b[i])                   (hamming.go)
+
+TPU-first design: instead of one scalar kernel per graph edge, every call
+evaluates a [B, N] block of distances between B queries and N stored vectors
+with a single matmul (dot/cosine/l2 expand to Q @ X^T, which XLA tiles onto
+the 128x128 systolic array in bf16/f32). Manhattan/hamming have no matmul
+form; they stream X in N-chunks with a lax.scan so the broadcast buffer stays
+VMEM-sized.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from weaviate_tpu.entities import vectorindex as vi
+
+Array = jax.Array
+
+# chunk of stored vectors processed per scan step for non-matmul metrics
+_STREAM_CHUNK = 4096
+
+
+def normalize_rows(x: Array, eps: float = 1e-30) -> Array:
+    """L2-normalize rows (cosine is normalize-then-dot, cosine_dist.go)."""
+    norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2, axis=-1, keepdims=True))
+    return (x / jnp.maximum(norm, eps)).astype(x.dtype)
+
+
+# JAX's DEFAULT matmul precision truncates f32 operands to bf16 on TPU (and
+# mirrors that on CPU); distances feed ranking decisions, so accumulate at
+# full f32 — the bf16 *store dtype* remains the explicit speed/memory knob.
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def _matmul(q: Array, x: Array) -> Array:
+    return jnp.matmul(q, x.T, preferred_element_type=jnp.float32, precision=_PRECISION)
+
+
+def _dot_dists(q: Array, x: Array, x_sq_norms: Array | None) -> Array:
+    # negative dot: smaller = closer (dot_product.go negates)
+    return -_matmul(q, x)
+
+
+def _cosine_dists(q: Array, x: Array, x_sq_norms: Array | None) -> Array:
+    # caller guarantees both sides are normalized; 1 - dot
+    return 1.0 - _matmul(q, x)
+
+
+def _l2_dists(q: Array, x: Array, x_sq_norms: Array | None) -> Array:
+    # ||q-x||^2 = ||q||^2 - 2 q.x + ||x||^2 ; the q.x term is the MXU matmul
+    qx = _matmul(q, x)
+    q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+    if x_sq_norms is None:
+        x_sq_norms = jnp.sum(x.astype(jnp.float32) ** 2, axis=-1)
+    d = q_sq - 2.0 * qx + x_sq_norms[None, :]
+    return jnp.maximum(d, 0.0)
+
+
+def _streamed(elem_fn: Callable[[Array, Array], Array]):
+    """Build a [B,N] distance fn that scans over N-chunks of x.
+
+    elem_fn(q[B,1,D], xc[1,C,D]) -> [B,C] partial distances.
+    """
+
+    def fn(q: Array, x: Array, x_sq_norms: Array | None) -> Array:
+        n = x.shape[0]
+        chunk = min(_STREAM_CHUNK, n)
+        # pad N to a multiple of chunk (store is already padded by the index,
+        # but be safe for direct calls)
+        pad = (-n) % chunk
+        if pad:
+            x = jnp.pad(x, ((0, pad), (0, 0)))
+        xc = x.reshape(-1, chunk, x.shape[-1])
+        qf = q.astype(jnp.float32)
+
+        def step(_, xblock):
+            return None, elem_fn(qf[:, None, :], xblock[None, :, :].astype(jnp.float32))
+
+        _, parts = jax.lax.scan(step, None, xc)
+        out = jnp.moveaxis(parts, 0, 1).reshape(q.shape[0], -1)
+        return out[:, :n]
+
+    return fn
+
+
+_manhattan_dists = _streamed(lambda q, xc: jnp.sum(jnp.abs(q - xc), axis=-1))
+_hamming_dists = _streamed(lambda q, xc: jnp.sum((q != xc).astype(jnp.float32), axis=-1))
+
+
+DISTANCE_FNS: dict[str, Callable[[Array, Array, Array | None], Array]] = {
+    vi.DISTANCE_DOT: _dot_dists,
+    vi.DISTANCE_COSINE: _cosine_dists,
+    vi.DISTANCE_L2: _l2_dists,
+    vi.DISTANCE_MANHATTAN: _manhattan_dists,
+    vi.DISTANCE_HAMMING: _hamming_dists,
+}
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def pairwise_distances(
+    q: Array, x: Array, metric: str = vi.DISTANCE_L2, x_sq_norms: Array | None = None
+) -> Array:
+    """[B, D] queries x [N, D] store -> [B, N] float32 distances.
+
+    For cosine, q and x must already be row-normalized (the index normalizes
+    at insert; queries are normalized once per batch).
+    """
+    fn = DISTANCE_FNS[metric]
+    return fn(q, x, x_sq_norms)
+
+
+def single_distance(a, b, metric: str = vi.DISTANCE_L2) -> float:
+    """Scalar convenience twin of Provider.SingleDist (distancer/provider.go:14).
+    Host-side numpy path for control-plane uses (heuristics, geo, tests)."""
+    import numpy as np
+
+    a = np.asarray(a, dtype=np.float32)
+    b = np.asarray(b, dtype=np.float32)
+    if metric == vi.DISTANCE_L2:
+        d = a - b
+        return float(np.dot(d, d))
+    if metric == vi.DISTANCE_DOT:
+        return float(-np.dot(a, b))
+    if metric == vi.DISTANCE_COSINE:
+        na = np.linalg.norm(a) or 1.0
+        nb = np.linalg.norm(b) or 1.0
+        return float(1.0 - np.dot(a, b) / (na * nb))
+    if metric == vi.DISTANCE_MANHATTAN:
+        return float(np.sum(np.abs(a - b)))
+    if metric == vi.DISTANCE_HAMMING:
+        return float(np.sum(a != b))
+    raise ValueError(f"unknown metric {metric!r}")

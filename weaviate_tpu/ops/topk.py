@@ -1,0 +1,68 @@
+"""Masked top-k over distance blocks.
+
+Replaces the reference's per-query binary heaps
+(vector/hnsw/priorityqueue/, flat_search.go:19 max-heap) with a single
+device-side lax.top_k over a [B, N] distance block, after masking out:
+- unused capacity slots (store is padded),
+- tombstoned docIDs (delete.go tombstone semantics),
+- docIDs outside the filter allowList (search.go:283-291 applies the
+  allowList in the hot loop; here it is a vectorized mask).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# plain python float: must NOT materialize a device array at import time
+# (importing the package would force backend init before config is settled)
+INF = float("inf")
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def masked_top_k(
+    dists: Array,
+    valid_mask: Array,
+    k: int,
+    allow_mask: Array | None = None,
+) -> tuple[Array, Array]:
+    """dists [B, N] + valid_mask [N] bool (+ optional allow_mask [N] or [B, N])
+    -> (top_dists [B, k], top_idx [B, k] int32). Masked-out slots surface as
+    +inf distance with index -1."""
+    mask = valid_mask[None, :]
+    if allow_mask is not None:
+        allow = allow_mask if allow_mask.ndim == 2 else allow_mask[None, :]
+        mask = jnp.logical_and(mask, allow)
+    masked = jnp.where(mask, dists, INF)
+    # lax.top_k returns the k largest; negate for smallest
+    neg_top, idx = jax.lax.top_k(-masked, k)
+    top = -neg_top
+    idx = jnp.where(jnp.isinf(top), -1, idx)
+    return top, idx.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_top_k(dists_a: Array, idx_a: Array, dists_b: Array, idx_b: Array, k: int):
+    """Merge two [B, k'] top-k candidate sets into one [B, k] (scatter-gather
+    merge by distance, reference index.go:1040-1046, vectorized)."""
+    d = jnp.concatenate([dists_a, dists_b], axis=1)
+    i = jnp.concatenate([idx_a, idx_b], axis=1)
+    neg_top, pos = jax.lax.top_k(-d, k)
+    return -neg_top, jnp.take_along_axis(i, pos, axis=1)
+
+
+def bitmap_to_mask(bitmap_words: Array, n: int) -> Array:
+    """Expand a packed uint32 bitmap [ceil(N/32)] into a bool mask [N].
+
+    This is the device twin of helpers.AllowList (sroar bitmap,
+    helpers/allow_list.go:19-29): the host serializes the filter result as a
+    dense bitset over docID slots; the device unpacks it with vector ops.
+    """
+    w = bitmap_words.astype(jnp.uint32)
+    bits = jnp.arange(32, dtype=jnp.uint32)
+    expanded = (w[:, None] >> bits[None, :]) & jnp.uint32(1)
+    return expanded.reshape(-1)[:n].astype(jnp.bool_)

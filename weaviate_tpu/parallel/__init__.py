@@ -1,0 +1,15 @@
+"""Multi-chip device data plane.
+
+Reference parallelism (SURVEY.md §2.8): goroutine scatter-gather across
+shards + HTTP between nodes (index.go:967-1046). TPU-native analog: one
+logical index sharded row-wise over a jax.sharding Mesh — each chip holds a
+[N/devices, D] slab in its HBM, a query batch is replicated, every chip
+scores its slab and the per-chip top-k candidates are merged with an
+all_gather over ICI (not host HTTP). Host-level (DCN / multi-node)
+scatter-gather stays on the cluster API plane, mirroring the reference's
+local-shard vs remote-shard split (index.go:996-1017).
+"""
+
+from weaviate_tpu.parallel.mesh_search import MeshSearchPlan, distributed_search_step
+
+__all__ = ["MeshSearchPlan", "distributed_search_step"]

@@ -1,0 +1,148 @@
+"""Sharded batched kNN over a device mesh (shard_map + ICI collectives).
+
+The device twin of Index.objectVectorSearch's errgroup fan-out + merge-sort
+(adapters/repos/db/index.go:967-1046): instead of goroutines + HTTP, the
+"fan-out" is SPMD execution of the same program on every chip over its local
+HBM slab, and the "merge by distance" is an all_gather of [B, k] candidate
+sets over ICI followed by a k-selection — all inside one jit.
+
+Also provides the write path (sharded insert step): appends land on the chip
+that owns the target slot via masked dynamic_update_slice, so a full
+update+search step compiles into a single SPMD program (this is what
+__graft_entry__.dryrun_multichip validates on a virtual mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from weaviate_tpu.ops.distances import DISTANCE_FNS
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()[: n_devices or len(jax.devices())]
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def _local_topk(dists, k):
+    neg, idx = jax.lax.top_k(-dists, k)
+    return -neg, idx
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "mesh"))
+def distributed_search_step(store, tombs, n_per_shard, queries, k, metric, mesh):
+    """One fully-sharded search step.
+
+    store:   [n_dev * N_loc, D], sharded P('shard', None)  — HBM slabs
+    tombs:   [n_dev * N_loc], sharded P('shard')           — tombstone mask
+    n_per_shard: [n_dev] int32, replicated — live high-water mark per slab
+    queries: [B, D], replicated
+    -> (dists [B, k], global_rows [B, k]) replicated; global row = slab row +
+       shard_index * N_loc (host maps rows→docIDs).
+    """
+    n_loc = store.shape[0] // mesh.devices.size
+
+    def shard_fn(store_l, tombs_l, n_all, q):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        n_mine = n_all[my]
+        valid = jnp.logical_and(jnp.arange(n_loc) < n_mine, jnp.logical_not(tombs_l))
+        d = DISTANCE_FNS[metric](q, store_l, None)
+        d = jnp.where(valid[None, :], d, jnp.inf)
+        d_top, i_top = _local_topk(d, k)
+        i_glob = i_top + my * n_loc
+        # merge across chips over ICI: gather all candidate sets, reselect
+        d_all = jax.lax.all_gather(d_top, SHARD_AXIS, axis=1, tiled=True)  # [B, ndev*k]
+        i_all = jax.lax.all_gather(i_glob, SHARD_AXIS, axis=1, tiled=True)
+        d_fin, pos = _local_topk(d_all, k)
+        i_fin = jnp.take_along_axis(i_all, pos, axis=1)
+        return d_fin, jnp.where(jnp.isinf(d_fin), -1, i_fin).astype(jnp.int32)
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(store, tombs, n_per_shard, queries)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+def distributed_insert_step(store, chunk, target_shard, offset, mesh):
+    """Sharded append: write `chunk` [C, D] into the slab of `target_shard`
+    at local row `offset`. Chips other than the target write their own slab
+    back unchanged (masked update keeps the program SPMD)."""
+    n_loc = store.shape[0] // mesh.devices.size
+
+    def shard_fn(store_l, chunk_r, tgt, off):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        updated = jax.lax.dynamic_update_slice(store_l, chunk_r.astype(store_l.dtype), (off, 0))
+        return jnp.where(my == tgt, updated, store_l)
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(), P(), P()),
+        out_specs=P(SHARD_AXIS, None),
+        check_vma=False,
+    )(store, chunk, target_shard, offset)
+
+
+class MeshSearchPlan:
+    """A logical index spread over every chip of a mesh.
+
+    Placement mirrors the sharding ring (usecases/sharding/state.go): docIDs
+    are assigned round-robin to chips; each chip owns a [N_loc, D] slab.
+    """
+
+    def __init__(self, mesh: Mesh, dim: int, capacity_per_shard: int = 16384, metric: str = "l2-squared", dtype=jnp.float32):
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        self.dim = dim
+        self.n_loc = capacity_per_shard
+        self.metric = metric
+        sh = NamedSharding(mesh, P(SHARD_AXIS, None))
+        sh1 = NamedSharding(mesh, P(SHARD_AXIS))
+        rep = NamedSharding(mesh, P())
+        self.store = jax.device_put(jnp.zeros((self.n_dev * self.n_loc, dim), dtype), sh)
+        self.tombs = jax.device_put(jnp.zeros((self.n_dev * self.n_loc,), jnp.bool_), sh1)
+        self.n_per_shard = jax.device_put(jnp.zeros((self.n_dev,), jnp.int32), rep)
+        self._counts = np.zeros(self.n_dev, dtype=np.int64)
+        self._row_to_doc = np.full(self.n_dev * self.n_loc, -1, dtype=np.int64)
+
+    def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        """Round-robin the batch across shards, one insert step per shard."""
+        doc_ids = np.asarray(doc_ids, dtype=np.int64)
+        vectors = np.asarray(vectors, dtype=np.float32)
+        target = doc_ids % self.n_dev
+        for s in range(self.n_dev):
+            sel = target == s
+            if not sel.any():
+                continue
+            chunk = vectors[sel]
+            off = int(self._counts[s])
+            if off + chunk.shape[0] > self.n_loc:
+                raise ValueError("mesh shard capacity exceeded")
+            self.store = distributed_insert_step(
+                self.store, jnp.asarray(chunk), jnp.int32(s), jnp.int32(off), self.mesh
+            )
+            rows = s * self.n_loc + off + np.arange(chunk.shape[0])
+            self._row_to_doc[rows] = doc_ids[sel]
+            self._counts[s] += chunk.shape[0]
+        self.n_per_shard = jnp.asarray(self._counts.astype(np.int32))
+
+    def search(self, queries: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+        d, rows = distributed_search_step(
+            self.store, self.tombs, self.n_per_shard, jnp.asarray(queries, jnp.float32), k, self.metric, self.mesh
+        )
+        rows = np.asarray(rows)
+        ids = np.where(rows >= 0, self._row_to_doc[np.clip(rows, 0, None)], -1)
+        return ids, np.asarray(d)

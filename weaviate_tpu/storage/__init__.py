@@ -1,0 +1,9 @@
+"""Storage primitives: LSM KV engine, bitmaps, docID counter.
+
+Reference: adapters/repos/db/lsmkv (LSM store), helpers/allow_list.go +
+sroar (bitmaps), indexcounter/ (docID allocation).
+"""
+
+from weaviate_tpu.storage.bitmap import Bitmap
+
+__all__ = ["Bitmap"]
