@@ -2,17 +2,18 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Workload mirrors BASELINE.md config #1/#5: 1M x 128 float32 vectors (SIFT1M
-shape), L2, k=10, 256-query batches — the reference's SIFT harness
-(test/benchmark/benchmark_sift.go: l2, efC=64, maxConn=64) and the gRPC
-256-query batched-kNN config.
+Workload mirrors BASELINE.md config #1/#5: 1M x 128 float32 clustered
+vectors (SIFT1M shape and cluster structure), L2, k=10, 256..1024-query
+batches — the reference's SIFT harness (test/benchmark/benchmark_sift.go:
+l2, efC=64, maxConn=64) and the gRPC 256-query batched-kNN config.
 
-vs_baseline compares TPU QPS against a CPU comparator measured in-process on
-the same data: the native C++ HNSW engine if built (the reference's real
-comparator — CPU graph traversal), else single-thread numpy brute force.
-Recall@10 of the TPU path is measured against exact float64 ground truth and
-the run only counts if recall >= 0.95 (it is 1.0 by construction for the
-exact device index at f32).
+vs_baseline = TPU QPS / CPU-HNSW QPS at recall@10 >= 0.95. The CPU baseline
+is our native C++ HNSW engine (the same role the reference's Go HNSW plays),
+measured on the same data distribution and cached in baseline_cpu.json
+(re-measure with BENCH_MEASURE_CPU=1 — it builds a graph, which takes
+minutes and doesn't affect query QPS, so it is not re-run every bench).
+TPU recall@10 is measured against exact ground truth every run and must be
+>= 0.95 (it is 1.0: the device index is exact at f32).
 """
 
 import json
@@ -28,22 +29,101 @@ B = int(os.environ.get("BENCH_BATCH", 1024))
 K = 10
 N_QUERY_BATCHES = int(os.environ.get("BENCH_QUERY_BATCHES", 10))
 N_GT = 64  # queries used for recall ground truth
+N_CLUSTERS = 1024
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline_cpu.json")
+CPU_N = int(os.environ.get("BENCH_CPU_N", 100_000))
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def make_data(n, dim, rng):
+    """SIFT-like clustered distribution: mixture of gaussians."""
+    centers = rng.standard_normal((N_CLUSTERS, dim), dtype=np.float32) * 2.0
+    assign = rng.integers(0, N_CLUSTERS, n)
+    vecs = centers[assign] + 0.35 * rng.standard_normal((n, dim), dtype=np.float32)
+    return vecs
+
+
+def exact_gt(vecs, queries, k):
+    gt = []
+    for q in queries:
+        d = ((vecs - q) ** 2).sum(1)
+        gt.append(np.argpartition(d, k)[:k][np.argsort(d[np.argpartition(d, k)[:k]])])
+    return gt
+
+
+def measure_cpu_baseline(rng):
+    """CPU HNSW (native C++ engine) QPS at recall@10 >= 0.95 on CPU_N points,
+    reference SIFT params (efC=64, maxConn=64), ef swept upward to recall."""
+    from weaviate_tpu.entities import vectorindex as vi
+    from weaviate_tpu.index.hnsw import HnswIndex
+
+    vecs = make_data(CPU_N, DIM, rng)
+    queries = rng.standard_normal((256, DIM), dtype=np.float32) * 0.1 + vecs[
+        rng.integers(0, CPU_N, 256)
+    ]
+    cfg = vi.HnswUserConfig.from_dict(
+        {"distance": vi.DISTANCE_L2, "efConstruction": 64, "maxConnections": 64}, "hnsw"
+    )
+    idx = HnswIndex(cfg, "/tmp/bench_cpu_hnsw", persist=False)
+    log(f"building CPU HNSW graph on {CPU_N} vectors (efC=64, M=64)...")
+    t0 = time.perf_counter()
+    idx.add_batch(np.arange(CPU_N), vecs)
+    build_s = time.perf_counter() - t0
+    log(f"built in {build_s:.0f}s ({CPU_N/build_s:.0f} vec/s)")
+    gt = exact_gt(vecs, queries[:32], K)
+    result = None
+    for ef in (64, 128, 256, 512, 1024):
+        idx.config.ef = ef
+        t0 = time.perf_counter()
+        ids, _ = idx.search_by_vectors(queries, K)
+        qps = 256 / (time.perf_counter() - t0)
+        hits = sum(
+            len(set(int(x) for x in ids[i][:K]) & set(gt[i].tolist())) for i in range(32)
+        )
+        recall = hits / (32 * K)
+        log(f"  ef={ef}: {qps:.0f} QPS, recall@10={recall:.3f}")
+        result = {"ef": ef, "qps": qps, "recall": recall}
+        if recall >= 0.95:
+            break
+    out = {
+        "comparator": "native C++ HNSW (weaviate_tpu.index.hnsw), single-thread",
+        "n": CPU_N,
+        "dim": DIM,
+        "k": K,
+        "efConstruction": 64,
+        "maxConnections": 64,
+        "build_seconds": round(build_s, 1),
+        "qps": round(result["qps"], 1),
+        "recall": round(result["recall"], 4),
+        "ef": result["ef"],
+        "note": "measured at n=%d; HNSW QPS decreases with n, so using it as the 1M baseline is conservative in the TPU's favor"
+        % CPU_N,
+    }
+    with open(BASELINE_FILE, "w") as f:
+        json.dump(out, f, indent=1)
+    log(f"wrote {BASELINE_FILE}: {out['qps']} QPS @ recall {out['recall']}")
+    return out
+
+
 def main():
+    rng = np.random.default_rng(7)
+    if os.environ.get("BENCH_MEASURE_CPU"):
+        measure_cpu_baseline(rng)
+        return
+
     import jax
 
     from weaviate_tpu.entities import vectorindex as vi
     from weaviate_tpu.index.tpu import TpuVectorIndex
 
-    rng = np.random.default_rng(7)
-    log(f"generating {N}x{DIM} vectors...")
-    vecs = rng.standard_normal((N, DIM), dtype=np.float32)
-    queries = rng.standard_normal((B, DIM), dtype=np.float32)
+    log(f"generating {N}x{DIM} clustered vectors...")
+    vecs = make_data(N, DIM, rng)
+    queries = rng.standard_normal((B, DIM), dtype=np.float32) * 0.1 + vecs[
+        rng.integers(0, N, B)
+    ]
 
     cfg = vi.HnswUserConfig.from_dict({"distance": vi.DISTANCE_L2}, "hnsw_tpu")
     idx = TpuVectorIndex(cfg, "/tmp/bench_shard", persist=False)
@@ -56,7 +136,6 @@ def main():
 
     # warmup + compile
     ids, dists = idx.search_by_vectors(queries, K)
-    jax.block_until_ready(idx._store)
 
     t0 = time.perf_counter()
     for _ in range(N_QUERY_BATCHES):
@@ -65,28 +144,29 @@ def main():
     qps = (N_QUERY_BATCHES * B) / elapsed
     log(f"TPU batched kNN: {qps:.0f} QPS ({elapsed/N_QUERY_BATCHES*1000:.2f} ms / {B}-query batch)")
 
-    # recall@10 against exact ground truth
-    recall_hits = 0
-    for i in range(N_GT):
-        d = ((vecs.astype(np.float32) - queries[i]) ** 2).sum(1)
-        gt = set(np.argsort(d)[:K].tolist())
-        got = set(int(x) for x in ids[i][:K])
-        recall_hits += len(gt & got)
-    recall = recall_hits / (N_GT * K)
+    gt = exact_gt(vecs, queries[:N_GT], K)
+    hits = sum(len(set(int(x) for x in ids[i][:K]) & set(gt[i].tolist())) for i in range(N_GT))
+    recall = hits / (N_GT * K)
     log(f"recall@10 = {recall:.4f}")
 
-    # CPU baseline: numpy brute force, single batch timed
-    nb = 4
-    t0 = time.perf_counter()
-    for i in range(nb):
-        d = ((vecs - queries[i]) ** 2).sum(1)
-        np.argpartition(d, K)[:K]
-    cpu_elapsed = time.perf_counter() - t0
-    cpu_qps = nb / cpu_elapsed
-    log(f"CPU numpy brute force: {cpu_qps:.1f} QPS")
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            cpu = json.load(f)
+        cpu_qps = cpu["qps"]
+        base_note = f"CPU HNSW ef={cpu['ef']}"
+    else:
+        # fallback: numpy brute force, single queries
+        nb = 4
+        t0 = time.perf_counter()
+        for i in range(nb):
+            d = ((vecs - queries[i]) ** 2).sum(1)
+            np.argpartition(d, K)[:K]
+        cpu_qps = nb / (time.perf_counter() - t0)
+        base_note = "numpy brute force"
+    log(f"baseline ({base_note}): {cpu_qps:.1f} QPS")
 
     out = {
-        "metric": f"batched kNN QPS (N={N}, d={DIM}, k={K}, batch={B}, L2, recall@10={recall:.3f})",
+        "metric": f"batched kNN QPS (N={N}, d={DIM}, k={K}, batch={B}, L2, recall@10={recall:.3f}, baseline={base_note})",
         "value": round(qps, 1),
         "unit": "qps",
         "vs_baseline": round(qps / cpu_qps, 1),

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native engines into weaviate_tpu/_native/.
+set -e
+cd "$(dirname "$0")"
+OUT_DIR="../weaviate_tpu/_native"
+mkdir -p "$OUT_DIR"
+g++ -O3 -march=native -std=c++17 -shared -fPIC -o "$OUT_DIR/libhnsw.so" hnsw.cpp
+echo "built $OUT_DIR/libhnsw.so"

@@ -1,0 +1,533 @@
+// Native HNSW graph engine for weaviate_tpu's "hnsw" index type.
+//
+// Fresh C++17 implementation of the HNSW algorithm (Malkov & Yashunin 2016)
+// with the same externally-observable semantics as the reference's Go engine
+// (reference: adapters/repos/db/vector/hnsw/ — insert.go, search.go,
+// heuristic.go, delete.go), exposed through a C ABI consumed via ctypes:
+//
+// - geometric level assignment (levelNormalizer = 1/ln(M), insert.go)
+// - per-level greedy descent with ef=1 above the target, beam search with
+//   ef >= k at layer 0 (search.go:460 knnSearchByVector)
+// - neighbor selection by the classic heuristic: a candidate is kept only if
+//   it is closer to the query than to any already-selected neighbor
+//   (heuristic.go:23), with re-pruning when a node exceeds maxConnections
+//   (neighbor_connections.go:134)
+// - deletes are tombstones: excluded from results, still traversable
+//   (delete.go semantics); allowList filtering applies at layer 0 only
+//   (search.go:283-291)
+// - metrics: l2-squared and (negative) dot; cosine = callers normalize then
+//   use dot (cosine_dist.go)
+//
+// Distance kernels use plain loops that GCC auto-vectorizes with
+// -O3 -march=native — the portable equivalent of the reference's hand-written
+// AVX2 asm (distancer/asm/{l2,dot}_amd64.s).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Metric : int32_t { METRIC_L2 = 0, METRIC_DOT = 1 };
+
+static inline float dist_l2(const float* a, const float* b, int32_t d) {
+  float acc = 0.f;
+  for (int32_t i = 0; i < d; ++i) {
+    const float t = a[i] - b[i];
+    acc += t * t;
+  }
+  return acc;
+}
+
+static inline float dist_dot(const float* a, const float* b, int32_t d) {
+  float acc = 0.f;
+  for (int32_t i = 0; i < d; ++i) acc += a[i] * b[i];
+  return -acc;
+}
+
+struct SortedU64 {
+  const uint64_t* data = nullptr;
+  int64_t n = 0;
+  bool contains(uint64_t v) const {
+    if (!data || n == 0) return false;
+    return std::binary_search(data, data + n, v);
+  }
+  bool active() const { return data != nullptr; }
+};
+
+struct Candidate {
+  float dist;
+  uint32_t id;
+};
+struct CmpMin {  // min-heap by distance
+  bool operator()(const Candidate& a, const Candidate& b) const { return a.dist > b.dist; }
+};
+struct CmpMax {  // max-heap by distance
+  bool operator()(const Candidate& a, const Candidate& b) const { return a.dist < b.dist; }
+};
+
+using MinHeap = std::priority_queue<Candidate, std::vector<Candidate>, CmpMin>;
+using MaxHeap = std::priority_queue<Candidate, std::vector<Candidate>, CmpMax>;
+
+struct Index {
+  int32_t dim;
+  Metric metric;
+  int32_t max_conn;        // M (upper layers); layer 0 allows 2*M
+  int32_t ef_construction;
+  double level_mult;       // 1 / ln(M)
+  std::mt19937_64 rng;
+
+  std::vector<float> vectors;              // [n, dim] row-major
+  std::vector<uint64_t> doc_ids;           // internal -> external
+  std::unordered_map<uint64_t, uint32_t> by_doc;  // external -> internal
+  std::vector<int32_t> levels;             // top level of each node
+  // links[node] = flat adjacency: level l occupies [offsets[l], offsets[l+1])
+  std::vector<std::vector<std::vector<uint32_t>>> links;  // [node][level][...]
+  std::vector<uint8_t> tombstone;
+  uint32_t entrypoint = UINT32_MAX;
+  int32_t max_level = -1;
+
+  // epoch-versioned visited list (visited/list_set.go:34)
+  std::vector<uint32_t> visited;
+  uint32_t visit_epoch = 0;
+
+  int64_t live = 0;
+
+  explicit Index(int32_t dim_, int32_t metric_, int32_t max_conn_, int32_t efc, uint64_t seed)
+      : dim(dim_),
+        metric(static_cast<Metric>(metric_)),
+        max_conn(max_conn_ < 4 ? 4 : max_conn_),
+        ef_construction(efc < 4 ? 4 : efc),
+        level_mult(1.0 / std::log(static_cast<double>(max_conn_ < 4 ? 4 : max_conn_))),
+        rng(seed) {}
+
+  inline const float* vec(uint32_t i) const { return vectors.data() + static_cast<size_t>(i) * dim; }
+
+  inline float dist(const float* a, const float* b) const {
+    return metric == METRIC_L2 ? dist_l2(a, b, dim) : dist_dot(a, b, dim);
+  }
+
+  inline uint32_t n_nodes() const { return static_cast<uint32_t>(doc_ids.size()); }
+
+  inline int32_t cap_at(int32_t level) const { return level == 0 ? 2 * max_conn : max_conn; }
+
+  void begin_visit() {
+    if (++visit_epoch == 0) {  // wrapped: reset
+      std::fill(visited.begin(), visited.end(), 0);
+      visit_epoch = 1;
+    }
+    visited.resize(doc_ids.size(), 0);
+  }
+  inline bool seen(uint32_t i) { return visited[i] == visit_epoch; }
+  inline void mark(uint32_t i) { visited[i] = visit_epoch; }
+
+  int32_t random_level() {
+    std::uniform_real_distribution<double> u(0.0, 1.0);
+    double r = u(rng);
+    if (r <= 0.0) r = 1e-12;
+    const int32_t lvl = static_cast<int32_t>(-std::log(r) * level_mult);
+    return lvl > 48 ? 48 : lvl;
+  }
+
+  // Beam search in one layer (searchLayerByVector, search.go:160).
+  // allow/tombstones are applied to RESULT admission only; traversal crosses
+  // every node.
+  void search_layer(const float* q, uint32_t ep, int32_t ef, int32_t level,
+                    const SortedU64& allow, bool skip_tombs, MaxHeap& results) {
+    begin_visit();
+    MinHeap candidates;
+    const float dep = dist(q, vec(ep));
+    mark(ep);
+    candidates.push({dep, ep});
+    const bool ep_ok = (!skip_tombs || !tombstone[ep]) && (!allow.active() || allow.contains(doc_ids[ep]));
+    if (ep_ok) results.push({dep, ep});
+
+    while (!candidates.empty()) {
+      Candidate c = candidates.top();
+      if (!results.empty() && c.dist > results.top().dist &&
+          static_cast<int32_t>(results.size()) >= ef)
+        break;
+      candidates.pop();
+      if (level < static_cast<int32_t>(links[c.id].size())) {
+        for (uint32_t nb : links[c.id][level]) {
+          if (seen(nb)) continue;
+          mark(nb);
+          const float dn = dist(q, vec(nb));
+          const bool admit = (!skip_tombs || !tombstone[nb]) &&
+                             (!allow.active() || allow.contains(doc_ids[nb]));
+          if (static_cast<int32_t>(results.size()) < ef ||
+              dn < results.top().dist || results.empty()) {
+            candidates.push({dn, nb});
+            if (admit) {
+              results.push({dn, nb});
+              if (static_cast<int32_t>(results.size()) > ef) results.pop();
+            }
+          }
+        }
+      }
+    }
+  }
+
+  // classic select heuristic (heuristic.go:23)
+  void select_heuristic(const float* q, std::vector<Candidate>& cands, int32_t m,
+                        std::vector<uint32_t>& out) {
+    std::sort(cands.begin(), cands.end(),
+              [](const Candidate& a, const Candidate& b) { return a.dist < b.dist; });
+    out.clear();
+    for (const Candidate& c : cands) {
+      if (static_cast<int32_t>(out.size()) >= m) break;
+      bool good = true;
+      for (uint32_t s : out) {
+        if (dist(vec(c.id), vec(s)) < c.dist) {
+          good = false;
+          break;
+        }
+      }
+      if (good) out.push_back(c.id);
+    }
+    // backfill with nearest pruned if underfull (keeps connectivity)
+    if (static_cast<int32_t>(out.size()) < m) {
+      for (const Candidate& c : cands) {
+        if (static_cast<int32_t>(out.size()) >= m) break;
+        if (std::find(out.begin(), out.end(), c.id) == out.end()) out.push_back(c.id);
+      }
+    }
+  }
+
+  void prune_node(uint32_t node, int32_t level) {
+    auto& nl = links[node][level];
+    const int32_t cap = cap_at(level);
+    if (static_cast<int32_t>(nl.size()) <= cap) return;
+    std::vector<Candidate> cands;
+    cands.reserve(nl.size());
+    for (uint32_t nb : nl) cands.push_back({dist(vec(node), vec(nb)), nb});
+    std::vector<uint32_t> kept;
+    select_heuristic(vec(node), cands, cap, kept);
+    nl = std::move(kept);
+  }
+
+  void insert(uint64_t doc_id, const float* v) {
+    // re-add of an existing doc = tombstone the old node first
+    auto it = by_doc.find(doc_id);
+    if (it != by_doc.end()) {
+      if (!tombstone[it->second]) {
+        tombstone[it->second] = 1;
+        --live;
+      }
+      by_doc.erase(it);
+    }
+    const uint32_t id = n_nodes();
+    vectors.insert(vectors.end(), v, v + dim);
+    doc_ids.push_back(doc_id);
+    by_doc[doc_id] = id;
+    tombstone.push_back(0);
+    ++live;
+    const int32_t lvl = random_level();
+    levels.push_back(lvl);
+    links.emplace_back(static_cast<size_t>(lvl) + 1);
+
+    if (entrypoint == UINT32_MAX) {
+      entrypoint = id;
+      max_level = lvl;
+      return;
+    }
+
+    uint32_t ep = entrypoint;
+    // greedy descent with ef=1 from the top to lvl+1
+    for (int32_t l = max_level; l > lvl; --l) {
+      bool changed = true;
+      float dep = dist(v, vec(ep));
+      while (changed) {
+        changed = false;
+        if (l < static_cast<int32_t>(links[ep].size())) {
+          for (uint32_t nb : links[ep][l]) {
+            const float dn = dist(v, vec(nb));
+            if (dn < dep) {
+              dep = dn;
+              ep = nb;
+              changed = true;
+            }
+          }
+        }
+      }
+    }
+    // connect at each level from min(lvl, max_level) down to 0
+    SortedU64 no_filter;
+    for (int32_t l = std::min(lvl, max_level); l >= 0; --l) {
+      MaxHeap res;
+      search_layer(v, ep, ef_construction, l, no_filter, /*skip_tombs=*/false, res);
+      std::vector<Candidate> cands;
+      cands.reserve(res.size());
+      while (!res.empty()) {
+        cands.push_back(res.top());
+        res.pop();
+      }
+      if (!cands.empty()) ep = cands.back().id;  // nearest becomes next ep
+      std::vector<uint32_t> selected;
+      select_heuristic(v, cands, max_conn, selected);
+      links[id][l] = selected;
+      for (uint32_t nb : selected) {
+        if (l < static_cast<int32_t>(links[nb].size())) {
+          links[nb][l].push_back(id);
+          prune_node(nb, l);
+        }
+      }
+    }
+    if (lvl > max_level) {
+      max_level = lvl;
+      entrypoint = id;
+    }
+  }
+
+  int32_t knn(const float* q, int32_t k, int32_t ef, const SortedU64& allow,
+              uint64_t* out_ids, float* out_dists) {
+    if (entrypoint == UINT32_MAX || live == 0) return 0;
+    if (ef < k) ef = k;
+    uint32_t ep = entrypoint;
+    float dep = dist(q, vec(ep));
+    for (int32_t l = max_level; l > 0; --l) {
+      bool changed = true;
+      while (changed) {
+        changed = false;
+        if (l < static_cast<int32_t>(links[ep].size())) {
+          for (uint32_t nb : links[ep][l]) {
+            const float dn = dist(q, vec(nb));
+            if (dn < dep) {
+              dep = dn;
+              ep = nb;
+              changed = true;
+            }
+          }
+        }
+      }
+    }
+    MaxHeap res;
+    search_layer(q, ep, ef, 0, allow, /*skip_tombs=*/true, res);
+    while (static_cast<int32_t>(res.size()) > k) res.pop();
+    const int32_t n = static_cast<int32_t>(res.size());
+    for (int32_t i = n - 1; i >= 0; --i) {
+      out_ids[i] = doc_ids[res.top().id];
+      out_dists[i] = res.top().dist;
+      res.pop();
+    }
+    return n;
+  }
+
+  // brute force over an allowList (flat_search.go:19)
+  int32_t flat(const float* q, int32_t k, const SortedU64& allow, uint64_t* out_ids,
+               float* out_dists) {
+    MaxHeap res;
+    for (int64_t i = 0; i < allow.n; ++i) {
+      auto it = by_doc.find(allow.data[i]);
+      if (it == by_doc.end() || tombstone[it->second]) continue;
+      const float d = dist(q, vec(it->second));
+      if (static_cast<int32_t>(res.size()) < k) {
+        res.push({d, it->second});
+      } else if (d < res.top().dist) {
+        res.pop();
+        res.push({d, it->second});
+      }
+    }
+    const int32_t n = static_cast<int32_t>(res.size());
+    for (int32_t i = n - 1; i >= 0; --i) {
+      out_ids[i] = doc_ids[res.top().id];
+      out_dists[i] = res.top().dist;
+      res.pop();
+    }
+    return n;
+  }
+
+  bool remove(uint64_t doc_id) {
+    auto it = by_doc.find(doc_id);
+    if (it == by_doc.end()) return false;
+    if (!tombstone[it->second]) {
+      tombstone[it->second] = 1;
+      --live;
+    }
+    by_doc.erase(it);
+    // move entrypoint if it was deleted (findNewGlobalEntrypoint, delete.go:422)
+    if (it->second == entrypoint) {
+      for (int32_t l = max_level; l >= 0; --l) {
+        for (uint32_t i = 0; i < n_nodes(); ++i) {
+          if (!tombstone[i] && levels[i] >= l) {
+            entrypoint = i;
+            max_level = levels[i];
+            return true;
+          }
+        }
+      }
+    }
+    return true;
+  }
+
+  // -- binary snapshot (save/load) ---------------------------------------
+
+  bool save(const char* path) const {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return false;
+    const char magic[4] = {'W', 'T', 'H', '1'};
+    std::fwrite(magic, 1, 4, f);
+    const uint32_t n = n_nodes();
+    int32_t metric_i = metric;
+    std::fwrite(&dim, 4, 1, f);
+    std::fwrite(&metric_i, 4, 1, f);
+    std::fwrite(&max_conn, 4, 1, f);
+    std::fwrite(&ef_construction, 4, 1, f);
+    std::fwrite(&n, 4, 1, f);
+    std::fwrite(&entrypoint, 4, 1, f);
+    std::fwrite(&max_level, 4, 1, f);
+    if (n) {
+      std::fwrite(vectors.data(), 4, static_cast<size_t>(n) * dim, f);
+      std::fwrite(doc_ids.data(), 8, n, f);
+      std::fwrite(levels.data(), 4, n, f);
+      std::fwrite(tombstone.data(), 1, n, f);
+      for (uint32_t i = 0; i < n; ++i) {
+        const int32_t nl = static_cast<int32_t>(links[i].size());
+        std::fwrite(&nl, 4, 1, f);
+        for (const auto& lv : links[i]) {
+          const int32_t c = static_cast<int32_t>(lv.size());
+          std::fwrite(&c, 4, 1, f);
+          if (c) std::fwrite(lv.data(), 4, c, f);
+        }
+      }
+    }
+    std::fclose(f);
+    return true;
+  }
+
+  static Index* load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return nullptr;
+    char magic[4];
+    if (std::fread(magic, 1, 4, f) != 4 || std::memcmp(magic, "WTH1", 4) != 0) {
+      std::fclose(f);
+      return nullptr;
+    }
+    int32_t dim, metric, max_conn, efc, max_level;
+    uint32_t n, ep;
+    if (std::fread(&dim, 4, 1, f) != 1 || std::fread(&metric, 4, 1, f) != 1 ||
+        std::fread(&max_conn, 4, 1, f) != 1 || std::fread(&efc, 4, 1, f) != 1 ||
+        std::fread(&n, 4, 1, f) != 1 || std::fread(&ep, 4, 1, f) != 1 ||
+        std::fread(&max_level, 4, 1, f) != 1) {
+      std::fclose(f);
+      return nullptr;
+    }
+    Index* ix = new Index(dim, metric, max_conn, efc, 0x5eed);
+    ix->entrypoint = ep;
+    ix->max_level = max_level;
+    if (n) {
+      ix->vectors.resize(static_cast<size_t>(n) * dim);
+      ix->doc_ids.resize(n);
+      ix->levels.resize(n);
+      ix->tombstone.resize(n);
+      bool ok = std::fread(ix->vectors.data(), 4, ix->vectors.size(), f) == ix->vectors.size() &&
+                std::fread(ix->doc_ids.data(), 8, n, f) == n &&
+                std::fread(ix->levels.data(), 4, n, f) == n &&
+                std::fread(ix->tombstone.data(), 1, n, f) == n;
+      if (!ok) {
+        std::fclose(f);
+        delete ix;
+        return nullptr;
+      }
+      ix->links.resize(n);
+      for (uint32_t i = 0; i < n && ok; ++i) {
+        int32_t nl = 0;
+        ok = std::fread(&nl, 4, 1, f) == 1 && nl >= 0 && nl <= 64;
+        if (!ok) break;
+        ix->links[i].resize(nl);
+        for (int32_t l = 0; l < nl && ok; ++l) {
+          int32_t c = 0;
+          ok = std::fread(&c, 4, 1, f) == 1 && c >= 0 && c <= (1 << 20);
+          if (!ok) break;
+          ix->links[i][l].resize(c);
+          if (c) ok = std::fread(ix->links[i][l].data(), 4, c, f) == static_cast<size_t>(c);
+        }
+      }
+      if (!ok) {
+        std::fclose(f);
+        delete ix;
+        return nullptr;
+      }
+      for (uint32_t i = 0; i < n; ++i) {
+        if (!ix->tombstone[i]) {
+          ix->by_doc[ix->doc_ids[i]] = i;
+          ++ix->live;
+        }
+      }
+    }
+    std::fclose(f);
+    return ix;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hnsw_new(int32_t dim, int32_t metric, int32_t max_conn, int32_t ef_construction,
+               uint64_t seed) {
+  return new Index(dim, metric, max_conn, ef_construction, seed);
+}
+
+void hnsw_free(void* h) { delete static_cast<Index*>(h); }
+
+void hnsw_add(void* h, uint64_t doc_id, const float* vec) {
+  static_cast<Index*>(h)->insert(doc_id, vec);
+}
+
+void hnsw_add_batch(void* h, int64_t n, const uint64_t* doc_ids, const float* vecs) {
+  Index* ix = static_cast<Index*>(h);
+  for (int64_t i = 0; i < n; ++i)
+    ix->insert(doc_ids[i], vecs + static_cast<size_t>(i) * ix->dim);
+}
+
+int32_t hnsw_delete(void* h, uint64_t doc_id) {
+  return static_cast<Index*>(h)->remove(doc_id) ? 1 : 0;
+}
+
+int32_t hnsw_contains(void* h, uint64_t doc_id) {
+  Index* ix = static_cast<Index*>(h);
+  return ix->by_doc.count(doc_id) ? 1 : 0;
+}
+
+int64_t hnsw_size(void* h) { return static_cast<Index*>(h)->live; }
+
+int32_t hnsw_search(void* h, const float* q, int32_t k, int32_t ef, const uint64_t* allow,
+                    int64_t allow_n, uint64_t* out_ids, float* out_dists) {
+  SortedU64 a{allow, allow_n};
+  return static_cast<Index*>(h)->knn(q, k, ef, a, out_ids, out_dists);
+}
+
+// batch search: out arrays are [b, k]; returns counts per query in out_counts
+void hnsw_search_batch(void* h, const float* qs, int32_t b, int32_t k, int32_t ef,
+                       const uint64_t* allow, int64_t allow_n, uint64_t* out_ids,
+                       float* out_dists, int32_t* out_counts) {
+  Index* ix = static_cast<Index*>(h);
+  SortedU64 a{allow, allow_n};
+  for (int32_t i = 0; i < b; ++i) {
+    out_counts[i] = ix->knn(qs + static_cast<size_t>(i) * ix->dim, k, ef, a,
+                            out_ids + static_cast<size_t>(i) * k,
+                            out_dists + static_cast<size_t>(i) * k);
+  }
+}
+
+int32_t hnsw_flat_search(void* h, const float* q, int32_t k, const uint64_t* allow,
+                         int64_t allow_n, uint64_t* out_ids, float* out_dists) {
+  SortedU64 a{allow, allow_n};
+  return static_cast<Index*>(h)->flat(q, k, a, out_ids, out_dists);
+}
+
+int32_t hnsw_save(void* h, const char* path) {
+  return static_cast<Index*>(h)->save(path) ? 1 : 0;
+}
+
+void* hnsw_load(const char* path) { return Index::load(path); }
+
+}  // extern "C"

@@ -1,0 +1,120 @@
+"""Native HNSW engine: recall, deletes, filters, persistence.
+
+Models the reference's recall fixture test (hnsw/recall_test.go:32 —
+recall >= 0.99 at ef sweep) and persistence/delete integration tests."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.entities import vectorindex as vi
+from weaviate_tpu.index.hnsw import HnswIndex
+from weaviate_tpu.storage.bitmap import Bitmap
+
+
+def make(tmp_path, metric=vi.DISTANCE_L2, **kw):
+    cfg = vi.HnswUserConfig.from_dict({"distance": metric, **kw}, "hnsw")
+    return HnswIndex(cfg, str(tmp_path))
+
+
+def brute(vecs, q, k, metric):
+    from weaviate_tpu.ops.distances import single_distance
+
+    d = np.array([single_distance(q, v, metric) for v in vecs])
+    order = np.argsort(d, kind="stable")[:k]
+    return order
+
+
+@pytest.mark.parametrize("metric", [vi.DISTANCE_L2, vi.DISTANCE_COSINE])
+def test_recall_099(tmp_path, rng, metric):
+    n, d, k, nq = 4000, 32, 10, 50
+    vecs = rng.standard_normal((n, d)).astype(np.float32)
+    idx = make(tmp_path / metric, metric, efConstruction=128, maxConnections=16)
+    idx.add_batch(np.arange(n), vecs)
+    queries = rng.standard_normal((nq, d)).astype(np.float32)
+    hits = 0
+    for q in queries:
+        ids, _ = idx.search_by_vector(q, k)
+        want = set(brute(vecs, q, k, metric).tolist())
+        hits += len(want & set(ids.tolist()))
+    recall = hits / (nq * k)
+    assert recall >= 0.99, f"recall {recall}"
+
+
+def test_batch_search(tmp_path, rng):
+    n, d = 1000, 16
+    vecs = rng.standard_normal((n, d)).astype(np.float32)
+    idx = make(tmp_path)
+    idx.add_batch(np.arange(n), vecs)
+    qs = vecs[:5]
+    ids, dists = idx.search_by_vectors(qs, 3)
+    assert ids.shape == (5, 3)
+    for i in range(5):
+        assert ids[i][0] == i
+        assert dists[i][0] < 1e-4
+
+
+def test_delete_and_entrypoint_move(tmp_path, rng):
+    idx = make(tmp_path)
+    vecs = rng.standard_normal((200, 8)).astype(np.float32)
+    idx.add_batch(np.arange(200), vecs)
+    idx.delete(*range(100))
+    assert len(idx) == 100
+    ids, _ = idx.search_by_vector(vecs[150], 10)
+    assert ids[0] == 150
+    assert all(i >= 100 for i in ids.tolist())
+
+
+def test_readd_replaces(tmp_path, rng):
+    idx = make(tmp_path)
+    idx.add(5, np.ones(8, np.float32))
+    idx.add(5, -np.ones(8, np.float32))
+    assert len(idx) == 1
+    ids, dists = idx.search_by_vector(-np.ones(8, np.float32), 1)
+    assert ids[0] == 5 and dists[0] < 1e-5
+
+
+def test_allowlist_flat_and_graph(tmp_path, rng):
+    vecs = rng.standard_normal((500, 8)).astype(np.float32)
+    # small allowList -> flat path
+    idx = make(tmp_path / "flat")
+    idx.add_batch(np.arange(500), vecs)
+    allow = Bitmap([3, 7, 450])
+    ids, _ = idx.search_by_vector(vecs[0], 10, allow)
+    assert set(ids.tolist()) == {3, 7, 450}
+    # force graph path with cutoff 0
+    idx2 = make(tmp_path / "graph", flatSearchCutoff=0)
+    idx2.add_batch(np.arange(500), vecs)
+    allow2 = Bitmap(np.arange(0, 500, 2))
+    ids2, _ = idx2.search_by_vector(vecs[0], 10, allow2)
+    assert len(ids2) > 0 and all(i % 2 == 0 for i in ids2.tolist())
+
+
+def test_persistence_snapshot_and_delta(tmp_path, rng):
+    p = tmp_path / "shard"
+    vecs = rng.standard_normal((300, 8)).astype(np.float32)
+    idx = make(p)
+    idx.add_batch(np.arange(200), vecs[:200])
+    idx.flush()  # snapshot + truncate log
+    idx.add_batch(np.arange(200, 300), vecs[200:])  # delta in log only
+    idx.delete(0)
+    idx._log.flush()
+    # simulate crash: no shutdown, reopen
+    idx2 = make(p)
+    assert len(idx2) == 299
+    ids, _ = idx2.search_by_vector(vecs[250], 1)
+    assert ids[0] == 250
+    ids, _ = idx2.search_by_vector(vecs[0], 3)
+    assert 0 not in ids.tolist()
+
+
+def test_search_by_vector_distance(tmp_path, rng):
+    idx = make(tmp_path)
+    vecs = rng.standard_normal((200, 4)).astype(np.float32)
+    idx.add_batch(np.arange(200), vecs)
+    ids, dists = idx.search_by_vector_distance(vecs[0], 0.5, 100)
+    assert (dists <= 0.5).all()
+
+
+def test_manhattan_rejected(tmp_path):
+    with pytest.raises(vi.ConfigValidationError):
+        make(tmp_path, vi.DISTANCE_MANHATTAN)

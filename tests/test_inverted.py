@@ -1,0 +1,166 @@
+"""Inverted index: analyzer, filters -> AllowList, BM25 ranking."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.inverted import BM25Searcher, FilterSearcher, InvertedIndex
+from weaviate_tpu.inverted.analyzer import encode_float, encode_int, tokenize
+from weaviate_tpu.storage.lsm import Store
+
+
+@pytest.fixture
+def class_def():
+    return ClassDef(
+        name="Article",
+        properties=[
+            Property(name="title", data_type=["text"]),
+            Property(name="body", data_type=["text"]),
+            Property(name="wordCount", data_type=["int"]),
+            Property(name="rating", data_type=["number"]),
+            Property(name="published", data_type=["boolean"]),
+            Property(name="tags", data_type=["text[]"], tokenization="field"),
+        ],
+    )
+
+
+@pytest.fixture
+def indexed(tmp_path, class_def):
+    store = Store(str(tmp_path / "lsm"))
+    inv = InvertedIndex(store, class_def)
+    docs = {
+        1: {"title": "The quick brown fox", "body": "jumps over the lazy dog", "wordCount": 100, "rating": 4.5, "published": True, "tags": ["animals", "fables"]},
+        2: {"title": "Fox hunting banned", "body": "the fox is safe now, fox fox", "wordCount": 250, "rating": 3.0, "published": True, "tags": ["news"]},
+        3: {"title": "Python programming", "body": "snakes and code", "wordCount": 500, "rating": 5.0, "published": False, "tags": ["tech"]},
+        4: {"title": "Quick pasta recipes", "body": "cook dinner fast", "wordCount": 80, "rating": 2.5, "published": True},
+    }
+    for d, props in docs.items():
+        inv.add_object(d, props)
+    return inv, docs
+
+
+def F(d):
+    return LocalFilter.from_dict(d)
+
+
+def test_tokenizations():
+    assert tokenize("word", "Hello, World-2000!") == ["hello", "world", "2000"]
+    assert tokenize("lowercase", "Hello, World!") == ["hello,", "world!"]
+    assert tokenize("whitespace", "Hello W") == ["Hello", "W"]
+    assert tokenize("field", "  Hello World ") == ["Hello World"]
+
+
+def test_sortable_encodings():
+    assert encode_int(-5) < encode_int(0) < encode_int(3) < encode_int(1000)
+    assert encode_float(-2.5) < encode_float(-0.1) < encode_float(0.0) < encode_float(7.25)
+
+
+def test_filter_equal_text(indexed, class_def):
+    inv, _ = indexed
+    s = FilterSearcher(inv, class_def)
+    got = s.doc_ids(F({"operator": "Equal", "path": ["title"], "valueText": "fox"}))
+    assert sorted(got) == [1, 2]
+
+
+def test_filter_int_range(indexed, class_def):
+    inv, _ = indexed
+    s = FilterSearcher(inv, class_def)
+    got = s.doc_ids(F({"operator": "GreaterThan", "path": ["wordCount"], "valueInt": 100}))
+    assert sorted(got) == [2, 3]
+    got = s.doc_ids(F({"operator": "GreaterThanEqual", "path": ["wordCount"], "valueInt": 100}))
+    assert sorted(got) == [1, 2, 3]
+    got = s.doc_ids(F({"operator": "LessThan", "path": ["rating"], "valueNumber": 4.5}))
+    assert sorted(got) == [2, 4]
+
+
+def test_filter_bool_and_or_not(indexed, class_def):
+    inv, _ = indexed
+    s = FilterSearcher(inv, class_def)
+    pub = {"operator": "Equal", "path": ["published"], "valueBoolean": True}
+    fox = {"operator": "Equal", "path": ["title"], "valueText": "fox"}
+    got = s.doc_ids(F({"operator": "And", "operands": [pub, fox]}))
+    assert sorted(got) == [1, 2]
+    got = s.doc_ids(F({"operator": "Or", "operands": [fox, {"operator": "Equal", "path": ["title"], "valueText": "python"}]}))
+    assert sorted(got) == [1, 2, 3]
+    got = s.doc_ids(F({"operator": "Not", "operands": [pub]}))
+    assert sorted(got) == [3]
+    got = s.doc_ids(F({"operator": "NotEqual", "path": ["published"], "valueBoolean": True}))
+    assert sorted(got) == [3]
+
+
+def test_filter_like(indexed, class_def):
+    inv, _ = indexed
+    s = FilterSearcher(inv, class_def)
+    got = s.doc_ids(F({"operator": "Like", "path": ["title"], "valueText": "qu?ck"}))
+    assert sorted(got) == [1, 4]
+    got = s.doc_ids(F({"operator": "Like", "path": ["tags"], "valueText": "fab*"}))
+    assert sorted(got) == [1]
+
+
+def test_filter_is_null(indexed, class_def):
+    inv, _ = indexed
+    s = FilterSearcher(inv, class_def)
+    got = s.doc_ids(F({"operator": "IsNull", "path": ["tags"], "valueBoolean": True}))
+    assert sorted(got) == [4]
+    got = s.doc_ids(F({"operator": "IsNull", "path": ["tags"], "valueBoolean": False}))
+    assert sorted(got) == [1, 2, 3]
+
+
+def test_filter_contains(indexed, class_def):
+    inv, _ = indexed
+    s = FilterSearcher(inv, class_def)
+    got = s.doc_ids(F({"operator": "ContainsAny", "path": ["tags"], "valueText": ["news", "tech"]}))
+    assert sorted(got) == [2, 3]
+
+
+def test_delete_object(indexed, class_def):
+    inv, docs = indexed
+    inv.delete_object(2, docs[2])
+    s = FilterSearcher(inv, class_def)
+    got = s.doc_ids(F({"operator": "Equal", "path": ["title"], "valueText": "fox"}))
+    assert sorted(got) == [1]
+    assert inv.doc_count() == 3
+
+
+def test_bm25_ranking(indexed, class_def):
+    inv, _ = indexed
+    bm = BM25Searcher(inv, class_def)
+    res = bm.search("fox", 10)
+    ids = [d for d, _, _ in res]
+    assert set(ids) == {1, 2}
+    # doc 2 mentions fox 4x across title+body -> higher score
+    assert ids[0] == 2
+    scores = [s for _, s, _ in res]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_bm25_properties_and_allowlist(indexed, class_def):
+    from weaviate_tpu.storage.bitmap import Bitmap
+
+    inv, _ = indexed
+    bm = BM25Searcher(inv, class_def)
+    res = bm.search("fox", 10, properties=["title"])
+    assert {d for d, _, _ in res} == {1, 2}
+    res = bm.search("fox", 10, allow_list=Bitmap([1]))
+    assert [d for d, _, _ in res] == [1]
+
+
+def test_bm25_explain(indexed, class_def):
+    inv, _ = indexed
+    bm = BM25Searcher(inv, class_def)
+    res = bm.search("fox", 10, additional_explanations=True)
+    assert res[0][2] is not None
+    assert any("frequency" in k for k in res[0][2])
+
+
+def test_persistence(tmp_path, class_def):
+    store = Store(str(tmp_path / "lsm"))
+    inv = InvertedIndex(store, class_def)
+    inv.add_object(7, {"title": "hello world", "wordCount": 9})
+    store.shutdown()
+    store2 = Store(str(tmp_path / "lsm"))
+    inv2 = InvertedIndex(store2, class_def)
+    s = FilterSearcher(inv2, class_def)
+    got = s.doc_ids(F({"operator": "Equal", "path": ["title"], "valueText": "hello"}))
+    assert sorted(got) == [7]

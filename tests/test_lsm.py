@@ -1,0 +1,171 @@
+"""LSM store: strategies, WAL recovery, flush/segments, compaction, blooms.
+
+Models the reference's lsmkv unit/integration tiers (strategy tests,
+bucket_recover_from_wal.go behavior)."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.storage.bitmap import Bitmap
+from weaviate_tpu.storage.docid import Counter
+from weaviate_tpu.storage.lsm import (
+    STRATEGY_MAP,
+    STRATEGY_REPLACE,
+    STRATEGY_ROARINGSET,
+    STRATEGY_SET,
+    Bucket,
+    LsmError,
+    Store,
+)
+
+
+def test_replace_basic(tmp_path):
+    b = Bucket(str(tmp_path / "b"), STRATEGY_REPLACE)
+    b.put(b"k1", b"v1")
+    b.put(b"k2", b"v2")
+    b.put(b"k1", b"v1b")
+    assert b.get(b"k1") == b"v1b"
+    b.delete(b"k2")
+    assert b.get(b"k2") is None
+    assert b.keys() == [b"k1"]
+
+
+def test_replace_wal_recovery(tmp_path):
+    p = str(tmp_path / "b")
+    b = Bucket(p, STRATEGY_REPLACE)
+    b.put(b"a", b"1")
+    b.delete(b"a")
+    b.put(b"b", b"2")
+    b.flush()
+    # no shutdown — simulate crash
+    b2 = Bucket(p, STRATEGY_REPLACE)
+    assert b2.get(b"a") is None
+    assert b2.get(b"b") == b"2"
+
+
+def test_replace_segments_and_tombstones(tmp_path):
+    p = str(tmp_path / "b")
+    b = Bucket(p, STRATEGY_REPLACE)
+    b.put(b"x", b"old")
+    b.flush_memtable()  # segment 1
+    b.put(b"x", b"new")
+    b.delete(b"y")
+    b.flush_memtable()  # segment 2
+    b.put(b"y", b"alive")
+    assert b.get(b"x") == b"new"
+    assert b.get(b"y") == b"alive"
+    b.shutdown()
+    b3 = Bucket(p, STRATEGY_REPLACE)
+    assert b3.get(b"x") == b"new"
+    assert b3.get(b"y") == b"alive"
+
+
+def test_replace_compaction(tmp_path):
+    p = str(tmp_path / "b")
+    b = Bucket(p, STRATEGY_REPLACE)
+    for i in range(10):
+        b.put(f"k{i}".encode(), f"v{i}".encode())
+        if i % 3 == 0:
+            b.flush_memtable()
+    b.delete(b"k5")
+    b.flush_memtable()
+    assert len(b._segments) > 2
+    b.compact()
+    assert len(b._segments) == 1
+    assert b.get(b"k5") is None
+    assert b.get(b"k4") == b"v4"
+    assert len(b.keys()) == 9
+
+
+def test_set_strategy(tmp_path):
+    b = Bucket(str(tmp_path / "b"), STRATEGY_SET)
+    b.set_add(b"k", b"a")
+    b.set_add(b"k", b"b")
+    b.flush_memtable()
+    b.set_remove(b"k", b"a")
+    b.set_add(b"k", b"c")
+    assert b.set_get(b"k") == {b"b", b"c"}
+    b.compact()  # single segment is a no-op here but must not corrupt
+    b.flush_memtable()
+    b.compact()
+    assert b.set_get(b"k") == {b"b", b"c"}
+
+
+def test_map_strategy(tmp_path):
+    p = str(tmp_path / "b")
+    b = Bucket(p, STRATEGY_MAP)
+    b.map_put(b"term", b"doc1", b"tf=3")
+    b.map_put(b"term", b"doc2", b"tf=1")
+    b.flush_memtable()
+    b.map_delete(b"term", b"doc1")
+    b.map_put(b"term", b"doc3", b"tf=9")
+    assert b.map_get(b"term") == {b"doc2": b"tf=1", b"doc3": b"tf=9"}
+    b.shutdown()
+    b2 = Bucket(p, STRATEGY_MAP)
+    assert b2.map_get(b"term") == {b"doc2": b"tf=1", b"doc3": b"tf=9"}
+
+
+def test_roaringset_strategy(tmp_path):
+    p = str(tmp_path / "b")
+    b = Bucket(p, STRATEGY_ROARINGSET)
+    b.roaring_add_many(b"color:red", [1, 2, 3, 100])
+    b.flush_memtable()
+    b.roaring_remove_many(b"color:red", [2])
+    b.roaring_add_many(b"color:red", [200])
+    got = b.roaring_get(b"color:red")
+    assert sorted(got) == [1, 3, 100, 200]
+    b.flush_memtable()
+    b.compact()
+    assert sorted(b.roaring_get(b"color:red")) == [1, 3, 100, 200]
+
+
+def test_wal_torn_tail(tmp_path):
+    p = str(tmp_path / "b")
+    b = Bucket(p, STRATEGY_REPLACE)
+    b.put(b"good", b"1")
+    b.flush()
+    b._wal.close()
+    with open(p + "/bucket.wal", "ab") as f:
+        f.write(b"\x01\x02\xff\xff\xff")  # torn record
+    b2 = Bucket(p, STRATEGY_REPLACE)
+    assert b2.get(b"good") == b"1"
+
+
+def test_cursor_sorted(tmp_path):
+    b = Bucket(str(tmp_path / "b"), STRATEGY_REPLACE)
+    for k in [b"c", b"a", b"b"]:
+        b.put(k, k)
+    b.flush_memtable()
+    b.put(b"d", b"d")
+    assert [k for k, _ in b.cursor()] == [b"a", b"b", b"c", b"d"]
+
+
+def test_memtable_autoflush(tmp_path):
+    b = Bucket(str(tmp_path / "b"), STRATEGY_REPLACE, memtable_max_bytes=100)
+    for i in range(50):
+        b.put(f"key{i:04d}".encode(), b"x" * 20)
+    assert len(b._segments) > 0
+    assert b.get(b"key0000") == b"x" * 20
+
+
+def test_store_buckets(tmp_path):
+    s = Store(str(tmp_path / "store"))
+    obj = s.create_or_load_bucket("objects", STRATEGY_REPLACE)
+    inv = s.create_or_load_bucket("inv", STRATEGY_ROARINGSET)
+    obj.put(b"k", b"v")
+    inv.roaring_add_many(b"p", [7])
+    with pytest.raises(LsmError):
+        s.create_or_load_bucket("objects", STRATEGY_SET)
+    assert s.bucket("objects").get(b"k") == b"v"
+
+
+def test_docid_counter(tmp_path):
+    p = str(tmp_path / "cnt" / "counter.bin")
+    c = Counter(p, reserve=10)
+    ids = [c.get_and_inc() for _ in range(5)]
+    assert ids == [0, 1, 2, 3, 4]
+    first = c.get_and_inc_many(3)
+    assert first == 5
+    # crash-restart must never reuse
+    c2 = Counter(p, reserve=10)
+    assert c2.get_and_inc() >= 8

@@ -1,0 +1,354 @@
+"""The "hnsw" index type: native C++ graph engine behind the VectorIndex seam.
+
+This is the CPU parity index mirroring the reference's Go HNSW
+(adapters/repos/db/vector/hnsw/) — graph semantics live in native/hnsw.cpp;
+this wrapper adds:
+- dynamic ef (autoEfFromK, search.go:46: ef = k*factor clamped to [min,max])
+- cosine = normalize-then-dot (cosine_dist.go, search.go:64)
+- flat-search cutoff: allowLists smaller than flatSearchCutoff are brute
+  forced over the allowList only (search.go:73-77 → flat_search.go)
+- durability: snapshot (hnsw_save) + VectorLog delta replay — the analog of
+  commit-log condensing (condensor.go): flush() persists a snapshot and
+  truncates the delta log; restore = load snapshot, replay the delta.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.entities import vectorindex as vi
+from weaviate_tpu.index.interface import AllowList, VectorIndex
+from weaviate_tpu.index.tpu import VectorLog
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libhnsw.so")
+_SRC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "hnsw.cpp",
+)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            if not os.path.exists(_SRC_PATH):
+                raise ImportError(f"native hnsw source not found at {_SRC_PATH}")
+            os.makedirs(_NATIVE_DIR, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+                 "-o", _SO_PATH, _SRC_PATH],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO_PATH)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.hnsw_new.restype = ctypes.c_void_p
+        lib.hnsw_new.argtypes = [ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                                 ctypes.c_int32, ctypes.c_uint64]
+        lib.hnsw_free.argtypes = [ctypes.c_void_p]
+        lib.hnsw_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64, f32p]
+        lib.hnsw_add_batch.argtypes = [ctypes.c_void_p, ctypes.c_int64, u64p, f32p]
+        lib.hnsw_delete.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.hnsw_delete.restype = ctypes.c_int32
+        lib.hnsw_contains.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.hnsw_contains.restype = ctypes.c_int32
+        lib.hnsw_size.argtypes = [ctypes.c_void_p]
+        lib.hnsw_size.restype = ctypes.c_int64
+        lib.hnsw_search.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int32, ctypes.c_int32,
+                                    u64p, ctypes.c_int64, u64p, f32p]
+        lib.hnsw_search.restype = ctypes.c_int32
+        lib.hnsw_search_batch.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int32,
+                                          ctypes.c_int32, ctypes.c_int32, u64p,
+                                          ctypes.c_int64, u64p, f32p, i32p]
+        lib.hnsw_flat_search.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int32, u64p,
+                                         ctypes.c_int64, u64p, f32p]
+        lib.hnsw_flat_search.restype = ctypes.c_int32
+        lib.hnsw_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hnsw_save.restype = ctypes.c_int32
+        lib.hnsw_load.argtypes = [ctypes.c_char_p]
+        lib.hnsw_load.restype = ctypes.c_void_p
+        _lib = lib
+        return _lib
+
+
+_METRIC_L2 = 0
+_METRIC_DOT = 1
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u64p(a: Optional[np.ndarray]):
+    if a is None or a.size == 0:
+        return None
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+class HnswIndex(VectorIndex):
+    def __init__(
+        self,
+        config: vi.HnswUserConfig,
+        shard_path: str,
+        shard_name: str = "",
+        metrics=None,
+        persist: bool = True,
+    ):
+        self.config = config
+        self.metric = config.distance
+        if self.metric in (vi.DISTANCE_MANHATTAN, vi.DISTANCE_HAMMING):
+            raise vi.ConfigValidationError(
+                f"hnsw native engine supports l2-squared/dot/cosine, not {self.metric}"
+            )
+        self.shard_path = shard_path
+        self._lib = _load_lib()
+        self._lock = threading.RLock()
+        self.dim: Optional[int] = None
+        self._h = None
+        self._snapshot_path = os.path.join(shard_path, "hnsw.snapshot")
+        self._log = VectorLog(os.path.join(shard_path, "hnsw.log")) if persist else None
+        if persist:
+            self._restore()
+
+    # -- internals -----------------------------------------------------------
+
+    def _native_metric(self) -> int:
+        return _METRIC_L2 if self.metric == vi.DISTANCE_L2 else _METRIC_DOT
+
+    def _ensure_handle(self, dim: int) -> None:
+        if self._h is None:
+            self.dim = dim
+            self._h = self._lib.hnsw_new(
+                dim,
+                self._native_metric(),
+                self.config.max_connections,
+                self.config.ef_construction,
+                0x5EED,
+            )
+
+    def _prep(self, v: np.ndarray) -> np.ndarray:
+        v = np.ascontiguousarray(v, dtype=np.float32)
+        if self.metric == vi.DISTANCE_COSINE:
+            n = float(np.linalg.norm(v))
+            if n > 0:
+                v = v / n
+        return v
+
+    def _restore(self) -> None:
+        if os.path.exists(self._snapshot_path):
+            h = self._lib.hnsw_load(self._snapshot_path.encode())
+            if h:
+                self._h = h
+                # dim is embedded in the snapshot; probe via a search no-op is
+                # overkill — store alongside
+                dim_file = self._snapshot_path + ".dim"
+                if os.path.exists(dim_file):
+                    self.dim = int(open(dim_file).read().strip())
+        if self._log is not None:
+            for op, doc_id, vec in VectorLog.replay(self._log.path):
+                if op == "add":
+                    v = np.asarray(vec, dtype=np.float32)  # already normalized at log time
+                    self._ensure_handle(v.shape[0])
+                    self._lib.hnsw_add(self._h, doc_id, _f32p(np.ascontiguousarray(v)))
+                elif self._h is not None:
+                    self._lib.hnsw_delete(self._h, doc_id)
+
+    def _ef(self, k: int) -> int:
+        ef = self.config.ef
+        if ef != -1:
+            return max(ef, k)
+        # autoEfFromK (search.go:46)
+        ef = k * self.config.dynamic_ef_factor
+        ef = min(max(ef, self.config.dynamic_ef_min), self.config.dynamic_ef_max)
+        return max(ef, k)
+
+    # -- VectorIndex ---------------------------------------------------------
+
+    def add(self, doc_id: int, vector: np.ndarray) -> None:
+        v = self._prep(vector)
+        with self._lock:
+            if self.dim is not None and v.shape[0] != self.dim:
+                raise ValueError(f"dim mismatch: index has {self.dim}, got {v.shape[0]}")
+            self._ensure_handle(v.shape[0])
+            if self._log is not None:
+                self._log.append_add(int(doc_id), v)
+            self._lib.hnsw_add(self._h, int(doc_id), _f32p(v))
+
+    def add_batch(self, doc_ids: Sequence[int], vectors: np.ndarray) -> None:
+        vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+        if self.metric == vi.DISTANCE_COSINE:
+            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+            norms[norms == 0] = 1.0
+            vectors = np.ascontiguousarray(vectors / norms)
+        ids = np.ascontiguousarray(np.asarray(doc_ids, dtype=np.uint64))
+        with self._lock:
+            if self.dim is not None and vectors.shape[1] != self.dim:
+                raise ValueError(f"dim mismatch: index has {self.dim}, got {vectors.shape[1]}")
+            self._ensure_handle(int(vectors.shape[1]))
+            if self._log is not None:
+                self._log.append_add_batch(ids, vectors)
+            self._lib.hnsw_add_batch(self._h, len(ids), _u64p(ids), _f32p(vectors))
+
+    def delete(self, *doc_ids: int) -> None:
+        with self._lock:
+            if self._h is None:
+                return
+            for d in doc_ids:
+                if self._log is not None:
+                    self._log.append_delete(int(d))
+                self._lib.hnsw_delete(self._h, int(d))
+
+    def contains(self, doc_id: int) -> bool:
+        with self._lock:
+            return bool(self._h and self._lib.hnsw_contains(self._h, int(doc_id)))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return int(self._lib.hnsw_size(self._h)) if self._h else 0
+
+    def distancer_name(self) -> str:
+        return self.metric
+
+    def search_by_vector(
+        self, vector: np.ndarray, k: int, allow_list: Optional[AllowList] = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        q = self._prep(vector)
+        with self._lock:
+            if self._h is None:
+                return np.zeros(0, np.uint64), np.zeros(0, np.float32)
+            out_ids = np.zeros(k, dtype=np.uint64)
+            out_d = np.zeros(k, dtype=np.float32)
+            if allow_list is not None:
+                allow = np.ascontiguousarray(allow_list.to_array(), dtype=np.uint64)
+                if allow.size < self.config.flat_search_cutoff:
+                    n = self._lib.hnsw_flat_search(
+                        self._h, _f32p(q), k, _u64p(allow), allow.size, _u64p(out_ids), _f32p(out_d)
+                    )
+                else:
+                    n = self._lib.hnsw_search(
+                        self._h, _f32p(q), k, self._ef(k), _u64p(allow), allow.size,
+                        _u64p(out_ids), _f32p(out_d),
+                    )
+            else:
+                n = self._lib.hnsw_search(
+                    self._h, _f32p(q), k, self._ef(k), None, 0, _u64p(out_ids), _f32p(out_d)
+                )
+            return out_ids[:n], out_d[:n]
+
+    def search_by_vectors(
+        self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+        if self.metric == vi.DISTANCE_COSINE:
+            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+            norms[norms == 0] = 1.0
+            vectors = np.ascontiguousarray(vectors / norms)
+        b = vectors.shape[0]
+        with self._lock:
+            if self._h is None:
+                return np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32)
+            if allow_list is not None and len(allow_list) < self.config.flat_search_cutoff:
+                return super().search_by_vectors(vectors, k, allow_list)
+            allow = None
+            a_n = 0
+            if allow_list is not None:
+                allow = np.ascontiguousarray(allow_list.to_array(), dtype=np.uint64)
+                a_n = allow.size
+            out_ids = np.zeros((b, k), dtype=np.uint64)
+            out_d = np.full((b, k), np.inf, dtype=np.float32)
+            counts = np.zeros(b, dtype=np.int32)
+            self._lib.hnsw_search_batch(
+                self._h, _f32p(vectors), b, k, self._ef(k), _u64p(allow), a_n,
+                _u64p(out_ids), _f32p(out_d),
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            # mask out unfilled tails
+            for i in range(b):
+                if counts[i] < k:
+                    out_d[i, counts[i]:] = np.inf
+                    out_ids[i, counts[i]:] = np.iinfo(np.uint64).max
+            return out_ids, out_d
+
+    def search_by_vector_distance(
+        self,
+        vector: np.ndarray,
+        target_distance: float,
+        max_limit: int,
+        allow_list: Optional[AllowList] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Iteratively double the limit (search.go:90-157)."""
+        limit = 64
+        while True:
+            ids, dists = self.search_by_vector(vector, min(limit, max_limit), allow_list)
+            if len(ids) == 0:
+                return ids, dists
+            if (dists > target_distance).any() or limit >= max_limit or len(ids) >= len(self):
+                keep = dists <= target_distance
+                return ids[keep][:max_limit], dists[keep][:max_limit]
+            limit *= 2
+
+    def update_user_config(self, updated: vi.HnswUserConfig) -> None:
+        with self._lock:
+            vi.validate_config_update(self.config, updated)
+            self.config = updated
+
+    def flush(self) -> None:
+        """Snapshot + truncate the delta log (commit-log condense analog)."""
+        with self._lock:
+            if self._h is None:
+                return
+            if self._log is not None:
+                tmp = self._snapshot_path + ".tmp"
+                if self._lib.hnsw_save(self._h, tmp.encode()):
+                    os.replace(tmp, self._snapshot_path)
+                    with open(self._snapshot_path + ".dim", "w") as f:
+                        f.write(str(self.dim))
+                    self._log.rewrite([])
+                self._log.flush()
+
+    def drop(self) -> None:
+        with self._lock:
+            if self._h is not None:
+                self._lib.hnsw_free(self._h)
+                self._h = None
+            self.dim = None
+            if self._log is not None:
+                self._log.close()
+                for p in (self._log.path, self._snapshot_path, self._snapshot_path + ".dim"):
+                    try:
+                        os.remove(p)
+                    except FileNotFoundError:
+                        pass
+                self._log = None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self.flush()
+            if self._log is not None:
+                self._log.close()
+            if self._h is not None:
+                self._lib.hnsw_free(self._h)
+                self._h = None
+
+    def list_files(self) -> list[str]:
+        out = []
+        if self._log is not None:
+            out.append(self._log.path)
+        if os.path.exists(self._snapshot_path):
+            out.extend([self._snapshot_path, self._snapshot_path + ".dim"])
+        return out

@@ -1,0 +1,117 @@
+"""BM25F keyword search over the searchable map buckets.
+
+Reference: inverted/bm25_searcher.go:77 (BM25F over map buckets with term
+frequencies, WAND-style term iteration :99), config defaults k1=1.2 b=0.75
+(entities/models InvertedIndexConfig.BM25).
+
+Scoring: classic BM25 with per-property weights (BM25F flavor): for query
+term t and doc d with term frequency tf in property p of length L_p:
+
+    idf(t)  = ln(1 + (N - df + 0.5) / (df + 0.5))
+    s(t, d) = idf(t) * tf' * (k1 + 1) / (tf' + k1 * (1 - b + b * L/avgL))
+
+with tf' summed over weighted properties.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import struct
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.entities.schema import DataType
+from weaviate_tpu.inverted.analyzer import tokenize
+from weaviate_tpu.inverted.index import InvertedIndex, length_bucket, searchable_bucket
+from weaviate_tpu.index.interface import AllowList
+
+DEFAULT_K1 = 1.2
+DEFAULT_B = 0.75
+
+
+class BM25Searcher:
+    def __init__(self, inverted: InvertedIndex, class_def, config: Optional[dict] = None):
+        self.inverted = inverted
+        self.class_def = class_def
+        bm = (config or {}).get("bm25") or {}
+        self.k1 = float(bm.get("k1", DEFAULT_K1))
+        self.b = float(bm.get("b", DEFAULT_B))
+
+    def _searchable_props(self, properties: Optional[Sequence[str]]) -> list[tuple[str, float]]:
+        """-> [(prop, weight)]; supports "prop^2" boost syntax."""
+        out = []
+        if properties:
+            for p in properties:
+                if "^" in p:
+                    name, w = p.split("^", 1)
+                    out.append((name, float(w)))
+                else:
+                    out.append((p, 1.0))
+        else:
+            for prop in self.class_def.properties:
+                pt = prop.primitive_type()
+                if (
+                    pt is not None
+                    and pt.base in (DataType.TEXT, DataType.STRING)
+                    and prop.index_searchable
+                ):
+                    out.append((prop.name, 1.0))
+        return out
+
+    def search(
+        self,
+        query: str,
+        limit: int,
+        properties: Optional[Sequence[str]] = None,
+        allow_list: Optional[AllowList] = None,
+        additional_explanations: bool = False,
+    ) -> list[tuple[int, float, Optional[dict]]]:
+        """-> [(doc_id, score, explain|None)] sorted by score desc."""
+        props = self._searchable_props(properties)
+        n_docs = max(self.inverted.doc_count(), 1)
+        scores: dict[int, float] = {}
+        explains: dict[int, dict] = {}
+
+        # collect per-term postings across properties
+        terms: dict[str, float] = {}
+        for prop_name, weight in props:
+            prop = self.class_def.get_property(prop_name)
+            tk = prop.tokenization if prop else "word"
+            for t in tokenize(tk, query):
+                terms.setdefault(t, 0.0)
+
+        for prop_name, weight in props:
+            sb = self.inverted.store.bucket(searchable_bucket(prop_name))
+            lb = self.inverted.store.bucket(length_bucket(prop_name))
+            if sb is None:
+                continue
+            lengths = lb.map_get(b"len") if lb is not None else {}
+            if lengths:
+                total = sum(struct.unpack("<I", v)[0] for v in lengths.values())
+                avg_len = total / len(lengths)
+            else:
+                avg_len = 1.0
+            for term in terms:
+                postings = sb.map_get(term.encode("utf-8"))
+                if not postings:
+                    continue
+                df = len(postings)
+                idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+                for did_b, tf_b in postings.items():
+                    (doc_id,) = struct.unpack("<Q", did_b)
+                    if allow_list is not None and not allow_list.contains(doc_id):
+                        continue
+                    (tf,) = struct.unpack("<f", tf_b)
+                    L_b = lengths.get(did_b)
+                    L = struct.unpack("<I", L_b)[0] if L_b else avg_len
+                    denom = tf + self.k1 * (1 - self.b + self.b * (L / avg_len))
+                    s = weight * idf * tf * (self.k1 + 1) / denom
+                    scores[doc_id] = scores.get(doc_id, 0.0) + s
+                    if additional_explanations:
+                        explains.setdefault(doc_id, {})[f"BM25F_{term}_frequency"] = tf
+                        explains[doc_id][f"BM25F_{term}_propLength"] = L
+
+        top = heapq.nlargest(limit, scores.items(), key=lambda kv: (kv[1], -kv[0]))
+        return [(d, s, explains.get(d) if additional_explanations else None) for d, s in top]

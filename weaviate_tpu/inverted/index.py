@@ -1,0 +1,141 @@
+"""Per-property inverted buckets + write path.
+
+Reference bucket layout (shard_write_inverted*.go, inverted/):
+- filterable  -> RoaringSet bucket  property_<name>_filterable: token -> docID bitmap
+- searchable  -> Map bucket         property_<name>_searchable: token -> {docID: tf}
+- null        -> RoaringSet bucket  property_<name>__null: {0x00/0x01 -> docIDs}
+- lengths     -> Map bucket         property_<name>__length (BM25 prop-length
+                 tracker, proplengthtracker role)
+- __all_docs  -> RoaringSet         live docID universe (for Not/complement)
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import Counter as PyCounter
+from typing import Optional
+
+from weaviate_tpu.entities.schema import ClassDef, DataType
+from weaviate_tpu.inverted.analyzer import Analyzer
+from weaviate_tpu.storage.bitmap import Bitmap
+from weaviate_tpu.storage.lsm import (
+    STRATEGY_MAP,
+    STRATEGY_ROARINGSET,
+    Store,
+)
+
+ALL_DOCS_KEY = b"__all__"
+NULL_TRUE = b"\x01"
+NULL_FALSE = b"\x00"
+
+
+def filterable_bucket(prop: str) -> str:
+    return f"property_{prop}_filterable"
+
+
+def searchable_bucket(prop: str) -> str:
+    return f"property_{prop}_searchable"
+
+
+def null_bucket(prop: str) -> str:
+    return f"property_{prop}__null"
+
+
+def length_bucket(prop: str) -> str:
+    return f"property_{prop}__length"
+
+
+class InvertedIndex:
+    def __init__(self, store: Store, class_def: ClassDef):
+        self.store = store
+        self.class_def = class_def
+        self.analyzer = Analyzer(class_def)
+        self._all = store.create_or_load_bucket("_all_docs", STRATEGY_ROARINGSET)
+        self._ensure_buckets()
+
+    def _ensure_buckets(self) -> None:
+        for prop in self.class_def.properties:
+            pt = prop.primitive_type()
+            if pt is None or pt.base in (DataType.GEO_COORDINATES, DataType.BLOB):
+                continue
+            if prop.index_filterable:
+                self.store.create_or_load_bucket(filterable_bucket(prop.name), STRATEGY_ROARINGSET)
+                self.store.create_or_load_bucket(null_bucket(prop.name), STRATEGY_ROARINGSET)
+            if prop.index_searchable and pt.base in (DataType.TEXT, DataType.STRING):
+                self.store.create_or_load_bucket(searchable_bucket(prop.name), STRATEGY_MAP)
+                self.store.create_or_load_bucket(length_bucket(prop.name), STRATEGY_MAP)
+
+    def update_schema(self, class_def: ClassDef) -> None:
+        """Pick up added properties (migrator AddProperty path)."""
+        self.class_def = class_def
+        self.analyzer = Analyzer(class_def)
+        self._ensure_buckets()
+
+    # -- write path ----------------------------------------------------------
+
+    def add_object(self, doc_id: int, properties: dict) -> None:
+        tokens_by_prop = self.analyzer.analyze(properties)
+        self._all.roaring_add_many(ALL_DOCS_KEY, [doc_id])
+        did = struct.pack("<Q", doc_id)
+        for prop in self.class_def.properties:
+            pt = prop.primitive_type()
+            if pt is None or pt.base in (DataType.GEO_COORDINATES, DataType.BLOB):
+                continue
+            toks = tokens_by_prop.get(prop.name)
+            if prop.index_filterable:
+                nb = self.store.bucket(null_bucket(prop.name))
+                nb.roaring_add_many(NULL_TRUE if toks is None else NULL_FALSE, [doc_id])
+                if toks:
+                    fb = self.store.bucket(filterable_bucket(prop.name))
+                    for t in set(toks):
+                        fb.roaring_add_many(t, [doc_id])
+            if (
+                prop.index_searchable
+                and pt.base in (DataType.TEXT, DataType.STRING)
+                and toks
+            ):
+                sb = self.store.bucket(searchable_bucket(prop.name))
+                counts = PyCounter(toks)
+                for t, tf in counts.items():
+                    sb.map_put(t, did, struct.pack("<f", float(tf)))
+                lb = self.store.bucket(length_bucket(prop.name))
+                lb.map_put(b"len", did, struct.pack("<I", len(toks)))
+
+    def delete_object(self, doc_id: int, properties: dict) -> None:
+        tokens_by_prop = self.analyzer.analyze(properties)
+        self._all.roaring_remove_many(ALL_DOCS_KEY, [doc_id])
+        did = struct.pack("<Q", doc_id)
+        for prop in self.class_def.properties:
+            pt = prop.primitive_type()
+            if pt is None or pt.base in (DataType.GEO_COORDINATES, DataType.BLOB):
+                continue
+            toks = tokens_by_prop.get(prop.name)
+            if prop.index_filterable:
+                nb = self.store.bucket(null_bucket(prop.name))
+                nb.roaring_remove_many(NULL_TRUE if toks is None else NULL_FALSE, [doc_id])
+                if toks:
+                    fb = self.store.bucket(filterable_bucket(prop.name))
+                    for t in set(toks):
+                        fb.roaring_remove_many(t, [doc_id])
+            if (
+                prop.index_searchable
+                and pt.base in (DataType.TEXT, DataType.STRING)
+                and toks
+            ):
+                sb = self.store.bucket(searchable_bucket(prop.name))
+                for t in set(toks):
+                    sb.map_delete(t, did)
+                lb = self.store.bucket(length_bucket(prop.name))
+                lb.map_delete(b"len", did)
+
+    def update_object(self, doc_id_old: int, props_old: dict, doc_id_new: int, props_new: dict) -> None:
+        self.delete_object(doc_id_old, props_old)
+        self.add_object(doc_id_new, props_new)
+
+    # -- read helpers --------------------------------------------------------
+
+    def all_doc_ids(self) -> Bitmap:
+        return self._all.roaring_get(ALL_DOCS_KEY)
+
+    def doc_count(self) -> int:
+        return len(self.all_doc_ids())

@@ -1,0 +1,772 @@
+"""LSM key-value store: memtables, WAL, sorted segments, compaction, blooms.
+
+Reference: adapters/repos/db/lsmkv/ — Store/Bucket with four strategies
+(strategies.go:22-25):
+
+- "replace":    latest value wins (object store)
+- "set":        per-key set of byte values with add/remove (legacy inverted)
+- "map":        per-key map of subkey->value with per-pair tombstones
+                (searchable inverted index with term frequencies)
+- "roaringset": per-key bitmap with additions/deletions (filterable inverted
+                index; lsmkv/roaringset/)
+
+Same write path shape as the reference: mutation -> WAL append (commitlogger
+.go) + memtable; flush -> sorted segment file + bloom sidecar
+(segment_bloom_filters.go); reads merge memtable over segments newest-first;
+compaction merges segment pairs (segment_group_compaction.go). Disk formats
+are our own: segments carry a key-offset footer read at open, values are
+fetched via mmap — no full segment load.
+"""
+
+from __future__ import annotations
+
+import bisect
+import io
+import mmap
+import os
+import struct
+import threading
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from weaviate_tpu.storage.bitmap import Bitmap
+
+STRATEGY_REPLACE = "replace"
+STRATEGY_SET = "set"
+STRATEGY_MAP = "map"
+STRATEGY_ROARINGSET = "roaringset"
+
+STRATEGIES = (STRATEGY_REPLACE, STRATEGY_SET, STRATEGY_MAP, STRATEGY_ROARINGSET)
+
+_SEG_MAGIC = b"WTSG"
+_WAL_MAGIC = b"WTWL"
+_TOMBSTONE = b"\x00__wt_tombstone__"
+
+# WAL record ops
+_W_PUT = 1          # replace put / set add / map put
+_W_DELETE = 2       # replace delete / set remove / map-pair delete / rs remove
+_W_RS_ADD_MANY = 3  # roaringset bulk add
+_W_RS_DEL_MANY = 4
+
+
+class LsmError(RuntimeError):
+    pass
+
+
+def _write_frame(f, *parts: bytes) -> None:
+    for p in parts:
+        f.write(struct.pack("<I", len(p)))
+        f.write(p)
+
+
+def _read_frame(buf: memoryview, off: int) -> tuple[bytes, int]:
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return bytes(buf[off : off + n]), off + n
+
+
+class BloomFilter:
+    """Simple double-hashed bloom (segment_bloom_filters.go role)."""
+
+    def __init__(self, n_items: int, bits_per_item: int = 10):
+        self.m = max(64, n_items * bits_per_item)
+        self.k = 7
+        self.bits = np.zeros((self.m + 7) // 8, dtype=np.uint8)
+
+    def _hashes(self, key: bytes):
+        h1 = hash(key) & 0xFFFFFFFFFFFF
+        h2 = hash(b"\x01" + key) | 1
+        for i in range(self.k):
+            yield (h1 + i * h2) % self.m
+
+    def add(self, key: bytes) -> None:
+        for h in self._hashes(key):
+            self.bits[h >> 3] |= 1 << (h & 7)
+
+    def __contains__(self, key: bytes) -> bool:
+        return all(self.bits[h >> 3] & (1 << (h & 7)) for h in self._hashes(key))
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<QI", self.m, self.k) + self.bits.tobytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "BloomFilter":
+        m, k = struct.unpack_from("<QI", data, 0)
+        b = cls.__new__(cls)
+        b.m, b.k = m, k
+        b.bits = np.frombuffer(data, dtype=np.uint8, offset=12).copy()
+        return b
+
+
+# -- memtables ---------------------------------------------------------------
+
+
+class _MemReplace:
+    def __init__(self):
+        self.data: dict[bytes, bytes] = {}  # value or _TOMBSTONE
+
+    def put(self, k, v):
+        self.data[k] = v
+
+    def delete(self, k):
+        self.data[k] = _TOMBSTONE
+
+    def get(self, k):
+        return self.data.get(k)
+
+    def __len__(self):
+        return len(self.data)
+
+    def approx_bytes(self):
+        return sum(len(k) + len(v) for k, v in self.data.items())
+
+
+class _MemSet:
+    def __init__(self):
+        self.adds: dict[bytes, set[bytes]] = {}
+        self.dels: dict[bytes, set[bytes]] = {}
+
+    def add(self, k, v):
+        self.adds.setdefault(k, set()).add(v)
+        self.dels.get(k, set()).discard(v)
+
+    def remove(self, k, v):
+        self.dels.setdefault(k, set()).add(v)
+        self.adds.get(k, set()).discard(v)
+
+    def __len__(self):
+        return len(self.adds) + len(self.dels)
+
+    def approx_bytes(self):
+        t = 0
+        for d in (self.adds, self.dels):
+            for k, s in d.items():
+                t += len(k) + sum(len(v) for v in s)
+        return t
+
+
+class _MemMap:
+    def __init__(self):
+        # key -> {subkey: value or None(=tombstone)}
+        self.data: dict[bytes, dict[bytes, Optional[bytes]]] = {}
+
+    def put(self, k, sub, v):
+        self.data.setdefault(k, {})[sub] = v
+
+    def delete_pair(self, k, sub):
+        self.data.setdefault(k, {})[sub] = None
+
+    def __len__(self):
+        return len(self.data)
+
+    def approx_bytes(self):
+        t = 0
+        for k, m in self.data.items():
+            t += len(k) + sum(len(s) + len(v or b"") for s, v in m.items())
+        return t
+
+
+class _MemRoaring:
+    def __init__(self):
+        self.adds: dict[bytes, Bitmap] = {}
+        self.dels: dict[bytes, Bitmap] = {}
+
+    def add_many(self, k, ids: Iterable[int]):
+        self.adds[k] = self.adds.get(k, Bitmap()).add_many(ids)
+        if k in self.dels:
+            self.dels[k] = self.dels[k].remove_many(list(ids))
+
+    def del_many(self, k, ids: Iterable[int]):
+        self.dels[k] = self.dels.get(k, Bitmap()).add_many(ids)
+        if k in self.adds:
+            self.adds[k] = self.adds[k].remove_many(list(ids))
+
+    def __len__(self):
+        return len(self.adds) + len(self.dels)
+
+    def approx_bytes(self):
+        t = 0
+        for d in (self.adds, self.dels):
+            for k, bm in d.items():
+                t += len(k) + 8 * len(bm)
+        return t
+
+
+# -- segments ----------------------------------------------------------------
+
+
+class Segment:
+    """Immutable sorted segment with footer key index, mmap-backed values.
+
+    Layout: magic | strategy u8 | count u64 | entries... | footer | footer_off
+    u64. Entry payloads are strategy-specific; the footer lists (key, offset,
+    length) sorted by key.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        mv = memoryview(self._mm)
+        if bytes(mv[:4]) != _SEG_MAGIC:
+            raise LsmError(f"bad segment magic in {path}")
+        self.strategy = STRATEGIES[mv[4]]
+        (footer_off,) = struct.unpack_from("<Q", mv, len(mv) - 8)
+        (count,) = struct.unpack_from("<Q", mv, footer_off)
+        off = footer_off + 8
+        self.keys: list[bytes] = []
+        self.offsets: list[tuple[int, int]] = []
+        for _ in range(count):
+            k, off = _read_frame(mv, off)
+            o, ln = struct.unpack_from("<QQ", mv, off)
+            off += 16
+            self.keys.append(k)
+            self.offsets.append((o, ln))
+        bloom_path = path + ".bloom"
+        self.bloom: Optional[BloomFilter] = None
+        if os.path.exists(bloom_path):
+            with open(bloom_path, "rb") as bf:
+                self.bloom = BloomFilter.from_bytes(bf.read())
+
+    def get_raw(self, key: bytes) -> Optional[bytes]:
+        if self.bloom is not None and key not in self.bloom:
+            return None
+        i = bisect.bisect_left(self.keys, key)
+        if i >= len(self.keys) or self.keys[i] != key:
+            return None
+        o, ln = self.offsets[i]
+        return bytes(self._mm[o : o + ln])
+
+    def items_raw(self) -> Iterator[tuple[bytes, bytes]]:
+        for k, (o, ln) in zip(self.keys, self.offsets):
+            yield k, bytes(self._mm[o : o + ln])
+
+    def close(self):
+        self._mm.close()
+        self._f.close()
+
+    @staticmethod
+    def write(path: str, strategy: str, items: list[tuple[bytes, bytes]]) -> None:
+        """items must be sorted by key; values are pre-encoded payloads."""
+        tmp = path + ".tmp"
+        bloom = BloomFilter(len(items))
+        with open(tmp, "wb") as f:
+            f.write(_SEG_MAGIC + bytes([STRATEGIES.index(strategy)]))
+            footer: list[tuple[bytes, int, int]] = []
+            for k, payload in items:
+                off = f.tell()
+                f.write(payload)
+                footer.append((k, off, len(payload)))
+                bloom.add(k)
+            footer_off = f.tell()
+            f.write(struct.pack("<Q", len(footer)))
+            for k, o, ln in footer:
+                _write_frame(f, k)
+                f.write(struct.pack("<QQ", o, ln))
+            f.write(struct.pack("<Q", footer_off))
+            f.flush()
+            os.fsync(f.fileno())
+        with open(tmp + ".bloom", "wb") as f:
+            f.write(bloom.to_bytes())
+        os.replace(tmp + ".bloom", path + ".bloom")
+        os.replace(tmp, path)
+
+
+# payload codecs per strategy ------------------------------------------------
+
+
+def _enc_set(adds: set[bytes], dels: set[bytes]) -> bytes:
+    out = io.BytesIO()
+    out.write(struct.pack("<II", len(adds), len(dels)))
+    for v in sorted(adds):
+        _write_frame(out, v)
+    for v in sorted(dels):
+        _write_frame(out, v)
+    return out.getvalue()
+
+
+def _dec_set(payload: bytes) -> tuple[set[bytes], set[bytes]]:
+    mv = memoryview(payload)
+    na, nd = struct.unpack_from("<II", mv, 0)
+    off = 8
+    adds, dels = set(), set()
+    for _ in range(na):
+        v, off = _read_frame(mv, off)
+        adds.add(v)
+    for _ in range(nd):
+        v, off = _read_frame(mv, off)
+        dels.add(v)
+    return adds, dels
+
+
+def _enc_map(m: dict[bytes, Optional[bytes]]) -> bytes:
+    out = io.BytesIO()
+    out.write(struct.pack("<I", len(m)))
+    for sub in sorted(m):
+        v = m[sub]
+        _write_frame(out, sub)
+        out.write(b"\x01" if v is None else b"\x00")
+        _write_frame(out, v or b"")
+    return out.getvalue()
+
+
+def _dec_map(payload: bytes) -> dict[bytes, Optional[bytes]]:
+    mv = memoryview(payload)
+    (n,) = struct.unpack_from("<I", mv, 0)
+    off = 4
+    out: dict[bytes, Optional[bytes]] = {}
+    for _ in range(n):
+        sub, off = _read_frame(mv, off)
+        tomb = mv[off]
+        off += 1
+        v, off = _read_frame(mv, off)
+        out[sub] = None if tomb else v
+    return out
+
+
+def _enc_roaring(adds: Bitmap, dels: Bitmap) -> bytes:
+    a, d = adds.to_bytes(), dels.to_bytes()
+    return struct.pack("<II", len(a), len(d)) + a + d
+
+
+def _dec_roaring(payload: bytes) -> tuple[Bitmap, Bitmap]:
+    la, ld = struct.unpack_from("<II", payload, 0)
+    a = Bitmap.from_bytes(payload[8 : 8 + la])
+    d = Bitmap.from_bytes(payload[8 + la : 8 + la + ld])
+    return a, d
+
+
+# -- bucket ------------------------------------------------------------------
+
+
+class Bucket:
+    """One named LSM bucket (lsmkv.Bucket)."""
+
+    def __init__(
+        self,
+        path: str,
+        strategy: str,
+        memtable_max_bytes: int = 16 * 1024 * 1024,
+        sync_writes: bool = False,
+    ):
+        if strategy not in STRATEGIES:
+            raise LsmError(f"unknown strategy {strategy!r}")
+        self.path = path
+        self.strategy = strategy
+        self.memtable_max_bytes = memtable_max_bytes
+        self.sync_writes = sync_writes
+        self._lock = threading.RLock()
+        os.makedirs(path, exist_ok=True)
+        self._segments: list[Segment] = []  # oldest..newest
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".seg"):
+                self._segments.append(Segment(os.path.join(path, name)))
+        self._seg_counter = (
+            max(
+                (int(s.path.split("/")[-1].split(".")[0]) for s in self._segments),
+                default=-1,
+            )
+            + 1
+        )
+        self._mem = self._new_memtable()
+        self._wal_path = os.path.join(path, "bucket.wal")
+        self._replay_wal()
+        self._wal = open(self._wal_path, "ab")
+        if self._wal.tell() == 0:
+            self._wal.write(_WAL_MAGIC)
+            self._wal.flush()
+
+    def _new_memtable(self):
+        return {
+            STRATEGY_REPLACE: _MemReplace,
+            STRATEGY_SET: _MemSet,
+            STRATEGY_MAP: _MemMap,
+            STRATEGY_ROARINGSET: _MemRoaring,
+        }[self.strategy]()
+
+    # -- WAL -----------------------------------------------------------------
+
+    def _wal_append(self, op: int, *parts: bytes) -> None:
+        buf = io.BytesIO()
+        buf.write(bytes([op]))
+        buf.write(bytes([len(parts)]))
+        for p in parts:
+            _write_frame(buf, p)
+        self._wal.write(buf.getvalue())
+        if self.sync_writes:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def _replay_wal(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            data = f.read()
+        if data[:4] != _WAL_MAGIC:
+            return
+        mv = memoryview(data)
+        off = 4
+        n = len(data)
+        try:
+            while off < n:
+                op = mv[off]
+                nparts = mv[off + 1]
+                off += 2
+                parts = []
+                for _ in range(nparts):
+                    p, off = _read_frame(mv, off)
+                    parts.append(p)
+                self._apply(op, parts)
+        except (struct.error, IndexError, ValueError):
+            return  # torn tail: replay what parsed
+
+    def _apply(self, op: int, parts: list[bytes]) -> None:
+        m = self._mem
+        if self.strategy == STRATEGY_REPLACE:
+            if op == _W_PUT:
+                m.put(parts[0], parts[1])
+            elif op == _W_DELETE:
+                m.delete(parts[0])
+        elif self.strategy == STRATEGY_SET:
+            if op == _W_PUT:
+                m.add(parts[0], parts[1])
+            elif op == _W_DELETE:
+                m.remove(parts[0], parts[1])
+        elif self.strategy == STRATEGY_MAP:
+            if op == _W_PUT:
+                m.put(parts[0], parts[1], parts[2])
+            elif op == _W_DELETE:
+                m.delete_pair(parts[0], parts[1])
+        else:  # roaringset
+            ids = np.frombuffer(parts[1], dtype="<u8")
+            if op == _W_RS_ADD_MANY:
+                m.add_many(parts[0], ids)
+            elif op == _W_RS_DEL_MANY:
+                m.del_many(parts[0], ids)
+
+    # -- writes --------------------------------------------------------------
+
+    def put(self, key: bytes, value: bytes) -> None:
+        assert self.strategy == STRATEGY_REPLACE
+        with self._lock:
+            self._wal_append(_W_PUT, key, value)
+            self._mem.put(key, value)
+            self._maybe_flush()
+
+    def delete(self, key: bytes) -> None:
+        assert self.strategy == STRATEGY_REPLACE
+        with self._lock:
+            self._wal_append(_W_DELETE, key)
+            self._mem.delete(key)
+            self._maybe_flush()
+
+    def set_add(self, key: bytes, value: bytes) -> None:
+        assert self.strategy == STRATEGY_SET
+        with self._lock:
+            self._wal_append(_W_PUT, key, value)
+            self._mem.add(key, value)
+            self._maybe_flush()
+
+    def set_remove(self, key: bytes, value: bytes) -> None:
+        assert self.strategy == STRATEGY_SET
+        with self._lock:
+            self._wal_append(_W_DELETE, key, value)
+            self._mem.remove(key, value)
+            self._maybe_flush()
+
+    def map_put(self, key: bytes, subkey: bytes, value: bytes) -> None:
+        assert self.strategy == STRATEGY_MAP
+        with self._lock:
+            self._wal_append(_W_PUT, key, subkey, value)
+            self._mem.put(key, subkey, value)
+            self._maybe_flush()
+
+    def map_delete(self, key: bytes, subkey: bytes) -> None:
+        assert self.strategy == STRATEGY_MAP
+        with self._lock:
+            self._wal_append(_W_DELETE, key, subkey)
+            self._mem.delete_pair(key, subkey)
+            self._maybe_flush()
+
+    def roaring_add_many(self, key: bytes, doc_ids: Iterable[int]) -> None:
+        assert self.strategy == STRATEGY_ROARINGSET
+        ids = np.fromiter(doc_ids, dtype="<u8")
+        with self._lock:
+            self._wal_append(_W_RS_ADD_MANY, key, ids.tobytes())
+            self._mem.add_many(key, ids)
+            self._maybe_flush()
+
+    def roaring_remove_many(self, key: bytes, doc_ids: Iterable[int]) -> None:
+        assert self.strategy == STRATEGY_ROARINGSET
+        ids = np.fromiter(doc_ids, dtype="<u8")
+        with self._lock:
+            self._wal_append(_W_RS_DEL_MANY, key, ids.tobytes())
+            self._mem.del_many(key, ids)
+            self._maybe_flush()
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        """replace: newest value or None (tombstone-aware)."""
+        assert self.strategy == STRATEGY_REPLACE
+        with self._lock:
+            v = self._mem.get(key)
+            if v is not None:
+                return None if v == _TOMBSTONE else v
+            for seg in reversed(self._segments):
+                v = seg.get_raw(key)
+                if v is not None:
+                    return None if v == _TOMBSTONE else v
+            return None
+
+    def set_get(self, key: bytes) -> set[bytes]:
+        assert self.strategy == STRATEGY_SET
+        with self._lock:
+            out: set[bytes] = set()
+            removed: set[bytes] = set()
+            # oldest -> newest then memtable applies last; we walk newest-first
+            # collecting, honoring newer deletions
+            layers = []
+            for seg in self._segments:
+                raw = seg.get_raw(key)
+                if raw is not None:
+                    layers.append(_dec_set(raw))
+            layers.append((set(self._mem.adds.get(key, set())), set(self._mem.dels.get(key, set()))))
+            for adds, dels in layers:  # oldest -> newest
+                out -= dels
+                out |= adds
+            return out
+
+    def map_get(self, key: bytes) -> dict[bytes, bytes]:
+        assert self.strategy == STRATEGY_MAP
+        with self._lock:
+            merged: dict[bytes, Optional[bytes]] = {}
+            for seg in self._segments:
+                raw = seg.get_raw(key)
+                if raw is not None:
+                    merged.update(_dec_map(raw))
+            merged.update(self._mem.data.get(key, {}))
+            return {k: v for k, v in merged.items() if v is not None}
+
+    def roaring_get(self, key: bytes) -> Bitmap:
+        assert self.strategy == STRATEGY_ROARINGSET
+        with self._lock:
+            out = Bitmap()
+            for seg in self._segments:
+                raw = seg.get_raw(key)
+                if raw is not None:
+                    adds, dels = _dec_roaring(raw)
+                    out = out.and_not(dels).or_(adds)
+            madds = self._mem.adds.get(key)
+            mdels = self._mem.dels.get(key)
+            if mdels is not None:
+                out = out.and_not(mdels)
+            if madds is not None:
+                out = out.or_(madds)
+            return out
+
+    def keys(self) -> list[bytes]:
+        """Sorted live keys across memtable + segments."""
+        with self._lock:
+            ks: set[bytes] = set()
+            for seg in self._segments:
+                ks.update(seg.keys)
+            if self.strategy == STRATEGY_REPLACE:
+                for k, v in self._mem.data.items():
+                    ks.add(k)
+                return sorted(k for k in ks if self.get(k) is not None)
+            if self.strategy == STRATEGY_SET:
+                ks.update(self._mem.adds)
+                return sorted(k for k in ks if self.set_get(k))
+            if self.strategy == STRATEGY_MAP:
+                ks.update(self._mem.data)
+                return sorted(k for k in ks if self.map_get(k))
+            ks.update(self._mem.adds)
+            return sorted(k for k in ks if len(self.roaring_get(k)))
+
+    def cursor(self) -> Iterator[tuple[bytes, object]]:
+        """Sorted range scan over live entries (lsmkv cursors)."""
+        getter = {
+            STRATEGY_REPLACE: self.get,
+            STRATEGY_SET: self.set_get,
+            STRATEGY_MAP: self.map_get,
+            STRATEGY_ROARINGSET: self.roaring_get,
+        }[self.strategy]
+        for k in self.keys():
+            yield k, getter(k)
+
+    # -- flush / compaction --------------------------------------------------
+
+    def _maybe_flush(self) -> None:
+        if self._mem.approx_bytes() >= self.memtable_max_bytes:
+            self.flush_memtable()
+
+    def _encode_memtable(self) -> list[tuple[bytes, bytes]]:
+        items: list[tuple[bytes, bytes]] = []
+        if self.strategy == STRATEGY_REPLACE:
+            items = sorted(self._mem.data.items())
+        elif self.strategy == STRATEGY_SET:
+            keys = set(self._mem.adds) | set(self._mem.dels)
+            items = [
+                (k, _enc_set(self._mem.adds.get(k, set()), self._mem.dels.get(k, set())))
+                for k in sorted(keys)
+            ]
+        elif self.strategy == STRATEGY_MAP:
+            items = [(k, _enc_map(m)) for k, m in sorted(self._mem.data.items())]
+        else:
+            keys = set(self._mem.adds) | set(self._mem.dels)
+            items = [
+                (k, _enc_roaring(self._mem.adds.get(k, Bitmap()), self._mem.dels.get(k, Bitmap())))
+                for k in sorted(keys)
+            ]
+        return items
+
+    def flush_memtable(self) -> None:
+        with self._lock:
+            if not len(self._mem):
+                return
+            items = self._encode_memtable()
+            seg_path = os.path.join(self.path, f"{self._seg_counter:08d}.seg")
+            Segment.write(seg_path, self.strategy, items)
+            self._seg_counter += 1
+            self._segments.append(Segment(seg_path))
+            self._mem = self._new_memtable()
+            # truncate WAL
+            self._wal.close()
+            self._wal = open(self._wal_path, "wb")
+            self._wal.write(_WAL_MAGIC)
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def compact(self) -> None:
+        """Merge all segments into one (full compaction)."""
+        with self._lock:
+            if len(self._segments) < 2:
+                return
+            merged: dict[bytes, bytes] = {}
+            if self.strategy == STRATEGY_REPLACE:
+                for seg in self._segments:
+                    merged.update(seg.items_raw())
+                # drop tombstones at full compaction (nothing older remains)
+                items = sorted((k, v) for k, v in merged.items() if v != _TOMBSTONE)
+            elif self.strategy == STRATEGY_SET:
+                acc: dict[bytes, tuple[set, set]] = {}
+                for seg in self._segments:
+                    for k, raw in seg.items_raw():
+                        adds, dels = _dec_set(raw)
+                        cur = acc.get(k, (set(), set()))
+                        cur = (cur[0] - dels | adds, set())  # full merge: net state
+                        acc[k] = cur
+                items = sorted((k, _enc_set(a, d)) for k, (a, d) in acc.items() if a or d)
+            elif self.strategy == STRATEGY_MAP:
+                accm: dict[bytes, dict[bytes, Optional[bytes]]] = {}
+                for seg in self._segments:
+                    for k, raw in seg.items_raw():
+                        accm.setdefault(k, {}).update(_dec_map(raw))
+                items = sorted(
+                    (k, _enc_map({s: v for s, v in m.items() if v is not None}))
+                    for k, m in accm.items()
+                    if any(v is not None for v in m.values())
+                )
+            else:
+                accr: dict[bytes, Bitmap] = {}
+                for seg in self._segments:
+                    for k, raw in seg.items_raw():
+                        adds, dels = _dec_roaring(raw)
+                        accr[k] = accr.get(k, Bitmap()).and_not(dels).or_(adds)
+                items = sorted((k, _enc_roaring(bm, Bitmap())) for k, bm in accr.items() if len(bm))
+            seg_path = os.path.join(self.path, f"{self._seg_counter:08d}.seg")
+            Segment.write(seg_path, self.strategy, items)
+            self._seg_counter += 1
+            old = self._segments
+            self._segments = [Segment(seg_path)]
+            for seg in old:
+                seg.close()
+                os.remove(seg.path)
+                try:
+                    os.remove(seg.path + ".bloom")
+                except FileNotFoundError:
+                    pass
+
+    def flush(self) -> None:
+        with self._lock:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def count(self) -> int:
+        return len(self.keys())
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self.flush_memtable()
+            self._wal.close()
+            for seg in self._segments:
+                seg.close()
+            self._segments = []
+
+    def drop(self) -> None:
+        with self._lock:
+            try:
+                self._wal.close()
+            except Exception:
+                pass
+            for seg in self._segments:
+                seg.close()
+            self._segments = []
+            import shutil
+
+            shutil.rmtree(self.path, ignore_errors=True)
+
+    def list_files(self) -> list[str]:
+        with self._lock:
+            out = [self._wal_path]
+            for seg in self._segments:
+                out.append(seg.path)
+                if os.path.exists(seg.path + ".bloom"):
+                    out.append(seg.path + ".bloom")
+            return out
+
+
+class Store:
+    """Named-bucket container (lsmkv.Store, store.go:111)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._buckets: dict[str, Bucket] = {}
+        self._lock = threading.Lock()
+
+    def create_or_load_bucket(self, name: str, strategy: str, **kw) -> Bucket:
+        with self._lock:
+            b = self._buckets.get(name)
+            if b is None:
+                b = Bucket(os.path.join(self.root, name), strategy, **kw)
+                self._buckets[name] = b
+            elif b.strategy != strategy:
+                raise LsmError(f"bucket {name} exists with strategy {b.strategy}")
+            return b
+
+    def bucket(self, name: str) -> Optional[Bucket]:
+        return self._buckets.get(name)
+
+    def flush_all(self) -> None:
+        for b in list(self._buckets.values()):
+            b.flush()
+
+    def shutdown(self) -> None:
+        for b in list(self._buckets.values()):
+            b.shutdown()
+
+    def drop(self) -> None:
+        for b in list(self._buckets.values()):
+            b.drop()
+        import shutil
+
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    def list_files(self) -> list[str]:
+        out = []
+        for b in self._buckets.values():
+            out.extend(b.list_files())
+        return out
