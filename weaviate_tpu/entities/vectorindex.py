@@ -118,6 +118,8 @@ class HnswUserConfig:
     ivf_nlist: int = 0               # 0 = auto (~sqrt(N) rounded to mult of 8)
     ivf_nprobe: int = 64
     query_batch_window_ms: float = 1.0  # cross-query batching window
+    store_dtype: str = "float32"        # device store dtype: float32 | bfloat16
+    exact_topk: bool = False            # force lax.top_k over approx_min_k
 
     def IndexType(self) -> str:  # discriminator parity (config.go:69-71)
         return self.index_type
@@ -143,6 +145,8 @@ class HnswUserConfig:
             "ivfNlist": self.ivf_nlist,
             "ivfNprobe": self.ivf_nprobe,
             "queryBatchWindowMs": self.query_batch_window_ms,
+            "storeDtype": self.store_dtype,
+            "exactTopK": self.exact_topk,
         }
 
     @classmethod
@@ -166,6 +170,8 @@ class HnswUserConfig:
             ivf_nlist=int(d.get("ivfNlist", 0)),
             ivf_nprobe=int(d.get("ivfNprobe", 64)),
             query_batch_window_ms=float(d.get("queryBatchWindowMs", 1.0)),
+            store_dtype=d.get("storeDtype", "float32"),
+            exact_topk=bool(d.get("exactTopK", False)),
         )
         cfg.validate()
         return cfg
