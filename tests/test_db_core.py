@@ -1,0 +1,254 @@
+"""DB core: Shard / ClassIndex / DB CRUD, batch, vector + BM25 + filtered
+search, persistence across restart, sharding routing.
+
+Mirrors the reference's integration tier (crud_integration_test.go,
+restart_journey_integration_test.go) on real disk, JAX CPU backend.
+"""
+
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster.sharding import ShardingConfig, ShardingState, murmur3_64
+from weaviate_tpu.db import DB
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+
+
+def make_class(name="Article"):
+    return ClassDef(
+        name=name,
+        properties=[
+            Property(name="title", data_type=["text"]),
+            Property(name="wordCount", data_type=["int"]),
+            Property(name="published", data_type=["boolean"]),
+        ],
+        vector_index_type="hnsw_tpu",
+    )
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = DB(str(tmp_path / "data"))
+    yield d
+    d.shutdown()
+
+
+def new_obj(i, dim=8, cls="Article"):
+    rng = np.random.default_rng(i)
+    return StorObj(
+        class_name=cls,
+        uuid=str(uuidlib.UUID(int=i + 1)),
+        properties={"title": f"hello world {i}", "wordCount": i, "published": i % 2 == 0},
+        vector=rng.standard_normal(dim).astype(np.float32),
+    )
+
+
+def test_crud_roundtrip(db):
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    idx = db.add_class(make_class(), cfg)
+    obj = new_obj(1)
+    idx.put_object(obj)
+    got = idx.object_by_uuid(obj.uuid)
+    assert got is not None
+    assert got.properties["title"] == "hello world 1"
+    assert got.vector is not None and got.vector.shape == (8,)
+    assert idx.exists(obj.uuid)
+    assert idx.object_count() == 1
+
+    # update: same uuid, new props; docID must advance, count stays 1
+    old_doc = got.doc_id
+    obj2 = new_obj(1)
+    obj2.properties["title"] = "updated title"
+    idx.put_object(obj2)
+    got2 = idx.object_by_uuid(obj2.uuid)
+    assert got2.properties["title"] == "updated title"
+    assert got2.doc_id > old_doc
+    assert idx.object_count() == 1
+
+    assert idx.delete_object(obj.uuid)
+    assert not idx.exists(obj.uuid)
+    assert idx.object_count() == 0
+    assert not idx.delete_object(obj.uuid)
+
+
+def test_batch_and_vector_search(db):
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    idx = db.add_class(make_class(), cfg)
+    objs = [new_obj(i) for i in range(200)]
+    errs = idx.put_batch(objs)
+    assert all(e is None for e in errs)
+    assert idx.object_count() == 200
+
+    # self-search: each query vector must find its own object first
+    queries = np.stack([objs[i].vector for i in (0, 7, 42)])
+    res = idx.object_vector_search(queries, k=5)
+    assert len(res) == 3
+    for qi, i in enumerate((0, 7, 42)):
+        assert res[qi][0].obj.uuid == objs[i].uuid
+        assert res[qi][0].distance < 1e-3
+
+
+def test_filtered_vector_search(db):
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    idx = db.add_class(make_class(), cfg)
+    idx.put_batch([new_obj(i) for i in range(100)])
+    flt = LocalFilter.from_dict(
+        {"operator": "Equal", "path": ["published"], "valueBoolean": True}
+    )
+    res = idx.object_vector_search(new_obj(3).vector, k=10, flt=flt)
+    assert len(res[0]) == 10
+    for r in res[0]:
+        assert r.obj.properties["published"] is True
+
+    # range filter
+    flt2 = LocalFilter.from_dict(
+        {"operator": "LessThan", "path": ["wordCount"], "valueInt": 5}
+    )
+    res2 = idx.object_vector_search(new_obj(3).vector, k=10, flt=flt2)
+    assert 0 < len(res2[0]) <= 5
+    for r in res2[0]:
+        assert r.obj.properties["wordCount"] < 5
+
+
+def test_bm25_and_filter_only_search(db):
+    cfg = parse_and_validate_config("hnsw_tpu", {})
+    idx = db.add_class(make_class(), cfg)
+    objs = [new_obj(i) for i in range(20)]
+    objs[5].properties["title"] = "quantum computing breakthrough"
+    objs[6].properties["title"] = "quantum supremacy"
+    idx.put_batch(objs)
+
+    hits = idx.object_search(limit=10, keyword_ranking={"query": "quantum"})
+    assert len(hits) == 2
+    assert {h.obj.uuid for h in hits} == {objs[5].uuid, objs[6].uuid}
+    assert all(h.score is not None and h.score > 0 for h in hits)
+
+    listed = idx.object_search(limit=7)
+    assert len(listed) == 7
+
+    flt = LocalFilter.from_dict(
+        {"operator": "Equal", "path": ["published"], "valueBoolean": False}
+    )
+    res = idx.object_search(limit=100, flt=flt)
+    assert len(res) == 10
+    assert all(r.obj.properties["published"] is False for r in res)
+
+
+def test_merge_object(db):
+    cfg = parse_and_validate_config("hnsw_tpu", {})
+    idx = db.add_class(make_class(), cfg)
+    obj = new_obj(9)
+    idx.put_object(obj)
+    idx.merge_object(obj.uuid, {"title": "patched"})
+    got = idx.object_by_uuid(obj.uuid)
+    assert got.properties["title"] == "patched"
+    assert got.properties["wordCount"] == 9  # untouched prop survives
+
+
+def test_delete_by_filter(db):
+    cfg = parse_and_validate_config("hnsw_tpu", {})
+    idx = db.add_class(make_class(), cfg)
+    idx.put_batch([new_obj(i) for i in range(30)])
+    flt = LocalFilter.from_dict(
+        {"operator": "Equal", "path": ["published"], "valueBoolean": True}
+    )
+    dry = idx.delete_by_filter(flt, dry_run=True)
+    assert dry["matches"] == 15
+    assert idx.object_count() == 30
+    res = idx.delete_by_filter(flt)
+    assert res["matches"] == 15
+    assert idx.object_count() == 15
+
+
+def test_restart_journey(tmp_path):
+    """restart_journey_integration_test.go analog: write, shutdown, reopen."""
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    db1 = DB(str(tmp_path / "data"))
+    idx = db1.add_class(make_class(), cfg)
+    objs = [new_obj(i) for i in range(50)]
+    idx.put_batch(objs)
+    idx.delete_object(objs[10].uuid)
+    db1.flush()
+    db1.shutdown()
+
+    db2 = DB(str(tmp_path / "data"))
+    idx2 = db2.add_class(make_class(), cfg)
+    assert idx2.object_count() == 49
+    got = idx2.object_by_uuid(objs[3].uuid)
+    assert got is not None and got.properties["wordCount"] == 3
+    assert idx2.object_by_uuid(objs[10].uuid) is None
+    res = idx2.object_vector_search(objs[3].vector, k=3)
+    assert res[0][0].obj.uuid == objs[3].uuid
+    db2.shutdown()
+
+
+def test_multi_shard_routing_and_search(tmp_path):
+    """Multiple local shards: routing is deterministic, search fans out."""
+    state = ShardingState("Article", ShardingConfig(desired_count=4), ["node-0"])
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    db = DB(str(tmp_path / "data"))
+    idx = db.add_class(make_class(), cfg, sharding_state=state)
+    assert len(idx.shards) == 4
+    objs = [new_obj(i) for i in range(120)]
+    idx.put_batch(objs)
+    per_shard = [s.object_count() for s in idx.shards.values()]
+    assert sum(per_shard) == 120
+    assert all(c > 0 for c in per_shard)  # murmur3 spreads over all shards
+
+    res = idx.object_vector_search(objs[17].vector, k=5)
+    assert res[0][0].obj.uuid == objs[17].uuid
+
+    hits = idx.object_search(limit=200)
+    assert len(hits) == 120
+    db.shutdown()
+
+
+def test_murmur3_kat():
+    """Known-answer vectors for murmur3 x64_128 (first 64 bits)."""
+    # values computed from the canonical C++ MurmurHash3_x64_128
+    assert murmur3_64(b"") == 0
+    assert murmur3_64(b"hello") == 0xCBD8A7B341BD9B02
+    assert murmur3_64(b"hello, world") == 0x342FAC623A5EBC8E
+    assert murmur3_64(b"The quick brown fox jumps over the lazy dog") == 0xE34BBC7BBC071B6C
+
+
+def test_geo_filter(db):
+    cls = ClassDef(
+        name="Place",
+        properties=[
+            Property(name="name", data_type=["text"]),
+            Property(name="location", data_type=["geoCoordinates"]),
+        ],
+    )
+    cfg = parse_and_validate_config("hnsw_tpu", {})
+    idx = db.add_class(cls, cfg)
+    places = [
+        ("berlin", 52.52, 13.405),
+        ("potsdam", 52.39, 13.065),
+        ("munich", 48.137, 11.575),
+    ]
+    for i, (name, lat, lon) in enumerate(places):
+        idx.put_object(
+            StorObj(
+                class_name="Place",
+                uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"name": name, "location": {"latitude": lat, "longitude": lon}},
+            )
+        )
+    flt = LocalFilter.from_dict(
+        {
+            "operator": "WithinGeoRange",
+            "path": ["location"],
+            "valueGeoRange": {
+                "geoCoordinates": {"latitude": 52.52, "longitude": 13.405},
+                "distance": {"max": 40_000},
+            },
+        }
+    )
+    res = idx.object_search(limit=10, flt=flt)
+    names = {r.obj.properties["name"] for r in res}
+    assert names == {"berlin", "potsdam"}

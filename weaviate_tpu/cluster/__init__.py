@@ -1,0 +1,10 @@
+"""Distribution: sharding state, membership, cluster API, replication.
+
+Reference: usecases/sharding (virtual-shard ring), usecases/cluster
+(membership + schema 2PC), usecases/replica (per-op 2PC), and
+adapters/handlers/rest/clusterapi (internal node-to-node HTTP).
+"""
+
+from weaviate_tpu.cluster.sharding import ShardingState, ShardingConfig
+
+__all__ = ["ShardingState", "ShardingConfig"]

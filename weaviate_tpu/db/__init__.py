@@ -1,0 +1,12 @@
+"""DB core: per-node database of class indexes, shards, and searches.
+
+Reference: adapters/repos/db — db.DB (repo.go) -> Index per class (index.go)
+-> Shard (shard.go), the smallest complete unit: LSM object store + docID
+counter + inverted index + vector index.
+"""
+
+from weaviate_tpu.db.db import DB
+from weaviate_tpu.db.class_index import ClassIndex
+from weaviate_tpu.db.shard import Shard, SearchResult
+
+__all__ = ["DB", "ClassIndex", "Shard", "SearchResult"]

@@ -1,0 +1,289 @@
+"""ClassIndex: one logical index per class, scatter-gather over shards.
+
+Reference: adapters/repos/db/index.go — holds the class's shards, routes
+single-object ops by the sharding ring (PhysicalShard of the uuid), fans
+searches out over all shards (errgroup fan-out index.go:967) and merges by
+distance (index.go:1040). The `Incoming*` twins (clusterapi entry points for
+remote shards) are exposed as the same methods here; the remote transport
+(weaviate_tpu.cluster) calls them on the owning node.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid as uuidlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.cluster.sharding import ShardingConfig, ShardingState
+from weaviate_tpu.db.shard import SearchResult, Shard
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.schema import ClassDef
+from weaviate_tpu.entities.storobj import StorObj
+
+
+class ClassIndex:
+    def __init__(
+        self,
+        class_def: ClassDef,
+        vector_config,
+        root_path: str,
+        sharding_state: Optional[ShardingState] = None,
+        node_name: str = "node-0",
+        remote_client=None,
+        metrics=None,
+        invert_cfg: Optional[dict] = None,
+    ):
+        self.class_def = class_def
+        self.class_name = class_def.name
+        self.vector_config = vector_config
+        self.path = os.path.join(root_path, class_def.name.lower())
+        self.node_name = node_name
+        self.remote = remote_client  # cluster transport for non-local shards
+        self.metrics = metrics
+        self.invert_cfg = invert_cfg
+        self.sharding_state = sharding_state or ShardingState(
+            class_def.name, ShardingConfig(desired_count=1), [node_name]
+        )
+        self.shards: dict[str, Shard] = {}
+        self._lock = threading.RLock()
+        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix=f"idx-{self.class_name}")
+        for name in self.sharding_state.all_physical_shards():
+            if self.sharding_state.is_local(name, node_name):
+                self._load_shard(name)
+
+    def _load_shard(self, name: str) -> Shard:
+        s = Shard(
+            name,
+            os.path.join(self.path, name),
+            self.class_def,
+            self.vector_config,
+            metrics=self.metrics,
+            invert_cfg=self.invert_cfg,
+        )
+        self.shards[name] = s
+        return s
+
+    # -- routing -------------------------------------------------------------
+
+    def shard_for(self, uuid: str) -> str:
+        return self.sharding_state.physical_shard(uuidlib.UUID(uuid).bytes)
+
+    def _local_shard(self, name: str) -> Optional[Shard]:
+        return self.shards.get(name)
+
+    def _group_by_shard(self, uuids: Sequence[str]) -> dict[str, list[int]]:
+        groups: dict[str, list[int]] = {}
+        for i, u in enumerate(uuids):
+            groups.setdefault(self.shard_for(u), []).append(i)
+        return groups
+
+    # -- single-object ops (index.go putObject / objectByID / deleteObject) --
+
+    def put_object(self, obj: StorObj) -> StorObj:
+        name = self.shard_for(obj.uuid)
+        shard = self._local_shard(name)
+        if shard is not None:
+            return shard.put_object(obj)
+        return self.remote.put_object(self.class_name, name, obj)
+
+    def object_by_uuid(self, uuid: str, include_vector: bool = True) -> Optional[StorObj]:
+        name = self.shard_for(uuid)
+        shard = self._local_shard(name)
+        if shard is not None:
+            return shard.object_by_uuid(uuid, include_vector)
+        return self.remote.get_object(self.class_name, name, uuid, include_vector)
+
+    def exists(self, uuid: str) -> bool:
+        name = self.shard_for(uuid)
+        shard = self._local_shard(name)
+        if shard is not None:
+            return shard.exists(uuid)
+        return self.remote.exists(self.class_name, name, uuid)
+
+    def delete_object(self, uuid: str) -> bool:
+        name = self.shard_for(uuid)
+        shard = self._local_shard(name)
+        if shard is not None:
+            return shard.delete_object(uuid)
+        return self.remote.delete_object(self.class_name, name, uuid)
+
+    def merge_object(self, uuid: str, props: dict, vector=None) -> Optional[StorObj]:
+        name = self.shard_for(uuid)
+        shard = self._local_shard(name)
+        if shard is not None:
+            return shard.merge_object(uuid, props, vector)
+        return self.remote.merge_object(self.class_name, name, uuid, props, vector)
+
+    # -- batch (index.go:424 putObjectBatch, groups by PhysicalShard) --------
+
+    def put_batch(self, objs: Sequence[StorObj]) -> list[Optional[Exception]]:
+        groups = self._group_by_shard([o.uuid for o in objs])
+        errs: list[Optional[Exception]] = [None] * len(objs)
+
+        def run(name: str, idxs: list[int]):
+            batch = [objs[i] for i in idxs]
+            shard = self._local_shard(name)
+            if shard is not None:
+                sub = shard.put_batch(batch)
+            else:
+                sub = self.remote.put_batch(self.class_name, name, batch)
+            for i, e in zip(idxs, sub):
+                errs[i] = e
+
+        futs = [self._pool.submit(run, n, idxs) for n, idxs in groups.items()]
+        for f in futs:
+            f.result()
+        return errs
+
+    def delete_by_filter(self, flt: Optional[LocalFilter], dry_run: bool = False) -> dict:
+        """Batch delete (batch delete-by-filter REST op): -> per-uuid results."""
+        results = []
+        for name, shard in self.shards.items():
+            for u in shard.find_uuids(flt):
+                if dry_run:
+                    results.append({"id": u, "status": "DRYRUN"})
+                else:
+                    ok = shard.delete_object(u)
+                    results.append({"id": u, "status": "SUCCESS" if ok else "FAILED"})
+        if self.remote is not None:
+            for name in self.sharding_state.all_physical_shards():
+                if self._local_shard(name) is None:
+                    results.extend(
+                        self.remote.delete_by_filter(self.class_name, name, flt, dry_run)
+                    )
+        return {"matches": len(results), "objects": results}
+
+    # -- search (index.go:967 objectVectorSearch fan-out + merge) ------------
+
+    def _all_shard_targets(self):
+        """-> [(name, local_shard_or_None)] for every physical shard."""
+        out = []
+        for name in self.sharding_state.all_physical_shards():
+            out.append((name, self._local_shard(name)))
+        return out
+
+    def object_vector_search(
+        self,
+        vectors: np.ndarray,
+        k: int,
+        flt: Optional[LocalFilter] = None,
+        target_distance: Optional[float] = None,
+        include_vector: bool = False,
+    ) -> list[list[SearchResult]]:
+        """Batched scatter-gather: every shard scores the whole query batch in
+        one device dispatch; per-query merge-sort by distance, truncate to k."""
+        q = np.asarray(vectors, dtype=np.float32)
+        single = q.ndim == 1
+        if single:
+            q = q[None, :]
+        b = q.shape[0]
+        targets = self._all_shard_targets()
+
+        def run(name, shard):
+            if shard is not None:
+                return shard.object_vector_search(
+                    q, k, flt, target_distance, include_vector
+                )
+            return self.remote.search_shard(
+                self.class_name, name, q, k, flt, target_distance, include_vector
+            )
+
+        if len(targets) == 1:
+            all_results = [run(*targets[0])]
+        else:
+            futs = [self._pool.submit(run, n, s) for n, s in targets]
+            all_results = [f.result() for f in futs]
+
+        merged: list[list[SearchResult]] = []
+        for qi in range(b):
+            rows: list[SearchResult] = []
+            for shard_res in all_results:
+                rows.extend(shard_res[qi])
+            rows.sort(key=lambda r: (r.distance if r.distance is not None else np.inf))
+            merged.append(rows[:k])
+        return merged
+
+    def object_search(
+        self,
+        limit: int,
+        flt: Optional[LocalFilter] = None,
+        keyword_ranking: Optional[dict] = None,
+        offset: int = 0,
+        include_vector: bool = False,
+        cursor_after: Optional[str] = None,
+    ) -> list[SearchResult]:
+        targets = self._all_shard_targets()
+
+        def run(name, shard):
+            if shard is not None:
+                return shard.object_search(
+                    limit + offset, flt, keyword_ranking, 0, include_vector, cursor_after
+                )
+            return self.remote.search_shard_objects(
+                self.class_name, name, limit + offset, flt, keyword_ranking,
+                include_vector, cursor_after,
+            )
+
+        if len(targets) == 1:
+            rows = run(*targets[0])
+        else:
+            futs = [self._pool.submit(run, n, s) for n, s in targets]
+            rows = [r for f in futs for r in f.result()]
+        if keyword_ranking:
+            rows.sort(key=lambda r: -(r.score or 0.0))
+        elif cursor_after is not None:
+            rows.sort(key=lambda r: r.obj.uuid)
+        return rows[offset : offset + limit]
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def object_count(self) -> int:
+        total = sum(s.object_count() for s in self.shards.values())
+        if self.remote is not None:
+            for name in self.sharding_state.all_physical_shards():
+                if self._local_shard(name) is None:
+                    total += self.remote.object_count(self.class_name, name)
+        return total
+
+    def update_schema(self, class_def: ClassDef) -> None:
+        with self._lock:
+            self.class_def = class_def
+            for s in self.shards.values():
+                s.update_schema(class_def)
+
+    def update_vector_config(self, cfg) -> None:
+        with self._lock:
+            for s in self.shards.values():
+                s.update_vector_config(cfg)
+            self.vector_config = cfg
+
+    def shards_status(self) -> list[dict]:
+        return [
+            {"name": n, "status": s.status, "objectCount": s.object_count()}
+            for n, s in sorted(self.shards.items())
+        ]
+
+    def flush(self) -> None:
+        for s in self.shards.values():
+            s.flush()
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+        for s in self.shards.values():
+            s.shutdown()
+
+    def drop(self) -> None:
+        self._pool.shutdown(wait=False)
+        for s in self.shards.values():
+            s.drop()
+        import shutil
+
+        shutil.rmtree(self.path, ignore_errors=True)
+
+    def post_startup(self) -> None:
+        for s in self.shards.values():
+            s.post_startup()

@@ -1,0 +1,109 @@
+"""DB: the per-node database root — class name -> ClassIndex.
+
+Reference: adapters/repos/db/repo.go (db.DB) + migrator.go (schema-change ->
+storage ops). The reference's central batch job queue + worker pool
+(repo.go:110-117) has no analog here because the TPU write path is already
+batch-first (vectors land as one device write per chunk, not one job per
+vector).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from weaviate_tpu.cluster.sharding import ShardingConfig, ShardingState
+from weaviate_tpu.db.class_index import ClassIndex
+from weaviate_tpu.entities.schema import ClassDef
+
+
+class DB:
+    def __init__(
+        self,
+        root_path: str,
+        node_name: str = "node-0",
+        remote_client=None,
+        metrics=None,
+        node_names: Optional[list[str]] = None,
+    ):
+        self.root_path = root_path
+        self.node_name = node_name
+        self.node_names = node_names or [node_name]
+        self.remote = remote_client
+        self.metrics = metrics
+        self.indexes: dict[str, ClassIndex] = {}
+        self._lock = threading.RLock()
+        os.makedirs(root_path, exist_ok=True)
+
+    # -- migrator (migrator.go) ----------------------------------------------
+
+    def add_class(
+        self,
+        class_def: ClassDef,
+        vector_config,
+        sharding_state: Optional[ShardingState] = None,
+    ) -> ClassIndex:
+        with self._lock:
+            if class_def.name in self.indexes:
+                return self.indexes[class_def.name]
+            if sharding_state is None:
+                cfg = ShardingConfig.from_dict(
+                    getattr(class_def, "sharding_config", None), len(self.node_names)
+                )
+                sharding_state = ShardingState(class_def.name, cfg, self.node_names)
+            idx = ClassIndex(
+                class_def,
+                vector_config,
+                self.root_path,
+                sharding_state=sharding_state,
+                node_name=self.node_name,
+                remote_client=self.remote,
+                metrics=self.metrics,
+                invert_cfg=getattr(class_def, "inverted_index_config", None),
+            )
+            self.indexes[class_def.name] = idx
+            return idx
+
+    def drop_class(self, class_name: str) -> None:
+        with self._lock:
+            idx = self.indexes.pop(class_name, None)
+            if idx is not None:
+                idx.drop()
+
+    def update_class(self, class_def: ClassDef) -> None:
+        idx = self.indexes.get(class_def.name)
+        if idx is not None:
+            idx.update_schema(class_def)
+
+    def update_vector_config(self, class_name: str, cfg) -> None:
+        idx = self.indexes.get(class_name)
+        if idx is not None:
+            idx.update_vector_config(cfg)
+
+    # -- access --------------------------------------------------------------
+
+    def get_index(self, class_name: str) -> Optional[ClassIndex]:
+        return self.indexes.get(class_name)
+
+    def object_by_uuid_any_class(self, uuid: str, include_vector: bool = True):
+        """Cross-class lookup (legacy /v1/objects/{id} without class)."""
+        for idx in self.indexes.values():
+            obj = idx.object_by_uuid(uuid, include_vector)
+            if obj is not None:
+                return obj, idx
+        return None, None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self) -> None:
+        for idx in list(self.indexes.values()):
+            idx.flush()
+
+    def shutdown(self) -> None:
+        for idx in list(self.indexes.values()):
+            idx.shutdown()
+
+    def post_startup(self) -> None:
+        for idx in list(self.indexes.values()):
+            idx.post_startup()

@@ -1,0 +1,441 @@
+"""Shard: the smallest complete storage unit.
+
+Reference: adapters/repos/db/shard.go — one shard = LSM store + indexcounter
+(docID allocator) + inverted index + vector index (+ per-geo-prop indexes),
+with the read path of shard_read.go (objectVectorSearch: filters ->
+buildAllowList -> vectorIndex.SearchByVector -> hydrate winners) and the
+write path of shard_write_put.go / shard_write_batch_objects.go.
+
+TPU-first deltas from the reference:
+- the vector write path is batch-first: a batch import stages host-side and
+  lands on the device as fixed-size chunked writes (one compiled shape),
+  instead of the reference's goroutine-pool of single-vector inserts
+  (shard_write_batch_objects.go:220);
+- the read path is batched end-to-end: N concurrent queries ride ONE device
+  dispatch ([B, N] distance block + masked top-k).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.entities.filters import GeoRange, LocalFilter
+from weaviate_tpu.entities.schema import ClassDef, DataType
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.index import new_vector_index
+from weaviate_tpu.inverted.bm25 import BM25Searcher
+from weaviate_tpu.inverted.index import InvertedIndex
+from weaviate_tpu.inverted.searcher import FilterSearcher
+from weaviate_tpu.storage.bitmap import Bitmap
+from weaviate_tpu.storage.docid import Counter
+from weaviate_tpu.storage.lsm import STRATEGY_REPLACE, Store
+
+# shard status (entities/storagestate)
+STATUS_READY = "READY"
+STATUS_READONLY = "READONLY"
+
+
+class ShardReadOnlyError(RuntimeError):
+    pass
+
+
+@dataclass
+class SearchResult:
+    """One search hit: the object + additional result props
+    (the reference's search.Result / _additional map)."""
+
+    obj: StorObj
+    distance: Optional[float] = None
+    certainty: Optional[float] = None
+    score: Optional[float] = None
+    explain_score: Optional[str] = None
+    shard: str = ""
+    additional: dict = field(default_factory=dict)
+
+
+def _uuid_bytes(u: str) -> bytes:
+    return uuidlib.UUID(u).bytes
+
+
+class Shard:
+    def __init__(
+        self,
+        name: str,
+        path: str,
+        class_def: ClassDef,
+        vector_config,
+        metrics=None,
+        invert_cfg: Optional[dict] = None,
+    ):
+        self.name = name
+        self.path = path
+        self.class_def = class_def
+        self.metrics = metrics
+        os.makedirs(path, exist_ok=True)
+        self.store = Store(os.path.join(path, "lsm"))
+        # objects bucket keyed by uuid bytes; docid bucket docID -> uuid bytes
+        # (reference: helpers.ObjectsBucketLSM + docid lookup)
+        self.objects = self.store.create_or_load_bucket("objects", STRATEGY_REPLACE)
+        self.docid_lookup = self.store.create_or_load_bucket("docid_lookup", STRATEGY_REPLACE)
+        self.counter = Counter(os.path.join(path, "indexcount"))
+        self.invert_cfg = invert_cfg
+        self.inverted = InvertedIndex(self.store, class_def)
+        self.vector_index = new_vector_index(vector_config, path, name, metrics=metrics)
+        self._geo_indexes: dict[str, object] = {}
+        self._init_geo_indexes()
+        self.searcher = FilterSearcher(
+            self.inverted, class_def, geo_search=self._geo_search
+        )
+        self.bm25 = BM25Searcher(self.inverted, class_def, invert_cfg)
+        self.status = STATUS_READY
+        self._lock = threading.RLock()
+
+    # -- geo props (propertyspecific/ + vector/geo) --------------------------
+
+    def _init_geo_indexes(self) -> None:
+        for prop in self.class_def.properties:
+            pt = prop.primitive_type()
+            if pt is not None and pt.base is DataType.GEO_COORDINATES:
+                if prop.name in self._geo_indexes:
+                    continue  # keep the live instance (open handle + buffer)
+                from weaviate_tpu.index.geo import GeoIndex
+
+                self._geo_indexes[prop.name] = GeoIndex(
+                    os.path.join(self.path, f"geo.{prop.name}")
+                )
+
+    def _geo_search(self, prop_name: str, geo: GeoRange) -> Bitmap:
+        idx = self._geo_indexes.get(prop_name)
+        if idx is None:
+            return Bitmap()
+        return idx.within_range(geo.latitude, geo.longitude, geo.distance_max)
+
+    # -- schema migration ----------------------------------------------------
+
+    def update_schema(self, class_def: ClassDef) -> None:
+        with self._lock:
+            self.class_def = class_def
+            self.inverted.update_schema(class_def)
+            self._init_geo_indexes()
+            self.searcher = FilterSearcher(self.inverted, class_def, geo_search=self._geo_search)
+            self.bm25 = BM25Searcher(self.inverted, class_def, self.invert_cfg)
+
+    def update_vector_config(self, cfg) -> None:
+        self.vector_index.update_user_config(cfg)
+
+    # -- status (entities/storagestate, shard_status.go) ---------------------
+
+    def set_status(self, status: str) -> None:
+        self.status = status
+
+    def _check_writable(self) -> None:
+        if self.status == STATUS_READONLY:
+            raise ShardReadOnlyError(f"shard {self.name} is read-only")
+
+    # -- write path ----------------------------------------------------------
+
+    def put_object(self, obj: StorObj) -> StorObj:
+        """Upsert (shard_write_put.go:putObject): allocate a fresh docID,
+        clean up the previous version's inverted/vector entries, write LSM
+        object + lookup, update inverted + geo + vector index."""
+        with self._lock:
+            self._check_writable()
+            key = _uuid_bytes(obj.uuid)
+            prev_raw = self.objects.get(key)
+            if prev_raw is not None:
+                prev = StorObj.from_binary(prev_raw)
+                obj.creation_time_unix = prev.creation_time_unix
+                obj.last_update_time_unix = int(time.time() * 1000)
+                self._cleanup_previous(prev)
+            doc_id = self.counter.get_and_inc()
+            obj.doc_id = doc_id
+            self.objects.put(key, obj.to_binary())
+            self.docid_lookup.put(struct.pack("<Q", doc_id), key)
+            self.inverted.add_object(doc_id, obj.properties)
+            self._geo_add(doc_id, obj.properties)
+            if obj.vector is not None:
+                self.vector_index.add(doc_id, obj.vector)
+            return obj
+
+    def _cleanup_previous(self, prev: StorObj) -> None:
+        self.inverted.delete_object(prev.doc_id, prev.properties)
+        self._geo_delete(prev.doc_id, prev.properties)
+        self.docid_lookup.delete(struct.pack("<Q", prev.doc_id))
+        self.vector_index.delete(prev.doc_id)
+
+    def _geo_add(self, doc_id: int, props: dict) -> None:
+        for name, idx in self._geo_indexes.items():
+            v = props.get(name)
+            if isinstance(v, dict) and "latitude" in v and "longitude" in v:
+                idx.add(doc_id, float(v["latitude"]), float(v["longitude"]))
+
+    def _geo_delete(self, doc_id: int, props: dict) -> None:
+        for name, idx in self._geo_indexes.items():
+            if isinstance(props.get(name), dict):
+                idx.delete(doc_id)
+
+    def put_batch(self, objs: Sequence[StorObj]) -> list[Optional[Exception]]:
+        """Batch import (shard_write_batch_objects.go): LSM + inverted per
+        object host-side, vectors land on the device as ONE batched add."""
+        with self._lock:
+            self._check_writable()
+            errs: list[Optional[Exception]] = [None] * len(objs)
+            fresh_ids: list[int] = []
+            fresh_vecs: list[np.ndarray] = []
+            dim: Optional[int] = None
+            for i, obj in enumerate(objs):
+                try:
+                    key = _uuid_bytes(obj.uuid)
+                    prev_raw = self.objects.get(key)
+                    if prev_raw is not None:
+                        prev = StorObj.from_binary(prev_raw)
+                        obj.creation_time_unix = prev.creation_time_unix
+                        obj.last_update_time_unix = int(time.time() * 1000)
+                        self._cleanup_previous(prev)
+                    doc_id = self.counter.get_and_inc()
+                    obj.doc_id = doc_id
+                    self.objects.put(key, obj.to_binary())
+                    self.docid_lookup.put(struct.pack("<Q", doc_id), key)
+                    self.inverted.add_object(doc_id, obj.properties)
+                    self._geo_add(doc_id, obj.properties)
+                    if obj.vector is not None:
+                        if dim is None:
+                            dim = int(np.asarray(obj.vector).shape[0])
+                        if int(np.asarray(obj.vector).shape[0]) == dim:
+                            fresh_ids.append(doc_id)
+                            fresh_vecs.append(np.asarray(obj.vector, dtype=np.float32))
+                        else:
+                            self.vector_index.add(doc_id, obj.vector)
+                except Exception as e:  # per-object error isolation (batch semantics)
+                    errs[i] = e
+            if fresh_ids:
+                try:
+                    self.vector_index.add_batch(fresh_ids, np.stack(fresh_vecs))
+                except Exception:
+                    # keep per-object error isolation: retry row-by-row so one
+                    # bad vector doesn't fail the whole batch post-LSM-write
+                    by_doc = {o.doc_id: i for i, o in enumerate(objs)}
+                    for d, v in zip(fresh_ids, fresh_vecs):
+                        try:
+                            self.vector_index.add(d, v)
+                        except Exception as e:
+                            errs[by_doc[d]] = e
+            return errs
+
+    def delete_object(self, uuid: str) -> bool:
+        with self._lock:
+            self._check_writable()
+            key = _uuid_bytes(uuid)
+            raw = self.objects.get(key)
+            if raw is None:
+                return False
+            prev = StorObj.from_binary(raw)
+            self._cleanup_previous(prev)
+            self.objects.delete(key)
+            return True
+
+    def merge_object(self, uuid: str, props: dict, vector=None) -> Optional[StorObj]:
+        """PATCH semantics (objects.Manager.MergeObject): shallow-merge props."""
+        with self._lock:
+            raw = self.objects.get(_uuid_bytes(uuid))
+            if raw is None:
+                return None
+            obj = StorObj.from_binary(raw)
+            merged = dict(obj.properties)
+            merged.update(props)
+            obj.properties = merged
+            if vector is not None:
+                obj.vector = np.asarray(vector, dtype=np.float32)
+            return self.put_object(obj)
+
+    # -- read path -----------------------------------------------------------
+
+    def object_by_uuid(self, uuid: str, include_vector: bool = True) -> Optional[StorObj]:
+        raw = self.objects.get(_uuid_bytes(uuid))
+        return StorObj.from_binary(raw, include_vector) if raw is not None else None
+
+    def multi_get(self, uuids: Sequence[str], include_vector: bool = False) -> list[Optional[StorObj]]:
+        return [self.object_by_uuid(u, include_vector) for u in uuids]
+
+    def exists(self, uuid: str) -> bool:
+        return self.objects.get(_uuid_bytes(uuid)) is not None
+
+    def object_count(self) -> int:
+        return self.inverted.doc_count()
+
+    def vector_count(self) -> int:
+        return len(self.vector_index)
+
+    def objects_by_doc_ids(
+        self, doc_ids: Sequence[int], include_vector: bool = False
+    ) -> list[Optional[StorObj]]:
+        """Hydrate winners (storobj.ObjectsByDocID, storage_object.go:211)."""
+        out: list[Optional[StorObj]] = []
+        for d in doc_ids:
+            key = self.docid_lookup.get(struct.pack("<Q", int(d)))
+            if key is None:
+                out.append(None)
+                continue
+            raw = self.objects.get(key)
+            out.append(StorObj.from_binary(raw, include_vector) if raw is not None else None)
+        return out
+
+    def build_allow_list(self, flt: Optional[LocalFilter]) -> Optional[Bitmap]:
+        """filters -> allowList (shard_read.go:377 buildAllowList)."""
+        if flt is None:
+            return None
+        return self.searcher.doc_ids(flt)
+
+    def object_vector_search(
+        self,
+        vectors: np.ndarray,
+        k: int,
+        flt: Optional[LocalFilter] = None,
+        target_distance: Optional[float] = None,
+        include_vector: bool = False,
+    ) -> list[list[SearchResult]]:
+        """Batched vector search (shard_read.go:223 objectVectorSearch),
+        [B, D] queries in one device dispatch -> per-query hydrated results."""
+        allow = self.build_allow_list(flt)
+        if allow is not None and len(allow) == 0:
+            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
+            return [[] for _ in range(b)]
+        q = np.asarray(vectors, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if target_distance is not None:
+            out: list[list[SearchResult]] = []
+            for row in q:
+                ids, dists = self.vector_index.search_by_vector_distance(
+                    row, target_distance, max_limit=k, allow_list=allow
+                )
+                out.append(self._hydrate(ids, dists, include_vector))
+            return out
+        ids, dists = self.vector_index.search_by_vectors(q, k, allow)
+        return [
+            self._hydrate(ids[i], dists[i], include_vector) for i in range(ids.shape[0])
+        ]
+
+    def _hydrate(self, ids, dists, include_vector: bool) -> list[SearchResult]:
+        valid = ~np.isinf(np.asarray(dists, dtype=np.float32))
+        ids = np.asarray(ids)[valid]
+        dists = np.asarray(dists)[valid]
+        objs = self.objects_by_doc_ids([int(i) for i in ids], include_vector)
+        out = []
+        for obj, dist in zip(objs, dists):
+            if obj is None:
+                continue  # deleted between search and hydration
+            out.append(SearchResult(obj=obj, distance=float(dist), shard=self.name))
+        return out
+
+    def object_search(
+        self,
+        limit: int,
+        flt: Optional[LocalFilter] = None,
+        keyword_ranking: Optional[dict] = None,
+        offset: int = 0,
+        include_vector: bool = False,
+        cursor_after: Optional[str] = None,
+    ) -> list[SearchResult]:
+        """BM25 / filter-only / list search (search.go objectSearch)."""
+        if keyword_ranking:
+            allow = self.build_allow_list(flt)
+            hits = self.bm25.search(
+                keyword_ranking.get("query", ""),
+                limit + offset,
+                properties=keyword_ranking.get("properties") or None,
+                allow_list=allow,
+                additional_explanations=keyword_ranking.get("additionalExplanations", False),
+            )
+            hits = hits[offset : offset + limit]
+            objs = self.objects_by_doc_ids([h[0] for h in hits], include_vector)
+            out = []
+            for (doc_id, score, explain), obj in zip(hits, objs):
+                if obj is None:
+                    continue
+                out.append(
+                    SearchResult(
+                        obj=obj,
+                        score=float(score),
+                        explain_score=str(explain) if explain else None,
+                        shard=self.name,
+                    )
+                )
+            return out
+        if flt is not None:
+            bm = self.searcher.doc_ids(flt)
+            doc_ids = bm.to_array()
+        else:
+            doc_ids = self.inverted.all_doc_ids().to_array()
+        if cursor_after is not None:
+            # cursor iteration is by uuid ordering (reference cursor api)
+            return self._list_after(doc_ids, cursor_after, limit, include_vector)
+        take = doc_ids[offset : offset + limit]
+        objs = self.objects_by_doc_ids([int(i) for i in take], include_vector)
+        return [SearchResult(obj=o, shard=self.name) for o in objs if o is not None]
+
+    def _list_after(self, doc_ids, after_uuid: str, limit: int, include_vector: bool):
+        objs = self.objects_by_doc_ids([int(i) for i in doc_ids], include_vector)
+        pairs = sorted((o.uuid, o) for o in objs if o is not None)
+        out = []
+        for u, o in pairs:
+            if after_uuid and u <= after_uuid:
+                continue
+            out.append(SearchResult(obj=o, shard=self.name))
+            if len(out) >= limit:
+                break
+        return out
+
+    def find_doc_ids(self, flt: Optional[LocalFilter]) -> Bitmap:
+        """Doc IDs matching a filter (batch delete-by-filter support)."""
+        if flt is None:
+            return self.inverted.all_doc_ids()
+        return self.searcher.doc_ids(flt)
+
+    def find_uuids(self, flt: Optional[LocalFilter]) -> list[str]:
+        ids = self.find_doc_ids(flt).to_array()
+        objs = self.objects_by_doc_ids([int(i) for i in ids], include_vector=False)
+        return [o.uuid for o in objs if o is not None]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self) -> None:
+        self.store.flush_all()
+        self.vector_index.flush()
+        for g in self._geo_indexes.values():
+            g.flush()
+
+    def shutdown(self) -> None:
+        self.store.shutdown()
+        self.vector_index.shutdown()
+        for g in self._geo_indexes.values():
+            g.shutdown()
+
+    def drop(self) -> None:
+        self.vector_index.drop()
+        for g in self._geo_indexes.values():
+            g.drop()
+        self.store.drop()
+        self.counter.drop()
+        import shutil
+
+        shutil.rmtree(self.path, ignore_errors=True)
+
+    def list_files(self) -> list[str]:
+        """Files to copy for a backup (shard_backup.go ListBackupFiles)."""
+        out = self.store.list_files()
+        out.extend(self.vector_index.list_files())
+        if os.path.exists(self.counter.path):
+            out.append(self.counter.path)
+        return out
+
+    def post_startup(self) -> None:
+        self.vector_index.post_startup()

@@ -187,6 +187,10 @@ class HnswUserConfig:
             raise ConfigValidationError("efConstruction must be >= 4")
         if self.ef != -1 and self.ef < 1:
             raise ConfigValidationError("ef must be -1 (dynamic) or >= 1")
+        if self.store_dtype not in ("float32", "bfloat16"):
+            raise ConfigValidationError(
+                f"storeDtype must be 'float32' or 'bfloat16', got {self.store_dtype!r}"
+            )
         if self.pq.enabled:
             if self.pq.centroids < 1 or self.pq.centroids > 65536:
                 raise ConfigValidationError("pq.centroids must be in [1, 65536]")
