@@ -1,0 +1,276 @@
+"""Schema manager, auto-schema, objects/batch managers, traverser/explorer,
+hybrid fusion (usecases layer tests; reference: usecases/*_test.go with real
+repos instead of fakes — the TPU-sim CPU backend makes that cheap)."""
+
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.db import DB
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.schema import AutoSchema, SchemaManager, SchemaValidationError
+from weaviate_tpu.usecases.objects import BatchManager, NotFoundError, ObjectsManager, ObjectsError
+from weaviate_tpu.usecases.traverser import Explorer, GetParams, Traverser
+
+
+@pytest.fixture
+def stack(tmp_path):
+    db = DB(str(tmp_path / "data"))
+    mgr = SchemaManager(str(tmp_path / "schema.json"), migrator=db)
+    auto = AutoSchema(mgr)
+    om = ObjectsManager(db, mgr, auto_schema=auto)
+    bm = BatchManager(om)
+    explorer = Explorer(db, mgr)
+    trav = Traverser(explorer)
+    yield db, mgr, om, bm, trav
+    db.shutdown()
+
+
+def make_article_class(mgr):
+    return mgr.add_class(
+        {
+            "class": "Article",
+            "properties": [
+                {"name": "title", "dataType": ["text"]},
+                {"name": "wordCount", "dataType": ["int"]},
+            ],
+            "vectorIndexType": "hnsw_tpu",
+            "vectorIndexConfig": {"distance": "l2-squared"},
+        }
+    )
+
+
+def test_schema_ddl_and_persistence(tmp_path, stack):
+    db, mgr, om, bm, trav = stack
+    make_article_class(mgr)
+    assert mgr.get_class("Article") is not None
+    assert db.get_index("Article") is not None
+
+    with pytest.raises(SchemaValidationError):
+        make_article_class(mgr)  # duplicate
+
+    mgr.add_property("Article", {"name": "summary", "dataType": ["text"]})
+    assert mgr.get_class("Article").get_property("summary") is not None
+    with pytest.raises(SchemaValidationError):
+        mgr.add_property("Article", {"name": "summary", "dataType": ["text"]})
+    with pytest.raises(SchemaValidationError):
+        mgr.add_property("Article", {"name": "id", "dataType": ["text"]})
+
+    # reload from disk: schema + indexes rebuilt
+    db2 = DB(str(tmp_path / "data2"))
+    mgr2 = SchemaManager(str(tmp_path / "schema.json"), migrator=db2)
+    assert mgr2.get_class("Article").get_property("summary") is not None
+    assert db2.get_index("Article") is not None
+    db2.shutdown()
+
+    # immutables
+    with pytest.raises(SchemaValidationError):
+        mgr.update_class("Article", {"vectorizer": "text2vec-foo"})
+    mgr.update_class("Article", {"description": "news articles"})
+    assert mgr.get_class("Article").description == "news articles"
+
+    mgr.delete_class("Article")
+    assert mgr.get_class("Article") is None
+    assert db.get_index("Article") is None
+
+
+def test_vector_config_hot_update(stack):
+    db, mgr, om, bm, trav = stack
+    make_article_class(mgr)
+    mgr.update_class("Article", {"vectorIndexConfig": {"distance": "l2-squared", "ef": 256}})
+    with pytest.raises(SchemaValidationError):
+        # distance immutable
+        mgr.update_class("Article", {"vectorIndexConfig": {"distance": "cosine"}})
+
+
+def test_auto_schema_and_objects_crud(stack):
+    db, mgr, om, bm, trav = stack
+    obj = om.add(
+        {
+            "class": "Person",
+            "properties": {"name": "ada", "age": 36, "score": 1.5, "active": True},
+            "vector": [0.1, 0.2, 0.3],
+        }
+    )
+    cd = mgr.get_class("Person")
+    assert cd is not None
+    assert cd.get_property("name").data_type == ["text"]
+    assert cd.get_property("age").data_type == ["int"]
+    assert cd.get_property("score").data_type == ["number"]
+    assert cd.get_property("active").data_type == ["boolean"]
+
+    got = om.get(obj.uuid, "Person", include_vector=True)
+    assert got.properties["name"] == "ada"
+    assert got.vector.shape == (3,)
+
+    om.merge(obj.uuid, "Person", {"name": "ada lovelace"})
+    assert om.get(obj.uuid).properties["name"] == "ada lovelace"
+    assert om.get(obj.uuid).properties["age"] == 36
+
+    om.update(obj.uuid, {"class": "Person", "properties": {"name": "replaced"}, "vector": [1, 0, 0]})
+    got = om.get(obj.uuid)
+    assert got.properties == {"name": "replaced"}
+
+    om.delete(obj.uuid)
+    with pytest.raises(NotFoundError):
+        om.get(obj.uuid)
+
+    with pytest.raises(ObjectsError):
+        om.add({"properties": {"x": 1}})  # no class
+
+
+def test_batch_manager(stack):
+    db, mgr, om, bm, trav = stack
+    make_article_class(mgr)
+    rng = np.random.default_rng(0)
+    payloads = [
+        {
+            "class": "Article",
+            "id": str(uuidlib.UUID(int=i + 1)),
+            "properties": {"title": f"story {i}", "wordCount": i},
+            "vector": rng.standard_normal(8).tolist(),
+        }
+        for i in range(50)
+    ]
+    payloads.append({"class": "Article", "id": "not-a-uuid", "properties": {}})
+    results = bm.add_objects(payloads)
+    assert sum(1 for r in results if r.err is None) == 50
+    assert results[-1].err is not None
+    assert db.get_index("Article").object_count() == 50
+
+    res = bm.delete_objects(
+        "Article", {"operator": "LessThan", "path": ["wordCount"], "valueInt": 10}
+    )
+    assert res["results"]["successful"] == 10
+    assert db.get_index("Article").object_count() == 40
+
+
+def _import_articles(mgr, bm, n=60, dim=8):
+    make_article_class(mgr)
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    payloads = [
+        {
+            "class": "Article",
+            "id": str(uuidlib.UUID(int=i + 1)),
+            "properties": {"title": f"common token{i}", "wordCount": i},
+            "vector": vecs[i].tolist(),
+        }
+        for i in range(n)
+    ]
+    bm.add_objects(payloads)
+    return vecs
+
+
+def test_traverser_near_vector_and_near_object(stack):
+    db, mgr, om, bm, trav = stack
+    vecs = _import_articles(mgr, bm)
+    res = trav.get_class(
+        GetParams(class_name="Article", near_vector={"vector": vecs[5].tolist()}, limit=3)
+    )
+    assert res[0].obj.uuid == str(uuidlib.UUID(int=6))
+    assert res[0].distance < 1e-3
+
+    res2 = trav.get_class(
+        GetParams(
+            class_name="Article",
+            near_object={"id": str(uuidlib.UUID(int=6))},
+            limit=3,
+        )
+    )
+    assert res2[0].obj.uuid == str(uuidlib.UUID(int=6))
+
+    # distance threshold
+    res3 = trav.get_class(
+        GetParams(
+            class_name="Article",
+            near_vector={"vector": vecs[5].tolist(), "distance": 0.5},
+            limit=10,
+        )
+    )
+    assert all(r.distance <= 0.5 for r in res3)
+
+
+def test_traverser_bm25_and_list_and_sort(stack):
+    db, mgr, om, bm, trav = stack
+    _import_articles(mgr, bm)
+    res = trav.get_class(
+        GetParams(class_name="Article", keyword_ranking={"query": "token42"}, limit=5)
+    )
+    assert len(res) == 1 and res[0].obj.properties["wordCount"] == 42
+
+    listed = trav.get_class(GetParams(class_name="Article", limit=10))
+    assert len(listed) == 10
+
+    sorted_res = trav.get_class(
+        GetParams(
+            class_name="Article",
+            limit=100,
+            sort=[{"path": ["wordCount"], "order": "desc"}],
+        )
+    )
+    counts = [r.obj.properties["wordCount"] for r in sorted_res]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_traverser_hybrid(stack):
+    db, mgr, om, bm, trav = stack
+    vecs = _import_articles(mgr, bm)
+    res = trav.get_class(
+        GetParams(
+            class_name="Article",
+            hybrid={"query": "token13", "vector": vecs[13].tolist(), "alpha": 0.5},
+            limit=5,
+        )
+    )
+    assert res[0].obj.uuid == str(uuidlib.UUID(int=14))  # both legs rank it first
+    assert res[0].score is not None and res[0].explain_score
+
+    # pure keyword (alpha=0)
+    res_kw = trav.get_class(
+        GetParams(class_name="Article", hybrid={"query": "token13", "alpha": 0.0}, limit=5)
+    )
+    assert res_kw[0].obj.uuid == str(uuidlib.UUID(int=14))
+
+
+def test_batched_get(stack):
+    db, mgr, om, bm, trav = stack
+    vecs = _import_articles(mgr, bm)
+    params = [
+        GetParams(class_name="Article", near_vector={"vector": vecs[i].tolist()}, limit=2)
+        for i in (3, 9, 27)
+    ]
+    out = trav.get_class_batched(params)
+    assert [r[0].obj.uuid for r in out] == [str(uuidlib.UUID(int=i + 1)) for i in (3, 9, 27)]
+
+
+def test_explore_cross_class(stack):
+    db, mgr, om, bm, trav = stack
+    vecs = _import_articles(mgr, bm)
+    om.add({"class": "Author", "properties": {"name": "bob"}, "vector": vecs[2].tolist()})
+    ex = trav.explorer.explore(near_vector={"vector": vecs[2].tolist()}, limit=4)
+    classes = {e["className"] for e in ex[:2]}
+    assert classes == {"Article", "Author"}  # both classes' exact hits first
+
+
+def test_references(stack):
+    db, mgr, om, bm, trav = stack
+    mgr.add_class({"class": "Author", "properties": [{"name": "name", "dataType": ["text"]}]})
+    mgr.add_class(
+        {
+            "class": "Book",
+            "properties": [
+                {"name": "title", "dataType": ["text"]},
+                {"name": "writtenBy", "dataType": ["Author"]},
+            ],
+        }
+    )
+    a = om.add({"class": "Author", "properties": {"name": "bob"}})
+    b = om.add({"class": "Book", "properties": {"title": "x"}})
+    beacon = f"weaviate://localhost/Author/{a.uuid}"
+    om.add_reference(b.uuid, "Book", "writtenBy", beacon)
+    got = om.get(b.uuid, "Book")
+    assert got.properties["writtenBy"] == [{"beacon": beacon}]
+    om.delete_reference(b.uuid, "Book", "writtenBy", beacon)
+    assert om.get(b.uuid, "Book").properties["writtenBy"] == []

@@ -190,6 +190,7 @@ class Shard:
             errs: list[Optional[Exception]] = [None] * len(objs)
             fresh_ids: list[int] = []
             fresh_vecs: list[np.ndarray] = []
+            staged_pos: dict[int, int] = {}  # doc_id -> index into fresh_*
             dim: Optional[int] = None
             for i, obj in enumerate(objs):
                 try:
@@ -200,6 +201,12 @@ class Shard:
                         obj.creation_time_unix = prev.creation_time_unix
                         obj.last_update_time_unix = int(time.time() * 1000)
                         self._cleanup_previous(prev)
+                        # duplicate uuid within this batch: un-stage the
+                        # earlier version's vector (it was never device-added,
+                        # so vector_index.delete above was a no-op)
+                        pos = staged_pos.pop(prev.doc_id, None)
+                        if pos is not None:
+                            fresh_ids[pos] = -1
                     doc_id = self.counter.get_and_inc()
                     obj.doc_id = doc_id
                     self.objects.put(key, obj.to_binary())
@@ -210,13 +217,17 @@ class Shard:
                         if dim is None:
                             dim = int(np.asarray(obj.vector).shape[0])
                         if int(np.asarray(obj.vector).shape[0]) == dim:
+                            staged_pos[doc_id] = len(fresh_ids)
                             fresh_ids.append(doc_id)
                             fresh_vecs.append(np.asarray(obj.vector, dtype=np.float32))
                         else:
                             self.vector_index.add(doc_id, obj.vector)
                 except Exception as e:  # per-object error isolation (batch semantics)
                     errs[i] = e
-            if fresh_ids:
+            if any(d >= 0 for d in fresh_ids):
+                keep = [j for j, d in enumerate(fresh_ids) if d >= 0]
+                fresh_ids = [fresh_ids[j] for j in keep]
+                fresh_vecs = [fresh_vecs[j] for j in keep]
                 try:
                     self.vector_index.add_batch(fresh_ids, np.stack(fresh_vecs))
                 except Exception:

@@ -43,8 +43,14 @@ class GeoIndex:
         self._log = None
         if persist:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self._replay()
-            new = not os.path.exists(self._log_path)
+            valid_end = self._replay()
+            if os.path.exists(self._log_path):
+                size = os.path.getsize(self._log_path)
+                if valid_end < size:
+                    # truncate a torn tail so future appends stay replayable
+                    with open(self._log_path, "r+b") as f:
+                        f.truncate(max(valid_end, 0))
+            new = not os.path.exists(self._log_path) or os.path.getsize(self._log_path) < 4
             self._log = open(self._log_path, "ab")
             if new:
                 self._log.write(_MAGIC)
@@ -53,13 +59,14 @@ class GeoIndex:
     def _log_path(self) -> str:
         return self.path + ".log"
 
-    def _replay(self) -> None:
+    def _replay(self) -> int:
+        """-> byte offset of the last fully-valid record (for tail truncation)."""
         if not os.path.exists(self._log_path):
-            return
+            return 0
         with open(self._log_path, "rb") as f:
             data = f.read()
         if data[:4] != _MAGIC:
-            return
+            return 0
         off = 4
         while off + 1 <= len(data):
             op = data[off]
@@ -75,6 +82,7 @@ class GeoIndex:
                 off += 9
             else:
                 break  # torn tail
+        return off
 
     def add(self, doc_id: int, lat: float, lon: float) -> None:
         with self._lock:

@@ -1,0 +1,10 @@
+"""Schema subsystem: manager, validation, auto-schema, persistence.
+
+Reference: usecases/schema (manager, 2-phase cluster transactions),
+adapters/repos/schema (BoltDB persistence), usecases/objects/auto_schema.go.
+"""
+
+from weaviate_tpu.schema.manager import SchemaManager, SchemaValidationError
+from weaviate_tpu.schema.auto import AutoSchema
+
+__all__ = ["SchemaManager", "SchemaValidationError", "AutoSchema"]

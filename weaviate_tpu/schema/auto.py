@@ -1,0 +1,82 @@
+"""Auto-schema: infer classes and properties from object payloads.
+
+Reference: usecases/objects/auto_schema.go — when AUTOSCHEMA_ENABLED (default
+true), an import referencing a missing class creates it, and missing
+properties are added with inferred data types (defaults configurable:
+AUTOSCHEMA_DEFAULT_STRING=text, _NUMBER=number, _DATE=date).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Optional
+
+from weaviate_tpu.entities.schema import ClassDef, Property
+
+
+def _looks_like_date(v: str) -> bool:
+    try:
+        datetime.datetime.fromisoformat(v.replace("Z", "+00:00"))
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+class AutoSchema:
+    def __init__(
+        self,
+        manager,
+        enabled: bool = True,
+        default_string: str = "text",
+        default_number: str = "number",
+        default_date: str = "date",
+    ):
+        self.manager = manager
+        self.enabled = enabled
+        self.default_string = default_string
+        self.default_number = default_number
+        self.default_date = default_date
+
+    def infer_type(self, value: Any) -> Optional[str]:
+        if isinstance(value, bool):
+            return "boolean"
+        if isinstance(value, int):
+            return "int"
+        if isinstance(value, float):
+            return self.default_number
+        if isinstance(value, str):
+            return self.default_date if _looks_like_date(value) else self.default_string
+        if isinstance(value, dict):
+            if {"latitude", "longitude"} <= set(value):
+                return "geoCoordinates"
+            if {"input"} <= set(value) or {"internationalFormatted"} <= set(value):
+                return "phoneNumber"
+            return "object"
+        if isinstance(value, list) and value:
+            inner = self.infer_type(value[0])
+            return f"{inner}[]" if inner in ("text", "int", "number", "boolean", "date", "uuid") else inner
+        return None
+
+    def ensure(self, class_name: str, properties: dict) -> str:
+        """Create the class and/or add missing properties as needed.
+        -> resolved class name. Raises if auto-schema disabled and missing."""
+        resolved = self.manager.resolve_class_name(class_name)
+        if resolved is None:
+            if not self.enabled:
+                from weaviate_tpu.schema.manager import SchemaValidationError
+
+                raise SchemaValidationError(f"class {class_name!r} not found")
+            cd = ClassDef(name=class_name[:1].upper() + class_name[1:], properties=[])
+            self.manager.add_class(cd)
+            resolved = cd.name
+        if not self.enabled or not properties:
+            return resolved
+        cd = self.manager.get_class(resolved)
+        for key, value in properties.items():
+            if cd.get_property(key) is not None or value is None:
+                continue
+            dt = self.infer_type(value)
+            if dt is None or dt == "object":
+                continue
+            self.manager.add_property(resolved, Property(name=key, data_type=[dt]))
+        return resolved

@@ -1,0 +1,5 @@
+"""Use-case (business-logic) layer.
+
+Reference: usecases/ — objects.Manager/BatchManager, traverser.Traverser/
+Explorer, hybrid fusion, classification, backup, nodes.
+"""

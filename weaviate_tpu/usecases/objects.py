@@ -1,0 +1,299 @@
+"""Objects manager: single-object CRUD + batch with validation/auto-schema.
+
+Reference: usecases/objects — Manager (add/get/update/merge/delete/validate,
+manager.go) and BatchManager (batch_add.go:29 AddObjects: concurrent
+validation, auto-schema, module vectorization, then repo batch put).
+"""
+
+from __future__ import annotations
+
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.entities.storobj import StorObj
+
+
+class ObjectsError(ValueError):
+    pass
+
+
+class NotFoundError(ObjectsError):
+    pass
+
+
+def _valid_uuid(u: str) -> str:
+    try:
+        return str(uuidlib.UUID(u))
+    except (ValueError, AttributeError, TypeError) as e:
+        raise ObjectsError(f"invalid uuid {u!r}") from e
+
+
+@dataclass
+class BatchResult:
+    """Per-object batch outcome (reference BatchObject with Err)."""
+
+    obj: Optional[StorObj] = None
+    err: Optional[str] = None
+    original: dict = field(default_factory=dict)
+
+
+class ObjectsManager:
+    def __init__(self, db, schema_manager, auto_schema=None, modules=None, metrics=None):
+        self.db = db
+        self.schema = schema_manager
+        self.auto = auto_schema
+        self.modules = modules  # modules provider (vectorize-at-import)
+        self.metrics = metrics
+
+    # -- validation + vectorization ------------------------------------------
+
+    def _prepare(self, payload: dict, require_class: bool = True) -> StorObj:
+        class_name = payload.get("class") or payload.get("class_name")
+        if not class_name:
+            raise ObjectsError("object is missing a class")
+        props = payload.get("properties") or {}
+        if self.auto is not None:
+            class_name = self.auto.ensure(class_name, props)
+        else:
+            resolved = self.schema.resolve_class_name(class_name)
+            if resolved is None:
+                raise ObjectsError(f"class {class_name!r} not found in schema")
+            class_name = resolved
+        cd = self.schema.get_class(class_name)
+        self._validate_props(cd, props)
+        obj_uuid = payload.get("id")
+        obj_uuid = _valid_uuid(obj_uuid) if obj_uuid else str(uuidlib.uuid4())
+        vector = payload.get("vector")
+        obj = StorObj(
+            class_name=class_name,
+            uuid=obj_uuid,
+            properties=props,
+            vector=np.asarray(vector, dtype=np.float32) if vector is not None else None,
+        )
+        if obj.vector is None and self.modules is not None:
+            vec = self.modules.vectorize_object(cd, obj)
+            if vec is not None:
+                obj.vector = np.asarray(vec, dtype=np.float32)
+        return obj
+
+    def _validate_props(self, cd, props: dict) -> None:
+        for key, value in props.items():
+            prop = cd.get_property(key)
+            if prop is None:
+                if self.auto is None:
+                    raise ObjectsError(
+                        f"property {key!r} not in schema of class {cd.name!r}"
+                    )
+                continue
+            pt = prop.primitive_type()
+            if pt is None:
+                # cross-reference: list of beacons
+                if value is not None and not isinstance(value, list):
+                    raise ObjectsError(f"reference property {key!r} must be a list of beacons")
+
+    def _index_or_raise(self, class_name: str):
+        resolved = self.schema.resolve_class_name(class_name)
+        idx = self.db.get_index(resolved) if resolved else None
+        if idx is None:
+            raise NotFoundError(f"class {class_name!r} not found")
+        return idx
+
+    # -- CRUD (usecases/objects/manager.go) ----------------------------------
+
+    def add(self, payload: dict) -> StorObj:
+        obj = self._prepare(payload)
+        idx = self._index_or_raise(obj.class_name)
+        if payload.get("id") and idx.exists(obj.uuid):
+            raise ObjectsError(f"id {obj.uuid!r} already exists")
+        return idx.put_object(obj)
+
+    def get(
+        self, uuid: str, class_name: Optional[str] = None, include_vector: bool = False
+    ) -> StorObj:
+        uuid = _valid_uuid(uuid)
+        if class_name:
+            idx = self._index_or_raise(class_name)
+            obj = idx.object_by_uuid(uuid, include_vector)
+        else:
+            obj, _ = self.db.object_by_uuid_any_class(uuid, include_vector)
+        if obj is None:
+            raise NotFoundError(f"object {uuid} not found")
+        return obj
+
+    def exists(self, uuid: str, class_name: Optional[str] = None) -> bool:
+        uuid = _valid_uuid(uuid)
+        if class_name:
+            resolved = self.schema.resolve_class_name(class_name)
+            idx = self.db.get_index(resolved) if resolved else None
+            return idx.exists(uuid) if idx else False
+        obj, _ = self.db.object_by_uuid_any_class(uuid, include_vector=False)
+        return obj is not None
+
+    def update(self, uuid: str, payload: dict) -> StorObj:
+        """PUT semantics: full replace (keeps creation time via shard upsert)."""
+        uuid = _valid_uuid(uuid)
+        payload = dict(payload)
+        payload["id"] = uuid
+        obj = self._prepare(payload)
+        idx = self._index_or_raise(obj.class_name)
+        if not idx.exists(uuid):
+            raise NotFoundError(f"object {uuid} not found")
+        return idx.put_object(obj)
+
+    def merge(self, uuid: str, class_name: str, props: dict, vector=None) -> StorObj:
+        """PATCH semantics (MergeObject)."""
+        uuid = _valid_uuid(uuid)
+        idx = self._index_or_raise(class_name)
+        cd = self.schema.get_class(idx.class_name)
+        if self.auto is not None:
+            self.auto.ensure(idx.class_name, props)
+        self._validate_props(cd, props)
+        out = idx.merge_object(uuid, props, vector)
+        if out is None:
+            raise NotFoundError(f"object {uuid} not found")
+        return out
+
+    def delete(self, uuid: str, class_name: Optional[str] = None) -> None:
+        uuid = _valid_uuid(uuid)
+        if class_name:
+            idx = self._index_or_raise(class_name)
+            if not idx.delete_object(uuid):
+                raise NotFoundError(f"object {uuid} not found")
+            return
+        obj, idx = self.db.object_by_uuid_any_class(uuid, include_vector=False)
+        if obj is None:
+            raise NotFoundError(f"object {uuid} not found")
+        idx.delete_object(uuid)
+
+    def list_objects(
+        self,
+        class_name: Optional[str] = None,
+        limit: int = 25,
+        offset: int = 0,
+        after: Optional[str] = None,
+        include_vector: bool = False,
+    ) -> list[StorObj]:
+        if class_name:
+            idx = self._index_or_raise(class_name)
+            res = idx.object_search(
+                limit, offset=offset, include_vector=include_vector, cursor_after=after
+            )
+            return [r.obj for r in res]
+        out: list[StorObj] = []
+        for idx in self.db.indexes.values():
+            res = idx.object_search(limit + offset, offset=0, include_vector=include_vector)
+            out.extend(r.obj for r in res)
+        return out[offset : offset + limit]
+
+    def validate(self, payload: dict) -> None:
+        """POST /v1/objects/validate: prepare without writing."""
+        self._prepare(payload)
+
+    # -- references ----------------------------------------------------------
+
+    def add_reference(self, uuid: str, class_name: str, prop: str, beacon: str) -> None:
+        idx = self._index_or_raise(class_name)
+        obj = idx.object_by_uuid(_valid_uuid(uuid), include_vector=True)
+        if obj is None:
+            raise NotFoundError(f"object {uuid} not found")
+        refs = obj.properties.get(prop) or []
+        refs.append({"beacon": beacon})
+        idx.merge_object(obj.uuid, {prop: refs})
+
+    def put_references(self, uuid: str, class_name: str, prop: str, beacons: list[str]) -> None:
+        idx = self._index_or_raise(class_name)
+        if not idx.exists(_valid_uuid(uuid)):
+            raise NotFoundError(f"object {uuid} not found")
+        idx.merge_object(uuid, {prop: [{"beacon": b} for b in beacons]})
+
+    def delete_reference(self, uuid: str, class_name: str, prop: str, beacon: str) -> None:
+        idx = self._index_or_raise(class_name)
+        obj = idx.object_by_uuid(_valid_uuid(uuid), include_vector=True)
+        if obj is None:
+            raise NotFoundError(f"object {uuid} not found")
+        refs = [r for r in (obj.properties.get(prop) or []) if r.get("beacon") != beacon]
+        idx.merge_object(obj.uuid, {prop: refs})
+
+
+class BatchManager:
+    """Batch import (usecases/objects/batch_add.go)."""
+
+    def __init__(self, objects_manager: ObjectsManager):
+        self.om = objects_manager
+
+    def add_objects(self, payloads: Sequence[dict]) -> list[BatchResult]:
+        results = [BatchResult(original=p) for p in payloads]
+        by_class: dict[str, list[int]] = {}
+        for i, p in enumerate(payloads):
+            try:
+                obj = self.om._prepare(p)
+                results[i].obj = obj
+                by_class.setdefault(obj.class_name, []).append(i)
+            except Exception as e:
+                results[i].err = str(e)
+        for class_name, idxs in by_class.items():
+            index = self.om.db.get_index(class_name)
+            if index is None:
+                for i in idxs:
+                    results[i].err = f"class {class_name!r} not found"
+                continue
+            errs = index.put_batch([results[i].obj for i in idxs])
+            for i, e in zip(idxs, errs):
+                if e is not None:
+                    results[i].err = str(e)
+        return results
+
+    def add_references(self, refs: Sequence[dict]) -> list[dict]:
+        """POST /v1/batch/references: [{from: beacon w/ prop, to: beacon}]."""
+        out = []
+        for r in refs:
+            try:
+                frm, to = r.get("from", ""), r.get("to", "")
+                # from format: weaviate://localhost/{Class}/{uuid}/{prop}
+                parts = frm.split("weaviate://")[-1].split("/")
+                if len(parts) < 4:
+                    raise ObjectsError(f"invalid 'from' beacon {frm!r}")
+                _, class_name, uuid, prop = parts[:4]
+                self.om.add_reference(uuid, class_name, prop, to)
+                out.append({"from": frm, "to": to, "result": {"status": "SUCCESS"}})
+            except Exception as e:
+                out.append(
+                    {
+                        "from": r.get("from"),
+                        "to": r.get("to"),
+                        "result": {"status": "FAILED", "errors": {"error": [{"message": str(e)}]}},
+                    }
+                )
+        return out
+
+    def delete_objects(
+        self,
+        class_name: str,
+        where: Optional[dict],
+        dry_run: bool = False,
+        output: str = "minimal",
+    ) -> dict:
+        from weaviate_tpu.entities.filters import LocalFilter
+
+        idx = self.om._index_or_raise(class_name)
+        flt = LocalFilter.from_dict(where) if where else None
+        res = idx.delete_by_filter(flt, dry_run=dry_run)
+        successful = sum(1 for o in res["objects"] if o["status"] == "SUCCESS")
+        failed = sum(1 for o in res["objects"] if o["status"] == "FAILED")
+        out = {
+            "match": {"class": class_name, "where": where},
+            "output": output,
+            "dryRun": dry_run,
+            "results": {
+                "matches": res["matches"],
+                "limit": 10000,
+                "successful": successful,
+                "failed": failed,
+            },
+        }
+        if output == "verbose":
+            out["results"]["objects"] = res["objects"]
+        return out

@@ -1,0 +1,382 @@
+"""Traverser: query orchestration — GetClass, Explore, hybrid, grouping.
+
+Reference: usecases/traverser — Traverser.GetClass (traverser_get.go:23,
+gated by MAXIMUM_CONCURRENT_GET_REQUESTS), Explorer dispatch keyword vs
+vector vs list (explorer.go:108-139), hybrid (explorer.go:227 +
+hybrid/searcher.go), near-params -> vector resolution via modules
+(near_params_vector.go), CrossClassVectorSearch (explorer.go:492), result ->
+map conversion (explorer.go:338), grouper (usecases/traverser/grouper).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.db.shard import SearchResult
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.vectorindex import DISTANCE_COSINE
+from weaviate_tpu.usecases import hybrid as hybrid_mod
+
+
+class TraverserError(ValueError):
+    pass
+
+
+@dataclass
+class GetParams:
+    """traverser.GetParams analog (the full Get arg surface)."""
+
+    class_name: str
+    properties: list[str] = field(default_factory=list)
+    filters: Optional[LocalFilter] = None
+    near_vector: Optional[dict] = None       # {vector, certainty?, distance?}
+    near_object: Optional[dict] = None       # {id|beacon, certainty?, distance?}
+    near_text: Optional[dict] = None         # module-resolved {concepts, ...}
+    keyword_ranking: Optional[dict] = None   # {query, properties?}
+    hybrid: Optional[dict] = None            # {query, alpha?, vector?, fusionType?}
+    sort: list[dict] = field(default_factory=list)  # [{path, order}]
+    group: Optional[dict] = None             # {type: closest|merge, force}
+    group_by: Optional[dict] = None          # {path, groups, objectsPerGroup}
+    limit: int = 25
+    offset: int = 0
+    after: Optional[str] = None
+    additional: dict = field(default_factory=dict)
+    include_vector: bool = False
+    consistency_level: Optional[str] = None
+
+
+class Traverser:
+    """Rate-limited facade (traverser_get.go:23)."""
+
+    def __init__(self, explorer, max_concurrent: int = 0):
+        self.explorer = explorer
+        self._gate = threading.Semaphore(max_concurrent) if max_concurrent > 0 else None
+
+    def get_class(self, params: GetParams) -> list[SearchResult]:
+        if self._gate is not None:
+            with self._gate:
+                return self.explorer.get_class(params)
+        return self.explorer.get_class(params)
+
+    def get_class_batched(self, params_list: Sequence[GetParams]) -> list[list[SearchResult]]:
+        """Cross-query batched entry (TPU extension): nearVector queries of
+        the same class ride one device dispatch."""
+        return self.explorer.get_class_batched(params_list)
+
+
+class Explorer:
+    def __init__(self, db, schema_manager, modules=None, query_limit: int = 25, max_results: int = 10000):
+        self.db = db
+        self.schema = schema_manager
+        self.modules = modules
+        self.query_limit = query_limit
+        self.max_results = max_results
+
+    # -- vector resolution (near_params_vector.go) ---------------------------
+
+    def _resolve_vector(self, params: GetParams, idx) -> Optional[np.ndarray]:
+        nv = params.near_vector
+        if nv is not None and nv.get("vector") is not None:
+            return np.asarray(nv["vector"], dtype=np.float32)
+        no = params.near_object
+        if no is not None:
+            target = no.get("id") or (no.get("beacon") or "").split("/")[-1]
+            if not target:
+                raise TraverserError("nearObject needs id or beacon")
+            obj = idx.object_by_uuid(target, include_vector=True)
+            if obj is None or obj.vector is None:
+                raise TraverserError(f"nearObject: object {target} has no vector")
+            return obj.vector
+        nt = params.near_text
+        if nt is not None:
+            if self.modules is None:
+                raise TraverserError("nearText requires a vectorizer module")
+            cd = self.schema.get_class(idx.class_name)
+            vec = self.modules.vectorize_query(cd, nt)
+            if vec is None:
+                raise TraverserError("nearText: vectorizer returned no vector")
+            return np.asarray(vec, dtype=np.float32)
+        return None
+
+    def _near_threshold(self, params: GetParams, idx) -> Optional[float]:
+        """certainty/distance -> target distance. certainty is defined only
+        for cosine (d = 2(1-c)); the reference rejects it elsewhere."""
+        src = params.near_vector or params.near_object or params.near_text or {}
+        if src.get("distance") is not None:
+            return float(src["distance"])
+        if src.get("certainty") is not None:
+            if idx is not None and idx.vector_config.distance != DISTANCE_COSINE:
+                raise TraverserError(
+                    "certainty is only valid for distance 'cosine'; use 'distance'"
+                )
+            c = float(src["certainty"])
+            return 2.0 * (1.0 - c)
+        return None
+
+    # -- dispatch (explorer.go:108-139) --------------------------------------
+
+    def get_class(self, params: GetParams) -> list[SearchResult]:
+        return self.get_class_batched([params])[0]
+
+    def get_class_batched(self, params_list: Sequence[GetParams]) -> list[list[SearchResult]]:
+        # group pure nearVector queries per class into one device dispatch
+        out: list[Optional[list[SearchResult]]] = [None] * len(params_list)
+        batchable: dict[tuple, list[int]] = {}
+        for i, p in enumerate(params_list):
+            limit = p.limit or self.query_limit
+            if limit + p.offset > self.max_results:
+                raise TraverserError(
+                    f"limit+offset ({limit + p.offset}) exceeds QUERY_MAXIMUM_RESULTS ({self.max_results})"
+                )
+            if (
+                p.near_vector is not None
+                and p.near_vector.get("vector") is not None
+                and not (p.hybrid or p.keyword_ranking or p.group_by or p.group or p.sort)
+                and p.filters is None
+                and p.near_vector.get("distance") is None
+                and p.near_vector.get("certainty") is None
+            ):
+                key = (p.class_name, limit, p.offset, p.include_vector)
+                batchable.setdefault(key, []).append(i)
+            else:
+                out[i] = self._get_one(p)
+        for (class_name, limit, offset, inc_vec), idxs in batchable.items():
+            idx = self._index(class_name)
+            vecs = np.stack(
+                [np.asarray(params_list[i].near_vector["vector"], np.float32) for i in idxs]
+            )
+            res = idx.object_vector_search(vecs, limit + offset, include_vector=inc_vec)
+            for j, i in enumerate(idxs):
+                out[i] = self._postprocess(params_list[i], res[j][offset:])
+        return out  # type: ignore[return-value]
+
+    def _index(self, class_name: str):
+        resolved = self.schema.resolve_class_name(class_name)
+        idx = self.db.get_index(resolved) if resolved else None
+        if idx is None:
+            raise TraverserError(f"class {class_name!r} not found")
+        return idx
+
+    def _get_one(self, params: GetParams) -> list[SearchResult]:
+        idx = self._index(params.class_name)
+        limit = params.limit or self.query_limit
+        if limit + params.offset > self.max_results:
+            raise TraverserError(
+                f"limit+offset ({limit + params.offset}) exceeds QUERY_MAXIMUM_RESULTS ({self.max_results})"
+            )
+        # grouping needs result vectors even if the caller didn't ask for them
+        if params.group is not None:
+            params.include_vector = True
+        if params.hybrid is not None:
+            res = self._hybrid(params, idx, limit)
+        elif params.keyword_ranking is not None:
+            res = idx.object_search(
+                limit,
+                flt=params.filters,
+                keyword_ranking=params.keyword_ranking,
+                offset=params.offset,
+                include_vector=params.include_vector,
+            )
+        else:
+            vec = self._resolve_vector(params, idx)
+            if vec is not None:
+                target = self._near_threshold(params, idx)
+                res = idx.object_vector_search(
+                    vec,
+                    limit + params.offset,
+                    flt=params.filters,
+                    target_distance=target,
+                    include_vector=params.include_vector,
+                )[0][params.offset :]
+            else:
+                res = idx.object_search(
+                    limit,
+                    flt=params.filters,
+                    offset=params.offset,
+                    include_vector=params.include_vector,
+                    cursor_after=params.after,
+                )
+        return self._postprocess(params, res)
+
+    # -- hybrid (explorer.go:227, hybrid/searcher.go) ------------------------
+
+    def _hybrid(self, params: GetParams, idx, limit: int) -> list[SearchResult]:
+        h = params.hybrid
+        alpha = float(h.get("alpha", 0.75))
+        query = h.get("query") or ""
+        fetch = max(limit * 4, 100)  # oversample both legs before fusion
+        sparse: list[SearchResult] = []
+        dense: list[SearchResult] = []
+        if alpha < 1 and query:
+            sparse = idx.object_search(
+                fetch,
+                flt=params.filters,
+                keyword_ranking={"query": query, "properties": h.get("properties")},
+                include_vector=params.include_vector,
+            )
+        if alpha > 0:
+            vec = h.get("vector")
+            if vec is None and query:
+                if self.modules is not None:
+                    cd = self.schema.get_class(idx.class_name)
+                    vec = self.modules.vectorize_query(cd, {"concepts": [query]})
+            if vec is not None:
+                dense = idx.object_vector_search(
+                    np.asarray(vec, dtype=np.float32),
+                    fetch,
+                    flt=params.filters,
+                    include_vector=params.include_vector,
+                )[0]
+        fused = hybrid_mod.fuse(sparse, dense, alpha, h.get("fusionType"))
+        return fused[params.offset : params.offset + limit]
+
+    # -- post-processing: sort, group ----------------------------------------
+
+    def _postprocess(self, params: GetParams, res: list[SearchResult]) -> list[SearchResult]:
+        if params.sort:
+            res = self._sort(params.sort, res)
+        if params.group is not None:
+            res = self._group(params.group, res)
+        if params.group_by is not None:
+            res = self._group_by(params.group_by, res)
+        if params.additional.get("certainty") or "certainty" in params.additional:
+            self._add_certainty(params, res)
+        return res
+
+    def _sort(self, sort: list[dict], res: list[SearchResult]) -> list[SearchResult]:
+        for s in reversed(sort):
+            path = s.get("path") or []
+            prop = path[0] if path else None
+            desc = (s.get("order") or "asc") == "desc"
+            if prop:
+                res = sorted(
+                    res,
+                    key=lambda r: (
+                        (v := r.obj.properties.get(prop)) is None,
+                        v if not isinstance(v, bool) else int(v),
+                    ),
+                    reverse=desc,
+                )
+        return res
+
+    def _group(self, group: dict, res: list[SearchResult]) -> list[SearchResult]:
+        """Get(group:) semantics (usecases/traverser/grouper): cluster results
+        whose pairwise OBJECT-vector cosine distance <= (1-force); merge or
+        keep the closest-to-query representative."""
+        if not res:
+            return res
+        gtype = group.get("type", "closest")
+        force = float(group.get("force", 0.5))
+
+        def unit(v):
+            v = np.asarray(v, dtype=np.float32)
+            n = float(np.linalg.norm(v))
+            return v / n if n > 0 else v
+
+        groups: list[list[SearchResult]] = []
+        heads: list[Optional[np.ndarray]] = []
+        for r in res:
+            v = unit(r.obj.vector) if r.obj.vector is not None else None
+            placed = False
+            for gi, g in enumerate(groups):
+                hv = heads[gi]
+                if v is not None and hv is not None:
+                    if 1.0 - float(np.dot(v, hv)) <= (1 - force):
+                        g.append(r)
+                        placed = True
+                        break
+            if not placed:
+                groups.append([r])
+                heads.append(v)
+        out = []
+        for g in groups:
+            if gtype == "merge":
+                head = g[0]
+                for other in g[1:]:
+                    for k, v in other.obj.properties.items():
+                        hv = head.obj.properties.get(k)
+                        if isinstance(hv, str) and isinstance(v, str) and v not in hv:
+                            head.obj.properties[k] = f"{hv} ({v})"
+                out.append(head)
+            else:
+                out.append(g[0])
+        return out
+
+    def _group_by(self, group_by: dict, res: list[SearchResult]) -> list[SearchResult]:
+        """groupBy{path, groups, objectsPerGroup}: one result per group head,
+        hits recorded in additional (the gRPC group-by shape)."""
+        path = group_by.get("path") or []
+        prop = path[0] if path else None
+        max_groups = int(group_by.get("groups", 5))
+        per_group = int(group_by.get("objectsPerGroup", 5))
+        if prop is None:
+            return res
+        seen: dict[Any, list[SearchResult]] = {}
+        for r in res:
+            v = r.obj.properties.get(prop)
+            key = tuple(v) if isinstance(v, list) else v
+            seen.setdefault(key, [])
+            if len(seen[key]) < per_group:
+                seen[key].append(r)
+        out = []
+        for key, rows in list(seen.items())[:max_groups]:
+            head = rows[0]
+            head.additional["group"] = {
+                "groupedBy": {"path": [prop], "value": key},
+                "count": len(rows),
+                "hits": [
+                    {**row.obj.to_rest(), "_additional": {"distance": row.distance}}
+                    for row in rows
+                ],
+            }
+            out.append(head)
+        return out
+
+    def _add_certainty(self, params: GetParams, res: list[SearchResult]) -> None:
+        idx = self._index(params.class_name)
+        if idx.vector_config.distance != DISTANCE_COSINE:
+            return
+        for r in res:
+            if r.distance is not None:
+                r.certainty = max(0.0, 1.0 - r.distance / 2.0)
+
+    # -- Explore (cross-class, explorer.go:492) ------------------------------
+
+    def explore(
+        self,
+        near_vector: Optional[dict] = None,
+        near_object: Optional[dict] = None,
+        near_text: Optional[dict] = None,
+        limit: int = 25,
+    ) -> list[dict]:
+        out = []
+        for idx in self.db.indexes.values():
+            p = GetParams(
+                class_name=idx.class_name,
+                near_vector=near_vector,
+                near_object=near_object,
+                near_text=near_text,
+                limit=limit,
+            )
+            try:
+                for r in self._get_one(p):
+                    out.append(
+                        {
+                            "className": idx.class_name,
+                            "beacon": f"weaviate://localhost/{idx.class_name}/{r.obj.uuid}",
+                            "distance": r.distance,
+                            "certainty": (
+                                max(0.0, 1.0 - r.distance / 2.0)
+                                if r.distance is not None
+                                else None
+                            ),
+                        }
+                    )
+            except TraverserError:
+                continue
+        out.sort(key=lambda d: d.get("distance") if d.get("distance") is not None else np.inf)
+        return out[:limit]
