@@ -1,0 +1,276 @@
+"""GraphQL parser + executor and aggregator tests.
+
+Reference surfaces: adapters/handlers/graphql/local/{get,aggregate,explore},
+adapters/repos/db/aggregator.
+"""
+
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.db import DB
+from weaviate_tpu.graphql import GraphQLExecutor, GraphQLParseError, parse_query
+from weaviate_tpu.graphql.parser import EnumValue, Field
+from weaviate_tpu.schema import AutoSchema, SchemaManager
+from weaviate_tpu.usecases.aggregator import AggregateParams, Aggregator
+from weaviate_tpu.usecases.objects import BatchManager, ObjectsManager
+from weaviate_tpu.usecases.traverser import Explorer, Traverser
+
+
+# -- parser ------------------------------------------------------------------
+
+
+def test_parse_basic_get():
+    op = parse_query(
+        """
+        { Get { Article(limit: 5, where: {operator: Equal, path: ["title"], valueText: "x"})
+            { title wordCount _additional { id distance } } } }
+        """
+    )
+    get = op.selections[0]
+    assert get.name == "Get"
+    art = get.selections[0]
+    assert art.name == "Article"
+    assert art.args["limit"] == 5
+    assert isinstance(art.args["where"]["operator"], EnumValue)
+    assert str(art.args["where"]["operator"]) == "Equal"
+    assert art.args["where"]["path"] == ["title"]
+    names = [s.name for s in art.selections]
+    assert names == ["title", "wordCount", "_additional"]
+
+
+def test_parse_variables_fragments_aliases():
+    op = parse_query(
+        """
+        query Q($lim: Int = 3, $vec: [Float]) {
+          first: Get { Article(limit: $lim, nearVector: {vector: $vec}) {
+            title
+            writtenBy { ... on Author { name } }
+            ...extra
+          } }
+        }
+        fragment extra on Article { wordCount }
+        """,
+        variables={"vec": [0.1, 0.2]},
+    )
+    get = op.selections[0]
+    assert get.out_name == "first"
+    art = get.selections[0]
+    assert art.args["limit"] == 3  # default applied
+    assert art.args["nearVector"]["vector"] == [0.1, 0.2]
+    frag_types = [s.type_name for s in art.selections if not isinstance(s, Field)]
+    assert "Article" in frag_types  # named fragment inlined
+
+
+def test_parse_errors():
+    with pytest.raises(GraphQLParseError):
+        parse_query("mutation { x }")
+    with pytest.raises(GraphQLParseError):
+        parse_query("{ Get { A(limit: $nope) { t } } }")
+    with pytest.raises(GraphQLParseError):
+        parse_query('{ Get { A(s: "unterminated) { t } } }')
+
+
+# -- executor + aggregator ---------------------------------------------------
+
+
+@pytest.fixture
+def gql(tmp_path):
+    db = DB(str(tmp_path / "data"))
+    mgr = SchemaManager(str(tmp_path / "schema.json"), migrator=db)
+    om = ObjectsManager(db, mgr, auto_schema=AutoSchema(mgr))
+    bm = BatchManager(om)
+    explorer = Explorer(db, mgr)
+    trav = Traverser(explorer)
+    agg = Aggregator(db, mgr, explorer)
+    ex = GraphQLExecutor(trav, agg, mgr, db)
+
+    mgr.add_class(
+        {
+            "class": "Article",
+            "properties": [
+                {"name": "title", "dataType": ["text"]},
+                {"name": "wordCount", "dataType": ["int"]},
+                {"name": "published", "dataType": ["boolean"]},
+            ],
+            "vectorIndexConfig": {"distance": "l2-squared"},
+        }
+    )
+    rng = np.random.default_rng(3)
+    vecs = rng.standard_normal((30, 8)).astype(np.float32)
+    bm.add_objects(
+        [
+            {
+                "class": "Article",
+                "id": str(uuidlib.UUID(int=i + 1)),
+                "properties": {
+                    "title": f"piece number{i}",
+                    "wordCount": i * 10,
+                    "published": i % 2 == 0,
+                },
+                "vector": vecs[i].tolist(),
+            }
+            for i in range(30)
+        ]
+    )
+    yield ex, vecs, om, mgr
+    db.shutdown()
+
+
+def test_get_near_vector(gql):
+    ex, vecs, om, mgr = gql
+    res = ex.execute(
+        "query($v: [Float]) { Get { Article(nearVector: {vector: $v}, limit: 3) "
+        "{ title _additional { id distance } } } }",
+        variables={"v": vecs[4].tolist()},
+    )
+    assert "errors" not in res, res.get("errors")
+    rows = res["data"]["Get"]["Article"]
+    assert len(rows) == 3
+    assert rows[0]["_additional"]["id"] == str(uuidlib.UUID(int=5))
+    assert rows[0]["_additional"]["distance"] < 1e-3
+    assert rows[0]["title"] == "piece number4"
+
+
+def test_get_where_and_bm25(gql):
+    ex, vecs, om, mgr = gql
+    res = ex.execute(
+        '{ Get { Article(where: {operator: And, operands: ['
+        "{operator: Equal, path: [\"published\"], valueBoolean: true}, "
+        "{operator: GreaterThan, path: [\"wordCount\"], valueInt: 100}"
+        "]}, limit: 20) { wordCount published } } }"
+    )
+    rows = res["data"]["Get"]["Article"]
+    assert rows and all(r["published"] and r["wordCount"] > 100 for r in rows)
+
+    res2 = ex.execute('{ Get { Article(bm25: {query: "number7"}) { title _additional { score } } } }')
+    rows2 = res2["data"]["Get"]["Article"]
+    assert len(rows2) == 1 and rows2[0]["title"] == "piece number7"
+    assert float(rows2[0]["_additional"]["score"]) > 0
+
+
+def test_get_hybrid_and_sort(gql):
+    ex, vecs, om, mgr = gql
+    res = ex.execute(
+        "query($v: [Float]) { Get { Article(hybrid: {query: \"number11\", vector: $v, alpha: 0.5}, limit: 5)"
+        " { title } } }",
+        variables={"v": vecs[11].tolist()},
+    )
+    assert res["data"]["Get"]["Article"][0]["title"] == "piece number11"
+
+    res2 = ex.execute(
+        '{ Get { Article(sort: [{path: ["wordCount"], order: desc}], limit: 30) { wordCount } } }'
+    )
+    counts = [r["wordCount"] for r in res2["data"]["Get"]["Article"]]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_get_cross_reference(gql):
+    ex, vecs, om, mgr = gql
+    mgr.add_class({"class": "Author", "properties": [{"name": "name", "dataType": ["text"]}]})
+    mgr.add_property("Article", {"name": "writtenBy", "dataType": ["Author"]})
+    a = om.add({"class": "Author", "properties": {"name": "grace"}})
+    om.add_reference(
+        str(uuidlib.UUID(int=1)), "Article", "writtenBy", f"weaviate://localhost/Author/{a.uuid}"
+    )
+    res = ex.execute(
+        '{ Get { Article(where: {operator: Equal, path: ["wordCount"], valueInt: 0}) '
+        "{ title writtenBy { ... on Author { name _additional { id } } } } } }"
+    )
+    rows = res["data"]["Get"]["Article"]
+    assert len(rows) == 1
+    assert rows[0]["writtenBy"] == [{"name": "grace", "_additional": {"id": a.uuid}}]
+
+
+def test_aggregate(gql):
+    ex, vecs, om, mgr = gql
+    res = ex.execute(
+        "{ Aggregate { Article { meta { count } wordCount { mean maximum minimum count } "
+        "published { totalTrue percentageFalse } title { topOccurrences { value occurs } } } } }"
+    )
+    assert "errors" not in res, res.get("errors")
+    agg = res["data"]["Aggregate"]["Article"][0]
+    assert agg["meta"]["count"] == 30
+    assert agg["wordCount"]["maximum"] == 290
+    assert agg["wordCount"]["mean"] == pytest.approx(145.0)
+    assert agg["published"]["totalTrue"] == 15
+    assert agg["published"]["percentageFalse"] == pytest.approx(0.5)
+    assert len(agg["title"]["topOccurrences"]) == 5
+
+    # grouped + filtered
+    res2 = ex.execute(
+        '{ Aggregate { Article(groupBy: ["published"], where: '
+        '{operator: LessThan, path: ["wordCount"], valueInt: 100}) '
+        "{ groupedBy { value } meta { count } wordCount { count sum } } } }"
+    )
+    groups = res2["data"]["Aggregate"]["Article"]
+    assert len(groups) == 2
+    total = sum(g["meta"]["count"] for g in groups)
+    assert total == 10
+
+
+def test_aggregate_near_vector(gql):
+    ex, vecs, om, mgr = gql
+    res = ex.execute(
+        "query($v: [Float]) { Aggregate { Article(nearVector: {vector: $v}, objectLimit: 5) "
+        "{ meta { count } } } }",
+        variables={"v": vecs[0].tolist()},
+    )
+    assert res["data"]["Aggregate"]["Article"][0]["meta"]["count"] == 5
+
+
+def test_explore(gql):
+    ex, vecs, om, mgr = gql
+    res = ex.execute(
+        "query($v: [Float]) { Explore(nearVector: {vector: $v}, limit: 2) "
+        "{ beacon className distance } }",
+        variables={"v": vecs[0].tolist()},
+    )
+    assert "errors" not in res, res.get("errors")
+    hits = res["data"]["Explore"]
+
+    # declared-but-missing variable must error, not silently resolve to null
+    res_missing = ex.execute(
+        "query($v: [Float]) { Explore(nearVector: {vector: $v}) { beacon } }"
+    )
+    assert res_missing["errors"]
+    assert len(hits) == 2
+    assert hits[0]["className"] == "Article"
+    assert str(uuidlib.UUID(int=1)) in hits[0]["beacon"]
+
+
+def test_error_paths(gql):
+    ex, vecs, om, mgr = gql
+    res = ex.execute("{ Get { NoSuchClass { x } } }")
+    assert res["errors"]
+    res2 = ex.execute("{ Nope { x } }")
+    assert res2["errors"]
+    res3 = ex.execute("{ Get { Article(")
+    assert res3["errors"]
+
+
+def test_aggregate_api_direct(tmp_path):
+    """Aggregator date aggs direct (no fixture class has dates)."""
+    db = DB(str(tmp_path / "d2"))
+    mgr = SchemaManager(str(tmp_path / "s2.json"), migrator=db)
+    om = ObjectsManager(db, mgr, auto_schema=AutoSchema(mgr))
+    for i in range(5):
+        om.add(
+            {
+                "class": "Event",
+                "properties": {"when": f"2023-0{i+1}-01T00:00:00Z", "n": i},
+            }
+        )
+    agg = Aggregator(db, mgr)
+    out = agg.aggregate(
+        AggregateParams(
+            class_name="Event",
+            properties={"when": ["count", "minimum", "maximum"], "n": ["median", "mode"]},
+        )
+    )[0]
+    assert out["when"]["count"] == 5
+    assert out["when"]["minimum"].startswith("2023-01-01")
+    assert out["when"]["maximum"].startswith("2023-05-01")
+    assert out["n"]["median"] == 2.0
+    db.shutdown()

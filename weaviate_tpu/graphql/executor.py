@@ -1,0 +1,299 @@
+"""GraphQL executor: Get / Aggregate / Explore roots over the traverser.
+
+Reference: adapters/handlers/graphql/local — get (class_builder_fields.go:229
+makeResolveGetClass), aggregate, explore; the `where` grammar of
+local/common_filters, `_additional` props (class_builder_fields.go:526-620),
+and result->map conversion (usecases/traverser/explorer.go:338).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.graphql.parser import (
+    EnumValue,
+    Field,
+    GraphQLParseError,
+    InlineFragment,
+    parse_query,
+)
+from weaviate_tpu.usecases.aggregator import AggregateParams
+from weaviate_tpu.usecases.traverser import GetParams
+
+
+def _plain(v: Any) -> Any:
+    """EnumValue -> str, recursively (args arrive enum-typed from the parser)."""
+    if isinstance(v, EnumValue):
+        return v.name
+    if isinstance(v, list):
+        return [_plain(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _plain(x) for k, x in v.items()}
+    return v
+
+
+class GraphQLExecutor:
+    def __init__(self, traverser, aggregator, schema_manager, db):
+        self.traverser = traverser
+        self.aggregator = aggregator
+        self.schema = schema_manager
+        self.db = db
+
+    # -- entry ---------------------------------------------------------------
+
+    def execute(self, query: str, variables: Optional[dict] = None) -> dict:
+        try:
+            op = parse_query(query, variables)
+        except GraphQLParseError as e:
+            return {"errors": [{"message": str(e)}]}
+        data: dict[str, Any] = {}
+        errors: list[dict] = []
+        for sel in op.selections:
+            if not isinstance(sel, Field):
+                errors.append({"message": "fragments not allowed at root"})
+                continue
+            try:
+                if sel.name == "Get":
+                    data[sel.out_name] = self._exec_get(sel)
+                elif sel.name == "Aggregate":
+                    data[sel.out_name] = self._exec_aggregate(sel)
+                elif sel.name == "Explore":
+                    data[sel.out_name] = self._exec_explore(sel)
+                else:
+                    errors.append({"message": f"unknown root field {sel.name!r}"})
+            except Exception as e:
+                errors.append({"message": str(e), "path": [sel.name]})
+        out: dict[str, Any] = {"data": data}
+        if errors:
+            out["errors"] = errors
+        return out
+
+    # -- Get -----------------------------------------------------------------
+
+    def _exec_get(self, root: Field) -> dict:
+        out = {}
+        for class_field in root.selections:
+            if not isinstance(class_field, Field):
+                raise GraphQLParseError("expected class field under Get")
+            params = self._get_params(class_field)
+            results = self.traverser.get_class(params)
+            out[class_field.out_name] = [
+                self._project(r, class_field.selections, params) for r in results
+            ]
+        return out
+
+    def _get_params(self, f: Field) -> GetParams:
+        a = {k: _plain(v) for k, v in f.args.items()}
+        where = a.get("where")
+        needs_vector = self._selection_wants_vector(f.selections)
+        params = GetParams(
+            class_name=f.name,
+            filters=LocalFilter.from_dict(self._convert_where(where)) if where else None,
+            near_vector=a.get("nearVector"),
+            near_object=a.get("nearObject"),
+            near_text=a.get("nearText"),
+            keyword_ranking=a.get("bm25"),
+            hybrid=a.get("hybrid"),
+            sort=self._as_list(a.get("sort")),
+            group=a.get("group"),
+            group_by=a.get("groupBy"),
+            limit=int(a.get("limit", 0) or 0) or 25,
+            offset=int(a.get("offset", 0) or 0),
+            after=a.get("after"),
+            include_vector=needs_vector,
+            consistency_level=(a.get("consistencyLevel") or None),
+        )
+        if params.keyword_ranking is not None:
+            params.keyword_ranking = dict(params.keyword_ranking)
+            params.keyword_ranking.setdefault("query", "")
+        return params
+
+    @staticmethod
+    def _as_list(v):
+        if v is None:
+            return []
+        return v if isinstance(v, list) else [v]
+
+    def _convert_where(self, w: dict) -> dict:
+        """GraphQL where arg -> entities.filters dict (same keys; nested
+        operands recursed; enum operator already plain)."""
+        out = dict(w)
+        if "operands" in out and out["operands"]:
+            out["operands"] = [self._convert_where(o) for o in out["operands"]]
+        return out
+
+    def _selection_wants_vector(self, sels: list) -> bool:
+        for s in sels:
+            if isinstance(s, Field) and s.name == "_additional":
+                for sub in s.selections:
+                    if isinstance(sub, Field) and sub.name == "vector":
+                        return True
+        return False
+
+    # -- result projection ---------------------------------------------------
+
+    def _project(self, r, sels: list, params: GetParams) -> dict:
+        obj = r.obj
+        row: dict[str, Any] = {}
+        for s in sels:
+            if isinstance(s, InlineFragment):
+                continue
+            if s.name == "_additional":
+                row[s.out_name] = self._additional(r, s.selections, params)
+                continue
+            value = obj.properties.get(s.name)
+            if s.selections and isinstance(value, list):
+                # cross-reference: resolve beacons via inline fragments
+                row[s.out_name] = self._resolve_refs(value, s.selections)
+            elif s.selections and isinstance(value, dict):
+                row[s.out_name] = {
+                    sub.out_name: value.get(sub.name)
+                    for sub in s.selections
+                    if isinstance(sub, Field)
+                }
+            else:
+                row[s.out_name] = value
+        return row
+
+    def _resolve_refs(self, beacons: list, sels: list) -> list:
+        out = []
+        frags = [s for s in sels if isinstance(s, InlineFragment)]
+        for b in beacons:
+            beacon = b.get("beacon") if isinstance(b, dict) else None
+            if beacon is None:
+                continue
+            parts = beacon.split("weaviate://")[-1].split("/")
+            # host/Class/uuid or host/uuid (legacy)
+            target_class = parts[1] if len(parts) >= 3 else None
+            target_uuid = parts[-1]
+            obj, idx = (None, None)
+            if target_class:
+                tidx = self.db.get_index(target_class)
+                if tidx is not None:
+                    obj = tidx.object_by_uuid(target_uuid, include_vector=False)
+            else:
+                obj, idx = self.db.object_by_uuid_any_class(target_uuid, False)
+            if obj is None:
+                continue
+            for frag in frags:
+                if frag.type_name == obj.class_name:
+                    row = {
+                        sub.out_name: obj.properties.get(sub.name)
+                        for sub in frag.selections
+                        if isinstance(sub, Field) and sub.name != "_additional"
+                    }
+                    for sub in frag.selections:
+                        if isinstance(sub, Field) and sub.name == "_additional":
+                            row[sub.out_name] = {"id": obj.uuid}
+                    out.append(row)
+        return out
+
+    def _additional(self, r, sels: list, params: GetParams) -> dict:
+        obj = r.obj
+        add: dict[str, Any] = {}
+        for s in sels:
+            if not isinstance(s, Field):
+                continue
+            n = s.name
+            if n == "id":
+                add[s.out_name] = obj.uuid
+            elif n == "vector":
+                add[s.out_name] = (
+                    [float(x) for x in obj.vector] if obj.vector is not None else None
+                )
+            elif n == "distance":
+                add[s.out_name] = r.distance
+            elif n == "certainty":
+                add[s.out_name] = (
+                    r.certainty
+                    if r.certainty is not None
+                    else (
+                        max(0.0, 1.0 - r.distance / 2.0)
+                        if r.distance is not None and self._is_cosine(params.class_name)
+                        else None
+                    )
+                )
+            elif n == "score":
+                add[s.out_name] = None if r.score is None else str(r.score)
+            elif n == "explainScore":
+                add[s.out_name] = r.explain_score
+            elif n == "creationTimeUnix":
+                add[s.out_name] = str(obj.creation_time_unix)
+            elif n == "lastUpdateTimeUnix":
+                add[s.out_name] = str(obj.last_update_time_unix)
+            elif n == "group":
+                add[s.out_name] = r.additional.get("group")
+            elif n == "isConsistent":
+                add[s.out_name] = True
+            else:
+                add[s.out_name] = r.additional.get(n)
+        return add
+
+    def _is_cosine(self, class_name: str) -> bool:
+        resolved = self.schema.resolve_class_name(class_name)
+        idx = self.db.get_index(resolved) if resolved else None
+        return idx is not None and idx.vector_config.distance == "cosine"
+
+    # -- Aggregate -----------------------------------------------------------
+
+    def _exec_aggregate(self, root: Field) -> dict:
+        out = {}
+        for class_field in root.selections:
+            if not isinstance(class_field, Field):
+                continue
+            a = {k: _plain(v) for k, v in class_field.args.items()}
+            prop_aggs: dict[str, list[str]] = {}
+            include_meta = False
+            group_by_sel = False
+            for s in class_field.selections:
+                if not isinstance(s, Field):
+                    continue
+                if s.name == "meta":
+                    include_meta = True
+                elif s.name == "groupedBy":
+                    group_by_sel = True
+                else:
+                    prop_aggs[s.name] = [
+                        sub.name for sub in s.selections if isinstance(sub, Field)
+                    ]
+            gb = a.get("groupBy")
+            params = AggregateParams(
+                class_name=class_field.name,
+                filters=(
+                    LocalFilter.from_dict(self._convert_where(a["where"]))
+                    if a.get("where")
+                    else None
+                ),
+                near_vector=a.get("nearVector"),
+                near_object=a.get("nearObject"),
+                object_limit=a.get("objectLimit"),
+                group_by=self._as_list(gb) if gb else None,
+                properties=prop_aggs,
+                include_meta_count=include_meta,
+                limit=a.get("limit"),
+            )
+            groups = self.aggregator.aggregate(params)
+            rows = []
+            for g in groups:
+                row = dict(g)
+                if not group_by_sel:
+                    row.pop("groupedBy", None)
+                rows.append(row)
+            out[class_field.out_name] = rows
+        return out
+
+    # -- Explore -------------------------------------------------------------
+
+    def _exec_explore(self, root: Field) -> list[dict]:
+        a = {k: _plain(v) for k, v in root.args.items()}
+        hits = self.traverser.explorer.explore(
+            near_vector=a.get("nearVector"),
+            near_object=a.get("nearObject"),
+            near_text=a.get("nearText"),
+            limit=int(a.get("limit", 25) or 25),
+        )
+        wanted = [s.name for s in root.selections if isinstance(s, Field)]
+        return [{k: h.get(k) for k in wanted} for h in hits]

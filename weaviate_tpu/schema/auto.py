@@ -11,7 +11,7 @@ from __future__ import annotations
 import datetime
 from typing import Any, Optional
 
-from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.entities.schema import ClassDef, Property, SchemaError, datatype_of_value
 
 
 def _looks_like_date(v: str) -> bool:
@@ -38,24 +38,25 @@ class AutoSchema:
         self.default_date = default_date
 
     def infer_type(self, value: Any) -> Optional[str]:
-        if isinstance(value, bool):
-            return "boolean"
-        if isinstance(value, int):
-            return "int"
-        if isinstance(value, float):
-            return self.default_number
+        """Delegates to entities.schema.datatype_of_value; layers the
+        configurable defaults (string->text|date, number) on top."""
         if isinstance(value, str):
             return self.default_date if _looks_like_date(value) else self.default_string
-        if isinstance(value, dict):
-            if {"latitude", "longitude"} <= set(value):
-                return "geoCoordinates"
-            if {"input"} <= set(value) or {"internationalFormatted"} <= set(value):
-                return "phoneNumber"
-            return "object"
-        if isinstance(value, list) and value:
+        if isinstance(value, float):
+            return self.default_number
+        if isinstance(value, list) and value and isinstance(value[0], str):
             inner = self.infer_type(value[0])
-            return f"{inner}[]" if inner in ("text", "int", "number", "boolean", "date", "uuid") else inner
-        return None
+            return f"{inner}[]"
+        if isinstance(value, dict) and not (
+            {"latitude", "longitude"} <= set(value)
+            or "input" in value
+            or "internationalFormatted" in value
+        ):
+            return "object"  # plain nested object: not auto-indexable
+        try:
+            return datatype_of_value(value).value
+        except SchemaError:
+            return None
 
     def ensure(self, class_name: str, properties: dict) -> str:
         """Create the class and/or add missing properties as needed.

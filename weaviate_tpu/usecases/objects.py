@@ -83,7 +83,7 @@ class ObjectsManager:
         for key, value in props.items():
             prop = cd.get_property(key)
             if prop is None:
-                if self.auto is None:
+                if self.auto is None or not self.auto.enabled:
                     raise ObjectsError(
                         f"property {key!r} not in schema of class {cd.name!r}"
                     )
@@ -196,7 +196,7 @@ class ObjectsManager:
 
     def add_reference(self, uuid: str, class_name: str, prop: str, beacon: str) -> None:
         idx = self._index_or_raise(class_name)
-        obj = idx.object_by_uuid(_valid_uuid(uuid), include_vector=True)
+        obj = idx.object_by_uuid(_valid_uuid(uuid), include_vector=False)
         if obj is None:
             raise NotFoundError(f"object {uuid} not found")
         refs = obj.properties.get(prop) or []
@@ -211,7 +211,7 @@ class ObjectsManager:
 
     def delete_reference(self, uuid: str, class_name: str, prop: str, beacon: str) -> None:
         idx = self._index_or_raise(class_name)
-        obj = idx.object_by_uuid(_valid_uuid(uuid), include_vector=True)
+        obj = idx.object_by_uuid(_valid_uuid(uuid), include_vector=False)
         if obj is None:
             raise NotFoundError(f"object {uuid} not found")
         refs = [r for r in (obj.properties.get(prop) or []) if r.get("beacon") != beacon]

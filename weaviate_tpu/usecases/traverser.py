@@ -120,38 +120,56 @@ class Explorer:
     # -- dispatch (explorer.go:108-139) --------------------------------------
 
     def get_class(self, params: GetParams) -> list[SearchResult]:
-        return self.get_class_batched([params])[0]
+        res = self.get_class_batched([params])[0]
+        if isinstance(res, Exception):
+            raise res
+        return res
 
-    def get_class_batched(self, params_list: Sequence[GetParams]) -> list[list[SearchResult]]:
-        # group pure nearVector queries per class into one device dispatch
-        out: list[Optional[list[SearchResult]]] = [None] * len(params_list)
+    def get_class_batched(
+        self, params_list: Sequence[GetParams]
+    ) -> list[list[SearchResult] | Exception]:
+        """Cross-query batched Get with per-query error isolation: a failed
+        slot holds the Exception instead of results (callers surface it as
+        that query's error; the other slots are unaffected)."""
+        out: list[Optional[list[SearchResult] | Exception]] = [None] * len(params_list)
         batchable: dict[tuple, list[int]] = {}
         for i, p in enumerate(params_list):
-            limit = p.limit or self.query_limit
-            if limit + p.offset > self.max_results:
-                raise TraverserError(
-                    f"limit+offset ({limit + p.offset}) exceeds QUERY_MAXIMUM_RESULTS ({self.max_results})"
-                )
-            if (
-                p.near_vector is not None
-                and p.near_vector.get("vector") is not None
-                and not (p.hybrid or p.keyword_ranking or p.group_by or p.group or p.sort)
-                and p.filters is None
-                and p.near_vector.get("distance") is None
-                and p.near_vector.get("certainty") is None
-            ):
-                key = (p.class_name, limit, p.offset, p.include_vector)
-                batchable.setdefault(key, []).append(i)
-            else:
-                out[i] = self._get_one(p)
+            try:
+                limit = p.limit or self.query_limit
+                if limit + p.offset > self.max_results:
+                    raise TraverserError(
+                        f"limit+offset ({limit + p.offset}) exceeds QUERY_MAXIMUM_RESULTS ({self.max_results})"
+                    )
+                if (
+                    p.near_vector is not None
+                    and p.near_vector.get("vector") is not None
+                    and not (p.hybrid or p.keyword_ranking or p.group_by or p.group or p.sort)
+                    and p.filters is None
+                    and p.near_vector.get("distance") is None
+                    and p.near_vector.get("certainty") is None
+                ):
+                    key = (p.class_name, limit, p.offset, p.include_vector)
+                    batchable.setdefault(key, []).append(i)
+                else:
+                    out[i] = self._get_one(p)
+            except Exception as e:
+                out[i] = e
         for (class_name, limit, offset, inc_vec), idxs in batchable.items():
-            idx = self._index(class_name)
-            vecs = np.stack(
-                [np.asarray(params_list[i].near_vector["vector"], np.float32) for i in idxs]
-            )
-            res = idx.object_vector_search(vecs, limit + offset, include_vector=inc_vec)
-            for j, i in enumerate(idxs):
-                out[i] = self._postprocess(params_list[i], res[j][offset:])
+            try:
+                idx = self._index(class_name)
+                vecs = np.stack(
+                    [np.asarray(params_list[i].near_vector["vector"], np.float32) for i in idxs]
+                )
+                res = idx.object_vector_search(vecs, limit + offset, include_vector=inc_vec)
+                for j, i in enumerate(idxs):
+                    out[i] = self._postprocess(params_list[i], res[j][offset:])
+            except Exception as e:
+                # ragged shapes or a bad class: isolate per query
+                for i in idxs:
+                    try:
+                        out[i] = self._get_one(params_list[i])
+                    except Exception as e2:
+                        out[i] = e2
         return out  # type: ignore[return-value]
 
     def _index(self, class_name: str):
@@ -169,8 +187,7 @@ class Explorer:
                 f"limit+offset ({limit + params.offset}) exceeds QUERY_MAXIMUM_RESULTS ({self.max_results})"
             )
         # grouping needs result vectors even if the caller didn't ask for them
-        if params.group is not None:
-            params.include_vector = True
+        inc_vec = params.include_vector or params.group is not None
         if params.hybrid is not None:
             res = self._hybrid(params, idx, limit)
         elif params.keyword_ranking is not None:
@@ -179,7 +196,7 @@ class Explorer:
                 flt=params.filters,
                 keyword_ranking=params.keyword_ranking,
                 offset=params.offset,
-                include_vector=params.include_vector,
+                include_vector=inc_vec,
             )
         else:
             vec = self._resolve_vector(params, idx)
@@ -190,14 +207,14 @@ class Explorer:
                     limit + params.offset,
                     flt=params.filters,
                     target_distance=target,
-                    include_vector=params.include_vector,
+                    include_vector=inc_vec,
                 )[0][params.offset :]
             else:
                 res = idx.object_search(
                     limit,
                     flt=params.filters,
                     offset=params.offset,
-                    include_vector=params.include_vector,
+                    include_vector=inc_vec,
                     cursor_after=params.after,
                 )
         return self._postprocess(params, res)
@@ -362,6 +379,8 @@ class Explorer:
                 near_text=near_text,
                 limit=limit,
             )
+            # certainty is a cosine-only concept (same gate as _add_certainty)
+            is_cos = idx.vector_config.distance == DISTANCE_COSINE
             try:
                 for r in self._get_one(p):
                     out.append(
@@ -371,7 +390,7 @@ class Explorer:
                             "distance": r.distance,
                             "certainty": (
                                 max(0.0, 1.0 - r.distance / 2.0)
-                                if r.distance is not None
+                                if r.distance is not None and is_cos
                                 else None
                             ),
                         }
