@@ -1,0 +1,3 @@
+from weaviate_tpu.compress.pq import ProductQuantizer
+
+__all__ = ["ProductQuantizer"]

@@ -1,0 +1,278 @@
+"""Product quantization: codebooks, encoders, and the asymmetric LUT kernel.
+
+Reference: vector/ssdhelpers/product_quantization.go — segments x centroids
+codebooks fit by KMeans (kmeans.go) or the distribution-based Tile scalar
+encoder (tile_encoder.go); per-query asymmetric distances via a lazily
+computed segment x centroid DistanceLookUpTable (product_quantization.go:30-75)
+summed over a row's codes (LookUp :56).
+
+TPU-first deltas:
+- fit and encode are batched device programs (vmapped per-segment kmeans /
+  one argmin matmul per segment) instead of scalar Go loops;
+- the LUT scan is a jitted lax.scan over HBM chunks of the uint8 code
+  matrix: per segment a vectorized table gather ([B, C] LUT rows indexed by
+  a [chunk] code column) accumulated into the [B, chunk] distance block;
+- search keeps a float rescoring pass (gather the top-R candidates' float
+  vectors, exact distance, final top-k) so recall stays near-exact while the
+  HBM-resident store shrinks 4-16x. The reference returns raw PQ distances;
+  rescoring is the knob that buys back its recall loss.
+
+Role in the index: PQ here is a *capacity* trade, not a speed trade — the
+uint8 scan does M table-lookups per row on the VPU, while the uncompressed
+path is one MXU matmul. Enable it when a shard outgrows HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.entities import vectorindex as vi
+
+Array = jax.Array
+
+_FIT_SAMPLE_MAX = 16384   # rows used to fit codebooks (kmeans.go samples too)
+_KMEANS_ITERS = 10
+_ENCODE_CHUNK = 8192
+
+
+# -- kmeans (per-segment, on device) ----------------------------------------
+
+def _kmeans_one_segment(data: Array, init: Array) -> Array:
+    """Lloyd iterations for one segment. data [N, ds], init [C, ds] -> [C, ds]."""
+    n = data.shape[0]
+    c = init.shape[0]
+
+    def step(_, cent):
+        # assign: [N, C] squared distances via the MXU
+        xc = jnp.matmul(data, cent.T, preferred_element_type=jnp.float32)
+        d = (
+            jnp.sum(data**2, axis=1, keepdims=True)
+            - 2.0 * xc
+            + jnp.sum(cent**2, axis=1)[None, :]
+        )
+        assign = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(assign, c, dtype=jnp.float32)  # [N, C]
+        counts = jnp.sum(onehot, axis=0)  # [C]
+        sums = jnp.matmul(onehot.T, data, preferred_element_type=jnp.float32)
+        new = sums / jnp.maximum(counts, 1.0)[:, None]
+        # empty clusters keep their previous centroid
+        return jnp.where(counts[:, None] > 0, new, cent)
+
+    return jax.lax.fori_loop(0, _KMEANS_ITERS, step, init)
+
+
+@jax.jit
+def _kmeans_fit(data_seg: Array, init: Array) -> Array:
+    """data_seg [M, N, ds], init [M, C, ds] -> codebook [M, C, ds].
+    lax.map keeps peak memory at one segment's [N, C] assignment matrix."""
+    return jax.lax.map(lambda t: _kmeans_one_segment(t[0], t[1]), (data_seg, init))
+
+
+# -- encode ------------------------------------------------------------------
+
+@jax.jit
+def _encode_chunk(chunk_seg: Array, codebook: Array) -> Array:
+    """chunk_seg [M, chunk, ds] x codebook [M, C, ds] -> codes [chunk, M] int32.
+
+    Nearest-centroid assignment per segment; ||x||^2 is constant per row so
+    only the cross term + centroid norms decide the argmin."""
+
+    def enc_one(t):
+        data, cent = t
+        xc = jnp.matmul(data, cent.T, preferred_element_type=jnp.float32)
+        d = -2.0 * xc + jnp.sum(cent**2, axis=1)[None, :]
+        return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+    return jnp.transpose(jax.lax.map(enc_one, (chunk_seg, codebook)))  # [chunk, M]
+
+
+# -- LUT ---------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def build_lut(q: Array, codebook: Array, metric: str) -> Array:
+    """[B, D] queries x [M, C, ds] codebook -> LUT [B, M, C] float32.
+
+    Additive decomposition per metric (LookUp sums segment contributions):
+      l2:        ||q_m - c||^2
+      dot:       -(q_m . c)
+      cosine:    -(q_m . c)            (+1 constant applied by the caller)
+      manhattan: sum |q_m - c|
+    """
+    b, d = q.shape
+    m, c, ds = codebook.shape
+    qs = q.reshape(b, m, ds).astype(jnp.float32)
+    if metric == vi.DISTANCE_MANHATTAN:
+        # [B, M, C, ds] broadcast — fine at LUT scale (B*M*C*ds = B*C*D)
+        return jnp.sum(jnp.abs(qs[:, :, None, :] - codebook[None, :, :, :]), axis=-1)
+    qc = jnp.einsum("bmd,mcd->bmc", qs, codebook.astype(jnp.float32))
+    if metric in (vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+        return -qc
+    if metric == vi.DISTANCE_L2:
+        qn = jnp.sum(qs**2, axis=-1)[:, :, None]
+        cn = jnp.sum(codebook.astype(jnp.float32) ** 2, axis=-1)[None, :, :]
+        return jnp.maximum(qn - 2.0 * qc + cn, 0.0)
+    raise ValueError(f"metric {metric!r} has no additive PQ decomposition")
+
+
+def lut_scan_block(codes_block: Array, lut: Array) -> Array:
+    """codes_block [chunk, M] int — LUT [B, M, C] -> distances [B, chunk].
+
+    The PQ hot loop (product_quantization.go:56-75 LookUp, vectorized): for
+    each segment, gather the [B]-column of the LUT at each row's code and
+    accumulate. Expressed as a fori over segments so the live buffer is one
+    [B, chunk] accumulator plus one [B, C] table — VPU gathers from a
+    VMEM-resident table, codes stream from HBM once.
+    """
+    b = lut.shape[0]
+    m = codes_block.shape[1]
+    chunk = codes_block.shape[0]
+
+    def seg(i, acc):
+        table = jax.lax.dynamic_index_in_dim(lut, i, axis=1, keepdims=False)  # [B, C]
+        col = jax.lax.dynamic_index_in_dim(codes_block, i, axis=1, keepdims=False)  # [chunk]
+        return acc + jnp.take(table, col, axis=1)  # [B, chunk]
+
+    return jax.lax.fori_loop(0, m, seg, jnp.zeros((b, chunk), jnp.float32))
+
+
+# -- the quantizer -----------------------------------------------------------
+
+class ProductQuantizer:
+    """Codebook container + fit/encode (ProductQuantizer, ssdhelpers)."""
+
+    def __init__(self, dim: int, segments: int, centroids: int, metric: str,
+                 encoder: str = vi.PQ_ENCODER_KMEANS,
+                 distribution: str = vi.PQ_DISTRIBUTION_LOG_NORMAL):
+        if segments <= 0:
+            segments = dim  # auto (= dims), pq_config.go default
+        if dim % segments != 0:
+            raise vi.ConfigValidationError(
+                f"pq.segments ({segments}) must divide vector dims ({dim})")
+        if centroids > 65536:
+            raise vi.ConfigValidationError("pq.centroids must be <= 65536")
+        if encoder == vi.PQ_ENCODER_TILE and dim != segments:
+            raise vi.ConfigValidationError("tile encoder requires segments == dims")
+        self.dim = dim
+        self.segments = segments
+        self.centroids = centroids
+        self.ds = dim // segments
+        self.metric = metric
+        self.encoder = encoder
+        self.distribution = distribution
+        self.code_dtype = np.uint8 if centroids <= 256 else np.uint16
+        self.codebook: Optional[np.ndarray] = None  # [M, C, ds] float32
+        self._codebook_dev: Optional[Array] = None
+
+    # fit ---------------------------------------------------------------
+
+    def fit(self, vectors: np.ndarray, seed: int = 0) -> None:
+        vectors = np.asarray(vectors, dtype=np.float32)
+        if vectors.shape[0] > _FIT_SAMPLE_MAX:
+            rng = np.random.default_rng(seed)
+            sel = rng.choice(vectors.shape[0], _FIT_SAMPLE_MAX, replace=False)
+            vectors = vectors[sel]
+        if self.encoder == vi.PQ_ENCODER_TILE:
+            self.codebook = self._fit_tile(vectors)
+        else:
+            self.codebook = self._fit_kmeans(vectors, seed)
+        self._codebook_dev = None
+
+    def _fit_kmeans(self, vectors: np.ndarray, seed: int) -> np.ndarray:
+        n = vectors.shape[0]
+        m, c, ds = self.segments, self.centroids, self.ds
+        data_seg = np.ascontiguousarray(
+            vectors.reshape(n, m, ds).transpose(1, 0, 2))  # [M, N, ds]
+        rng = np.random.default_rng(seed)
+        # init from distinct sample rows per segment (kmeans.go random init)
+        init = np.stack([seg[rng.choice(n, min(c, n), replace=False)]
+                         for seg in data_seg])
+        if init.shape[1] < c:  # fewer samples than centroids: tile them
+            reps = -(-c // init.shape[1])
+            init = np.tile(init, (1, reps, 1))[:, :c]
+        cb = _kmeans_fit(jnp.asarray(data_seg), jnp.asarray(init))
+        return np.asarray(cb, dtype=np.float32)
+
+    def _fit_tile(self, vectors: np.ndarray) -> np.ndarray:
+        """Distribution-based scalar quantile encoder (tile_encoder.go): per
+        dimension, fit a (log-)normal and place centroids at equal-probability
+        quantile centers. Encoding then reuses the same nearest-centroid
+        argmin as kmeans (exact for 1-d sorted centroids)."""
+        c = self.centroids
+        x = vectors  # [N, D], ds == 1 enforced in __init__
+        if self.distribution == vi.PQ_DISTRIBUTION_LOG_NORMAL:
+            # guard non-positive values the way a log-normal fit must
+            shift = np.minimum(x.min(axis=0), 0.0) - 1e-6
+            y = np.log(x - shift[None, :])
+        else:
+            shift = None
+            y = x
+        mu = y.mean(axis=0)  # [D]
+        sigma = np.maximum(y.std(axis=0), 1e-9)
+        p = (np.arange(c, dtype=np.float64) + 0.5) / c  # bin centers
+        z = np.asarray(jax.scipy.special.erfinv(2.0 * p - 1.0)) * np.sqrt(2.0)
+        cent = mu[:, None] + sigma[:, None] * z[None, :]  # [D, C]
+        if shift is not None:
+            cent = np.exp(cent) + shift[:, None]
+        return cent[:, :, None].astype(np.float32)  # [M=D, C, ds=1]
+
+    # encode ------------------------------------------------------------
+
+    def _dev_codebook(self) -> Array:
+        if self._codebook_dev is None:
+            self._codebook_dev = jnp.asarray(self.codebook)
+        return self._codebook_dev
+
+    def encode(self, vectors: np.ndarray) -> np.ndarray:
+        """[N, D] float32 -> [N, M] uint8/16 codes (Encode, :348)."""
+        vectors = np.asarray(vectors, dtype=np.float32)
+        n = vectors.shape[0]
+        m, ds = self.segments, self.ds
+        out = np.empty((n, m), dtype=self.code_dtype)
+        cb = self._dev_codebook()
+        for off in range(0, n, _ENCODE_CHUNK):
+            end = min(off + _ENCODE_CHUNK, n)
+            blk = vectors[off:end].reshape(end - off, m, ds).transpose(1, 0, 2)
+            codes = np.asarray(_encode_chunk(jnp.asarray(blk), cb))
+            out[off:end] = codes.astype(self.code_dtype)
+        return out
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        """[N, M] codes -> [N, D] reconstructed float32 (centroid lookup)."""
+        codes = np.asarray(codes)
+        n, m = codes.shape
+        recon = self.codebook[np.arange(m)[None, :], codes.astype(np.int64)]  # [N, M, ds]
+        return recon.reshape(n, self.dim).astype(np.float32)
+
+    # persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        np.savez(
+            path,
+            codebook=self.codebook,
+            dim=self.dim,
+            segments=self.segments,
+            centroids=self.centroids,
+            metric=self.metric,
+            encoder=self.encoder,
+            distribution=self.distribution,
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "ProductQuantizer":
+        z = np.load(path, allow_pickle=False)
+        pq = cls(
+            dim=int(z["dim"]),
+            segments=int(z["segments"]),
+            centroids=int(z["centroids"]),
+            metric=str(z["metric"]),
+            encoder=str(z["encoder"]),
+            distribution=str(z["distribution"]),
+        )
+        pq.codebook = z["codebook"].astype(np.float32)
+        return pq

@@ -71,6 +71,10 @@ class PQConfig:
     segments: int = 0  # 0 = auto (= dims)
     centroids: int = DEFAULT_PQ_CENTROIDS
     encoder: PQEncoderConfig = field(default_factory=PQEncoderConfig)
+    # TPU extensions: exact float rescoring of the PQ top-R candidates
+    # (buys back the reference's PQ recall loss; 0 = auto R)
+    rescore: bool = True
+    rescore_limit: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "PQConfig":
@@ -84,6 +88,8 @@ class PQConfig:
                 type=enc.get("type", PQ_ENCODER_KMEANS),
                 distribution=enc.get("distribution", PQ_DISTRIBUTION_LOG_NORMAL),
             ),
+            rescore=bool(d.get("rescore", True)),
+            rescore_limit=int(d.get("rescoreLimit", 0)),
         )
 
     def to_dict(self) -> dict:
@@ -93,6 +99,8 @@ class PQConfig:
             "segments": self.segments,
             "centroids": self.centroids,
             "encoder": {"type": self.encoder.type, "distribution": self.encoder.distribution},
+            "rescore": self.rescore,
+            "rescoreLimit": self.rescore_limit,
         }
 
 

@@ -100,7 +100,7 @@ class GraphQLExecutor:
             sort=self._as_list(a.get("sort")),
             group=a.get("group"),
             group_by=a.get("groupBy"),
-            limit=int(a.get("limit", 0) or 0) or 25,
+            limit=int(a.get("limit", 0) or 0),  # 0 => traverser's query_limit
             offset=int(a.get("offset", 0) or 0),
             after=a.get("after"),
             include_vector=needs_vector,

@@ -116,7 +116,12 @@ def _tokenize(src: str) -> list[tuple[str, Any]]:
                     if esc == "u":
                         if j + 6 > n:
                             raise GraphQLParseError("unterminated unicode escape")
-                        buf.append(chr(int(src[j + 2 : j + 6], 16)))
+                        try:
+                            buf.append(chr(int(src[j + 2 : j + 6], 16)))
+                        except ValueError:
+                            raise GraphQLParseError(
+                                f"invalid unicode escape {src[j : j + 6]!r}"
+                            ) from None
                         j += 6
                         continue
                     buf.append(mapping.get(esc, esc))
@@ -139,10 +144,13 @@ def _tokenize(src: str) -> list[tuple[str, Any]]:
                     break
                 j += 1
             text = src[i:j]
-            if any(ch in text for ch in ".eE"):
-                toks.append(("float", float(text)))
-            else:
-                toks.append(("int", int(text)))
+            try:
+                if any(ch in text for ch in ".eE"):
+                    toks.append(("float", float(text)))
+                else:
+                    toks.append(("int", int(text)))
+            except ValueError:
+                raise GraphQLParseError(f"malformed number literal {text!r}") from None
             i = j
             continue
         if c.isalpha() or c == "_":

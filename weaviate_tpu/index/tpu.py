@@ -182,6 +182,95 @@ def _search_full(
     return _pack(top, idx)
 
 
+# rows of the uint8 code matrix scored per PQ scan step ([B, chunk] f32
+# accumulator + one [B, C] VMEM table per segment; codes stream from HBM)
+_PQ_SCAN_CHUNK = 32768
+
+
+@functools.partial(
+    jax.jit, static_argnames=("r", "use_allow", "exact", "active_chunks")
+)
+def _search_pq(codes, tombs, n, lut, allow_words, r, use_allow, exact=False,
+               active_chunks=None):
+    """PQ twin of _search_full: scan the [cap, M] code matrix in HBM chunks,
+    score each chunk via the additive LUT gather (compress/pq.py
+    lut_scan_block — product_quantization.go:56-75 LookUp, vectorized),
+    exact cross-chunk merge of the top-r candidate slots."""
+    from weaviate_tpu.compress.pq import lut_scan_block
+
+    cap, m = codes.shape
+    chunk = min(cap, _PQ_SCAN_CHUNK)
+    nchunks = cap // chunk
+    if active_chunks is not None:
+        nchunks = max(1, min(nchunks, active_chunks))
+    b = lut.shape[0]
+
+    ext = nchunks * chunk
+    codes_c = codes[:ext].reshape(nchunks, chunk, m)
+    tombs_c = tombs[:ext].reshape(nchunks, chunk)
+    allow_c = allow_words[: ext // 32].reshape(nchunks, chunk // 32) if use_allow else None
+
+    def step(carry, xs):
+        best_d, best_i = carry
+        ci, codes_l, tombs_l = xs[0], xs[1], xs[2]
+        base = ci * chunk
+        valid = jnp.logical_and(jnp.arange(chunk) + base < n, jnp.logical_not(tombs_l))
+        if use_allow:
+            valid = jnp.logical_and(valid, bitmap_to_mask(xs[3], chunk))
+        d = lut_scan_block(codes_l.astype(jnp.int32), lut)
+        d = jnp.where(valid[None, :], d, jnp.inf)
+        if exact:
+            neg, li = jax.lax.top_k(-d, r)
+            td = -neg
+        else:
+            td, li = jax.lax.approx_min_k(d, r, recall_target=0.95)
+        merged = merge_top_k(best_d, best_i, td, li + base, r)
+        return merged, None
+
+    init = (jnp.full((b, r), jnp.inf, jnp.float32), jnp.full((b, r), -1, jnp.int32))
+    xs = [jnp.arange(nchunks), codes_c, tombs_c]
+    if use_allow:
+        xs.append(allow_c)
+    (top, idx), _ = jax.lax.scan(step, init, tuple(xs))
+    idx = jnp.where(jnp.isinf(top), -1, idx).astype(jnp.int32)
+    return _pack(top, idx)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _rescore_candidates(cand_vecs, q, cand_valid, k, metric):
+    """Exact float rescoring of PQ candidates: cand_vecs [B, R, D] (gathered
+    host-side from the full-precision row store), q [B, D] -> packed top-k
+    (dists, positions-into-R). Elementwise per-pair distances — R is small so
+    this is VPU work overlapping the next batch's scan."""
+    qf = q.astype(jnp.float32)[:, None, :]
+    cf = cand_vecs.astype(jnp.float32)
+    if metric == vi.DISTANCE_L2:
+        d = jnp.sum((cf - qf) ** 2, axis=-1)
+    elif metric == vi.DISTANCE_DOT:
+        d = -jnp.sum(cf * qf, axis=-1)
+    elif metric == vi.DISTANCE_COSINE:
+        d = 1.0 - jnp.sum(cf * qf, axis=-1)
+    elif metric == vi.DISTANCE_MANHATTAN:
+        d = jnp.sum(jnp.abs(cf - qf), axis=-1)
+    else:
+        d = jnp.sum((cf != qf).astype(jnp.float32), axis=-1)
+    d = jnp.where(cand_valid, d, jnp.inf)
+    neg, pos = jax.lax.top_k(-d, k)
+    top = -neg
+    return _pack(top, jnp.where(jnp.isinf(top), -1, pos).astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _score_rows(sub, q, row_valid, k, metric):
+    """Score an uploaded [R, D] row block against [B, D] queries (the gather
+    path when the float store lives host-side under PQ)."""
+    dists = DISTANCE_FNS[metric](q.astype(sub.dtype), sub, None)
+    masked = jnp.where(row_valid[None, :], dists, jnp.inf)
+    neg, idx = jax.lax.top_k(-masked, k)
+    top = -neg
+    return _pack(top, jnp.where(jnp.isinf(top), -1, idx).astype(jnp.int32))
+
+
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
 def _search_gathered(store, q, rows, row_valid, k, metric):
     """Gather path for small allowLists (flat_search.go:19 analog): score only
@@ -316,6 +405,15 @@ class TpuVectorIndex(VectorIndex):
         self._pending_tombs: list[int] = []
         # lazily-rebuilt sorted (docs, slots) pair for vectorized doc->slot
         self._map_cache: Optional[tuple[np.ndarray, np.ndarray]] = None
+        # PQ state (compress.go analog): when compressed, the device holds
+        # [cap, M] uint8/16 codes instead of floats; full-precision rows move
+        # to host RAM for the rescoring pass
+        self.compressed = False
+        self._pq = None                     # ProductQuantizer
+        self._codes = None                  # device [capacity, M]
+        self._host_vecs: Optional[np.ndarray] = None  # np [capacity, D] f32
+        self._pq_path = os.path.join(shard_path, "pq.npz")
+        self._restoring = False
         self._log = VectorLog(os.path.join(shard_path, "vector.log")) if persist else None
         if self._log is not None:
             self._restore()
@@ -323,12 +421,26 @@ class TpuVectorIndex(VectorIndex):
     # -- lifecycle -----------------------------------------------------------
 
     def _restore(self) -> None:
-        """Replay the vector log (startup.go:56 restoreFromDisk analog)."""
-        for op, doc_id, vec in VectorLog.replay(self._log.path):
-            if op == "add":
-                self._stage_add(doc_id, vec, log=False)
-            else:
-                self._stage_delete(doc_id, log=False)
+        """Replay the vector log (startup.go:56 restoreFromDisk analog); if a
+        persisted PQ codebook exists, re-enter compressed mode (the analog of
+        commit-log AddPQ replay, deserializer.go) — codes are re-derived on
+        device, which beats persisting them."""
+        self._restoring = True
+        try:
+            for op, doc_id, vec in VectorLog.replay(self._log.path):
+                if op == "add":
+                    self._stage_add(doc_id, vec, log=False)
+                else:
+                    self._stage_delete(doc_id, log=False)
+            if os.path.exists(self._pq_path):
+                from weaviate_tpu.compress.pq import ProductQuantizer
+
+                self._flush_pending()
+                if self.n > 0:
+                    vecs = np.asarray(self._store[: self.n], dtype=np.float32)
+                    self._enable_pq(ProductQuantizer.load(self._pq_path), vecs, save=False)
+        finally:
+            self._restoring = False
 
     def post_startup(self) -> None:
         self._flush_pending()
@@ -345,19 +457,49 @@ class TpuVectorIndex(VectorIndex):
         self._slot_to_doc = np.full(self.capacity, -1, dtype=np.int64)
 
     def _ensure_capacity(self, needed: int) -> None:
-        if self._store is None:
+        if self._store is None and self._codes is None:
             raise RuntimeError("store not initialised")
         cap = self.capacity
         while cap < needed:
             cap *= 2  # geometric growth (maintainance.go:31)
         if cap != self.capacity:
-            self._store = _grow_store(self._store, cap)
-            self._sq_norms = _grow_1d(self._sq_norms, cap, jnp.float32(0))
+            if self.compressed:
+                self._codes = _grow_store(self._codes, cap)
+                hv = np.zeros((cap, self.dim), np.float32)
+                hv[: self.capacity] = self._host_vecs
+                self._host_vecs = hv
+            else:
+                self._store = _grow_store(self._store, cap)
+                self._sq_norms = _grow_1d(self._sq_norms, cap, jnp.float32(0))
             self._tombs = _grow_1d(self._tombs, cap, False)
             s2d = np.full(cap, -1, dtype=np.int64)
             s2d[: self.capacity] = self._slot_to_doc
             self._slot_to_doc = s2d
             self.capacity = cap
+
+    def _write_block(self, rows: np.ndarray, start: int) -> None:
+        """Land [count, D] float32 rows at slots [start, start+count) in
+        fixed-size chunks (one compiled shape). In compressed mode the chunk
+        is PQ-encoded on device and only the codes hit HBM; the float rows go
+        to the host-side rescoring store."""
+        count = rows.shape[0]
+        off = 0
+        while off < count:
+            take = min(_CHUNK, count - off)
+            chunk = np.zeros((_CHUNK, self.dim), dtype=np.float32)
+            chunk[:take] = rows[off : off + take]
+            self._ensure_capacity(start + off + _CHUNK)
+            if self.compressed:
+                codes = self._pq.encode(chunk)  # [_CHUNK, M]
+                self._codes = _write_rows(self._codes, jnp.asarray(codes), start + off)
+            else:
+                self._store = _write_rows(self._store, jnp.asarray(chunk, self.dtype), start + off)
+                if self.metric == vi.DISTANCE_L2:
+                    nchunk = jnp.asarray((chunk.astype(np.float64) ** 2).sum(1).astype(np.float32))
+                    self._sq_norms = _write_norms(self._sq_norms, nchunk, start + off)
+            off += take
+        if self.compressed:
+            self._host_vecs[start : start + count] = rows
 
     def _stage_add(self, doc_id: int, vector: np.ndarray, log: bool = True) -> None:
         vector = np.asarray(vector, dtype=np.float32)
@@ -406,22 +548,9 @@ class TpuVectorIndex(VectorIndex):
             docs = np.array(list(self._pending.keys()), dtype=np.int64)
             count = rows.shape[0]
             self._ensure_capacity(self.n + count)
-            # write in fixed-size chunks (pad the tail) to keep one compiled shape
-            off = 0
-            while off < count:
-                take = min(_CHUNK, count - off)
-                chunk = np.zeros((_CHUNK, self.dim), dtype=np.float32)
-                chunk[:take] = rows[off : off + take]
-                # tail padding must not clobber rows beyond n+count: since
-                # capacity is padded in _CHUNK multiples beyond need this only
-                # overwrites unused slots
-                self._ensure_capacity(self.n + off + _CHUNK)
-                dchunk = jnp.asarray(chunk, self.dtype)
-                self._store = _write_rows(self._store, dchunk, self.n + off)
-                if self.metric == vi.DISTANCE_L2:
-                    nchunk = jnp.asarray((chunk.astype(np.float64) ** 2).sum(1).astype(np.float32))
-                    self._sq_norms = _write_norms(self._sq_norms, nchunk, self.n + off)
-                off += take
+            # chunked writes pad the tail; capacity is padded in _CHUNK
+            # multiples beyond need so padding only lands in unused slots
+            self._write_block(rows, self.n)
             self._slot_to_doc[self.n : self.n + count] = docs
             for i, d in enumerate(docs):
                 self._doc_to_slot[int(d)] = self.n + i
@@ -435,6 +564,66 @@ class TpuVectorIndex(VectorIndex):
             padded[: len(idx)] = idx
             self._tombs = _set_tombstones(self._tombs, jnp.asarray(padded))
             self._pending_tombs.clear()
+        # pq.enabled set at class creation: compress once enough data exists
+        # to fit codebooks (the reference requires an explicit post-import
+        # config update; we also honor the declarative form)
+        if (
+            self.config.pq.enabled
+            and not self.compressed
+            and not self._restoring
+            and self.n >= max(256, self.config.pq.centroids)
+        ):
+            self._compress_locked()
+
+    # -- product quantization (compress.go analog) ---------------------------
+
+    def compress(self) -> None:
+        """Fit PQ on the current store, encode all rows, swap the device
+        float store for codes (compress.go:39: fit on cached vectors, encode,
+        persist codebook, drop float cache, flip compressed)."""
+        with self._lock:
+            self._pending_flush_for_compress()
+            self._compress_locked()
+
+    def _pending_flush_for_compress(self) -> None:
+        if self._pending or self._pending_tombs:
+            self._flush_pending()
+
+    def _compress_locked(self) -> None:
+        from weaviate_tpu.compress.pq import ProductQuantizer
+
+        if self.compressed:
+            return
+        if self.n == 0:
+            raise RuntimeError("compress requires imported vectors to fit on")
+        pq = ProductQuantizer(
+            dim=self.dim,
+            segments=self.config.pq.segments,
+            centroids=self.config.pq.centroids,
+            metric=self.metric,
+            encoder=self.config.pq.encoder.type,
+            distribution=self.config.pq.encoder.distribution,
+        )
+        vecs = np.asarray(self._store[: self.n], dtype=np.float32)
+        pq.fit(vecs)
+        self._enable_pq(pq, vecs, save=True)
+
+    def _enable_pq(self, pq, vecs_n: np.ndarray, save: bool) -> None:
+        codes = pq.encode(vecs_n)  # [n, M]
+        full = np.zeros((self.capacity, pq.segments), dtype=pq.code_dtype)
+        full[: self.n] = codes
+        self._codes = jax.device_put(jnp.asarray(full), self.device)
+        hv = np.zeros((self.capacity, self.dim), np.float32)
+        hv[: self.n] = vecs_n
+        self._host_vecs = hv
+        self._store = None
+        self._sq_norms = None
+        self._pq = pq
+        self.compressed = True
+        if not self.config.pq.enabled:
+            self.config.pq.enabled = True
+        if save and self._log is not None:
+            pq.save(self._pq_path)
 
     # -- VectorIndex ---------------------------------------------------------
 
@@ -475,17 +664,7 @@ class TpuVectorIndex(VectorIndex):
                 self._log.append_add_batch(doc_arr, vectors)
             count = vectors.shape[0]
             self._ensure_capacity(self.n + count + _CHUNK)
-            off = 0
-            while off < count:
-                take = min(_CHUNK, count - off)
-                chunk = np.zeros((_CHUNK, self.dim), dtype=np.float32)
-                chunk[:take] = vectors[off : off + take]
-                self._ensure_capacity(self.n + off + _CHUNK)
-                self._store = _write_rows(self._store, jnp.asarray(chunk, self.dtype), self.n + off)
-                if self.metric == vi.DISTANCE_L2:
-                    nchunk = jnp.asarray((chunk.astype(np.float64) ** 2).sum(1).astype(np.float32))
-                    self._sq_norms = _write_norms(self._sq_norms, nchunk, self.n + off)
-                off += take
+            self._write_block(vectors, self.n)
             self._slot_to_doc[self.n : self.n + count] = doc_arr
             new_slots = dict(zip(doc_arr.tolist(), range(self.n, self.n + count)))
             self._doc_to_slot.update(new_slots)
@@ -546,6 +725,8 @@ class TpuVectorIndex(VectorIndex):
 
             if allow_list is not None and len(allow_list) < self.config.flat_search_cutoff:
                 ids, dists = self._search_small_allow(q, b, k_eff, allow_list)
+            elif self.compressed:
+                ids, dists = self._search_full_pq(q, b, k_eff, allow_list)
             else:
                 allow_words = self._allow_words(allow_list) if allow_list is not None else None
                 kk = min(max(k_eff, 1), self.n)
@@ -570,6 +751,62 @@ class TpuVectorIndex(VectorIndex):
                 ids = np.where(idx >= 0, self._slot_to_doc[np.clip(idx, 0, None)], -1)
                 dists = top
             return ids.astype(np.uint64), dists.astype(np.float32)
+
+    def _search_full_pq(self, q: np.ndarray, b: int, k: int, allow_list):
+        """Compressed full-store search: LUT scan over the code matrix for the
+        top-R candidate slots, then (by default) exact float rescoring from
+        the host-side row store."""
+        from weaviate_tpu.compress.pq import build_lut
+
+        pqc = self.config.pq
+        rescore = pqc.rescore
+        # default candidate depth: 0.975+ recall at R=128 and ~1.0 at R=256
+        # on clustered data (see tests/test_pq.py); 8k/200 buckets to 256
+        r_cfg = pqc.rescore_limit or max(8 * k, 200)
+        # clamp to the scan chunk: per-chunk top-r can't select more rows
+        # than one chunk holds
+        r = min(_bucket_b(r_cfg) if rescore else k, self.n, _PQ_SCAN_CHUNK)
+        allow_words = self._allow_words(allow_list) if allow_list is not None else None
+        lut = build_lut(jnp.asarray(q), self._pq._dev_codebook(), self.metric)
+        packed = np.asarray(
+            _search_pq(
+                self._codes,
+                self._tombs,
+                self.n,
+                lut,
+                allow_words if allow_words is not None else jnp.zeros((self.capacity // 32,), jnp.uint32),
+                r,
+                allow_words is not None,
+                getattr(self.config, "exact_topk", False),
+                -(-self.n // _PQ_SCAN_CHUNK),
+            )
+        )
+        top, slots = _unpack(packed)  # padded [bb, R]
+        if not rescore:
+            top, slots = top[:b], slots[:b]
+            if self.metric == vi.DISTANCE_COSINE:
+                top = np.where(np.isinf(top), top, top + 1.0)
+            ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
+            return ids[:, :k], top[:, :k]
+        # gather candidates' float rows host-side, exact-rescore on device
+        # (padded batch throughout: one compiled shape per (bb, R, k))
+        safe = np.clip(slots, 0, None)
+        cand_vecs = self._host_vecs[safe]  # [bb, R, D]
+        packed2 = np.asarray(
+            _rescore_candidates(
+                jnp.asarray(cand_vecs),
+                jnp.asarray(q),
+                jnp.asarray(slots >= 0),
+                min(k, r),
+                self.metric,
+            )
+        )
+        dists, pos = _unpack(packed2)
+        dists, pos, slots = dists[:b], pos[:b], slots[:b]
+        row = np.arange(b)[:, None]
+        final_slots = np.where(pos >= 0, slots[row, np.clip(pos, 0, None)], -1)
+        ids = np.where(final_slots >= 0, self._slot_to_doc[np.clip(final_slots, 0, None)], -1)
+        return ids, dists
 
     def _sorted_doc_slots(self) -> tuple[np.ndarray, np.ndarray]:
         if self._map_cache is None:
@@ -599,11 +836,19 @@ class TpuVectorIndex(VectorIndex):
         row_valid = np.zeros(r, dtype=bool)
         row_valid[: slots.size] = True
         kk = min(k, slots.size)
-        packed = np.asarray(
-            _search_gathered(
-                self._store, jnp.asarray(q), jnp.asarray(rows), jnp.asarray(row_valid), kk, self.metric
+        if self.compressed:
+            # float rows live host-side under PQ: upload the gathered block
+            sub = np.zeros((r, self.dim), np.float32)
+            sub[: slots.size] = self._host_vecs[slots]
+            packed = np.asarray(
+                _score_rows(jnp.asarray(sub), jnp.asarray(q), jnp.asarray(row_valid), kk, self.metric)
             )
-        )
+        else:
+            packed = np.asarray(
+                _search_gathered(
+                    self._store, jnp.asarray(q), jnp.asarray(rows), jnp.asarray(row_valid), kk, self.metric
+                )
+            )
         top, idx = _unpack(packed)
         top = top[:b]
         idx = idx[:b]
@@ -631,6 +876,9 @@ class TpuVectorIndex(VectorIndex):
             if self.n == 0 or self.live == 0:
                 b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
                 return lambda: (np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32))
+            if self.compressed:
+                ids, dists = self.search_by_vectors(vectors, k)
+                return lambda: (ids, dists)
             q, b = self._prep_queries(vectors)
             kk = min(max(min(k, self.live), 1), self.n)
             packed_dev = _search_full(
@@ -682,7 +930,14 @@ class TpuVectorIndex(VectorIndex):
     def update_user_config(self, updated: vi.HnswUserConfig) -> None:
         with self._lock:
             vi.validate_config_update(self.config, updated)
+            was_enabled = self.config.pq.enabled
             self.config = updated
+            # pq.enabled flipped on by a config update triggers compression
+            # (compress.go: "triggered by config update pq.enabled")
+            if updated.pq.enabled and not was_enabled and not self.compressed:
+                self._flush_pending()
+                if self.n > 0:
+                    self._compress_locked()
 
     def flush(self) -> None:
         with self._lock:
@@ -691,7 +946,8 @@ class TpuVectorIndex(VectorIndex):
                 self._log.flush()
 
     def compact(self) -> None:
-        """Condense: drop tombstoned slots, rewrite log (condensor.go analog)."""
+        """Condense: drop tombstoned slots, rewrite log (condensor.go analog).
+        Under PQ the rebuild re-encodes against the existing codebook."""
         with self._lock:
             self._flush_pending()
             if self.n == 0:
@@ -699,12 +955,20 @@ class TpuVectorIndex(VectorIndex):
             live_slots = np.array(sorted(self._doc_to_slot.values()), dtype=np.int64)
             if live_slots.size == self.n:
                 return
-            store_host = np.asarray(self._store[: self.n]).astype(np.float32)
+            if self.compressed:
+                store_host = self._host_vecs[: self.n]
+            else:
+                store_host = np.asarray(self._store[: self.n]).astype(np.float32)
             docs = self._slot_to_doc[live_slots]
             vecs = store_host[live_slots]
             if self._log is not None:
                 self._log.rewrite(zip(docs.tolist(), vecs))
-            # rebuild device state
+            # rebuild device state (uncompressed rebuild, then re-encode)
+            pq, was_compressed = self._pq, self.compressed
+            self.compressed = False
+            self._pq = None
+            self._codes = None
+            self._host_vecs = None
             self.dim = None
             self.capacity = 0
             self.n = 0
@@ -715,6 +979,9 @@ class TpuVectorIndex(VectorIndex):
             for d, v in zip(docs.tolist(), vecs):
                 self._stage_add(int(d), v, log=False)
             self._flush_pending()
+            if was_compressed and self.n > 0:
+                fresh = np.asarray(self._store[: self.n], dtype=np.float32)
+                self._enable_pq(pq, fresh, save=False)
 
     def drop(self) -> None:
         with self._lock:
@@ -735,6 +1002,14 @@ class TpuVectorIndex(VectorIndex):
             self._map_cache = None
             self._pending.clear()
             self._pending_tombs.clear()
+            self.compressed = False
+            self._pq = None
+            self._codes = None
+            self._host_vecs = None
+            try:
+                os.remove(self._pq_path)
+            except FileNotFoundError:
+                pass
 
     def shutdown(self) -> None:
         with self._lock:
@@ -744,4 +1019,7 @@ class TpuVectorIndex(VectorIndex):
                 self._log.close()
 
     def list_files(self) -> list[str]:
-        return [self._log.path] if self._log is not None else []
+        files = [self._log.path] if self._log is not None else []
+        if os.path.exists(self._pq_path):
+            files.append(self._pq_path)
+        return files

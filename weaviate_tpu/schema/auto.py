@@ -44,15 +44,19 @@ class AutoSchema:
             return self.default_date if _looks_like_date(value) else self.default_string
         if isinstance(value, float):
             return self.default_number
-        if isinstance(value, list) and value and isinstance(value[0], str):
-            inner = self.infer_type(value[0])
-            return f"{inner}[]"
+        if isinstance(value, list) and value:
+            if isinstance(value[0], str):
+                inner = self.infer_type(value[0])
+                return f"{inner}[]"
+            if isinstance(value[0], dict):
+                return "object"  # list of nested objects: not auto-indexable
         if isinstance(value, dict) and not (
             {"latitude", "longitude"} <= set(value)
-            or "input" in value
-            or "internationalFormatted" in value
+            or ("input" in value or "internationalFormatted" in value)
         ):
             return "object"  # plain nested object: not auto-indexable
+        if isinstance(value, dict) and ("input" in value or "internationalFormatted" in value):
+            return "phoneNumber"
         try:
             return datatype_of_value(value).value
         except SchemaError:

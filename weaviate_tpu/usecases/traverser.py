@@ -62,9 +62,15 @@ class Traverser:
                 return self.explorer.get_class(params)
         return self.explorer.get_class(params)
 
-    def get_class_batched(self, params_list: Sequence[GetParams]) -> list[list[SearchResult]]:
+    def get_class_batched(
+        self, params_list: Sequence[GetParams]
+    ) -> "list[list[SearchResult] | Exception]":
         """Cross-query batched entry (TPU extension): nearVector queries of
-        the same class ride one device dispatch."""
+        the same class ride one device dispatch.
+
+        Per-slot error isolation: a slot whose query failed holds the
+        Exception instead of a result list (callers check isinstance) — one
+        bad query must not fail the whole device batch."""
         return self.explorer.get_class_batched(params_list)
 
 
@@ -189,7 +195,7 @@ class Explorer:
         # grouping needs result vectors even if the caller didn't ask for them
         inc_vec = params.include_vector or params.group is not None
         if params.hybrid is not None:
-            res = self._hybrid(params, idx, limit)
+            res = self._hybrid(params, idx, limit, inc_vec)
         elif params.keyword_ranking is not None:
             res = idx.object_search(
                 limit,
@@ -221,8 +227,12 @@ class Explorer:
 
     # -- hybrid (explorer.go:227, hybrid/searcher.go) ------------------------
 
-    def _hybrid(self, params: GetParams, idx, limit: int) -> list[SearchResult]:
+    def _hybrid(
+        self, params: GetParams, idx, limit: int, include_vector: bool | None = None
+    ) -> list[SearchResult]:
         h = params.hybrid
+        if include_vector is None:
+            include_vector = params.include_vector
         alpha = float(h.get("alpha", 0.75))
         query = h.get("query") or ""
         fetch = max(limit * 4, 100)  # oversample both legs before fusion
@@ -233,7 +243,7 @@ class Explorer:
                 fetch,
                 flt=params.filters,
                 keyword_ranking={"query": query, "properties": h.get("properties")},
-                include_vector=params.include_vector,
+                include_vector=include_vector,
             )
         if alpha > 0:
             vec = h.get("vector")
@@ -246,7 +256,7 @@ class Explorer:
                     np.asarray(vec, dtype=np.float32),
                     fetch,
                     flt=params.filters,
-                    include_vector=params.include_vector,
+                    include_vector=include_vector,
                 )[0]
         fused = hybrid_mod.fuse(sparse, dense, alpha, h.get("fusionType"))
         return fused[params.offset : params.offset + limit]
