@@ -1,0 +1,163 @@
+"""Config env parsing, auth composer, adminlist, metrics registry.
+
+Reference test model: usecases/config tests + auth composer/adminlist tests.
+"""
+
+import base64
+import json
+
+import pytest
+
+from weaviate_tpu.auth import (
+    Authenticator,
+    Authorizer,
+    ForbiddenError,
+    UnauthorizedError,
+)
+from weaviate_tpu.config import Config, ConfigError, load_config
+from weaviate_tpu.monitoring import noop_metrics
+
+
+def test_defaults():
+    cfg = load_config({})
+    assert cfg.persistence.data_path == "./data"
+    assert cfg.auth.anonymous.enabled is True
+    assert cfg.query_defaults_limit == 25
+    assert cfg.query_maximum_results == 10000
+    assert cfg.cluster.gossip_bind_port == 7946
+    assert cfg.monitoring.enabled is False
+
+
+def test_env_surface():
+    cfg = load_config({
+        "PERSISTENCE_DATA_PATH": "/tmp/w",
+        "QUERY_DEFAULTS_LIMIT": "50",
+        "QUERY_MAXIMUM_RESULTS": "500",
+        "PROMETHEUS_MONITORING_ENABLED": "true",
+        "PROMETHEUS_MONITORING_PORT": "9999",
+        "CLUSTER_HOSTNAME": "node1",
+        "CLUSTER_JOIN": "a:7946, b:7946",
+        "ENABLE_MODULES": "text2vec-contextionary,backup-filesystem",
+        "DEFAULT_VECTORIZER_MODULE": "text2vec-contextionary",
+        "TRACK_VECTOR_DIMENSIONS": "true",
+        "GRPC_PORT": "50055",
+    })
+    assert cfg.persistence.data_path == "/tmp/w"
+    assert cfg.query_defaults_limit == 50
+    assert cfg.monitoring.enabled and cfg.monitoring.port == 9999
+    assert cfg.cluster.join == ["a:7946", "b:7946"]
+    assert cfg.enable_modules == ["text2vec-contextionary", "backup-filesystem"]
+    assert cfg.track_vector_dimensions is True
+    assert cfg.grpc_port == 50055
+
+
+def test_invalid_int_rejected():
+    with pytest.raises(ConfigError):
+        load_config({"QUERY_MAXIMUM_RESULTS": "lots"})
+
+
+def test_apikey_requires_keys_and_users():
+    with pytest.raises(ConfigError):
+        load_config({"AUTHENTICATION_APIKEY_ENABLED": "true"})
+    with pytest.raises(ConfigError):
+        load_config({
+            "AUTHENTICATION_APIKEY_ENABLED": "true",
+            "AUTHENTICATION_APIKEY_ALLOWED_KEYS": "k1,k2",
+            "AUTHENTICATION_APIKEY_USERS": "a,b,c",  # mismatch
+        })
+
+
+def _auth_cfg(**env):
+    return load_config(env).auth
+
+
+def test_anonymous_disabled_when_apikey_on():
+    cfg = load_config({
+        "AUTHENTICATION_APIKEY_ENABLED": "true",
+        "AUTHENTICATION_APIKEY_ALLOWED_KEYS": "secret1,secret2",
+        "AUTHENTICATION_APIKEY_USERS": "alice,bob",
+    })
+    a = Authenticator(cfg.auth)
+    p = a.principal_from_bearer("secret2")
+    assert p.username == "bob"
+    with pytest.raises(UnauthorizedError):
+        a.principal_from_bearer("wrong")
+    with pytest.raises(UnauthorizedError):
+        a.principal_from_bearer(None)  # anonymous off by default with apikey on
+
+
+def test_single_user_for_all_keys():
+    cfg = load_config({
+        "AUTHENTICATION_APIKEY_ENABLED": "true",
+        "AUTHENTICATION_APIKEY_ALLOWED_KEYS": "k1,k2",
+        "AUTHENTICATION_APIKEY_USERS": "svc",
+    })
+    a = Authenticator(cfg.auth)
+    assert a.principal_from_bearer("k1").username == "svc"
+    assert a.principal_from_bearer("k2").username == "svc"
+
+
+def test_anonymous_principal():
+    a = Authenticator(load_config({}).auth)
+    p = a.principal_from_bearer(None)
+    assert p.anonymous and p.username == "anonymous"
+
+
+def test_oidc_fails_closed_without_validator():
+    cfg = load_config({
+        "AUTHENTICATION_OIDC_ENABLED": "true",
+        "AUTHENTICATION_OIDC_ISSUER": "https://issuer",
+        "AUTHENTICATION_OIDC_USERNAME_CLAIM": "email",
+    })
+    claims = base64.urlsafe_b64encode(
+        json.dumps({"email": "u@x.io"}).encode()).decode().rstrip("=")
+    token = f"h.{claims}.sig"
+    # forged/unsigned tokens are rejected unless a validator is wired
+    with pytest.raises(UnauthorizedError):
+        Authenticator(cfg.auth).principal_from_bearer(token)
+    # an explicitly-opted-in unverified validator (dev/test only) parses claims
+    a = Authenticator(cfg.auth)
+    a.oidc_validator = a.unverified_claims_validator()
+    assert a.principal_from_bearer(token).username == "u@x.io"
+
+
+def test_adminlist():
+    cfg = load_config({
+        "AUTHORIZATION_ADMINLIST_ENABLED": "true",
+        "AUTHORIZATION_ADMINLIST_USERS": "root",
+        "AUTHORIZATION_ADMINLIST_READONLY_USERS": "viewer",
+    })
+    z = Authorizer(cfg.authz)
+    from weaviate_tpu.auth.auth import Principal
+
+    z.authorize(Principal("root"), "create", "schema/things")
+    z.authorize(Principal("viewer"), "get", "schema/things")
+    with pytest.raises(ForbiddenError):
+        z.authorize(Principal("viewer"), "create", "schema/things")
+    with pytest.raises(ForbiddenError):
+        z.authorize(Principal("stranger"), "get", "schema/things")
+
+
+def test_adminlist_disabled_allows_all():
+    from weaviate_tpu.auth.auth import Principal
+
+    z = Authorizer(load_config({}).authz)
+    z.authorize(Principal("anyone"), "delete", "objects")  # no raise
+
+
+def test_metrics_registry_exposition():
+    m = noop_metrics()
+    m.object_count.labels(class_name="A", shard_name="s0").set(5)
+    m.query_durations.labels(class_name="A", query_type="vector").observe(1.5)
+    m.vector_index_ops.labels(operation="add", class_name="A", shard_name="s0").inc(3)
+    text = m.expose().decode()
+    assert 'weaviate_object_count{class_name="A",shard_name="s0"} 5.0' in text
+    assert "weaviate_queries_durations_ms_bucket" in text
+    assert "weaviate_vector_index_operations_total" in text
+
+
+def test_metrics_isolated_registries():
+    m1, m2 = noop_metrics(), noop_metrics()
+    m1.object_count.labels(class_name="A", shard_name="s").set(1)
+    assert b"weaviate_object_count" not in m2.expose() or \
+        b'class_name="A"' not in m2.expose()

@@ -1,0 +1,127 @@
+"""gRPC Search/BatchSearch service tests.
+
+Reference surface: adapters/handlers/grpc/server.go + grpc/weaviate.proto.
+"""
+
+import json
+import uuid as uuidlib
+
+import grpc
+import numpy as np
+import pytest
+
+from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+from weaviate_tpu.server import App
+from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    app = App(data_path=str(tmp_path_factory.mktemp("data")))
+    app.schema.add_class({
+        "class": "Doc",
+        "properties": [
+            {"name": "body", "dataType": ["text"]},
+            {"name": "rank", "dataType": ["int"]},
+        ],
+        "vectorIndexConfig": {"distance": "l2-squared"},
+    })
+    rng = np.random.default_rng(11)
+    vecs = rng.standard_normal((30, 16)).astype(np.float32)
+    app.batch.add_objects([{
+        "class": "Doc",
+        "id": str(uuidlib.UUID(int=i + 1)),
+        "properties": {"body": f"common term{i} text", "rank": i},
+        "vector": vecs[i].tolist(),
+    } for i in range(30)])
+    srv = GrpcServer(app, port=0)
+    srv.start()
+    client = SearchClient(f"127.0.0.1:{srv.port}")
+    yield app, srv, client, vecs
+    client.close()
+    srv.stop()
+    app.shutdown()
+
+
+def test_near_vector_search(setup):
+    app, srv, client, vecs = setup
+    req = pb.SearchRequest(
+        class_name="Doc", limit=3,
+        near_vector=pb.NearVectorParams(vector=vecs[5].tolist()),
+        additional_properties=["distance", "vector"],
+    )
+    reply = client.search(req)
+    assert len(reply.results) == 3
+    top = reply.results[0]
+    assert top.id == str(uuidlib.UUID(int=6))
+    assert top.distance < 1e-3
+    assert len(top.vector) == 16
+    props = json.loads(top.properties_json)
+    assert props["rank"] == 5
+
+
+def test_property_selection(setup):
+    _, _, client, vecs = setup
+    req = pb.SearchRequest(
+        class_name="Doc", limit=1, properties=["rank"],
+        near_vector=pb.NearVectorParams(vector=vecs[0].tolist()))
+    props = json.loads(client.search(req).results[0].properties_json)
+    assert set(props) == {"rank"}
+
+
+def test_bm25_and_filter(setup):
+    _, _, client, _ = setup
+    req = pb.SearchRequest(
+        class_name="Doc", limit=5,
+        bm25=pb.BM25Params(query="term7"),
+    )
+    reply = client.search(req)
+    assert reply.results and json.loads(reply.results[0].properties_json)["rank"] == 7
+
+    req = pb.SearchRequest(
+        class_name="Doc", limit=30,
+        where_json=json.dumps(
+            {"operator": "GreaterThanEqual", "path": ["rank"], "valueInt": 25}),
+    )
+    reply = client.search(req)
+    ranks = {json.loads(r.properties_json)["rank"] for r in reply.results}
+    assert ranks == {25, 26, 27, 28, 29}
+
+
+def test_unknown_class_aborts(setup):
+    _, _, client, vecs = setup
+    req = pb.SearchRequest(class_name="Nope", limit=1,
+                           near_vector=pb.NearVectorParams(vector=vecs[0].tolist()))
+    with pytest.raises(grpc.RpcError) as e:
+        client.search(req)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_batch_search_one_dispatch(setup):
+    _, _, client, vecs = setup
+    breq = pb.BatchSearchRequest(requests=[
+        pb.SearchRequest(class_name="Doc", limit=2,
+                         near_vector=pb.NearVectorParams(vector=vecs[i].tolist()))
+        for i in range(8)
+    ])
+    reply = client.batch_search(breq)
+    assert len(reply.replies) == 8
+    for i, one in enumerate(reply.replies):
+        assert one.results[0].id == str(uuidlib.UUID(int=i + 1))
+
+
+def test_batch_search_per_slot_errors(setup):
+    _, _, client, vecs = setup
+    breq = pb.BatchSearchRequest(requests=[
+        pb.SearchRequest(class_name="Doc", limit=2,
+                         near_vector=pb.NearVectorParams(vector=vecs[0].tolist())),
+        pb.SearchRequest(class_name="Doc", limit=2, where_json="{not json"),
+        pb.SearchRequest(class_name="Ghost", limit=2,
+                         near_vector=pb.NearVectorParams(vector=vecs[0].tolist())),
+    ])
+    reply = client.batch_search(breq)
+    assert len(reply.replies) == 3
+    assert reply.replies[0].results and not reply.replies[0].error_message
+    assert reply.replies[1].error_message  # malformed where_json
+    assert reply.replies[2].error_message  # unknown class
+    assert not reply.replies[1].results and not reply.replies[2].results

@@ -1,0 +1,17 @@
+from weaviate_tpu.auth.auth import (
+    AuthError,
+    Authenticator,
+    Authorizer,
+    ForbiddenError,
+    Principal,
+    UnauthorizedError,
+)
+
+__all__ = [
+    "AuthError",
+    "Authenticator",
+    "Authorizer",
+    "ForbiddenError",
+    "Principal",
+    "UnauthorizedError",
+]

@@ -1,0 +1,9 @@
+from weaviate_tpu.config.config import (
+    AuthConfig,
+    AuthzConfig,
+    Config,
+    ConfigError,
+    load_config,
+)
+
+__all__ = ["Config", "AuthConfig", "AuthzConfig", "ConfigError", "load_config"]

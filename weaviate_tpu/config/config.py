@@ -1,0 +1,260 @@
+"""Environment-driven server configuration.
+
+Reference: usecases/config/environment.go (env parsing) +
+config_handler.go:73-99 (the Config struct) — the full env surface is listed
+in SURVEY.md Appendix A. Same variable names, same defaults; TPU extensions
+(device mesh shape, store dtype) are additive.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _bool(env: Mapping[str, str], key: str, default: bool = False) -> bool:
+    v = env.get(key)
+    if v is None:
+        return default
+    return v.strip().lower() in ("true", "enabled", "on", "1")
+
+
+def _int(env: Mapping[str, str], key: str, default: int) -> int:
+    v = env.get(key)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ConfigError(f"invalid {key}: {v!r} (want int)") from None
+
+
+def _float(env: Mapping[str, str], key: str, default: float) -> float:
+    v = env.get(key)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ConfigError(f"invalid {key}: {v!r} (want float)") from None
+
+
+def _list(env: Mapping[str, str], key: str) -> list[str]:
+    v = env.get(key, "")
+    return [s.strip() for s in v.split(",") if s.strip()]
+
+
+@dataclass
+class AnonymousAccess:
+    enabled: bool = True  # environment.go default: anonymous on unless auth set
+
+
+@dataclass
+class APIKeyAuth:
+    enabled: bool = False
+    allowed_keys: list[str] = field(default_factory=list)
+    users: list[str] = field(default_factory=list)  # positional key->user map
+
+
+@dataclass
+class OIDCAuth:
+    enabled: bool = False
+    issuer: str = ""
+    client_id: str = ""
+    username_claim: str = "sub"
+    groups_claim: str = ""
+    skip_client_id_check: bool = False
+
+
+@dataclass
+class AuthConfig:
+    anonymous: AnonymousAccess = field(default_factory=AnonymousAccess)
+    apikey: APIKeyAuth = field(default_factory=APIKeyAuth)
+    oidc: OIDCAuth = field(default_factory=OIDCAuth)
+
+    def validate(self) -> None:
+        if self.apikey.enabled:
+            if not self.apikey.allowed_keys:
+                raise ConfigError(
+                    "AUTHENTICATION_APIKEY_ENABLED requires AUTHENTICATION_APIKEY_ALLOWED_KEYS")
+            if not self.apikey.users:
+                raise ConfigError(
+                    "AUTHENTICATION_APIKEY_ENABLED requires AUTHENTICATION_APIKEY_USERS")
+            if len(self.apikey.users) not in (1, len(self.apikey.allowed_keys)):
+                raise ConfigError(
+                    "AUTHENTICATION_APIKEY_USERS must have one user or one per key")
+
+
+@dataclass
+class AuthzConfig:
+    admin_list_enabled: bool = False
+    admin_users: list[str] = field(default_factory=list)
+    readonly_users: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ClusterConfig:
+    hostname: str = ""
+    gossip_bind_port: int = 7946
+    data_bind_port: int = 7947
+    join: list[str] = field(default_factory=list)
+    ignore_schema_sync: bool = False
+
+
+@dataclass
+class PersistenceConfig:
+    data_path: str = "./data"
+    memtables_max_size_mb: int = 200
+    memtables_min_active_seconds: int = 10
+    memtables_max_active_seconds: int = 300
+    flush_idle_memtables_after: int = 60
+
+
+@dataclass
+class MonitoringConfig:
+    enabled: bool = False
+    port: int = 2112
+    group_classes: bool = False
+
+
+@dataclass
+class DiskUseConfig:
+    warning_percentage: int = 80
+    readonly_percentage: int = 90
+
+
+@dataclass
+class MemUseConfig:
+    warning_percentage: int = 80
+    readonly_percentage: int = 0  # 0 = disabled (environment.go default)
+
+
+@dataclass
+class AutoSchemaConfig:
+    enabled: bool = True
+    default_string: str = "text"
+    default_number: str = "number"
+    default_date: str = "date"
+
+
+@dataclass
+class Config:
+    """config_handler.go:73-99 twin."""
+
+    persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
+    auth: AuthConfig = field(default_factory=AuthConfig)
+    authz: AuthzConfig = field(default_factory=AuthzConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
+    disk_use: DiskUseConfig = field(default_factory=DiskUseConfig)
+    mem_use: MemUseConfig = field(default_factory=MemUseConfig)
+    auto_schema: AutoSchemaConfig = field(default_factory=AutoSchemaConfig)
+
+    origin: str = ""
+    enable_modules: list[str] = field(default_factory=list)
+    default_vectorizer_module: str = "none"
+    default_vector_distance_metric: str = ""
+    query_defaults_limit: int = 25
+    query_maximum_results: int = 10000
+    max_import_goroutines_factor: float = 1.5
+    maximum_concurrent_get_requests: int = 0  # 0 = unlimited
+    track_vector_dimensions: bool = False
+    reindex_vector_dimensions_at_startup: bool = False
+    grpc_port: int = 50051
+    contextionary_url: str = ""
+
+    # TPU extensions
+    device_mesh_shards: int = 0  # 0 = one shard per local device
+    store_dtype: str = "float32"
+
+    def validate(self) -> None:
+        self.auth.validate()
+        if self.query_defaults_limit < 1:
+            raise ConfigError("QUERY_DEFAULTS_LIMIT must be >= 1")
+        if self.query_maximum_results < 1:
+            raise ConfigError("QUERY_MAXIMUM_RESULTS must be >= 1")
+        if not (0 <= self.disk_use.warning_percentage <= 100):
+            raise ConfigError("DISK_USE_WARNING_PERCENTAGE must be 0..100")
+        if not (0 <= self.disk_use.readonly_percentage <= 100):
+            raise ConfigError("DISK_USE_READONLY_PERCENTAGE must be 0..100")
+        if self.store_dtype not in ("float32", "bfloat16"):
+            raise ConfigError("STORE_DTYPE must be float32|bfloat16")
+
+
+def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
+    """LoadConfig twin (environment.go): parse the env surface, validate."""
+    e = dict(os.environ) if env is None else dict(env)
+    cfg = Config()
+
+    cfg.persistence.data_path = e.get("PERSISTENCE_DATA_PATH", "./data")
+    cfg.persistence.memtables_max_size_mb = _int(e, "PERSISTENCE_MEMTABLES_MAX_SIZE_MB", 200)
+    cfg.persistence.memtables_min_active_seconds = _int(
+        e, "PERSISTENCE_MEMTABLES_MIN_ACTIVE_DURATION_SECONDS", 10)
+    cfg.persistence.memtables_max_active_seconds = _int(
+        e, "PERSISTENCE_MEMTABLES_MAX_ACTIVE_DURATION_SECONDS", 300)
+    cfg.persistence.flush_idle_memtables_after = _int(
+        e, "PERSISTENCE_FLUSH_IDLE_MEMTABLES_AFTER", 60)
+
+    apikey_enabled = _bool(e, "AUTHENTICATION_APIKEY_ENABLED")
+    oidc_enabled = _bool(e, "AUTHENTICATION_OIDC_ENABLED")
+    anon_default = not (apikey_enabled or oidc_enabled)
+    cfg.auth.anonymous.enabled = _bool(
+        e, "AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED", anon_default)
+    cfg.auth.apikey.enabled = apikey_enabled
+    cfg.auth.apikey.allowed_keys = _list(e, "AUTHENTICATION_APIKEY_ALLOWED_KEYS")
+    cfg.auth.apikey.users = _list(e, "AUTHENTICATION_APIKEY_USERS")
+    cfg.auth.oidc.enabled = oidc_enabled
+    cfg.auth.oidc.issuer = e.get("AUTHENTICATION_OIDC_ISSUER", "")
+    cfg.auth.oidc.client_id = e.get("AUTHENTICATION_OIDC_CLIENT_ID", "")
+    cfg.auth.oidc.username_claim = e.get("AUTHENTICATION_OIDC_USERNAME_CLAIM", "sub")
+    cfg.auth.oidc.groups_claim = e.get("AUTHENTICATION_OIDC_GROUPS_CLAIM", "")
+    cfg.auth.oidc.skip_client_id_check = _bool(e, "AUTHENTICATION_OIDC_SKIP_CLIENT_ID_CHECK")
+
+    cfg.authz.admin_list_enabled = _bool(e, "AUTHORIZATION_ADMINLIST_ENABLED")
+    cfg.authz.admin_users = _list(e, "AUTHORIZATION_ADMINLIST_USERS")
+    cfg.authz.readonly_users = _list(e, "AUTHORIZATION_ADMINLIST_READONLY_USERS")
+
+    cfg.cluster.hostname = e.get("CLUSTER_HOSTNAME", "")
+    cfg.cluster.gossip_bind_port = _int(e, "CLUSTER_GOSSIP_BIND_PORT", 7946)
+    cfg.cluster.data_bind_port = _int(e, "CLUSTER_DATA_BIND_PORT", 7947)
+    cfg.cluster.join = _list(e, "CLUSTER_JOIN")
+    cfg.cluster.ignore_schema_sync = _bool(e, "CLUSTER_IGNORE_SCHEMA_SYNC")
+
+    cfg.monitoring.enabled = _bool(e, "PROMETHEUS_MONITORING_ENABLED")
+    cfg.monitoring.port = _int(e, "PROMETHEUS_MONITORING_PORT", 2112)
+    cfg.monitoring.group_classes = _bool(e, "PROMETHEUS_MONITORING_GROUP_CLASSES")
+
+    cfg.disk_use.warning_percentage = _int(e, "DISK_USE_WARNING_PERCENTAGE", 80)
+    cfg.disk_use.readonly_percentage = _int(e, "DISK_USE_READONLY_PERCENTAGE", 90)
+    cfg.mem_use.warning_percentage = _int(e, "MEMORY_WARNING_PERCENTAGE", 80)
+    cfg.mem_use.readonly_percentage = _int(e, "MEMORY_READONLY_PERCENTAGE", 0)
+
+    cfg.auto_schema.enabled = _bool(e, "AUTOSCHEMA_ENABLED", True)
+    cfg.auto_schema.default_string = e.get("AUTOSCHEMA_DEFAULT_STRING", "text")
+    cfg.auto_schema.default_number = e.get("AUTOSCHEMA_DEFAULT_NUMBER", "number")
+    cfg.auto_schema.default_date = e.get("AUTOSCHEMA_DEFAULT_DATE", "date")
+
+    cfg.origin = e.get("ORIGIN", "")
+    cfg.enable_modules = _list(e, "ENABLE_MODULES")
+    cfg.default_vectorizer_module = e.get("DEFAULT_VECTORIZER_MODULE", "none")
+    cfg.default_vector_distance_metric = e.get("DEFAULT_VECTOR_DISTANCE_METRIC", "")
+    cfg.query_defaults_limit = _int(e, "QUERY_DEFAULTS_LIMIT", 25)
+    cfg.query_maximum_results = _int(e, "QUERY_MAXIMUM_RESULTS", 10000)
+    cfg.max_import_goroutines_factor = _float(e, "MAX_IMPORT_GOROUTINES_FACTOR", 1.5)
+    cfg.maximum_concurrent_get_requests = _int(e, "MAXIMUM_CONCURRENT_GET_REQUESTS", 0)
+    cfg.track_vector_dimensions = _bool(e, "TRACK_VECTOR_DIMENSIONS")
+    cfg.reindex_vector_dimensions_at_startup = _bool(
+        e, "REINDEX_VECTOR_DIMENSIONS_AT_STARTUP")
+    cfg.grpc_port = _int(e, "GRPC_PORT", 50051)
+    cfg.contextionary_url = e.get("CONTEXTIONARY_URL", "")
+
+    cfg.device_mesh_shards = _int(e, "TPU_DEVICE_MESH_SHARDS", 0)
+    cfg.store_dtype = e.get("TPU_STORE_DTYPE", "float32")
+
+    cfg.validate()
+    return cfg

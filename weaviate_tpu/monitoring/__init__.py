@@ -1,0 +1,3 @@
+from weaviate_tpu.monitoring.metrics import Metrics, get_metrics, noop_metrics
+
+__all__ = ["Metrics", "get_metrics", "noop_metrics"]

@@ -1,0 +1,163 @@
+"""Prometheus metric registry.
+
+Reference: usecases/monitoring/prometheus.go:22-58 — a process-wide singleton
+(`GetMetrics`, prometheus.go:70) holding ~40 metric vecs covering batch
+durations, object counts, LSM activity, vector-index operations/durations/
+tombstones, query durations, the filtered-vector-search phase breakdown
+(shard_read.go:236-287), startup and backup timings.
+
+TPU-first delta: device-side timings come from whole batched dispatches, so
+the per-phase breakdown is {filter, device_search (one metric — upload +
+scan + topk are one XLA program), rescore, hydrate} rather than the
+reference's per-edge accounting. Exposition uses prometheus_client; the REST
+layer mounts it on PROMETHEUS_MONITORING_PORT like configure_api.go:116-121.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_MS_BUCKETS = (0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 10000)
+
+
+class Metrics:
+    """All metric vecs; label names mirror the reference's (class_name,
+    shard_name, operation ...)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+
+        def h(name, doc, labels=()):
+            return Histogram(name, doc, labels, registry=r, buckets=_MS_BUCKETS)
+
+        def g(name, doc, labels=()):
+            return Gauge(name, doc, labels, registry=r)
+
+        def c(name, doc, labels=()):
+            return Counter(name, doc, labels, registry=r)
+
+        # batch / object write path (prometheus.go batch metrics)
+        self.batch_durations = h(
+            "weaviate_batch_durations_ms", "Batch import phase durations",
+            ("operation", "class_name", "shard_name"))
+        self.batch_delete_durations = h(
+            "weaviate_batch_delete_durations_ms", "Batch delete durations",
+            ("class_name", "shard_name"))
+        self.objects_durations = h(
+            "weaviate_objects_durations_ms", "Single-object op durations",
+            ("operation", "step", "class_name", "shard_name"))
+        self.object_count = g(
+            "weaviate_object_count", "Objects per shard", ("class_name", "shard_name"))
+
+        # queries
+        self.queries_count = g(
+            "weaviate_concurrent_queries_count", "In-flight queries",
+            ("class_name", "query_type"))
+        self.query_durations = h(
+            "weaviate_queries_durations_ms", "Query durations",
+            ("class_name", "query_type"))
+        self.query_dimensions = c(
+            "weaviate_query_dimensions_total", "Vector dimensions searched",
+            ("query_type", "operation", "class_name"))
+        # filtered vector search phase breakdown (shard_read.go:236-287)
+        self.filtered_vector_filter = h(
+            "weaviate_filtered_vector_filter_durations_ms", "allowList build",
+            ("class_name", "shard_name"))
+        self.filtered_vector_search = h(
+            "weaviate_filtered_vector_search_durations_ms",
+            "device search dispatch (upload+scan+topk)", ("class_name", "shard_name"))
+        self.filtered_vector_rescore = h(
+            "weaviate_filtered_vector_rescore_durations_ms", "PQ rescoring pass",
+            ("class_name", "shard_name"))
+        self.filtered_vector_objects = h(
+            "weaviate_filtered_vector_objects_durations_ms", "result hydration",
+            ("class_name", "shard_name"))
+
+        # vector index lifecycle (hnsw metrics.go / insert_metrics.go analogs)
+        self.vector_index_ops = c(
+            "weaviate_vector_index_operations_total", "add/delete/search ops",
+            ("operation", "class_name", "shard_name"))
+        self.vector_index_durations = h(
+            "weaviate_vector_index_durations_ms", "index op durations",
+            ("operation", "step", "class_name", "shard_name"))
+        self.vector_index_tombstones = g(
+            "weaviate_vector_index_tombstones", "live tombstones",
+            ("class_name", "shard_name"))
+        self.vector_index_tombstone_cleanups = c(
+            "weaviate_vector_index_tombstone_cleanup_threads_total",
+            "tombstone cleanup runs", ("class_name", "shard_name"))
+        self.vector_index_size = g(
+            "weaviate_vector_index_size", "index capacity (slots)",
+            ("class_name", "shard_name"))
+        self.vector_dimensions = g(
+            "weaviate_vector_dimensions_sum", "tracked vector dimensions",
+            ("class_name",))
+        self.vector_segments = g(
+            "weaviate_vector_segments_sum", "tracked PQ segments", ("class_name",))
+
+        # LSM (prometheus.go lsm metrics)
+        self.lsm_active_segments = g(
+            "weaviate_lsm_active_segments", "segments per bucket",
+            ("strategy", "class_name", "shard_name", "path"))
+        self.lsm_segment_objects = g(
+            "weaviate_lsm_segment_objects", "entries per segment level",
+            ("strategy", "class_name", "shard_name", "path", "level"))
+        self.lsm_compactions = c(
+            "weaviate_lsm_compactions_total", "compactions run",
+            ("strategy", "path"))
+        self.lsm_memtable_durations = h(
+            "weaviate_lsm_memtable_durations_ms", "memtable op durations",
+            ("strategy", "operation"))
+
+        # startup (prometheus.go startup metrics)
+        self.startup_durations = h(
+            "weaviate_startup_durations_ms", "startup phase durations", ("operation",))
+        self.startup_progress = g(
+            "weaviate_startup_progress", "0..1 progress", ("operation",))
+
+        # backup
+        self.backup_store_durations = h(
+            "weaviate_backup_store_ms", "backup store durations",
+            ("backend", "class_name"))
+        self.backup_restore_durations = h(
+            "weaviate_backup_restore_ms", "restore durations",
+            ("backend", "class_name"))
+
+        # schema / cluster
+        self.schema_tx = c(
+            "weaviate_schema_tx_total", "schema transactions", ("type", "status"))
+        self.replication_ops = c(
+            "weaviate_replication_operations_total", "replication coordinator ops",
+            ("operation", "status"))
+
+    def expose(self) -> bytes:
+        """Text exposition (the /metrics handler body)."""
+        return generate_latest(self.registry)
+
+
+_lock = threading.Lock()
+_instance: Optional[Metrics] = None
+
+
+def get_metrics() -> Metrics:
+    """Process-wide singleton (GetMetrics, prometheus.go:70)."""
+    global _instance
+    with _lock:
+        if _instance is None:
+            _instance = Metrics()
+        return _instance
+
+
+def noop_metrics() -> Metrics:
+    """Fresh isolated registry (tests / embedded use)."""
+    return Metrics(CollectorRegistry())

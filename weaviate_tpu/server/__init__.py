@@ -1,0 +1,4 @@
+from weaviate_tpu.server.app import App
+from weaviate_tpu.server.rest import RestServer
+
+__all__ = ["App", "RestServer"]

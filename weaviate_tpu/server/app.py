@@ -1,0 +1,87 @@
+"""App: the whole-server object graph.
+
+Reference: adapters/handlers/rest/configure_api.go:105 `configureAPI` — the
+one place every singleton is wired: DB, schema manager (with the vector-index
+config parser injected), objects/batch managers, traverser/explorer,
+aggregator, GraphQL executor, auth, metrics. The REST/gRPC layers only ever
+see this object.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from weaviate_tpu.auth import Authenticator, Authorizer
+from weaviate_tpu.config import Config, load_config
+from weaviate_tpu.db import DB
+from weaviate_tpu.graphql import GraphQLExecutor
+from weaviate_tpu.monitoring import noop_metrics
+from weaviate_tpu.schema import AutoSchema, SchemaManager
+from weaviate_tpu.usecases.aggregator import Aggregator
+from weaviate_tpu.usecases.objects import BatchManager, ObjectsManager
+from weaviate_tpu.usecases.traverser import Explorer, Traverser
+from weaviate_tpu.version import __version__ as VERSION
+
+
+class App:
+    def __init__(self, config: Optional[Config] = None, data_path: Optional[str] = None,
+                 metrics=None, modules=None):
+        # no config given => read the process environment (environment.go)
+        self.config = config or load_config()
+        path = data_path or self.config.persistence.data_path
+        os.makedirs(path, exist_ok=True)
+        if metrics is not None:
+            self.metrics = metrics
+        elif self.config.monitoring.enabled:
+            from weaviate_tpu.monitoring import get_metrics
+
+            self.metrics = get_metrics()
+        else:
+            self.metrics = noop_metrics()
+
+        self.db = DB(path)
+        self.schema = SchemaManager(os.path.join(path, "schema.json"), migrator=self.db)
+        self.modules = modules
+        self.auto_schema = (
+            AutoSchema(
+                self.schema,
+                default_string=self.config.auto_schema.default_string,
+                default_number=self.config.auto_schema.default_number,
+                default_date=self.config.auto_schema.default_date,
+            )
+            if self.config.auto_schema.enabled
+            else None
+        )
+        self.objects = ObjectsManager(
+            self.db, self.schema, auto_schema=self.auto_schema,
+            modules=self.modules, metrics=self.metrics)
+        self.batch = BatchManager(self.objects)
+        self.explorer = Explorer(
+            self.db, self.schema, modules=self.modules,
+            query_limit=self.config.query_defaults_limit,
+            max_results=self.config.query_maximum_results)
+        self.traverser = Traverser(
+            self.explorer,
+            max_concurrent=self.config.maximum_concurrent_get_requests)
+        self.aggregator = Aggregator(self.db, self.schema, self.explorer)
+        self.graphql = GraphQLExecutor(self.traverser, self.aggregator, self.schema, self.db)
+        self.authenticator = Authenticator(self.config.auth)
+        self.authorizer = Authorizer(self.config.authz)
+        # populated by later subsystems (backup scheduler, classifier, nodes)
+        self.backup_scheduler = None
+        self.classifier = None
+        self.cluster = None
+
+    # -- meta ----------------------------------------------------------------
+
+    def meta(self) -> dict:
+        """GET /v1/meta payload (handlers_meta)."""
+        return {
+            "hostname": self.config.origin or "http://[::]:8080",
+            "version": VERSION,
+            "modules": self.modules.meta() if self.modules is not None else {},
+        }
+
+    def shutdown(self) -> None:
+        self.db.shutdown()

@@ -1,0 +1,210 @@
+"""gRPC Search service.
+
+Reference: adapters/handlers/grpc/server.go — `StartAndListen` (:35) exposes
+`Weaviate.Search` (:66): build traverser.GetParams from the proto
+(searchParamsFromProto, :137), call Traverser.GetClass, marshal results
+(searchResultsToProto, :85).
+
+TPU extension: BatchSearch maps onto Traverser.get_class_batched so N
+concurrent kNN queries ride one device dispatch instead of N.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+from weaviate_tpu.usecases.traverser import GetParams
+
+_SERVICE = "weaviatetpu.v1.Weaviate"
+
+
+def params_from_proto(req: pb.SearchRequest) -> GetParams:
+    """searchParamsFromProto twin (server.go:137)."""
+    near_vector = None
+    if req.HasField("near_vector") and len(req.near_vector.vector):
+        near_vector = {"vector": list(req.near_vector.vector)}
+        if req.near_vector.HasField("certainty"):
+            near_vector["certainty"] = req.near_vector.certainty
+        if req.near_vector.HasField("distance"):
+            near_vector["distance"] = req.near_vector.distance
+    near_object = None
+    if req.HasField("near_object") and req.near_object.id:
+        near_object = {"id": req.near_object.id}
+        if req.near_object.HasField("certainty"):
+            near_object["certainty"] = req.near_object.certainty
+        if req.near_object.HasField("distance"):
+            near_object["distance"] = req.near_object.distance
+    bm25 = None
+    if req.HasField("bm25") and req.bm25.query:
+        bm25 = {"query": req.bm25.query}
+        if req.bm25.properties:
+            bm25["properties"] = list(req.bm25.properties)
+    hybrid = None
+    if req.HasField("hybrid") and (req.hybrid.query or len(req.hybrid.vector)):
+        hybrid = {"query": req.hybrid.query}
+        if len(req.hybrid.vector):
+            hybrid["vector"] = list(req.hybrid.vector)
+        if req.hybrid.HasField("alpha"):
+            hybrid["alpha"] = req.hybrid.alpha
+        if req.hybrid.fusion_type:
+            hybrid["fusionType"] = req.hybrid.fusion_type
+    filters = None
+    if req.where_json:
+        filters = LocalFilter.from_dict(json.loads(req.where_json))
+    include_vector = "vector" in req.additional_properties
+    return GetParams(
+        class_name=req.class_name,
+        properties=list(req.properties),
+        filters=filters,
+        near_vector=near_vector,
+        near_object=near_object,
+        keyword_ranking=bm25,
+        hybrid=hybrid,
+        limit=int(req.limit) or 0,
+        offset=int(req.offset),
+        include_vector=include_vector,
+        consistency_level=req.consistency_level or None,
+    )
+
+
+def result_to_proto(r, req: pb.SearchRequest) -> pb.SearchResult:
+    """searchResultsToProto twin (server.go:85)."""
+    obj = r.obj
+    props = obj.properties or {}
+    if req.properties:
+        props = {k: v for k, v in props.items() if k in req.properties}
+    out = pb.SearchResult(
+        id=obj.uuid,
+        properties_json=json.dumps(props, default=str),
+        creation_time_unix=obj.creation_time_unix,
+        last_update_time_unix=obj.last_update_time_unix,
+    )
+    addl = set(req.additional_properties)
+    if r.distance is not None:
+        out.distance = float(r.distance)
+    if r.certainty is not None:
+        out.certainty = float(r.certainty)
+    if r.score is not None:
+        out.score = float(r.score)
+    if r.explain_score:
+        out.explain_score = r.explain_score
+    if "vector" in addl and obj.vector is not None:
+        out.vector.extend(float(x) for x in obj.vector)
+    return out
+
+
+class SearchServicer:
+    def __init__(self, app):
+        self.app = app
+
+    def Search(self, request: pb.SearchRequest, context) -> pb.SearchReply:
+        start = time.perf_counter()
+        try:
+            params = params_from_proto(request)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return
+        try:
+            results = self.app.traverser.get_class(params)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+            return
+        reply = pb.SearchReply(took_seconds=time.perf_counter() - start)
+        reply.results.extend(result_to_proto(r, request) for r in results)
+        return reply
+
+    def BatchSearch(self, request: pb.BatchSearchRequest, context) -> pb.BatchSearchReply:
+        """Per-slot error isolation end to end: a malformed request or failed
+        query yields a reply with error_message; the other slots still ride
+        the shared device dispatch."""
+        start = time.perf_counter()
+        slot_params: list = [None] * len(request.requests)
+        parse_errs: dict[int, str] = {}
+        for i, r in enumerate(request.requests):
+            try:
+                slot_params[i] = params_from_proto(r)
+            except Exception as e:
+                parse_errs[i] = str(e)
+        valid = [(i, p) for i, p in enumerate(slot_params) if i not in parse_errs]
+        results = self.app.traverser.get_class_batched([p for _, p in valid]) if valid else []
+        reply = pb.BatchSearchReply()
+        took = time.perf_counter() - start
+        slot_out: dict[int, object] = {i: res for (i, _), res in zip(valid, results)}
+        for i, req in enumerate(request.requests):
+            one = pb.SearchReply(took_seconds=took)
+            if i in parse_errs:
+                one.error_message = parse_errs[i]
+            else:
+                slot = slot_out.get(i)
+                if isinstance(slot, Exception):
+                    one.error_message = str(slot)
+                elif slot is not None:
+                    one.results.extend(result_to_proto(r, req) for r in slot)
+            reply.replies.append(one)
+        return reply
+
+
+def _handlers(servicer) -> grpc.GenericRpcHandler:
+    return grpc.method_handlers_generic_handler(_SERVICE, {
+        "Search": grpc.unary_unary_rpc_method_handler(
+            servicer.Search,
+            request_deserializer=pb.SearchRequest.FromString,
+            response_serializer=pb.SearchReply.SerializeToString,
+        ),
+        "BatchSearch": grpc.unary_unary_rpc_method_handler(
+            servicer.BatchSearch,
+            request_deserializer=pb.BatchSearchRequest.FromString,
+            response_serializer=pb.BatchSearchReply.SerializeToString,
+        ),
+    })
+
+
+class GrpcServer:
+    """StartAndListen twin (server.go:35)."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0, max_workers: int = 16):
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        self.server.add_generic_rpc_handlers((_handlers(SearchServicer(app)),))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        self.server.stop(grace).wait()
+
+
+class SearchClient:
+    """Minimal client (the generated-stub equivalent, for tests/tools)."""
+
+    def __init__(self, target: str):
+        self.channel = grpc.insecure_channel(target)
+        self._search = self.channel.unary_unary(
+            f"/{_SERVICE}/Search",
+            request_serializer=pb.SearchRequest.SerializeToString,
+            response_deserializer=pb.SearchReply.FromString,
+        )
+        self._batch = self.channel.unary_unary(
+            f"/{_SERVICE}/BatchSearch",
+            request_serializer=pb.BatchSearchRequest.SerializeToString,
+            response_deserializer=pb.BatchSearchReply.FromString,
+        )
+
+    def search(self, request: pb.SearchRequest, timeout: float = 30.0) -> pb.SearchReply:
+        return self._search(request, timeout=timeout)
+
+    def batch_search(self, request: pb.BatchSearchRequest, timeout: float = 60.0) -> pb.BatchSearchReply:
+        return self._batch(request, timeout=timeout)
+
+    def close(self) -> None:
+        self.channel.close()

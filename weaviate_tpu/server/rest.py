@@ -1,0 +1,511 @@
+"""REST API: the full /v1 surface on a threaded stdlib HTTP server.
+
+Reference: adapters/handlers/rest/ — go-swagger generated ops wired in
+configure_api.go:293-300 (objects CRUD, batch, schema, graphql, backups,
+nodes, meta, well-known, classifications). Here the routing is one regex
+table; handlers translate HTTP <-> the use-case managers exactly like the
+reference's handlers_*.go files, including Weaviate's error envelope
+`{"error": [{"message": ...}]}`.
+
+Threaded (not async) on purpose: handlers call synchronous use-case code
+whose hot path is a device dispatch; the GIL releases during device work so
+concurrent queries still batch. /metrics is mounted on the main port and,
+when PROMETHEUS_MONITORING_ENABLED, on its own port (configure_api.go:116).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from weaviate_tpu.auth import AuthError, ForbiddenError, UnauthorizedError
+from weaviate_tpu.schema.manager import SchemaError
+from weaviate_tpu.usecases.objects import NotFoundError, ObjectsError
+from weaviate_tpu.version import __version__ as VERSION
+
+_UUID_RE = r"[0-9a-fA-F-]{36}"
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        self.status = status
+        self.message = message
+
+
+def _err_body(message: str) -> dict:
+    return {"error": [{"message": message}]}
+
+
+class _Routes:
+    def __init__(self):
+        self.table: list[tuple[str, re.Pattern, str]] = []
+
+    def add(self, method: str, pattern: str, name: str):
+        self.table.append((method, re.compile("^" + pattern + "$"), name))
+
+    def match(self, method: str, path: str):
+        allowed = []
+        for m, pat, name in self.table:
+            mt = pat.match(path)
+            if mt:
+                if m == method or (m == "GET" and method == "HEAD" and name == "meta"):
+                    return name, mt
+                allowed.append(m)
+        if allowed:
+            raise HTTPError(405, f"method {method} not allowed")
+        raise HTTPError(404, f"no route for {path}")
+
+
+ROUTES = _Routes()
+for _m, _p, _n in [
+    ("GET", r"/v1/meta", "meta"),
+    ("GET", r"/v1/\.well-known/openid-configuration", "openid"),
+    ("GET", r"/v1/\.well-known/live", "live"),
+    ("GET", r"/v1/\.well-known/ready", "ready"),
+    ("GET", r"/v1/schema", "schema_list"),
+    ("POST", r"/v1/schema", "schema_create"),
+    ("GET", r"/v1/schema/(?P<cls>[^/]+)", "schema_get"),
+    ("PUT", r"/v1/schema/(?P<cls>[^/]+)", "schema_update"),
+    ("DELETE", r"/v1/schema/(?P<cls>[^/]+)", "schema_delete"),
+    ("POST", r"/v1/schema/(?P<cls>[^/]+)/properties", "schema_add_property"),
+    ("GET", r"/v1/schema/(?P<cls>[^/]+)/shards", "shards_get"),
+    ("PUT", r"/v1/schema/(?P<cls>[^/]+)/shards/(?P<shard>[^/]+)", "shard_update"),
+    ("GET", r"/v1/objects", "objects_list"),
+    ("POST", r"/v1/objects", "objects_create"),
+    ("POST", r"/v1/objects/validate", "objects_validate"),
+    # class-scoped must come before legacy so /v1/objects/Class/uuid wins
+    ("GET", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})", "object_get"),
+    ("HEAD", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})", "object_head"),
+    ("PUT", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})", "object_put"),
+    ("PATCH", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})", "object_patch"),
+    ("DELETE", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})", "object_delete"),
+    ("GET", rf"/v1/objects/(?P<id>{_UUID_RE})", "object_get"),
+    ("HEAD", rf"/v1/objects/(?P<id>{_UUID_RE})", "object_head"),
+    ("PUT", rf"/v1/objects/(?P<id>{_UUID_RE})", "object_put"),
+    ("PATCH", rf"/v1/objects/(?P<id>{_UUID_RE})", "object_patch"),
+    ("DELETE", rf"/v1/objects/(?P<id>{_UUID_RE})", "object_delete"),
+    ("POST", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})/references/(?P<prop>[^/]+)", "ref_add"),
+    ("PUT", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})/references/(?P<prop>[^/]+)", "ref_put"),
+    ("DELETE", rf"/v1/objects/(?P<cls>[^/]+)/(?P<id>{_UUID_RE})/references/(?P<prop>[^/]+)", "ref_delete"),
+    ("POST", r"/v1/batch/objects", "batch_objects"),
+    ("DELETE", r"/v1/batch/objects", "batch_delete"),
+    ("POST", r"/v1/batch/references", "batch_references"),
+    ("POST", r"/v1/graphql", "graphql"),
+    ("POST", r"/v1/graphql/batch", "graphql_batch"),
+    ("GET", r"/v1/nodes", "nodes"),
+    ("GET", r"/metrics", "metrics"),
+    ("POST", r"/v1/backups/(?P<backend>[^/]+)", "backup_create"),
+    ("GET", r"/v1/backups/(?P<backend>[^/]+)/(?P<id>[^/]+)", "backup_status"),
+    ("POST", r"/v1/backups/(?P<backend>[^/]+)/(?P<id>[^/]+)/restore", "backup_restore"),
+    ("GET", r"/v1/backups/(?P<backend>[^/]+)/(?P<id>[^/]+)/restore", "backup_restore_status"),
+    ("POST", r"/v1/classifications", "classification_create"),
+    ("GET", r"/v1/classifications/(?P<id>[^/]+)", "classification_get"),
+]:
+    ROUTES.add(_m, _p, _n)
+
+_WRITE_METHODS = {"POST": "create", "PUT": "update", "PATCH": "update", "DELETE": "delete"}
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    app = None  # injected by RestServer
+
+    # silence default stderr logging
+    def log_message(self, fmt, *args):
+        pass
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _json_body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        self._body_consumed = True
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise HTTPError(400, f"invalid json: {e}") from None
+
+    def _drain_body(self):
+        """Consume an unread request body so an early error reply doesn't
+        desynchronize the keep-alive stream (the next request would otherwise
+        parse the stale body bytes as its request line)."""
+        if getattr(self, "_body_consumed", False):
+            return
+        self._body_consumed = True
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except (TypeError, ValueError):
+            length = 0
+        while length > 0:
+            chunk = self.rfile.read(min(length, 65536))
+            if not chunk:
+                break
+            length -= len(chunk)
+
+    def _reply(self, status: int, body=None, raw: Optional[bytes] = None,
+               content_type: str = "application/json"):
+        self._drain_body()
+        data = raw if raw is not None else (
+            b"" if body is None else json.dumps(body).encode())
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    def _principal(self):
+        auth = self.headers.get("Authorization") or ""
+        token = auth[7:] if auth.startswith("Bearer ") else None
+        return self.app.authenticator.principal_from_bearer(token)
+
+    def _dispatch(self):
+        self._body_consumed = False
+        try:
+            parsed = urlparse(self.path)
+            self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            name, mt = ROUTES.match(self.command, parsed.path)
+            if name not in ("live", "ready", "openid", "metrics"):
+                principal = self._principal()
+                verb = _WRITE_METHODS.get(self.command, "get")
+                self.app.authorizer.authorize(principal, verb, parsed.path)
+            handler = getattr(self, "h_" + name)
+            handler(**mt.groupdict())
+        except HTTPError as e:
+            self._reply(e.status, _err_body(e.message))
+        except UnauthorizedError as e:
+            self._reply(401, _err_body(str(e)))
+        except ForbiddenError as e:
+            self._reply(403, _err_body(str(e)))
+        except NotFoundError as e:
+            self._reply(404, _err_body(str(e)))
+        except (ObjectsError, SchemaError, ValueError) as e:
+            self._reply(422, _err_body(str(e)))
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # internal
+            self._reply(500, _err_body(f"{type(e).__name__}: {e}"))
+
+    do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = do_HEAD = _dispatch
+
+    # -- well-known / meta ---------------------------------------------------
+
+    def h_meta(self):
+        self._reply(200, self.app.meta())
+
+    def h_openid(self):
+        oidc = self.app.config.auth.oidc
+        if not oidc.enabled:
+            self._reply(404, _err_body("OIDC not configured"))
+            return
+        self._reply(200, {"href": f"{oidc.issuer}/.well-known/openid-configuration",
+                          "clientId": oidc.client_id})
+
+    def h_live(self):
+        self._reply(200, raw=b"")
+
+    def h_ready(self):
+        self._reply(200, raw=b"")
+
+    def h_metrics(self):
+        self._reply(200, raw=self.app.metrics.expose(),
+                    content_type="text/plain; version=0.0.4")
+
+    # -- schema --------------------------------------------------------------
+
+    def h_schema_list(self):
+        self._reply(200, self.app.schema.get_schema().to_dict())
+
+    def h_schema_create(self):
+        body = self._json_body() or {}
+        cd = self.app.schema.add_class(body)
+        self._reply(200, cd.to_dict())
+
+    def _resolved(self, cls: str) -> str:
+        resolved = self.app.schema.resolve_class_name(cls)
+        if resolved is None:
+            raise NotFoundError(f"class {cls!r} not found")
+        return resolved
+
+    def h_schema_get(self, cls):
+        cd = self.app.schema.get_class(self._resolved(cls))
+        self._reply(200, cd.to_dict())
+
+    def h_schema_update(self, cls):
+        body = self._json_body() or {}
+        cd = self.app.schema.update_class(self._resolved(cls), body)
+        self._reply(200, cd.to_dict())
+
+    def h_schema_delete(self, cls):
+        self.app.schema.delete_class(self._resolved(cls))
+        self._reply(200)
+
+    def h_schema_add_property(self, cls):
+        body = self._json_body() or {}
+        prop = self.app.schema.add_property(self._resolved(cls), body)
+        self._reply(200, prop.to_dict())
+
+    def h_shards_get(self, cls):
+        self._reply(200, self.app.schema.shards_status(self._resolved(cls)))
+
+    def h_shard_update(self, cls, shard):
+        body = self._json_body() or {}
+        status = body.get("status", "")
+        self.app.schema.update_shard_status(self._resolved(cls), shard, status)
+        self._reply(200, {"status": status})
+
+    # -- objects -------------------------------------------------------------
+
+    def _include_vector(self) -> bool:
+        return "vector" in (self.query.get("include") or "")
+
+    def h_objects_list(self):
+        objs = self.app.objects.list_objects(
+            class_name=self.query.get("class"),
+            limit=int(self.query.get("limit", 25)),
+            offset=int(self.query.get("offset", 0)),
+            after=self.query.get("after"),
+            include_vector=self._include_vector(),
+        )
+        self._reply(200, {
+            "objects": [o.to_rest(self._include_vector()) for o in objs],
+            "totalResults": len(objs),
+        })
+
+    def h_objects_create(self):
+        obj = self.app.objects.add(self._json_body() or {})
+        self._reply(200, obj.to_rest(include_vector=True))
+
+    def h_objects_validate(self):
+        self.app.objects.validate(self._json_body() or {})
+        self._reply(200)
+
+    def h_object_get(self, id, cls=None):
+        obj = self.app.objects.get(id, cls, include_vector=self._include_vector())
+        self._reply(200, obj.to_rest(self._include_vector()))
+
+    def h_object_head(self, id, cls=None):
+        if self.app.objects.exists(id, cls):
+            self._reply(204)
+        else:
+            self._reply(404)
+
+    def h_object_put(self, id, cls=None):
+        body = self._json_body() or {}
+        if cls:
+            body.setdefault("class", cls)
+        body["id"] = id
+        obj = self.app.objects.update(id, body)
+        self._reply(200, obj.to_rest(include_vector=True))
+
+    def h_object_patch(self, id, cls=None):
+        body = self._json_body() or {}
+        class_name = cls or body.get("class")
+        if not class_name:
+            raise HTTPError(422, "PATCH requires the class name")
+        self.app.objects.merge(
+            id, class_name, body.get("properties") or {}, vector=body.get("vector"))
+        self._reply(204)
+
+    def h_object_delete(self, id, cls=None):
+        self.app.objects.delete(id, cls)
+        self._reply(204)
+
+    # -- references ----------------------------------------------------------
+
+    def h_ref_add(self, cls, id, prop):
+        body = self._json_body() or {}
+        self.app.objects.add_reference(id, cls, prop, body.get("beacon", ""))
+        self._reply(200)
+
+    def h_ref_put(self, cls, id, prop):
+        body = self._json_body()
+        beacons = [b.get("beacon", "") for b in body] if isinstance(body, list) else []
+        self.app.objects.put_references(id, cls, prop, beacons)
+        self._reply(200)
+
+    def h_ref_delete(self, cls, id, prop):
+        body = self._json_body() or {}
+        self.app.objects.delete_reference(id, cls, prop, body.get("beacon", ""))
+        self._reply(204)
+
+    # -- batch ---------------------------------------------------------------
+
+    def h_batch_objects(self):
+        body = self._json_body() or {}
+        payloads = body.get("objects") or []
+        results = self.app.batch.add_objects(payloads)
+        out = []
+        for r in results:
+            if r.err:
+                out.append({
+                    **(r.original or {}),
+                    "result": {"status": "FAILED",
+                               "errors": {"error": [{"message": r.err}]}},
+                })
+            else:
+                out.append({**r.obj.to_rest(include_vector=False),
+                            "result": {"status": "SUCCESS"}})
+        self._reply(200, out)
+
+    def h_batch_delete(self):
+        body = self._json_body() or {}
+        match = body.get("match") or {}
+        out = self.app.batch.delete_objects(
+            match.get("class", ""),
+            match.get("where"),
+            dry_run=bool(body.get("dryRun", False)),
+            output=body.get("output", "minimal"),
+        )
+        self._reply(200, out)
+
+    def h_batch_references(self):
+        body = self._json_body() or []
+        if not isinstance(body, list):
+            raise HTTPError(400, "batch references body must be a list")
+        self._reply(200, self.app.batch.add_references(body))
+
+    # -- graphql -------------------------------------------------------------
+
+    def h_graphql(self):
+        body = self._json_body() or {}
+        self._reply(200, self.app.graphql.execute(
+            body.get("query") or "", body.get("variables")))
+
+    def h_graphql_batch(self):
+        body = self._json_body() or []
+        if not isinstance(body, list):
+            raise HTTPError(400, "graphql batch body must be a list")
+        self._reply(200, [
+            self.app.graphql.execute(q.get("query") or "", q.get("variables"))
+            for q in body
+        ])
+
+    # -- nodes ---------------------------------------------------------------
+
+    def h_nodes(self):
+        if self.app.cluster is not None:
+            self._reply(200, {"nodes": self.app.cluster.nodes_status()})
+            return
+        shards = []
+        total = 0
+        for cls, idx in self.app.db.indexes.items():
+            for name, shard in idx.shards.items():
+                cnt = shard.object_count()
+                total += cnt
+                shards.append({"name": name, "class": cls, "objectCount": cnt})
+        self._reply(200, {"nodes": [{
+            "name": self.app.config.cluster.hostname or "node1",
+            "status": "HEALTHY",
+            "version": VERSION,
+            "gitHash": "",
+            "stats": {"objectCount": total, "shardCount": len(shards)},
+            "shards": shards,
+        }]})
+
+    # -- backups / classifications (wired when subsystems present) -----------
+
+    def _backup_or_501(self):
+        if self.app.backup_scheduler is None:
+            raise HTTPError(501, "backup subsystem not configured")
+        return self.app.backup_scheduler
+
+    def h_backup_create(self, backend):
+        s = self._backup_or_501()
+        body = self._json_body() or {}
+        self._reply(200, s.backup(backend, body))
+
+    def h_backup_status(self, backend, id):
+        s = self._backup_or_501()
+        self._reply(200, s.backup_status(backend, id))
+
+    def h_backup_restore(self, backend, id):
+        s = self._backup_or_501()
+        body = self._json_body() or {}
+        self._reply(200, s.restore(backend, id, body))
+
+    def h_backup_restore_status(self, backend, id):
+        s = self._backup_or_501()
+        self._reply(200, s.restore_status(backend, id))
+
+    def _classifier_or_501(self):
+        if self.app.classifier is None:
+            raise HTTPError(501, "classification subsystem not configured")
+        return self.app.classifier
+
+    def h_classification_create(self):
+        c = self._classifier_or_501()
+        self._reply(201, c.schedule(self._json_body() or {}))
+
+    def h_classification_get(self, id):
+        c = self._classifier_or_501()
+        st = c.get(id)
+        if st is None:
+            raise NotFoundError(f"classification {id} not found")
+        self._reply(200, st)
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    """Dedicated metrics listener (configure_api.go:116-121: Prometheus on
+    its own port when PROMETHEUS_MONITORING_ENABLED)."""
+
+    app = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        if urlparse(self.path).path != "/metrics":
+            self.send_error(404)
+            return
+        data = self.app.metrics.expose()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class RestServer:
+    """Threaded HTTP server hosting the /v1 surface for an App."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 8080):
+        self.app = app
+        handler = type("BoundHandler", (Handler,), {"app": app})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._metrics_httpd: Optional[ThreadingHTTPServer] = None
+        self._metrics_thread: Optional[threading.Thread] = None
+        if app.config.monitoring.enabled:
+            mhandler = type("BoundMetricsHandler", (_MetricsHandler,), {"app": app})
+            self._metrics_httpd = ThreadingHTTPServer(
+                (host, app.config.monitoring.port), mhandler)
+            self._metrics_httpd.daemon_threads = True
+            self.metrics_port = self._metrics_httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        if self._metrics_httpd is not None:
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_httpd.serve_forever, daemon=True)
+            self._metrics_thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()
+            if self._metrics_thread:
+                self._metrics_thread.join(timeout=5)
