@@ -1,0 +1,245 @@
+"""MeshVectorIndex ("hnsw_tpu_mesh") on the virtual 8-device CPU mesh:
+brute-force parity, deletes, filters, growth, durability replay, and the
+full serving path through DB/ClassIndex/Shard."""
+
+import uuid as uuidlib
+
+import jax
+import numpy as np
+import pytest
+
+from weaviate_tpu.db import DB
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.entities.vectorindex import (
+    ConfigValidationError,
+    parse_and_validate_config,
+)
+from weaviate_tpu.index.mesh import MeshVectorIndex
+from weaviate_tpu.storage.bitmap import Bitmap
+
+DIM = 16
+SENTINEL = np.iinfo(np.uint64).max
+
+
+def make_index(tmp_path, metric="l2-squared", persist=True, **cfg):
+    config = parse_and_validate_config("hnsw_tpu_mesh", {"distance": metric, **cfg})
+    return MeshVectorIndex(
+        config, str(tmp_path), persist=persist, initial_capacity_per_shard=64
+    )
+
+
+def brute(vecs, ids, q, k, metric="l2-squared"):
+    if metric == "l2-squared":
+        d = ((vecs - q) ** 2).sum(1)
+    elif metric == "cosine":
+        vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+        qn = q / np.linalg.norm(q)
+        d = 1.0 - vn @ qn
+    else:
+        d = -(vecs @ q)
+    order = np.argsort(d, kind="stable")[:k]
+    return ids[order], d[order]
+
+
+def test_devices():
+    assert len(jax.devices()) >= 8
+
+
+def test_bruteforce_parity(tmp_path, rng):
+    idx = make_index(tmp_path)
+    n = 700
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    ids = np.arange(10, 10 + n)
+    idx.add_batch(ids, vecs)
+    qs = rng.standard_normal((5, DIM)).astype(np.float32)
+    got_ids, got_d = idx.search_by_vectors(qs, 10)
+    assert got_ids.shape == (5, 10)
+    for bi in range(5):
+        want_ids, want_d = brute(vecs, ids, qs[bi], 10)
+        assert set(got_ids[bi].tolist()) == set(want_ids.tolist())
+        np.testing.assert_allclose(np.sort(got_d[bi]), np.sort(want_d), rtol=1e-4)
+    idx.shutdown()
+
+
+def test_cosine_metric(tmp_path, rng):
+    idx = make_index(tmp_path, metric="cosine")
+    vecs = rng.standard_normal((200, DIM)).astype(np.float32)
+    ids = np.arange(200)
+    idx.add_batch(ids, vecs)
+    q = vecs[7]
+    got_ids, got_d = idx.search_by_vector(q, 5)
+    assert got_ids[0] == 7
+    assert got_d[0] < 1e-5
+    idx.shutdown()
+
+
+def test_delete_and_update(tmp_path, rng):
+    idx = make_index(tmp_path)
+    vecs = rng.standard_normal((100, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(100), vecs)
+    assert len(idx) == 100
+    # delete the true nearest neighbor of q; it must vanish from results
+    q = vecs[42]
+    idx.delete(42)
+    assert len(idx) == 99
+    assert not idx.contains(42)
+    got_ids, _ = idx.search_by_vector(q, 5)
+    assert 42 not in got_ids.tolist()
+    # re-add with a new vector: old row tombstoned, new one found
+    newv = rng.standard_normal(DIM).astype(np.float32)
+    idx.add(42, newv)
+    got_ids, got_d = idx.search_by_vector(newv, 1)
+    assert got_ids[0] == 42 and got_d[0] < 1e-5
+    assert len(idx) == 100
+    idx.shutdown()
+
+
+def test_filtered_search_bitmap(tmp_path, rng):
+    idx = make_index(tmp_path)
+    n = 300
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    ids = np.arange(n)
+    idx.add_batch(ids, vecs)
+    allowed = np.arange(0, n, 3).astype(np.uint64)  # every 3rd doc
+    allow = Bitmap(allowed)
+    q = vecs[5]  # 5 is not allowed (5 % 3 != 0)
+    got_ids, got_d = idx.search_by_vectors(q[None], 10, allow_list=allow)
+    real = got_ids[0][got_ids[0] != SENTINEL]
+    assert len(real) == 10
+    assert all(int(i) % 3 == 0 for i in real)
+    want_ids, _ = brute(vecs[::3], ids[::3], q, 10)
+    assert set(int(i) for i in real) == set(want_ids.tolist())
+    idx.shutdown()
+
+
+def test_growth_beyond_initial_capacity(tmp_path, rng):
+    idx = make_index(tmp_path)  # 64 rows/chip * 8 chips = 512 initial
+    n = 2000
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    assert len(idx) == n
+    assert idx.n_loc > 64
+    q = vecs[1777]
+    got_ids, got_d = idx.search_by_vector(q, 3)
+    assert got_ids[0] == 1777 and got_d[0] < 1e-5
+    idx.shutdown()
+
+
+def test_durability_replay(tmp_path, rng):
+    idx = make_index(tmp_path)
+    vecs = rng.standard_normal((150, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(150), vecs)
+    idx.delete(3, 77)
+    idx.add(300, vecs[0] * 2.0)
+    idx.shutdown()
+
+    idx2 = make_index(tmp_path)
+    assert len(idx2) == 149  # 150 - 2 deleted + 1 added
+    assert not idx2.contains(3) and not idx2.contains(77)
+    assert idx2.contains(300)
+    got_ids, got_d = idx2.search_by_vector(vecs[10], 1)
+    assert got_ids[0] == 10 and got_d[0] < 1e-5
+    idx2.shutdown()
+
+
+def test_compact_drops_tombstones(tmp_path, rng):
+    idx = make_index(tmp_path)
+    vecs = rng.standard_normal((120, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(120), vecs)
+    idx.delete(*range(0, 120, 2))
+    assert len(idx) == 60
+    idx.compact()
+    assert len(idx) == 60
+    assert int(idx._counts.sum()) == 60  # tombstoned slots physically gone
+    got_ids, got_d = idx.search_by_vector(vecs[1], 5)
+    assert got_ids[0] == 1 and got_d[0] < 1e-5
+    assert all(int(i) % 2 == 1 for i in got_ids.tolist())
+    idx.shutdown()
+
+
+def test_pq_rejected_on_mesh(tmp_path):
+    with pytest.raises(ConfigValidationError):
+        make_index(tmp_path, pq={"enabled": True})
+
+
+def test_search_by_vector_distance(tmp_path, rng):
+    idx = make_index(tmp_path)
+    base = rng.standard_normal(DIM).astype(np.float32)
+    vecs = base + 0.01 * np.arange(50)[:, None].astype(np.float32)
+    idx.add_batch(np.arange(50), vecs.astype(np.float32))
+    ids, dists = idx.search_by_vector_distance(vecs[0], target_distance=0.01, max_limit=100)
+    assert len(ids) > 0
+    assert (dists <= 0.01).all()
+    idx.shutdown()
+
+
+# -- through the serving path (Shard / ClassIndex / DB) ----------------------
+
+
+def make_class(name="MeshArticle"):
+    return ClassDef(
+        name=name,
+        properties=[
+            Property(name="title", data_type=["text"]),
+            Property(name="wordCount", data_type=["int"]),
+            Property(name="published", data_type=["boolean"]),
+        ],
+        vector_index_type="hnsw_tpu_mesh",
+    )
+
+
+def new_obj(i, dim=8, cls="MeshArticle"):
+    rng = np.random.default_rng(i)
+    return StorObj(
+        class_name=cls,
+        uuid=str(uuidlib.UUID(int=i + 1)),
+        properties={"title": f"hello {i}", "wordCount": i, "published": i % 2 == 0},
+        vector=rng.standard_normal(dim).astype(np.float32),
+    )
+
+
+def test_mesh_through_shard(tmp_path):
+    cfg = parse_and_validate_config("hnsw_tpu_mesh", {"distance": "l2-squared"})
+    db = DB(str(tmp_path / "data"))
+    idx = db.add_class(make_class(), cfg)
+    objs = [new_obj(i) for i in range(60)]
+    idx.put_batch(objs)
+
+    res = idx.object_vector_search(objs[17].vector, k=5)
+    assert res[0][0].obj.uuid == objs[17].uuid
+
+    # filtered search goes through the device bitmap path
+    flt = LocalFilter.from_dict(
+        {"operator": "Equal", "path": ["published"], "valueBoolean": True}
+    )
+    res = idx.object_vector_search(objs[4].vector, k=10, flt=flt)
+    assert len(res[0]) == 10
+    assert all(r.obj.properties["published"] is True for r in res[0])
+
+    # delete through the shard: object disappears from vector results
+    idx.delete_object(objs[17].uuid)
+    res = idx.object_vector_search(objs[17].vector, k=5)
+    assert all(r.obj.uuid != objs[17].uuid for r in res[0])
+    db.shutdown()
+
+
+def test_mesh_restart_through_db(tmp_path):
+    cfg = parse_and_validate_config("hnsw_tpu_mesh", {"distance": "l2-squared"})
+    db1 = DB(str(tmp_path / "data"))
+    idx = db1.add_class(make_class(), cfg)
+    objs = [new_obj(i) for i in range(40)]
+    idx.put_batch(objs)
+    idx.delete_object(objs[8].uuid)
+    db1.flush()
+    db1.shutdown()
+
+    db2 = DB(str(tmp_path / "data"))
+    idx2 = db2.add_class(make_class(), cfg)
+    assert idx2.object_count() == 39
+    res = idx2.object_vector_search(objs[3].vector, k=3)
+    assert res[0][0].obj.uuid == objs[3].uuid
+    res = idx2.object_vector_search(objs[8].vector, k=5)
+    assert all(r.obj.uuid != objs[8].uuid for r in res[0])
+    db2.shutdown()

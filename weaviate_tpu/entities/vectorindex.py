@@ -128,6 +128,7 @@ class HnswUserConfig:
     query_batch_window_ms: float = 1.0  # cross-query batching window
     store_dtype: str = "float32"        # device store dtype: float32 | bfloat16
     exact_topk: bool = False            # force lax.top_k over approx_min_k
+    mesh_devices: int = 0               # hnsw_tpu_mesh: chips to shard over (0 = all)
 
     def IndexType(self) -> str:  # discriminator parity (config.go:69-71)
         return self.index_type
@@ -155,6 +156,7 @@ class HnswUserConfig:
             "queryBatchWindowMs": self.query_batch_window_ms,
             "storeDtype": self.store_dtype,
             "exactTopK": self.exact_topk,
+            "meshDevices": self.mesh_devices,
         }
 
     @classmethod
@@ -180,6 +182,7 @@ class HnswUserConfig:
             query_batch_window_ms=float(d.get("queryBatchWindowMs", 1.0)),
             store_dtype=d.get("storeDtype", "float32"),
             exact_topk=bool(d.get("exactTopK", False)),
+            mesh_devices=int(d.get("meshDevices", 0)),
         )
         cfg.validate()
         return cfg
@@ -244,5 +247,6 @@ def parse_and_validate_config(index_type: str, cfg: Optional[dict]) -> HnswUserC
 
 register_index_type("hnsw", lambda d: HnswUserConfig.from_dict(d, "hnsw"))
 register_index_type("hnsw_tpu", lambda d: HnswUserConfig.from_dict(d, "hnsw_tpu"))
+register_index_type("hnsw_tpu_mesh", lambda d: HnswUserConfig.from_dict(d, "hnsw_tpu_mesh"))
 register_index_type("flat", lambda d: HnswUserConfig.from_dict(d, "flat"))
 register_index_type("noop", lambda d: HnswUserConfig.from_dict({**(d or {}), "skip": True}, "noop"))

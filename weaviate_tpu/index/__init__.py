@@ -27,6 +27,10 @@ def new_vector_index(config, shard_path: str, shard_name: str = "", metrics=None
         from weaviate_tpu.index.tpu import TpuVectorIndex
 
         return TpuVectorIndex(config, shard_path, shard_name, metrics=metrics)
+    if t == "hnsw_tpu_mesh":
+        from weaviate_tpu.index.mesh import MeshVectorIndex
+
+        return MeshVectorIndex(config, shard_path, shard_name, metrics=metrics)
     if t == "hnsw":
         try:
             from weaviate_tpu.index.hnsw import HnswIndex

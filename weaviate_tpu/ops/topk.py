@@ -55,6 +55,19 @@ def merge_top_k(dists_a: Array, idx_a: Array, dists_b: Array, idx_b: Array, k: i
     return -neg_top, jnp.take_along_axis(i, pos, axis=1)
 
 
+def pack_topk(top: Array, idx: Array) -> Array:
+    """Pack (dists f32, idx i32) [B,k] each into one [B, 2k] i32 array so the
+    host needs a single device->host fetch (the PCIe/relay round trip costs
+    far more than the bytes)."""
+    return jnp.concatenate([jax.lax.bitcast_convert_type(top, jnp.int32), idx], axis=1)
+
+
+def unpack_topk(packed) -> tuple:
+    """Host-side inverse of pack_topk: np [B, 2k] i32 -> (dists f32, idx i32)."""
+    k = packed.shape[1] // 2
+    return packed[:, :k].view("<f4"), packed[:, k:]
+
+
 def bitmap_to_mask(bitmap_words: Array, n: int) -> Array:
     """Expand a packed uint32 bitmap [ceil(N/32)] into a bool mask [N].
 

@@ -10,6 +10,6 @@ scatter-gather stays on the cluster API plane, mirroring the reference's
 local-shard vs remote-shard split (index.go:996-1017).
 """
 
-from weaviate_tpu.parallel.mesh_search import MeshSearchPlan, distributed_search_step
+from weaviate_tpu.parallel.mesh_search import MeshSearchPlan, mesh_search_step
 
-__all__ = ["MeshSearchPlan", "distributed_search_step"]
+__all__ = ["MeshSearchPlan", "mesh_search_step"]

@@ -1,4 +1,4 @@
-"""Sharded batched kNN over a device mesh (shard_map + ICI collectives).
+"""Sharded vector-store kernels over a device mesh (shard_map + ICI collectives).
 
 The device twin of Index.objectVectorSearch's errgroup fan-out + merge-sort
 (adapters/repos/db/index.go:967-1046): instead of goroutines + HTTP, the
@@ -6,10 +6,21 @@ The device twin of Index.objectVectorSearch's errgroup fan-out + merge-sort
 HBM slab, and the "merge by distance" is an all_gather of [B, k] candidate
 sets over ICI followed by a k-selection — all inside one jit.
 
-Also provides the write path (sharded insert step): appends land on the chip
-that owns the target slot via masked dynamic_update_slice, so a full
-update+search step compiles into a single SPMD program (this is what
-__graft_entry__.dryrun_multichip validates on a virtual mesh).
+Every kernel here is a whole-mesh step:
+
+- mesh_search_step:  chunked masked kNN per slab (tombstones + allowList
+  bitmap, same semantics as the single-chip scan in index/tpu.py) with the
+  cross-chip merge riding ICI.
+- mesh_insert_step:  ALL shards land their staged rows in ONE program — the
+  host ships a [n_dev, C, D] block sharded over the mesh, each chip writes its
+  own chunk at its own offset (and derives l2 norms on device). No per-shard
+  dispatch loop.
+- mesh_delete_step:  tombstone scatter; each chip claims the global rows that
+  fall inside its slab.
+- mesh_grow_2d/1d:   geometric slab growth fully on device.
+
+The serving-path index built on these kernels is
+weaviate_tpu/index/mesh.py (vectorIndexType "hnsw_tpu_mesh").
 """
 
 from __future__ import annotations
@@ -23,8 +34,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from weaviate_tpu.ops.distances import DISTANCE_FNS
+from weaviate_tpu.ops.topk import bitmap_to_mask, merge_top_k, pack_topk
 
 SHARD_AXIS = "shard"
+
+# rows of a slab scored per scan step (bounds the [B, chunk] block in HBM,
+# same rationale as index/tpu.py _SCAN_CHUNK)
+_MESH_SCAN_CHUNK = 131072
 
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
@@ -33,116 +49,210 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (SHARD_AXIS,))
 
 
-def _local_topk(dists, k):
-    neg, idx = jax.lax.top_k(-dists, k)
-    return -neg, idx
+def shard_spec(mesh: Mesh, *trailing_dims: None) -> NamedSharding:
+    """NamedSharding splitting dim 0 over the mesh shard axis."""
+    return NamedSharding(mesh, P(SHARD_AXIS, *trailing_dims))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric", "mesh"))
-def distributed_search_step(store, tombs, n_per_shard, queries, k, metric, mesh):
-    """One fully-sharded search step.
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
 
-    store:   [n_dev * N_loc, D], sharded P('shard', None)  — HBM slabs
-    tombs:   [n_dev * N_loc], sharded P('shard')           — tombstone mask
-    n_per_shard: [n_dev] int32, replicated — live high-water mark per slab
-    queries: [B, D], replicated
-    -> (dists [B, k], global_rows [B, k]) replicated; global row = slab row +
-       shard_index * N_loc (host maps rows→docIDs).
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "use_norms", "exact", "mesh"),
+)
+def mesh_search_step(
+    store, sq_norms, tombs, n_per_shard, allow_words, queries,
+    k, metric, use_allow, use_norms, exact, mesh,
+):
+    """Fully-sharded masked kNN.
+
+    store:       [n_dev * n_loc, D] sharded P('shard', None) — HBM slabs
+    sq_norms:    [n_dev * n_loc] f32 sharded (l2 only; pass zeros otherwise)
+    tombs:       [n_dev * n_loc] bool sharded — tombstone mask
+    n_per_shard: [n_dev] int32 replicated — live high-water mark per slab
+    allow_words: [n_dev * n_loc / 32] uint32 sharded — packed filter bitmap
+    queries:     [B, D] replicated
+    -> packed [B, 2k] i32 (pack_topk), replicated; global row = slab row +
+       shard_index * n_loc (the host maps rows -> docIDs).
+
+    Per-chunk selection is lax.approx_min_k (the TPU PartialReduce primitive)
+    unless exact; the cross-chunk and cross-chip merges are exact, mirroring
+    the single-chip scan in index/tpu.py.
     """
-    n_loc = store.shape[0] // mesh.devices.size
+    n_dev = mesh.devices.size
+    n_loc = store.shape[0] // n_dev
+    dim = store.shape[1]
+    chunk = min(n_loc, _MESH_SCAN_CHUNK)
+    nchunks = n_loc // chunk  # n_loc is a power of two, so this divides
 
-    def shard_fn(store_l, tombs_l, n_all, q):
+    def shard_fn(store_l, norms_l, tombs_l, n_all, allow_l, q):
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
-        valid = jnp.logical_and(jnp.arange(n_loc) < n_mine, jnp.logical_not(tombs_l))
-        d = DISTANCE_FNS[metric](q, store_l, None)
-        d = jnp.where(valid[None, :], d, jnp.inf)
-        d_top, i_top = _local_topk(d, k)
-        i_glob = i_top + my * n_loc
+        b = q.shape[0]
+        store_c = store_l.reshape(nchunks, chunk, dim)
+        tombs_c = tombs_l.reshape(nchunks, chunk)
+        norms_c = norms_l.reshape(nchunks, chunk) if use_norms else None
+        allow_c = allow_l.reshape(nchunks, chunk // 32) if use_allow else None
+
+        def step(carry, xs):
+            best_d, best_i = carry
+            ci, st, tb = xs[0], xs[1], xs[2]
+            j = 3
+            nm = None
+            if use_norms:
+                nm = xs[j]
+                j += 1
+            al = xs[j] if use_allow else None
+            base = ci * chunk
+            valid = jnp.logical_and(
+                jnp.arange(chunk) + base < n_mine, jnp.logical_not(tb)
+            )
+            if use_allow:
+                valid = jnp.logical_and(valid, bitmap_to_mask(al, chunk))
+            d = DISTANCE_FNS[metric](q.astype(st.dtype), st, nm)
+            d = jnp.where(valid[None, :], d, jnp.inf)
+            if exact:
+                neg, li = jax.lax.top_k(-d, k)
+                td = -neg
+            else:
+                td, li = jax.lax.approx_min_k(d, k, recall_target=0.95)
+            return merge_top_k(best_d, best_i, td, li + base, k), None
+
+        init = (jnp.full((b, k), jnp.inf, jnp.float32), jnp.full((b, k), -1, jnp.int32))
+        xs = [jnp.arange(nchunks), store_c, tombs_c]
+        if use_norms:
+            xs.append(norms_c)
+        if use_allow:
+            xs.append(allow_c)
+        (d_top, i_top), _ = jax.lax.scan(step, init, tuple(xs))
+        i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
         # merge across chips over ICI: gather all candidate sets, reselect
         d_all = jax.lax.all_gather(d_top, SHARD_AXIS, axis=1, tiled=True)  # [B, ndev*k]
         i_all = jax.lax.all_gather(i_glob, SHARD_AXIS, axis=1, tiled=True)
-        d_fin, pos = _local_topk(d_all, k)
+        neg, pos = jax.lax.top_k(-d_all, k)
+        d_fin = -neg
         i_fin = jnp.take_along_axis(i_all, pos, axis=1)
-        return d_fin, jnp.where(jnp.isinf(d_fin), -1, i_fin).astype(jnp.int32)
+        i_fin = jnp.where(jnp.isinf(d_fin), -1, i_fin).astype(jnp.int32)
+        return pack_topk(d_fin, i_fin)
 
     return jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(), P()),
-        out_specs=(P(), P()),
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
+            P(SHARD_AXIS), P(),
+        ),
+        out_specs=P(),
         check_vma=False,
-    )(store, tombs, n_per_shard, queries)
+    )(store, sq_norms, tombs, n_per_shard, allow_words, queries)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("use_norms", "mesh"), donate_argnums=(0, 1)
+)
+def mesh_insert_step(store, sq_norms, chunks, offsets, use_norms, mesh):
+    """One whole-mesh append: chunks [n_dev, C, D] sharded over dim 0 (each
+    chip receives only its own [C, D] block), offsets [n_dev] replicated.
+    Every chip writes its chunk into its slab at its own offset and derives
+    the l2 square-norms on device — a full import lands in one SPMD program
+    regardless of shard count."""
+
+    def shard_fn(store_l, norms_l, chunk_l, offs):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        off = offs[my]
+        ch = chunk_l[0]  # [C, D]
+        new_store = jax.lax.dynamic_update_slice(
+            store_l, ch.astype(store_l.dtype), (off, 0)
+        )
+        if use_norms:
+            nch = jnp.sum(ch.astype(jnp.float32) ** 2, axis=1)
+            new_norms = jax.lax.dynamic_update_slice(norms_l, nch, (off,))
+        else:
+            new_norms = norms_l
+        return new_store, new_norms
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None, None), P()),
+        out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS)),
+        check_vma=False,
+    )(store, sq_norms, chunks, offsets)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
-def distributed_insert_step(store, chunk, target_shard, offset, mesh):
-    """Sharded append: write `chunk` [C, D] into the slab of `target_shard`
-    at local row `offset`. Chips other than the target write their own slab
-    back unchanged (masked update keeps the program SPMD)."""
-    n_loc = store.shape[0] // mesh.devices.size
+def mesh_delete_step(tombs, rows, mesh):
+    """Tombstone scatter: rows [P] int32 global rows, padded with -1. Each
+    chip claims the rows inside its slab; out-of-slab rows map to the
+    out-of-range sentinel and are dropped by the scatter."""
+    n_loc = tombs.shape[0] // mesh.devices.size
 
-    def shard_fn(store_l, chunk_r, tgt, off):
+    def shard_fn(tombs_l, rows_r):
         my = jax.lax.axis_index(SHARD_AXIS)
-        updated = jax.lax.dynamic_update_slice(store_l, chunk_r.astype(store_l.dtype), (off, 0))
-        return jnp.where(my == tgt, updated, store_l)
+        lo = my * n_loc
+        mine = jnp.logical_and(rows_r >= lo, rows_r < lo + n_loc)
+        local = jnp.where(mine, rows_r - lo, n_loc)
+        return tombs_l.at[local].set(True, mode="drop")
 
     return jax.shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(P(SHARD_AXIS, None), P(), P(), P()),
-        out_specs=P(SHARD_AXIS, None),
-        check_vma=False,
-    )(store, chunk, target_shard, offset)
+        shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
+        out_specs=P(SHARD_AXIS), check_vma=False,
+    )(tombs, rows)
+
+
+@functools.partial(jax.jit, static_argnames=("new_loc", "mesh"))
+def mesh_grow_2d(store, new_loc, mesh):
+    """Geometric slab growth (maintainance.go:31 parity) without leaving the
+    device: every chip pads its own slab to [new_loc, D]."""
+
+    def shard_fn(store_l):
+        out = jnp.zeros((new_loc, store_l.shape[1]), store_l.dtype)
+        return jax.lax.dynamic_update_slice(out, store_l, (0, 0))
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS, None),),
+        out_specs=P(SHARD_AXIS, None), check_vma=False,
+    )(store)
+
+
+@functools.partial(jax.jit, static_argnames=("new_loc", "mesh"))
+def mesh_grow_1d(arr, new_loc, mesh):
+    def shard_fn(arr_l):
+        out = jnp.zeros((new_loc,), arr_l.dtype)
+        return jax.lax.dynamic_update_slice(out, arr_l, (0,))
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+        out_specs=P(SHARD_AXIS), check_vma=False,
+    )(arr)
 
 
 class MeshSearchPlan:
-    """A logical index spread over every chip of a mesh.
+    """Thin compatibility facade over the mesh index (weaviate_tpu/index/mesh.py)
+    for standalone use (the driver dry run, notebooks): round-robin placement,
+    no durability."""
 
-    Placement mirrors the sharding ring (usecases/sharding/state.go): docIDs
-    are assigned round-robin to chips; each chip owns a [N_loc, D] slab.
-    """
+    def __init__(self, mesh: Mesh, dim: int, capacity_per_shard: int = 16384,
+                 metric: str = "l2-squared", dtype=jnp.float32):
+        from weaviate_tpu.entities import vectorindex as vi
+        from weaviate_tpu.index.mesh import MeshVectorIndex
 
-    def __init__(self, mesh: Mesh, dim: int, capacity_per_shard: int = 16384, metric: str = "l2-squared", dtype=jnp.float32):
+        cfg = vi.HnswUserConfig(index_type="hnsw_tpu_mesh", distance=metric)
+        if dtype == jnp.bfloat16:
+            cfg.store_dtype = "bfloat16"
+        self.index = MeshVectorIndex(
+            cfg, shard_path="", persist=False, mesh=mesh,
+            initial_capacity_per_shard=capacity_per_shard, dim_hint=dim,
+        )
         self.mesh = mesh
-        self.n_dev = mesh.devices.size
         self.dim = dim
-        self.n_loc = capacity_per_shard
-        self.metric = metric
-        sh = NamedSharding(mesh, P(SHARD_AXIS, None))
-        sh1 = NamedSharding(mesh, P(SHARD_AXIS))
-        rep = NamedSharding(mesh, P())
-        self.store = jax.device_put(jnp.zeros((self.n_dev * self.n_loc, dim), dtype), sh)
-        self.tombs = jax.device_put(jnp.zeros((self.n_dev * self.n_loc,), jnp.bool_), sh1)
-        self.n_per_shard = jax.device_put(jnp.zeros((self.n_dev,), jnp.int32), rep)
-        self._counts = np.zeros(self.n_dev, dtype=np.int64)
-        self._row_to_doc = np.full(self.n_dev * self.n_loc, -1, dtype=np.int64)
 
     def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
-        """Round-robin the batch across shards, one insert step per shard."""
-        doc_ids = np.asarray(doc_ids, dtype=np.int64)
-        vectors = np.asarray(vectors, dtype=np.float32)
-        target = doc_ids % self.n_dev
-        for s in range(self.n_dev):
-            sel = target == s
-            if not sel.any():
-                continue
-            chunk = vectors[sel]
-            off = int(self._counts[s])
-            if off + chunk.shape[0] > self.n_loc:
-                raise ValueError("mesh shard capacity exceeded")
-            self.store = distributed_insert_step(
-                self.store, jnp.asarray(chunk), jnp.int32(s), jnp.int32(off), self.mesh
-            )
-            rows = s * self.n_loc + off + np.arange(chunk.shape[0])
-            self._row_to_doc[rows] = doc_ids[sel]
-            self._counts[s] += chunk.shape[0]
-        self.n_per_shard = jnp.asarray(self._counts.astype(np.int32))
+        self.index.add_batch(np.asarray(doc_ids), np.asarray(vectors, np.float32))
 
     def search(self, queries: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
-        d, rows = distributed_search_step(
-            self.store, self.tombs, self.n_per_shard, jnp.asarray(queries, jnp.float32), k, self.metric, self.mesh
-        )
-        rows = np.asarray(rows)
-        ids = np.where(rows >= 0, self._row_to_doc[np.clip(rows, 0, None)], -1)
-        return ids, np.asarray(d)
+        ids, d = self.index.search_by_vectors(np.asarray(queries, np.float32), k)
+        # uint64 sentinel (max) -> -1 for the standalone API
+        return ids.view(np.int64), d
