@@ -159,6 +159,49 @@ def test_compact_drops_tombstones(tmp_path, rng):
     idx.shutdown()
 
 
+def test_insert_with_full_shards_keeps_live_rows(tmp_path, rng):
+    """Regression: a whole-mesh insert step must leave chips with no work
+    bit-identical — a full slab's clamped offset would otherwise zero its
+    last live row."""
+    idx = make_index(tmp_path)  # 64 rows/chip * 8 chips
+    n = 8 * 64 - 1  # fill every slab except one row on one chip
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    idx.add(n, rng.standard_normal(DIM).astype(np.float32))  # 7 chips idle
+    # every original vector must still be found exactly
+    probe = rng.integers(0, n, 32)
+    for i in probe:
+        got_ids, got_d = idx.search_by_vector(vecs[i], 1)
+        assert got_ids[0] == i and got_d[0] < 1e-5, i
+    idx.shutdown()
+
+
+def test_delete_then_grow_keeps_tombstones(tmp_path, rng):
+    """Regression: tombstones staged before a growth must land on the
+    remapped rows, and the deleted doc must not resurrect through the
+    rebuilt id map."""
+    idx = make_index(tmp_path)
+    n = 512
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    idx.delete(300)  # staged tombstone at old slab layout
+    more = rng.standard_normal((4096, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(10_000, 14_096), more)  # triggers growth
+    assert not idx.contains(300)
+    got_ids, _ = idx.search_by_vector(vecs[300], 5)
+    assert 300 not in got_ids.tolist()
+    # every other original row survived the grow + masked writes
+    for i in (0, 1, 299, 301, 511):
+        got_ids, got_d = idx.search_by_vector(vecs[i], 1)
+        assert got_ids[0] == i and got_d[0] < 1e-5, i
+    # compact must not re-add the deleted row either
+    idx.compact()
+    assert not idx.contains(300)
+    got_ids, _ = idx.search_by_vector(vecs[300], 5)
+    assert 300 not in got_ids.tolist()
+    idx.shutdown()
+
+
 def test_pq_rejected_on_mesh(tmp_path):
     with pytest.raises(ConfigValidationError):
         make_index(tmp_path, pq={"enabled": True})

@@ -7,4 +7,22 @@ adapters/handlers/rest/clusterapi (internal node-to-node HTTP).
 
 from weaviate_tpu.cluster.sharding import ShardingState, ShardingConfig
 
-__all__ = ["ShardingState", "ShardingConfig"]
+__all__ = [
+    "ShardingState",
+    "ShardingConfig",
+    "ClusterNode",
+    "ClusterState",
+]
+
+
+def __getattr__(name):
+    # lazy: ClusterNode pulls in the whole db/schema graph
+    if name == "ClusterNode":
+        from weaviate_tpu.cluster.node import ClusterNode
+
+        return ClusterNode
+    if name == "ClusterState":
+        from weaviate_tpu.cluster.membership import ClusterState
+
+        return ClusterState
+    raise AttributeError(name)

@@ -1,0 +1,70 @@
+"""Shared intra-cluster HTTP client plumbing.
+
+One connection stack for every outbound cluster caller (remote index ops,
+replication, schema 2PC, liveness probes): per-thread keep-alive connection
+cache with a single retry on a stale socket. Divergent hand-rolled
+http.client code paths are how exception-handling bugs creep in — everything
+routes through here.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Optional
+
+
+class RemoteError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"remote error {status}: {message}")
+        self.status = status
+
+
+class Http:
+    """Per-thread keep-alive connection cache."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+        self._local = threading.local()
+
+    def _conn(self, host: str) -> http.client.HTTPConnection:
+        cache = getattr(self._local, "conns", None)
+        if cache is None:
+            cache = self._local.conns = {}
+        conn = cache.get(host)
+        if conn is None:
+            h, p = host.rsplit(":", 1)
+            conn = http.client.HTTPConnection(h, int(p), timeout=self.timeout)
+            cache[host] = conn
+        return conn
+
+    def request(
+        self, host: str, method: str, path: str,
+        body: Optional[bytes] = None, content_type: str = "application/json",
+    ) -> tuple[int, bytes]:
+        for attempt in (0, 1):
+            conn = self._conn(host)
+            try:
+                conn.request(method, path, body=body,
+                             headers={"Content-Type": content_type} if body else {})
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                self._local.conns.pop(host, None)
+                if attempt == 1:
+                    raise
+        raise AssertionError("unreachable")
+
+    def json(self, host: str, method: str, path: str, payload=None) -> dict:
+        body = json.dumps(payload).encode("utf-8") if payload is not None else None
+        status, raw = self.request(host, method, path, body)
+        try:
+            data = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            data = {"error": raw.decode("utf-8", "replace")}
+        if status >= 400 and status != 404:
+            raise RemoteError(status, str(data.get("error", data)))
+        data["_status"] = status
+        return data

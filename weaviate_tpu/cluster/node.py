@@ -1,0 +1,148 @@
+"""ClusterNode: one node's full distributed object graph.
+
+The cluster-side slice of configure_api.go:105 — wires membership, the
+inbound cluster API listener, outbound clients, schema 2PC, replication
+coordinator, and the scaler around a DB + SchemaManager. Used by the server
+entry point and by the in-process multi-node test harness (the analog of
+adapters/repos/db/clusterintegrationtest/cluster_integration_test.go:61-80:
+real DBs + real cluster API servers on random ports).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from weaviate_tpu.cluster.clusterapi import ClusterApi, ClusterApiServer
+from weaviate_tpu.cluster.membership import ClusterState
+from weaviate_tpu.cluster.remote_client import (
+    NodeClient,
+    RemoteIndex,
+    ReplicationClient,
+)
+from weaviate_tpu.cluster.tx import TxManager, TxParticipant
+from weaviate_tpu.db import DB
+from weaviate_tpu.schema import SchemaManager
+from weaviate_tpu.usecases.replica import Finder, ReplicaCoordinator, Replicator
+from weaviate_tpu.usecases.scaler import Scaler
+
+
+class ClusterNode:
+    def __init__(
+        self,
+        data_path: str,
+        node_name: str,
+        node_names: Optional[list[str]] = None,
+        bind_host: str = "127.0.0.1",
+        bind_port: int = 0,
+        advertise_host: Optional[str] = None,
+        metrics=None,
+        default_vectorizer: str = "none",
+        tolerate_node_failures: bool = False,
+    ):
+        os.makedirs(data_path, exist_ok=True)
+        self.node_name = node_name
+        self.node_names = node_names or [node_name]
+        self.cluster = ClusterState(local_name=node_name)
+        self.remote_index = RemoteIndex(self._resolve_shard)
+        self.db = DB(
+            data_path,
+            node_name=node_name,
+            remote_client=self.remote_index,
+            metrics=metrics,
+            node_names=self.node_names,
+        )
+        self.tx_manager = TxManager(
+            self.cluster, tolerate_node_failures=tolerate_node_failures
+        )
+        self.schema = SchemaManager(
+            os.path.join(data_path, "schema.json"),
+            migrator=self.db,
+            node_names=self.node_names,
+            tx=self.tx_manager,
+            default_vectorizer=default_vectorizer,
+        )
+        self.tx_participant = TxParticipant(self.schema)
+        self.api = ClusterApi(
+            self.db, self.schema, self.tx_participant, self.cluster, node_name
+        )
+        self.server = ClusterApiServer(self.api, host=bind_host, port=bind_port)
+        # the address peers should dial: binding 0.0.0.0 means "all
+        # interfaces" and is not dialable, so advertise a concrete host
+        if advertise_host:
+            self.advertise = f"{advertise_host}:{self.server.port}"
+        elif bind_host == "0.0.0.0":
+            import socket as _socket
+
+            try:
+                host = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+            self.advertise = f"{host}:{self.server.port}"
+        else:
+            self.advertise = self.server.address
+        self.node_client = NodeClient()
+        self.replica_coord = ReplicaCoordinator(
+            node_name,
+            self.cluster,
+            self.api,
+            ReplicationClient(),
+            self.schema.sharding_state,
+        )
+        self.db.set_replication(
+            Replicator(self.replica_coord), Finder(self.replica_coord)
+        )
+        self.schema.scaler = Scaler(node_name, self.cluster, self.node_client, self.db)
+
+    # -- addressing ----------------------------------------------------------
+
+    def _resolve_shard(self, class_name: str, shard_name: str) -> Optional[str]:
+        """Pick an alive replica node for a non-local shard (the node lookup
+        of usecases/sharding/remote_index.go)."""
+        state = self.schema.sharding_state(class_name)
+        if state is None:
+            return None
+        for node in state.belongs_to_nodes(shard_name):
+            if node == self.node_name:
+                continue
+            if self.cluster.is_alive(node):
+                addr = self.cluster.node_address(node)
+                if addr is not None:
+                    return addr
+        return None
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self) -> None:
+        self.server.start()
+        self.cluster.register(self.node_name, self.advertise)
+        # liveness probing keeps is_alive()/_resolve_shard honest so reads
+        # fail over instead of timing out against a dead replica
+        self.cluster.start_probing()
+
+    def join(self, peers: dict[str, str]) -> None:
+        """Register peer nodes (CLUSTER_JOIN analog): {name: host:port}."""
+        for name, host in peers.items():
+            self.cluster.register(name, host)
+
+    # -- /v1/nodes cluster aggregation (usecases/nodes/handler.go) -----------
+
+    def nodes_status(self) -> list[dict]:
+        out = [self.api.node_status()]
+        for name in self.cluster.all_names():
+            if name == self.node_name:
+                continue
+            host = self.cluster.node_address(name)
+            try:
+                out.append(self.node_client.node_status(host))
+            except Exception:  # noqa: BLE001 — report unreachable nodes
+                out.append({"name": name, "status": "UNAVAILABLE", "shards": []})
+        return sorted(out, key=lambda n: n.get("name", ""))
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+        self.cluster.shutdown()
+        self.replica_coord.shutdown()
+        self.db.shutdown()

@@ -36,6 +36,8 @@ class ClassIndex:
         remote_client=None,
         metrics=None,
         invert_cfg: Optional[dict] = None,
+        replicator=None,
+        finder=None,
     ):
         self.class_def = class_def
         self.class_name = class_def.name
@@ -43,6 +45,8 @@ class ClassIndex:
         self.path = os.path.join(root_path, class_def.name.lower())
         self.node_name = node_name
         self.remote = remote_client  # cluster transport for non-local shards
+        self.replicator = replicator  # usecases/replica.Replicator (writes 2PC)
+        self.finder = finder          # usecases/replica.Finder (consistent reads)
         self.metrics = metrics
         self.invert_cfg = invert_cfg
         self.sharding_state = sharding_state or ShardingState(
@@ -81,38 +85,66 @@ class ClassIndex:
             groups.setdefault(self.shard_for(u), []).append(i)
         return groups
 
+    def _replicated(self, shard_name: str) -> bool:
+        """True when the shard has >1 replica and a replication coordinator
+        is wired — writes then take the 2PC path, reads the Finder path."""
+        return (
+            self.replicator is not None
+            and len(self.sharding_state.belongs_to_nodes(shard_name)) > 1
+        )
+
     # -- single-object ops (index.go putObject / objectByID / deleteObject) --
 
-    def put_object(self, obj: StorObj) -> StorObj:
+    def put_object(self, obj: StorObj, cl: Optional[str] = None) -> StorObj:
         name = self.shard_for(obj.uuid)
+        if self._replicated(name):
+            times = self.replicator.put_object(self.class_name, name, obj, cl)
+            if isinstance(times, dict):
+                # report the stored times (creation preserved on update)
+                obj.creation_time_unix = times.get("creationTimeUnix", obj.creation_time_unix)
+                obj.last_update_time_unix = times.get("lastUpdateTimeUnix", obj.last_update_time_unix)
+            return obj
         shard = self._local_shard(name)
         if shard is not None:
             return shard.put_object(obj)
         return self.remote.put_object(self.class_name, name, obj)
 
-    def object_by_uuid(self, uuid: str, include_vector: bool = True) -> Optional[StorObj]:
+    def object_by_uuid(
+        self, uuid: str, include_vector: bool = True, cl: Optional[str] = None
+    ) -> Optional[StorObj]:
         name = self.shard_for(uuid)
+        if self.finder is not None and len(self.sharding_state.belongs_to_nodes(name)) > 1:
+            return self.finder.get_object(self.class_name, name, uuid, cl, include_vector)
         shard = self._local_shard(name)
         if shard is not None:
             return shard.object_by_uuid(uuid, include_vector)
         return self.remote.get_object(self.class_name, name, uuid, include_vector)
 
-    def exists(self, uuid: str) -> bool:
+    def exists(self, uuid: str, cl: Optional[str] = None) -> bool:
         name = self.shard_for(uuid)
+        if self.finder is not None and len(self.sharding_state.belongs_to_nodes(name)) > 1:
+            return self.finder.exists(self.class_name, name, uuid, cl)
         shard = self._local_shard(name)
         if shard is not None:
             return shard.exists(uuid)
         return self.remote.exists(self.class_name, name, uuid)
 
-    def delete_object(self, uuid: str) -> bool:
+    def delete_object(self, uuid: str, cl: Optional[str] = None) -> bool:
         name = self.shard_for(uuid)
+        if self._replicated(name):
+            return self.replicator.delete_object(self.class_name, name, uuid, cl)
         shard = self._local_shard(name)
         if shard is not None:
             return shard.delete_object(uuid)
         return self.remote.delete_object(self.class_name, name, uuid)
 
-    def merge_object(self, uuid: str, props: dict, vector=None) -> Optional[StorObj]:
+    def merge_object(
+        self, uuid: str, props: dict, vector=None, cl: Optional[str] = None
+    ) -> Optional[StorObj]:
         name = self.shard_for(uuid)
+        if self._replicated(name):
+            ok = self.replicator.merge_object(self.class_name, name, uuid, props, vector, cl)
+            return self.object_by_uuid(uuid, cl=cl) if ok else None
         shard = self._local_shard(name)
         if shard is not None:
             return shard.merge_object(uuid, props, vector)
@@ -120,17 +152,26 @@ class ClassIndex:
 
     # -- batch (index.go:424 putObjectBatch, groups by PhysicalShard) --------
 
-    def put_batch(self, objs: Sequence[StorObj]) -> list[Optional[Exception]]:
+    def put_batch(
+        self, objs: Sequence[StorObj], cl: Optional[str] = None
+    ) -> list[Optional[Exception]]:
         groups = self._group_by_shard([o.uuid for o in objs])
         errs: list[Optional[Exception]] = [None] * len(objs)
 
         def run(name: str, idxs: list[int]):
             batch = [objs[i] for i in idxs]
-            shard = self._local_shard(name)
-            if shard is not None:
-                sub = shard.put_batch(batch)
+            if self._replicated(name):
+                try:
+                    sub = self.replicator.put_batch(self.class_name, name, batch, cl)
+                    sub = [RuntimeError(e) if e else None for e in sub]
+                except Exception as e:  # noqa: BLE001 — per-batch fault isolation
+                    sub = [e] * len(batch)
             else:
-                sub = self.remote.put_batch(self.class_name, name, batch)
+                shard = self._local_shard(name)
+                if shard is not None:
+                    sub = shard.put_batch(batch)
+                else:
+                    sub = self.remote.put_batch(self.class_name, name, batch)
             for i, e in zip(idxs, sub):
                 errs[i] = e
 
@@ -139,22 +180,38 @@ class ClassIndex:
             f.result()
         return errs
 
-    def delete_by_filter(self, flt: Optional[LocalFilter], dry_run: bool = False) -> dict:
+    def delete_by_filter(
+        self, flt: Optional[LocalFilter], dry_run: bool = False, cl: Optional[str] = None
+    ) -> dict:
         """Batch delete (batch delete-by-filter REST op): -> per-uuid results."""
         results = []
-        for name, shard in self.shards.items():
-            for u in shard.find_uuids(flt):
-                if dry_run:
-                    results.append({"id": u, "status": "DRYRUN"})
+        for name in self.sharding_state.all_physical_shards():
+            shard = self._local_shard(name)
+            if self._replicated(name):
+                if shard is not None:
+                    uuids = shard.find_uuids(flt)
                 else:
-                    ok = shard.delete_object(u)
-                    results.append({"id": u, "status": "SUCCESS" if ok else "FAILED"})
-        if self.remote is not None:
-            for name in self.sharding_state.all_physical_shards():
-                if self._local_shard(name) is None:
-                    results.extend(
-                        self.remote.delete_by_filter(self.class_name, name, flt, dry_run)
-                    )
+                    uuids = [
+                        r["id"]
+                        for r in self.remote.delete_by_filter(self.class_name, name, flt, True)
+                    ]
+                for u in uuids:
+                    if dry_run:
+                        results.append({"id": u, "status": "DRYRUN"})
+                    else:
+                        ok = self.replicator.delete_object(self.class_name, name, u, cl)
+                        results.append({"id": u, "status": "SUCCESS" if ok else "FAILED"})
+            elif shard is not None:
+                for u in shard.find_uuids(flt):
+                    if dry_run:
+                        results.append({"id": u, "status": "DRYRUN"})
+                    else:
+                        ok = shard.delete_object(u)
+                        results.append({"id": u, "status": "SUCCESS" if ok else "FAILED"})
+            elif self.remote is not None:
+                results.extend(
+                    self.remote.delete_by_filter(self.class_name, name, flt, dry_run)
+                )
         return {"matches": len(results), "objects": results}
 
     # -- search (index.go:967 objectVectorSearch fan-out + merge) ------------

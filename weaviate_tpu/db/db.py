@@ -26,11 +26,15 @@ class DB:
         remote_client=None,
         metrics=None,
         node_names: Optional[list[str]] = None,
+        replicator=None,
+        finder=None,
     ):
         self.root_path = root_path
         self.node_name = node_name
         self.node_names = node_names or [node_name]
         self.remote = remote_client
+        self.replicator = replicator
+        self.finder = finder
         self.metrics = metrics
         self.indexes: dict[str, ClassIndex] = {}
         self._lock = threading.RLock()
@@ -61,6 +65,8 @@ class DB:
                 remote_client=self.remote,
                 metrics=self.metrics,
                 invert_cfg=getattr(class_def, "inverted_index_config", None),
+                replicator=self.replicator,
+                finder=self.finder,
             )
             self.indexes[class_def.name] = idx
             return idx
@@ -80,6 +86,22 @@ class DB:
         idx = self.indexes.get(class_name)
         if idx is not None:
             idx.update_vector_config(cfg)
+
+    def update_sharding_state(self, class_name: str, state: ShardingState) -> None:
+        """Adopt a rebuilt sharding state (replication-factor change)."""
+        idx = self.indexes.get(class_name)
+        if idx is not None:
+            idx.sharding_state = state
+
+    def set_replication(self, replicator, finder) -> None:
+        """Late-bind the replication coordinator (it needs the in-process
+        cluster API facade, which needs this DB — configure-api wiring
+        order, configure_api.go:105)."""
+        self.replicator = replicator
+        self.finder = finder
+        for idx in self.indexes.values():
+            idx.replicator = replicator
+            idx.finder = finder
 
     # -- access --------------------------------------------------------------
 

@@ -96,6 +96,7 @@ class Shard:
         )
         self.bm25 = BM25Searcher(self.inverted, class_def, invert_cfg)
         self.status = STATUS_READY
+        self._deleted: dict[str, int] = {}  # uuid -> deletion ms (digests)
         self._lock = threading.RLock()
 
     # -- geo props (propertyspecific/ + vector/geo) --------------------------
@@ -142,18 +143,29 @@ class Shard:
 
     # -- write path ----------------------------------------------------------
 
-    def put_object(self, obj: StorObj) -> StorObj:
+    def put_object(self, obj: StorObj, preserve_times: bool = False) -> StorObj:
         """Upsert (shard_write_put.go:putObject): allocate a fresh docID,
         clean up the previous version's inverted/vector entries, write LSM
-        object + lookup, update inverted + geo + vector index."""
+        object + lookup, update inverted + geo + vector index.
+
+        preserve_times=True keeps the object's wire timestamps untouched —
+        the replica apply path, where the COORDINATOR stamps times once so
+        every replica stores identical values and digests converge
+        (otherwise each replica's local clock would make read repair
+        ping-pong forever)."""
         with self._lock:
             self._check_writable()
             key = _uuid_bytes(obj.uuid)
+            self._deleted.pop(obj.uuid, None)
             prev_raw = self.objects.get(key)
             if prev_raw is not None:
                 prev = StorObj.from_binary(prev_raw)
+                # creation time always survives an update; the update time is
+                # either stamped here (local write) or kept from the wire
+                # (coordinator-stamped replica apply)
                 obj.creation_time_unix = prev.creation_time_unix
-                obj.last_update_time_unix = int(time.time() * 1000)
+                if not preserve_times:
+                    obj.last_update_time_unix = int(time.time() * 1000)
                 self._cleanup_previous(prev)
             doc_id = self.counter.get_and_inc()
             obj.doc_id = doc_id
@@ -182,9 +194,12 @@ class Shard:
             if isinstance(props.get(name), dict):
                 idx.delete(doc_id)
 
-    def put_batch(self, objs: Sequence[StorObj]) -> list[Optional[Exception]]:
+    def put_batch(
+        self, objs: Sequence[StorObj], preserve_times: bool = False
+    ) -> list[Optional[Exception]]:
         """Batch import (shard_write_batch_objects.go): LSM + inverted per
-        object host-side, vectors land on the device as ONE batched add."""
+        object host-side, vectors land on the device as ONE batched add.
+        preserve_times: see put_object (replica apply path)."""
         with self._lock:
             self._check_writable()
             errs: list[Optional[Exception]] = [None] * len(objs)
@@ -195,11 +210,13 @@ class Shard:
             for i, obj in enumerate(objs):
                 try:
                     key = _uuid_bytes(obj.uuid)
+                    self._deleted.pop(obj.uuid, None)
                     prev_raw = self.objects.get(key)
                     if prev_raw is not None:
                         prev = StorObj.from_binary(prev_raw)
                         obj.creation_time_unix = prev.creation_time_unix
-                        obj.last_update_time_unix = int(time.time() * 1000)
+                        if not preserve_times:
+                            obj.last_update_time_unix = int(time.time() * 1000)
                         self._cleanup_previous(prev)
                         # duplicate uuid within this batch: un-stage the
                         # earlier version's vector (it was never device-added,
@@ -241,7 +258,11 @@ class Shard:
                             errs[by_doc[d]] = e
             return errs
 
-    def delete_object(self, uuid: str) -> bool:
+    def delete_object(self, uuid: str, deletion_time: Optional[int] = None) -> bool:
+        """deletion_time (ms) is coordinator-stamped on replicated deletes so
+        digests can order a deletion against concurrent writes; locally we
+        stamp now. Tombstone times are in-memory only (v1.19 reference
+        parity: deletes are not durable conflict-resolution state)."""
         with self._lock:
             self._check_writable()
             key = _uuid_bytes(uuid)
@@ -251,10 +272,18 @@ class Shard:
             prev = StorObj.from_binary(raw)
             self._cleanup_previous(prev)
             self.objects.delete(key)
+            self._deleted[uuid] = deletion_time or int(time.time() * 1000)
             return True
 
-    def merge_object(self, uuid: str, props: dict, vector=None) -> Optional[StorObj]:
-        """PATCH semantics (objects.Manager.MergeObject): shallow-merge props."""
+    def deletion_time(self, uuid: str) -> Optional[int]:
+        """ms timestamp of a known deletion, for digest comparison."""
+        return self._deleted.get(uuid)
+
+    def merge_object(self, uuid: str, props: dict, vector=None,
+                     update_time: Optional[int] = None) -> Optional[StorObj]:
+        """PATCH semantics (objects.Manager.MergeObject): shallow-merge props.
+        update_time is coordinator-stamped on replicated merges (see
+        put_object preserve_times)."""
         with self._lock:
             raw = self.objects.get(_uuid_bytes(uuid))
             if raw is None:
@@ -265,6 +294,9 @@ class Shard:
             obj.properties = merged
             if vector is not None:
                 obj.vector = np.asarray(vector, dtype=np.float32)
+            if update_time is not None:
+                obj.last_update_time_unix = update_time
+                return self.put_object(obj, preserve_times=True)
             return self.put_object(obj)
 
     # -- read path -----------------------------------------------------------

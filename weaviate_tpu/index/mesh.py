@@ -177,6 +177,10 @@ class MeshVectorIndex(VectorIndex):
         self._slot_to_doc = s2d
         rows = np.nonzero(s2d >= 0)[0]
         self._doc_to_row = dict(zip(s2d[rows].tolist(), rows.tolist()))
+        # staged-but-unflushed tombstone rows move with their slab
+        self._pending_tombs = [
+            (r // old_loc) * new_loc + (r % old_loc) for r in self._pending_tombs
+        ]
         self.n_loc = new_loc
 
     # -- staging -------------------------------------------------------------
@@ -194,6 +198,7 @@ class MeshVectorIndex(VectorIndex):
         old = self._doc_to_row.pop(doc_id, None)
         if old is not None:
             self._pending_tombs.append(old)
+            self._slot_to_doc[old] = -1  # dead row must not resurrect via _grow
             self.live -= 1
         if doc_id in self._pending:
             self.live -= 1
@@ -214,6 +219,7 @@ class MeshVectorIndex(VectorIndex):
                     self._log.append_delete(doc_id)
             return
         self._pending_tombs.append(row)
+        self._slot_to_doc[row] = -1  # dead row must not resurrect via _grow
         self.live -= 1
         if log and self._log is not None:
             self._log.append_delete(doc_id)
@@ -274,6 +280,7 @@ class MeshVectorIndex(VectorIndex):
             c = max(c, 1)
             chunks = np.zeros((self.n_dev, c, self.dim), np.float32)
             offsets = self._counts.astype(np.int32)
+            takes = np.zeros(self.n_dev, dtype=np.int32)
             taken: list[np.ndarray] = []
             for s in range(self.n_dev):
                 take = min(c, len(queues[s]))
@@ -281,6 +288,7 @@ class MeshVectorIndex(VectorIndex):
                 queues[s] = queues[s][take:]
                 if take:
                     chunks[s, :take] = rows[sel]
+                takes[s] = take
                 taken.append(sel)
             chunks_dev = jax.device_put(
                 jnp.asarray(chunks), shard_spec(self.mesh, None, None)
@@ -290,6 +298,7 @@ class MeshVectorIndex(VectorIndex):
                 self._sq_norms,
                 chunks_dev,
                 jnp.asarray(offsets),
+                jnp.asarray(takes),
                 self.metric == vi.DISTANCE_L2,
                 self.mesh,
             )

@@ -152,23 +152,31 @@ def mesh_search_step(
 @functools.partial(
     jax.jit, static_argnames=("use_norms", "mesh"), donate_argnums=(0, 1)
 )
-def mesh_insert_step(store, sq_norms, chunks, offsets, use_norms, mesh):
+def mesh_insert_step(store, sq_norms, chunks, offsets, takes, use_norms, mesh):
     """One whole-mesh append: chunks [n_dev, C, D] sharded over dim 0 (each
-    chip receives only its own [C, D] block), offsets [n_dev] replicated.
-    Every chip writes its chunk into its slab at its own offset and derives
-    the l2 square-norms on device — a full import lands in one SPMD program
-    regardless of shard count."""
+    chip receives only its own [C, D] block), offsets/takes [n_dev]
+    replicated. Every chip with work (takes[my] > 0) writes its chunk into
+    its slab at its own offset and derives the l2 square-norms on device — a
+    full import lands in one SPMD program regardless of shard count.
 
-    def shard_fn(store_l, norms_l, chunk_l, offs):
+    Chips with takes[my] == 0 keep their slab bit-identical: the masked
+    select below matters because a full slab's offset would clamp inside
+    dynamic_update_slice and silently zero live rows."""
+
+    def shard_fn(store_l, norms_l, chunk_l, offs, tks):
         my = jax.lax.axis_index(SHARD_AXIS)
         off = offs[my]
+        active = tks[my] > 0
         ch = chunk_l[0]  # [C, D]
-        new_store = jax.lax.dynamic_update_slice(
+        written = jax.lax.dynamic_update_slice(
             store_l, ch.astype(store_l.dtype), (off, 0)
         )
+        new_store = jnp.where(active, written, store_l)
         if use_norms:
             nch = jnp.sum(ch.astype(jnp.float32) ** 2, axis=1)
-            new_norms = jax.lax.dynamic_update_slice(norms_l, nch, (off,))
+            new_norms = jnp.where(
+                active, jax.lax.dynamic_update_slice(norms_l, nch, (off,)), norms_l
+            )
         else:
             new_norms = norms_l
         return new_store, new_norms
@@ -176,10 +184,12 @@ def mesh_insert_step(store, sq_norms, chunks, offsets, use_norms, mesh):
     return jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None, None), P()),
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None, None), P(), P(),
+        ),
         out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS)),
         check_vma=False,
-    )(store, sq_norms, chunks, offsets)
+    )(store, sq_norms, chunks, offsets, takes)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))

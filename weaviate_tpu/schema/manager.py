@@ -57,6 +57,7 @@ class SchemaManager:
         self.migrator = migrator
         self.node_names = node_names or ["node-0"]
         self.tx = tx  # cluster.TxManager or None (single node)
+        self.scaler = None  # usecases/scaler hook, set by cluster wiring
         self.default_vectorizer = default_vectorizer
         self.schema = Schema()
         self.sharding_states: dict[str, ShardingState] = {}
@@ -284,7 +285,20 @@ class SchemaManager:
             if "moduleConfig" in updated:
                 cd.module_config = updated["moduleConfig"]
             if "replicationConfig" in updated:
+                # replication-factor change: rebuild the ring with the new
+                # replica count and hand the local shards to the scaler
+                # (usecases/scaler/scaler.go trigger path). The file push
+                # runs BEFORE the new state activates, so in-flight writes
+                # keep targeting the old replica set during the copy; writes
+                # landing in that window reach the new replica via read
+                # repair afterwards.
+                old_state = self.sharding_states.get(class_name)
                 cd.replication_config = updated["replicationConfig"]
+                new_state = self._mk_sharding_state(cd)
+                if self.scaler is not None and old_state is not None:
+                    self.scaler.scale(class_name, old_state, new_state)
+                if self.migrator is not None and hasattr(self.migrator, "update_sharding_state"):
+                    self.migrator.update_sharding_state(class_name, new_state)
             if self.migrator is not None:
                 self.migrator.update_class(cd)
             self._save()

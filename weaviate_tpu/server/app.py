@@ -40,8 +40,38 @@ class App:
         else:
             self.metrics = noop_metrics()
 
-        self.db = DB(path)
-        self.schema = SchemaManager(os.path.join(path, "schema.json"), migrator=self.db)
+        # distributed deployments (CLUSTER_HOSTNAME/CLUSTER_JOIN set) build
+        # the full cluster graph: membership, cluster-API listener, schema
+        # 2PC, replication, scaler (configure_api.go startupRoutine's
+        # cluster.Init + clusterapi.Serve path). CLUSTER_JOIN entries are
+        # "name@host:port".
+        cl_cfg = self.config.cluster
+        if cl_cfg.hostname or cl_cfg.join:
+            from weaviate_tpu.cluster.node import ClusterNode
+
+            node_name = cl_cfg.hostname or "node-0"
+            peers = {}
+            for item in cl_cfg.join:
+                if "@" in item:
+                    pname, phost = item.split("@", 1)
+                    peers[pname] = phost
+            node_names = sorted(set(peers) | {node_name})
+            self.cluster_node = ClusterNode(
+                path,
+                node_name,
+                node_names=node_names,
+                bind_host="0.0.0.0",  # peers dial in from other machines
+                bind_port=cl_cfg.data_bind_port,
+                metrics=self.metrics,
+            )
+            self.cluster_node.start()
+            self.cluster_node.join(peers)
+            self.db = self.cluster_node.db
+            self.schema = self.cluster_node.schema
+        else:
+            self.cluster_node = None
+            self.db = DB(path, metrics=self.metrics)
+            self.schema = SchemaManager(os.path.join(path, "schema.json"), migrator=self.db)
         self.modules = modules
         self.auto_schema = (
             AutoSchema(
@@ -68,10 +98,10 @@ class App:
         self.graphql = GraphQLExecutor(self.traverser, self.aggregator, self.schema, self.db)
         self.authenticator = Authenticator(self.config.auth)
         self.authorizer = Authorizer(self.config.authz)
-        # populated by later subsystems (backup scheduler, classifier, nodes)
+        # populated by later subsystems (backup scheduler, classifier)
         self.backup_scheduler = None
         self.classifier = None
-        self.cluster = None
+        self.cluster = self.cluster_node  # /v1/nodes aggregation source
 
     # -- meta ----------------------------------------------------------------
 
@@ -84,4 +114,7 @@ class App:
         }
 
     def shutdown(self) -> None:
-        self.db.shutdown()
+        if self.cluster_node is not None:
+            self.cluster_node.shutdown()
+        else:
+            self.db.shutdown()

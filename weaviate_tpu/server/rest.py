@@ -265,6 +265,9 @@ class Handler(BaseHTTPRequestHandler):
     def _include_vector(self) -> bool:
         return "vector" in (self.query.get("include") or "")
 
+    def _cl(self):
+        return self.query.get("consistency_level")
+
     def h_objects_list(self):
         objs = self.app.objects.list_objects(
             class_name=self.query.get("class"),
@@ -279,7 +282,7 @@ class Handler(BaseHTTPRequestHandler):
         })
 
     def h_objects_create(self):
-        obj = self.app.objects.add(self._json_body() or {})
+        obj = self.app.objects.add(self._json_body() or {}, cl=self._cl())
         self._reply(200, obj.to_rest(include_vector=True))
 
     def h_objects_validate(self):
@@ -287,7 +290,8 @@ class Handler(BaseHTTPRequestHandler):
         self._reply(200)
 
     def h_object_get(self, id, cls=None):
-        obj = self.app.objects.get(id, cls, include_vector=self._include_vector())
+        obj = self.app.objects.get(
+            id, cls, include_vector=self._include_vector(), cl=self._cl())
         self._reply(200, obj.to_rest(self._include_vector()))
 
     def h_object_head(self, id, cls=None):
@@ -301,7 +305,7 @@ class Handler(BaseHTTPRequestHandler):
         if cls:
             body.setdefault("class", cls)
         body["id"] = id
-        obj = self.app.objects.update(id, body)
+        obj = self.app.objects.update(id, body, cl=self._cl())
         self._reply(200, obj.to_rest(include_vector=True))
 
     def h_object_patch(self, id, cls=None):
@@ -310,11 +314,12 @@ class Handler(BaseHTTPRequestHandler):
         if not class_name:
             raise HTTPError(422, "PATCH requires the class name")
         self.app.objects.merge(
-            id, class_name, body.get("properties") or {}, vector=body.get("vector"))
+            id, class_name, body.get("properties") or {}, vector=body.get("vector"),
+            cl=self._cl())
         self._reply(204)
 
     def h_object_delete(self, id, cls=None):
-        self.app.objects.delete(id, cls)
+        self.app.objects.delete(id, cls, cl=self._cl())
         self._reply(204)
 
     # -- references ----------------------------------------------------------
@@ -340,7 +345,7 @@ class Handler(BaseHTTPRequestHandler):
     def h_batch_objects(self):
         body = self._json_body() or {}
         payloads = body.get("objects") or []
-        results = self.app.batch.add_objects(payloads)
+        results = self.app.batch.add_objects(payloads, cl=self._cl())
         out = []
         for r in results:
             if r.err:

@@ -103,20 +103,21 @@ class ObjectsManager:
 
     # -- CRUD (usecases/objects/manager.go) ----------------------------------
 
-    def add(self, payload: dict) -> StorObj:
+    def add(self, payload: dict, cl: Optional[str] = None) -> StorObj:
         obj = self._prepare(payload)
         idx = self._index_or_raise(obj.class_name)
         if payload.get("id") and idx.exists(obj.uuid):
             raise ObjectsError(f"id {obj.uuid!r} already exists")
-        return idx.put_object(obj)
+        return idx.put_object(obj, cl=cl)
 
     def get(
-        self, uuid: str, class_name: Optional[str] = None, include_vector: bool = False
+        self, uuid: str, class_name: Optional[str] = None, include_vector: bool = False,
+        cl: Optional[str] = None,
     ) -> StorObj:
         uuid = _valid_uuid(uuid)
         if class_name:
             idx = self._index_or_raise(class_name)
-            obj = idx.object_by_uuid(uuid, include_vector)
+            obj = idx.object_by_uuid(uuid, include_vector, cl=cl)
         else:
             obj, _ = self.db.object_by_uuid_any_class(uuid, include_vector)
         if obj is None:
@@ -132,7 +133,7 @@ class ObjectsManager:
         obj, _ = self.db.object_by_uuid_any_class(uuid, include_vector=False)
         return obj is not None
 
-    def update(self, uuid: str, payload: dict) -> StorObj:
+    def update(self, uuid: str, payload: dict, cl: Optional[str] = None) -> StorObj:
         """PUT semantics: full replace (keeps creation time via shard upsert)."""
         uuid = _valid_uuid(uuid)
         payload = dict(payload)
@@ -141,9 +142,10 @@ class ObjectsManager:
         idx = self._index_or_raise(obj.class_name)
         if not idx.exists(uuid):
             raise NotFoundError(f"object {uuid} not found")
-        return idx.put_object(obj)
+        return idx.put_object(obj, cl=cl)
 
-    def merge(self, uuid: str, class_name: str, props: dict, vector=None) -> StorObj:
+    def merge(self, uuid: str, class_name: str, props: dict, vector=None,
+              cl: Optional[str] = None) -> StorObj:
         """PATCH semantics (MergeObject)."""
         uuid = _valid_uuid(uuid)
         idx = self._index_or_raise(class_name)
@@ -151,22 +153,23 @@ class ObjectsManager:
         if self.auto is not None:
             self.auto.ensure(idx.class_name, props)
         self._validate_props(cd, props)
-        out = idx.merge_object(uuid, props, vector)
+        out = idx.merge_object(uuid, props, vector, cl=cl)
         if out is None:
             raise NotFoundError(f"object {uuid} not found")
         return out
 
-    def delete(self, uuid: str, class_name: Optional[str] = None) -> None:
+    def delete(self, uuid: str, class_name: Optional[str] = None,
+               cl: Optional[str] = None) -> None:
         uuid = _valid_uuid(uuid)
         if class_name:
             idx = self._index_or_raise(class_name)
-            if not idx.delete_object(uuid):
+            if not idx.delete_object(uuid, cl=cl):
                 raise NotFoundError(f"object {uuid} not found")
             return
         obj, idx = self.db.object_by_uuid_any_class(uuid, include_vector=False)
         if obj is None:
             raise NotFoundError(f"object {uuid} not found")
-        idx.delete_object(uuid)
+        idx.delete_object(uuid, cl=cl)
 
     def list_objects(
         self,
@@ -224,7 +227,8 @@ class BatchManager:
     def __init__(self, objects_manager: ObjectsManager):
         self.om = objects_manager
 
-    def add_objects(self, payloads: Sequence[dict]) -> list[BatchResult]:
+    def add_objects(self, payloads: Sequence[dict],
+                    cl: Optional[str] = None) -> list[BatchResult]:
         results = [BatchResult(original=p) for p in payloads]
         by_class: dict[str, list[int]] = {}
         for i, p in enumerate(payloads):
@@ -240,7 +244,7 @@ class BatchManager:
                 for i in idxs:
                     results[i].err = f"class {class_name!r} not found"
                 continue
-            errs = index.put_batch([results[i].obj for i in idxs])
+            errs = index.put_batch([results[i].obj for i in idxs], cl=cl)
             for i, e in zip(idxs, errs):
                 if e is not None:
                     results[i].err = str(e)
