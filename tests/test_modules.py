@@ -1,0 +1,304 @@
+"""Module system: provider dispatch, local vectorizer, nearText end-to-end
+(GraphQL + gRPC fake sidecar), ref2vec-centroid, backup backend.
+
+Reference test model: usecases/modules tests + text2vec-contextionary
+client tests (with a fake gRPC server instead of a real sidecar).
+"""
+
+import json
+import uuid as uuidlib
+from concurrent import futures
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.modules import ModuleError, Provider, build_provider
+from weaviate_tpu.modules.text2vec_local import LocalTextVectorizer
+from weaviate_tpu.server import App, RestServer
+
+
+def make_class(vectorizer="text2vec-local"):
+    return ClassDef(
+        name="Doc",
+        properties=[
+            Property(name="title", data_type=["text"]),
+            Property(name="body", data_type=["text"]),
+            Property(name="count", data_type=["int"]),
+        ],
+        vectorizer=vectorizer,
+        vector_index_type="hnsw_tpu",
+        vector_index_config={"distance": "cosine"},
+    )
+
+
+def test_local_vectorizer_semantics():
+    v = LocalTextVectorizer()
+    vecs = v.vectorize_text([
+        "quantum computing hardware",
+        "quantum computing research",
+        "banana bread recipe",
+    ])
+    sim_close = float(vecs[0] @ vecs[1])
+    sim_far = float(vecs[0] @ vecs[2])
+    assert sim_close > sim_far + 0.2  # token overlap => closer
+    # determinism across instances
+    v2 = LocalTextVectorizer()
+    np.testing.assert_allclose(v2.vectorize_text(["quantum computing hardware"])[0], vecs[0])
+
+
+def test_provider_vectorize_object_and_query():
+    p = Provider()
+    p.register(LocalTextVectorizer())
+    cd = make_class()
+    from weaviate_tpu.entities.storobj import StorObj
+
+    obj = StorObj(class_name="Doc", uuid=str(uuidlib.uuid4()),
+                  properties={"title": "quantum computing", "body": "qubits", "count": 3})
+    vec = p.vectorize_object(cd, obj)
+    assert vec is not None and vec.shape == (256,)
+
+    qv = p.vectorize_query(cd, {"concepts": ["quantum computing qubits"]})
+    assert float(qv @ vec) > 0.3  # query near the object it describes
+
+    # moveTo pulls the query toward a concept
+    base = p.vectorize_query(cd, {"concepts": ["quantum"]})
+    moved = p.vectorize_query(cd, {"concepts": ["quantum"],
+                                   "moveTo": {"concepts": ["banana"], "force": 0.8}})
+    banana = p.vectorize_query(cd, {"concepts": ["banana"]})
+    assert float(moved @ banana) > float(base @ banana)
+
+    # moveAwayFrom pushes it away
+    away = p.vectorize_query(cd, {"concepts": ["quantum"],
+                                  "moveAwayFrom": {"concepts": ["banana"], "force": 0.8}})
+    assert float(away @ banana) < float(base @ banana)
+
+
+def test_provider_errors():
+    p = Provider()
+    cd = make_class(vectorizer="text2vec-local")
+    with pytest.raises(ModuleError):
+        p.vectorize_query(cd, {"concepts": ["x"]})  # module not enabled
+    p.register(LocalTextVectorizer())
+    with pytest.raises(ModuleError):
+        p.vectorize_query(cd, {})  # no concepts
+
+
+def test_build_provider_unknown_module():
+    c = Config()
+    c.enable_modules = ["no-such-module"]
+    with pytest.raises(ModuleError):
+        build_provider(c)
+
+
+@pytest.fixture(scope="module")
+def neartext_app(tmp_path_factory):
+    c = Config()
+    c.enable_modules = ["text2vec-local"]
+    c.default_vectorizer_module = "text2vec-local"
+    app = App(config=c, data_path=str(tmp_path_factory.mktemp("moddata")))
+    srv = RestServer(app, port=0)
+    srv.start()
+    yield app, srv
+    srv.stop()
+    app.shutdown()
+
+
+def _req(port, method, path, body=None):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data, method=method)
+    r.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None
+
+
+def test_neartext_end_to_end(neartext_app):
+    """Import WITHOUT vectors (module vectorizes at import), then nearText
+    retrieves by meaning — the full journey the reference runs against a
+    contextionary container, with zero external services."""
+    app, srv = neartext_app
+    st, _ = _req(srv.port, "POST", "/v1/schema", {
+        "class": "Doc",
+        "vectorizer": "text2vec-local",
+        "vectorIndexConfig": {"distance": "cosine"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "body", "dataType": ["text"]}],
+    })
+    assert st == 200
+    docs = [
+        ("quantum computing breakthrough", "qubits entanglement superposition"),
+        ("quantum hardware scaling", "qubit error correction"),
+        ("sourdough bread baking", "flour water salt yeast"),
+        ("marathon training plan", "running endurance intervals"),
+    ]
+    payloads = [{"class": "Doc", "id": str(uuidlib.UUID(int=i + 1)),
+                 "properties": {"title": t, "body": b}} for i, (t, b) in enumerate(docs)]
+    st, out = _req(srv.port, "POST", "/v1/batch/objects", {"objects": payloads})
+    assert st == 200 and all(o["result"]["status"] == "SUCCESS" for o in out)
+
+    # objects got vectors at import
+    st, got = _req(srv.port, "GET", f"/v1/objects/Doc/{payloads[0]['id']}?include=vector")
+    assert st == 200 and len(got["vector"]) == 256
+
+    q = '{ Get { Doc(nearText: {concepts: ["quantum qubits"]}, limit: 2) { title _additional { distance } } } }'
+    st, res = _req(srv.port, "POST", "/v1/graphql", {"query": q})
+    assert st == 200, res
+    hits = res["data"]["Get"]["Doc"]
+    assert len(hits) == 2
+    titles = {h["title"] for h in hits}
+    assert titles == {"quantum computing breakthrough", "quantum hardware scaling"}
+
+    # bread query finds bread
+    q2 = '{ Get { Doc(nearText: {concepts: ["bread flour baking"]}, limit: 1) { title } } }'
+    st, res2 = _req(srv.port, "POST", "/v1/graphql", {"query": q2})
+    assert res2["data"]["Get"]["Doc"][0]["title"] == "sourdough bread baking"
+
+    # meta reports the module
+    st, meta = _req(srv.port, "GET", "/v1/meta")
+    assert "text2vec-local" in meta["modules"]
+
+
+def test_patch_revectorizes(neartext_app):
+    """Regression: PATCHing text must recompute the module vector, or
+    nearText keeps ranking the object by its pre-edit text."""
+    app, srv = neartext_app
+    uid = str(uuidlib.UUID(int=777))
+    st, _ = _req(srv.port, "POST", "/v1/objects", {
+        "class": "Doc", "id": uid,
+        "properties": {"title": "quantum physics lecture", "body": "entanglement"},
+    })
+    assert st == 200
+    st, before = _req(srv.port, "GET", f"/v1/objects/Doc/{uid}?include=vector")
+    st, _ = _req(srv.port, "PATCH", f"/v1/objects/Doc/{uid}", {
+        "class": "Doc", "properties": {"title": "chocolate cake dessert",
+                                       "body": "sugar butter cocoa"}})
+    st, after = _req(srv.port, "GET", f"/v1/objects/Doc/{uid}?include=vector")
+    assert st == 200
+    assert not np.allclose(before["vector"], after["vector"])
+    # the edited object now answers dessert queries, not quantum ones
+    q = '{ Get { Doc(nearText: {concepts: ["chocolate dessert"]}, limit: 1) { _additional { id } } } }'
+    st, res = _req(srv.port, "POST", "/v1/graphql", {"query": q})
+    assert res["data"]["Get"]["Doc"][0]["_additional"]["id"] == uid
+    _req(srv.port, "DELETE", f"/v1/objects/Doc/{uid}")
+
+
+def test_disabled_vectorizer_rejected_at_class_creation(neartext_app):
+    app, srv = neartext_app
+    st, body = _req(srv.port, "POST", "/v1/schema", {
+        "class": "Bad", "vectorizer": "text2vec-typo",
+        "properties": [{"name": "t", "dataType": ["text"]}],
+    })
+    assert st == 422
+    assert "not an enabled module" in json.dumps(body)
+
+
+def test_contextionary_grpc_client(tmp_path):
+    """Drive the gRPC sidecar client against an in-process fake vectorizer
+    service (the contextionary dial pattern, client/contextionary.go:41)."""
+    import grpc
+
+    from weaviate_tpu.modules import contextionary_pb2 as pb
+    from weaviate_tpu.modules.text2vec_contextionary import (
+        _SERVICE,
+        ContextionaryVectorizer,
+    )
+
+    local = LocalTextVectorizer(dim=64)
+
+    def vectorize(request, context):
+        vecs = local.vectorize_text(list(request.texts))
+        return pb.VectorizeReply(
+            vectors=[pb.Vector(values=v.tolist()) for v in vecs]
+        )
+
+    def meta(request, context):
+        return pb.MetaReply(version="fake-1.0", word_count=1000, dimensions=64)
+
+    handlers = {
+        "Vectorize": grpc.unary_unary_rpc_method_handler(
+            vectorize,
+            request_deserializer=pb.VectorizeRequest.FromString,
+            response_serializer=pb.VectorizeReply.SerializeToString,
+        ),
+        "Meta": grpc.unary_unary_rpc_method_handler(
+            meta,
+            request_deserializer=pb.MetaRequest.FromString,
+            response_serializer=pb.MetaReply.SerializeToString,
+        ),
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_SERVICE.strip("/"), handlers),)
+    )
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        mod = ContextionaryVectorizer(url=f"127.0.0.1:{port}")
+        vecs = mod.vectorize_text(["quantum computing", "bread"])
+        assert vecs.shape == (2, 64)
+        want = local.vectorize_text(["quantum computing"])[0]
+        np.testing.assert_allclose(vecs[0], want, rtol=1e-6)
+        assert mod.meta()["version"] == "fake-1.0"
+        cd = make_class(vectorizer="text2vec-contextionary")
+        from weaviate_tpu.entities.storobj import StorObj
+
+        obj = StorObj(class_name="Doc", uuid=str(uuidlib.uuid4()),
+                      properties={"title": "hello world"})
+        assert mod.vectorize_object(cd, obj, {}).shape == (64,)
+        mod.shutdown()
+    finally:
+        server.stop(0)
+
+
+def test_ref2vec_centroid(tmp_path):
+    from weaviate_tpu.db import DB
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+    from weaviate_tpu.modules.ref2vec_centroid import Ref2VecCentroid
+
+    db = DB(str(tmp_path / "data"))
+    target_cls = ClassDef(name="Item", properties=[Property(name="t", data_type=["text"])],
+                          vector_index_type="hnsw_tpu")
+    idx = db.add_class(target_cls, parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"}))
+    u1, u2 = str(uuidlib.UUID(int=1)), str(uuidlib.UUID(int=2))
+    idx.put_object(StorObj(class_name="Item", uuid=u1, properties={"t": "a"},
+                           vector=np.array([1, 0, 0, 0], np.float32)))
+    idx.put_object(StorObj(class_name="Item", uuid=u2, properties={"t": "b"},
+                           vector=np.array([0, 1, 0, 0], np.float32)))
+
+    mod = Ref2VecCentroid()
+    mod.set_db(db)
+    owner_cls = ClassDef(
+        name="Owner",
+        properties=[Property(name="items", data_type=["Item"])],
+        vectorizer="ref2vec-centroid",
+    )
+    owner = StorObj(class_name="Owner", uuid=str(uuidlib.uuid4()), properties={
+        "items": [{"beacon": f"weaviate://localhost/Item/{u1}"},
+                  {"beacon": f"weaviate://localhost/Item/{u2}"}],
+    })
+    vec = mod.vectorize_object(owner_cls, owner, {})
+    np.testing.assert_allclose(vec, [0.5, 0.5, 0, 0])
+    db.shutdown()
+
+
+def test_backup_fs_backend(tmp_path):
+    from weaviate_tpu.modules.backup_fs import FilesystemBackupBackend
+
+    be = FilesystemBackupBackend(str(tmp_path / "backups"))
+    be.put_object("b1", "node-0/Doc/shard-0/vector.log", b"\x01\x02")
+    assert be.get_object("b1", "node-0/Doc/shard-0/vector.log") == b"\x01\x02"
+    be.write_meta("b1", {"status": "SUCCESS"})
+    assert be.read_meta("b1")["status"] == "SUCCESS"
+    assert be.read_meta("nope") is None
+    with pytest.raises(ValueError):
+        be.put_object("b1", "../escape", b"x")

@@ -167,6 +167,7 @@ class Config:
     reindex_vector_dimensions_at_startup: bool = False
     grpc_port: int = 50051
     contextionary_url: str = ""
+    backup_filesystem_path: str = ""
 
     # TPU extensions
     device_mesh_shards: int = 0  # 0 = one shard per local device
@@ -252,6 +253,7 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         e, "REINDEX_VECTOR_DIMENSIONS_AT_STARTUP")
     cfg.grpc_port = _int(e, "GRPC_PORT", 50051)
     cfg.contextionary_url = e.get("CONTEXTIONARY_URL", "")
+    cfg.backup_filesystem_path = e.get("BACKUP_FILESYSTEM_PATH", "")
 
     cfg.device_mesh_shards = _int(e, "TPU_DEVICE_MESH_SHARDS", 0)
     cfg.store_dtype = e.get("TPU_STORE_DTYPE", "float32")

@@ -122,7 +122,7 @@ class ClassDef:
     name: str
     description: str = ""
     properties: list[Property] = field(default_factory=list)
-    vectorizer: str = "none"
+    vectorizer: str = ""  # empty = unset -> DEFAULT_VECTORIZER_MODULE applies
     vector_index_type: str = "hnsw_tpu"
     vector_index_config: dict = field(default_factory=dict)
     inverted_index_config: dict = field(default_factory=dict)
@@ -157,7 +157,9 @@ class ClassDef:
             name=d.get("class") or d["name"],
             description=d.get("description", ""),
             properties=[Property.from_dict(p) for p in d.get("properties") or []],
-            vectorizer=d.get("vectorizer", "none"),
+            # empty = "not specified": the schema manager substitutes
+            # DEFAULT_VECTORIZER_MODULE; an explicit "none" stays none
+            vectorizer=d.get("vectorizer", ""),
             vector_index_type=d.get("vectorIndexType", "hnsw_tpu"),
             vector_index_config=d.get("vectorIndexConfig") or {},
             inverted_index_config=d.get("invertedIndexConfig") or {},

@@ -1,0 +1,89 @@
+"""Module capability interfaces.
+
+Reference: entities/modulecapabilities/module.go:34 (Module),
+vectorizer.go (Vectorizer), graphql.go (GraphQLArguments), additional.go
+(AdditionalProperties), backup.go (BackupBackend). A module declares a name
++ type and implements any subset of the capability mixins; the Provider
+(provider.py) dispatches on isinstance checks, the Python idiom for the
+reference's interface assertions.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class Module(abc.ABC):
+    """modulecapabilities.Module: identity + lifecycle."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @property
+    def module_type(self) -> str:
+        return "text2vec"
+
+    def init(self, config) -> None:
+        """Called once at registration (InitParams analog)."""
+
+    def meta(self) -> dict:
+        return {}
+
+    def shutdown(self) -> None:
+        pass
+
+
+class Vectorizer(abc.ABC):
+    """Vectorize-at-import + query-time near-args resolution
+    (modulecapabilities/vectorizer.go)."""
+
+    @abc.abstractmethod
+    def vectorize_object(self, class_def, obj, module_cfg: dict) -> Optional[np.ndarray]:
+        """Embed one object's text corpus; None = nothing to vectorize."""
+
+    @abc.abstractmethod
+    def vectorize_text(self, texts: Sequence[str]) -> np.ndarray:
+        """Embed raw query texts -> [len(texts), D] float32."""
+
+
+class GraphQLArguments(abc.ABC):
+    """near-args the module contributes to Get/Explore
+    (modulecapabilities/graphql.go)."""
+
+    def arguments(self) -> list[str]:
+        return []
+
+
+class AdditionalProperties(abc.ABC):
+    """_additional props the module can resolve
+    (modulecapabilities/additional.go)."""
+
+    def additional_properties(self) -> list[str]:
+        return []
+
+    def resolve_additional(self, prop: str, results, params: dict):
+        return None
+
+
+class BackupBackend(abc.ABC):
+    """Backup storage backend (modulecapabilities/backup.go):
+    write/read backup artifacts under (backup_id, node, path) keys."""
+
+    @abc.abstractmethod
+    def put_object(self, backup_id: str, key: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get_object(self, backup_id: str, key: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def write_meta(self, backup_id: str, meta: dict) -> None: ...
+
+    @abc.abstractmethod
+    def read_meta(self, backup_id: str) -> Optional[dict]: ...
+
+    def home_id(self, backup_id: str) -> str:
+        return backup_id

@@ -1,0 +1,204 @@
+"""Modules provider: registry + dispatch.
+
+Reference: usecases/modules/modules.go (Provider) + vectorizer.go — the one
+object the use-case layer talks to: vectorize on import, resolve near-args
+(nearText with moveTo/moveAwayFrom vector steering), validate per-class
+module config, aggregate module meta, and hand backup backends to the
+backup scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.modules.interface import (
+    BackupBackend,
+    Module,
+    Vectorizer,
+)
+
+
+class ModuleError(ValueError):
+    pass
+
+
+def corpus_from_object(class_def, obj, module_cfg: dict, module_name: str = "") -> str:
+    """Build the text corpus the vectorizer embeds
+    (text2vec-contextionary vectorizer semantics: optional class name +
+    non-skipped text property values, lowercased). Per-property module
+    config may be nested under the module name ({"text2vec-x": {"skip":
+    true}}) or flat ({"skip": true}); only the ACTIVE module's entry
+    applies."""
+    parts: list[str] = []
+    if module_cfg.get("vectorizeClassName", True):
+        parts.append(class_def.name)
+    for prop in class_def.properties:
+        pcfg = (prop.module_config or {}) if hasattr(prop, "module_config") else {}
+        if module_name and module_name in pcfg:
+            flat = pcfg[module_name] or {}
+        elif pcfg and not any(isinstance(v, dict) for v in pcfg.values()):
+            flat = pcfg  # flat form, no module nesting
+        else:
+            flat = {}
+        if flat.get("skip"):
+            continue
+        dt = prop.data_type[0] if prop.data_type else ""
+        if dt not in ("text", "string", "text[]", "string[]"):
+            continue
+        val = obj.properties.get(prop.name)
+        if val is None:
+            continue
+        if isinstance(val, list):
+            parts.extend(str(v) for v in val)
+        else:
+            parts.append(str(val))
+    return " ".join(parts).lower()
+
+
+class Provider:
+    """usecases/modules/modules.go Provider analog."""
+
+    def __init__(self):
+        self._modules: dict[str, Module] = {}
+
+    def register(self, module: Module) -> None:
+        self._modules[module.name] = module
+
+    def get(self, name: str) -> Optional[Module]:
+        return self._modules.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._modules)
+
+    def meta(self) -> dict:
+        return {name: m.meta() for name, m in self._modules.items()}
+
+    # -- vectorizer dispatch -------------------------------------------------
+
+    def _vectorizer_for(self, class_def) -> Optional[Vectorizer]:
+        name = getattr(class_def, "vectorizer", "none") or "none"
+        if name == "none":
+            return None
+        mod = self._modules.get(name)
+        if mod is None:
+            raise ModuleError(
+                f"class {class_def.name!r} uses vectorizer {name!r} which is "
+                f"not enabled (enabled: {self.names()})"
+            )
+        if not isinstance(mod, Vectorizer):
+            raise ModuleError(f"module {name!r} is not a vectorizer")
+        return mod
+
+    def _class_module_cfg(self, class_def, name: str) -> dict:
+        cfg = getattr(class_def, "module_config", None) or {}
+        return cfg.get(name) or {}
+
+    def vectorize_object(self, class_def, obj) -> Optional[np.ndarray]:
+        """Vectorize-at-import (modules/vectorizer.go UpdateVector path)."""
+        vec = self._vectorizer_for(class_def)
+        if vec is None:
+            return None
+        mod_cfg = self._class_module_cfg(class_def, class_def.vectorizer)
+        return vec.vectorize_object(class_def, obj, mod_cfg)
+
+    def vectorize_query(self, class_def, near_text: dict) -> Optional[np.ndarray]:
+        """nearText -> query vector with moveTo/moveAwayFrom steering
+        (traverser near_params_vector.go + text2vec concepts math: move the
+        query point toward/away from the concepts' centroid by `force`)."""
+        vec = self._vectorizer_for(class_def)
+        if vec is None:
+            raise ModuleError(
+                f"class {class_def.name!r} has no vectorizer; nearText needs one"
+            )
+        concepts = near_text.get("concepts") or []
+        if isinstance(concepts, str):
+            concepts = [concepts]
+        if not concepts:
+            raise ModuleError("nearText requires at least one concept")
+        base = vec.vectorize_text([" ".join(str(c) for c in concepts)])[0]
+        base_norm = float(np.linalg.norm(base))
+
+        def centroid(spec) -> Optional[np.ndarray]:
+            if not spec:
+                return None
+            texts = spec.get("concepts") or []
+            if isinstance(texts, str):
+                texts = [texts]
+            if not texts:
+                return None
+            return vec.vectorize_text([" ".join(map(str, texts))])[0]
+
+        move_to = near_text.get("moveTo") or {}
+        move_away = near_text.get("moveAwayFrom") or {}
+        to_c = centroid(move_to)
+        if to_c is not None:
+            f = float(move_to.get("force", 0.0))
+            base = base * (1.0 - f) + to_c * f
+        away_c = centroid(move_away)
+        if away_c is not None:
+            f = float(move_away.get("force", 0.0))
+            base = base + f * (base - away_c)
+        if to_c is not None or away_c is not None:
+            # steering changed the magnitude: restore the embedder's own
+            # scale so query and stored-vector geometry stay consistent
+            # (an embedder that emits unnormalized vectors keeps them so)
+            n = np.linalg.norm(base)
+            if n > 0 and base_norm > 0:
+                base = base * (base_norm / n)
+        return base.astype(np.float32)
+
+    def vectorize_texts(self, class_def, texts: Sequence[str]) -> np.ndarray:
+        vec = self._vectorizer_for(class_def)
+        if vec is None:
+            raise ModuleError(f"class {class_def.name!r} has no vectorizer")
+        return vec.vectorize_text(list(texts))
+
+    # -- backup backends -----------------------------------------------------
+
+    def backup_backend(self, name: str) -> Optional[BackupBackend]:
+        mod = self._modules.get(name) or self._modules.get(f"backup-{name}")
+        if mod is not None and isinstance(mod, BackupBackend):
+            return mod
+        return None
+
+    def shutdown(self) -> None:
+        for m in self._modules.values():
+            m.shutdown()
+
+
+def build_provider(config) -> Optional[Provider]:
+    """registerModules (configure_api.go:471): instantiate the modules named
+    in ENABLE_MODULES. Unknown names raise — a typo'd module must not
+    silently no-op."""
+    enabled = list(getattr(config, "enable_modules", []) or [])
+    if not enabled:
+        return None
+    p = Provider()
+    for name in enabled:
+        name = name.strip()
+        if not name:
+            continue
+        if name in ("text2vec-local", "text2vec-hash"):
+            from weaviate_tpu.modules.text2vec_local import LocalTextVectorizer
+
+            p.register(LocalTextVectorizer(name=name))
+        elif name == "text2vec-contextionary":
+            from weaviate_tpu.modules.text2vec_contextionary import (
+                ContextionaryVectorizer,
+            )
+
+            p.register(ContextionaryVectorizer(url=getattr(config, "contextionary_url", "")))
+        elif name == "ref2vec-centroid":
+            from weaviate_tpu.modules.ref2vec_centroid import Ref2VecCentroid
+
+            p.register(Ref2VecCentroid())
+        elif name == "backup-filesystem":
+            from weaviate_tpu.modules.backup_fs import FilesystemBackupBackend
+
+            p.register(FilesystemBackupBackend(
+                getattr(config, "backup_filesystem_path", "") or "./backups"))
+        else:
+            raise ModuleError(f"unknown module {name!r} in ENABLE_MODULES")
+    return p

@@ -1,0 +1,87 @@
+"""Local hash-embedding text vectorizer ("text2vec-local").
+
+The in-process counterpart of the reference's vectorizer sidecars: where
+text2vec-contextionary dials a gRPC service
+(modules/text2vec-contextionary/client/contextionary.go:41), this module
+embeds entirely locally so vectorize-at-import and nearText work with zero
+external services (tests, air-gapped deployments, CI).
+
+Embedding model: deterministic token hashing — each token maps to a fixed
+pseudo-random gaussian direction (seeded by the token's digest), a text is
+the L2-normalized sum of its token directions weighted by log(1+tf). Texts
+sharing tokens land close in cosine space, which is exactly the contract
+nearText needs (query concepts match objects containing those words);
+unrelated texts are near-orthogonal in high dimensions. No external model,
+fully reproducible across processes and platforms.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.modules.interface import GraphQLArguments, Module, Vectorizer
+from weaviate_tpu.modules.provider import corpus_from_object
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments):
+    def __init__(self, name: str = "text2vec-local", dim: int = 256):
+        self._name = name
+        self.dim = dim
+        self._cache: dict[str, np.ndarray] = {}
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def module_type(self) -> str:
+        return "text2vec"
+
+    def meta(self) -> dict:
+        return {"type": "text2vec", "model": "hash-embedding", "dimensions": self.dim}
+
+    def arguments(self) -> list[str]:
+        return ["nearText"]
+
+    # -- embedding -----------------------------------------------------------
+
+    def _token_vec(self, token: str) -> np.ndarray:
+        v = self._cache.get(token)
+        if v is None:
+            seed = int.from_bytes(
+                hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest(), "little"
+            )
+            v = np.random.default_rng(seed).standard_normal(self.dim).astype(np.float32)
+            if len(self._cache) < 200_000:  # bound the token cache
+                self._cache[token] = v
+        return v
+
+    def _embed(self, text: str) -> np.ndarray:
+        tokens = _TOKEN_RE.findall(text.lower())
+        if not tokens:
+            return np.zeros(self.dim, dtype=np.float32)
+        counts: dict[str, int] = {}
+        for t in tokens:
+            counts[t] = counts.get(t, 0) + 1
+        acc = np.zeros(self.dim, dtype=np.float32)
+        for t, c in counts.items():
+            acc += np.log1p(c) * self._token_vec(t)
+        n = np.linalg.norm(acc)
+        return acc / n if n > 0 else acc
+
+    # -- Vectorizer ----------------------------------------------------------
+
+    def vectorize_object(self, class_def, obj, module_cfg: dict) -> Optional[np.ndarray]:
+        corpus = corpus_from_object(class_def, obj, module_cfg, self._name)
+        if not corpus.strip():
+            return None
+        return self._embed(corpus)
+
+    def vectorize_text(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self._embed(t) for t in texts])

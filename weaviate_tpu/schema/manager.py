@@ -59,6 +59,8 @@ class SchemaManager:
         self.tx = tx  # cluster.TxManager or None (single node)
         self.scaler = None  # usecases/scaler hook, set by cluster wiring
         self.default_vectorizer = default_vectorizer
+        # set by App: name -> bool, is this vectorizer an enabled module?
+        self.vectorizer_validator = None
         self.schema = Schema()
         self.sharding_states: dict[str, ShardingState] = {}
         self._callbacks: list[Callable[[Schema], None]] = []
@@ -146,6 +148,16 @@ class SchemaManager:
                 raise SchemaValidationError(f"class {name!r} already exists")
             if not class_def.vectorizer:
                 class_def.vectorizer = self.default_vectorizer
+            if (
+                class_def.vectorizer
+                and class_def.vectorizer != "none"
+                and self.vectorizer_validator is not None
+                and not self.vectorizer_validator(class_def.vectorizer)
+            ):
+                raise SchemaValidationError(
+                    f"vectorizer {class_def.vectorizer!r} is not an enabled "
+                    "module (check ENABLE_MODULES)"
+                )
             for p in class_def.properties:
                 self._validate_property(class_def, p, check_dup=False)
             seen = set()

@@ -63,6 +63,7 @@ class App:
                 bind_host="0.0.0.0",  # peers dial in from other machines
                 bind_port=cl_cfg.data_bind_port,
                 metrics=self.metrics,
+                default_vectorizer=self.config.default_vectorizer_module,
             )
             self.cluster_node.start()
             self.cluster_node.join(peers)
@@ -71,8 +72,26 @@ class App:
         else:
             self.cluster_node = None
             self.db = DB(path, metrics=self.metrics)
-            self.schema = SchemaManager(os.path.join(path, "schema.json"), migrator=self.db)
+            self.schema = SchemaManager(
+                os.path.join(path, "schema.json"), migrator=self.db,
+                default_vectorizer=self.config.default_vectorizer_module)
+        # modules: explicit injection wins; else built from ENABLE_MODULES
+        # (registerModules, configure_api.go:471)
+        if modules is None:
+            from weaviate_tpu.modules import build_provider
+
+            modules = build_provider(self.config)
+        if modules is not None:
+            ref2vec = modules.get("ref2vec-centroid")
+            if ref2vec is not None:
+                ref2vec.set_db(self.db)
         self.modules = modules
+        # class creation must fail fast on a vectorizer that is not an
+        # enabled module (instead of importing vectorless objects)
+        enabled = set(modules.names()) if modules is not None else set()
+        self.schema.vectorizer_validator = (
+            lambda name: name in enabled
+        )
         self.auto_schema = (
             AutoSchema(
                 self.schema,

@@ -144,6 +144,20 @@ class ObjectsManager:
             raise NotFoundError(f"object {uuid} not found")
         return idx.put_object(obj, cl=cl)
 
+    def _revectorize(self, idx, cd, uuid: str, new_props: dict) -> Optional[np.ndarray]:
+        """Recompute the module vector for an object whose properties are
+        about to change (PATCH / reference mutation): without this, nearText
+        keeps ranking the object by its pre-edit text."""
+        if self.modules is None or not cd.vectorizer or cd.vectorizer == "none":
+            return None
+        cur = idx.object_by_uuid(uuid, include_vector=False)
+        if cur is None:
+            return None
+        merged = dict(cur.properties)
+        merged.update(new_props)
+        preview = StorObj(class_name=cd.name, uuid=uuid, properties=merged)
+        return self.modules.vectorize_object(cd, preview)
+
     def merge(self, uuid: str, class_name: str, props: dict, vector=None,
               cl: Optional[str] = None) -> StorObj:
         """PATCH semantics (MergeObject)."""
@@ -153,6 +167,8 @@ class ObjectsManager:
         if self.auto is not None:
             self.auto.ensure(idx.class_name, props)
         self._validate_props(cd, props)
+        if vector is None:
+            vector = self._revectorize(idx, cd, uuid, props)
         out = idx.merge_object(uuid, props, vector, cl=cl)
         if out is None:
             raise NotFoundError(f"object {uuid} not found")
@@ -197,6 +213,13 @@ class ObjectsManager:
 
     # -- references ----------------------------------------------------------
 
+    def _merge_with_revector(self, idx, uuid: str, props: dict) -> None:
+        """Reference mutations go through merge + re-vectorization so a
+        ref2vec-centroid class keeps its vector in sync with its refs."""
+        cd = self.schema.get_class(idx.class_name)
+        vec = self._revectorize(idx, cd, uuid, props)
+        idx.merge_object(uuid, props, vec)
+
     def add_reference(self, uuid: str, class_name: str, prop: str, beacon: str) -> None:
         idx = self._index_or_raise(class_name)
         obj = idx.object_by_uuid(_valid_uuid(uuid), include_vector=False)
@@ -204,13 +227,14 @@ class ObjectsManager:
             raise NotFoundError(f"object {uuid} not found")
         refs = obj.properties.get(prop) or []
         refs.append({"beacon": beacon})
-        idx.merge_object(obj.uuid, {prop: refs})
+        self._merge_with_revector(idx, obj.uuid, {prop: refs})
 
     def put_references(self, uuid: str, class_name: str, prop: str, beacons: list[str]) -> None:
         idx = self._index_or_raise(class_name)
-        if not idx.exists(_valid_uuid(uuid)):
+        uuid = _valid_uuid(uuid)
+        if not idx.exists(uuid):
             raise NotFoundError(f"object {uuid} not found")
-        idx.merge_object(uuid, {prop: [{"beacon": b} for b in beacons]})
+        self._merge_with_revector(idx, uuid, {prop: [{"beacon": b} for b in beacons]})
 
     def delete_reference(self, uuid: str, class_name: str, prop: str, beacon: str) -> None:
         idx = self._index_or_raise(class_name)
@@ -218,7 +242,7 @@ class ObjectsManager:
         if obj is None:
             raise NotFoundError(f"object {uuid} not found")
         refs = [r for r in (obj.properties.get(prop) or []) if r.get("beacon") != beacon]
-        idx.merge_object(obj.uuid, {prop: refs})
+        self._merge_with_revector(idx, obj.uuid, {prop: refs})
 
 
 class BatchManager:
