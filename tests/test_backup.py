@@ -1,0 +1,194 @@
+"""Backup subsystem: create -> destroy -> restore -> query journeys,
+single-node over REST and multi-node through the cluster harness.
+
+Reference test model: usecases/backup tests + backup journey acceptance
+tests (create/status/restore endpoints over a filesystem backend).
+"""
+
+import json
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.modules import Provider
+from weaviate_tpu.modules.backup_fs import FilesystemBackupBackend
+from weaviate_tpu.server import App, RestServer
+from weaviate_tpu.usecases.backup import BackupError, BackupScheduler
+
+
+def _req(port, method, path, body=None):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data, method=method)
+    r.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None
+
+
+@pytest.fixture
+def backed_app(tmp_path):
+    c = Config()
+    c.enable_modules = ["backup-filesystem"]
+    c.backup_filesystem_path = str(tmp_path / "backups")
+    app = App(config=c, data_path=str(tmp_path / "data"))
+    srv = RestServer(app, port=0)
+    srv.start()
+    yield app, srv
+    srv.stop()
+    app.shutdown()
+
+
+def _import_docs(port, n=20, cls="Doc"):
+    _req(port, "POST", "/v1/schema", {
+        "class": cls,
+        "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "n", "dataType": ["int"]}],
+    })
+    objs = [{"class": cls, "id": str(uuidlib.UUID(int=i + 1)),
+             "properties": {"title": f"doc {i}", "n": i},
+             "vector": np.random.default_rng(i).standard_normal(8).tolist()}
+            for i in range(n)]
+    st, out = _req(port, "POST", "/v1/batch/objects", {"objects": objs})
+    assert st == 200 and all(o["result"]["status"] == "SUCCESS" for o in out)
+    return objs
+
+
+def test_backup_restore_journey_rest(backed_app):
+    """The full journey over REST: import -> backup -> drop class ->
+    restore -> data and vector search are back."""
+    app, srv = backed_app
+    objs = _import_docs(srv.port)
+
+    st, out = _req(srv.port, "POST", "/v1/backups/filesystem", {"id": "snap1"})
+    assert st == 200 and out["status"] in ("STARTED", "TRANSFERRING", "SUCCESS")
+    final = app.backup_scheduler.wait("snap1")
+    assert final["status"] == "SUCCESS"
+    st, out = _req(srv.port, "GET", "/v1/backups/filesystem/snap1")
+    assert st == 200 and out["status"] == "SUCCESS"
+
+    # destroy the data
+    st, _ = _req(srv.port, "DELETE", "/v1/schema/Doc")
+    assert st == 200
+    st, _ = _req(srv.port, "GET", f"/v1/objects/Doc/{objs[3]['id']}")
+    assert st in (404, 422)
+
+    # restore
+    st, out = _req(srv.port, "POST", "/v1/backups/filesystem/snap1/restore", {})
+    assert st == 200
+    final = app.backup_scheduler.wait("snap1", restore=True)
+    assert final["status"] == "SUCCESS", final
+    st, out = _req(srv.port, "GET", "/v1/backups/filesystem/snap1/restore")
+    assert st == 200 and out["status"] == "SUCCESS"
+
+    # data is back, including vectors (search works)
+    st, got = _req(srv.port, "GET", f"/v1/objects/Doc/{objs[3]['id']}")
+    assert st == 200 and got["properties"]["n"] == 3
+    q = json.dumps(objs[7]["vector"])
+    st, res = _req(srv.port, "POST", "/v1/graphql", {"query":
+        '{ Get { Doc(nearVector: {vector: %s}, limit: 1) { n _additional { id } } } }' % q})
+    assert res["data"]["Get"]["Doc"][0]["_additional"]["id"] == objs[7]["id"]
+
+
+def test_backup_errors(backed_app):
+    app, srv = backed_app
+    _import_docs(srv.port)
+    # unknown backend
+    st, out = _req(srv.port, "POST", "/v1/backups/s3", {"id": "x"})
+    assert st == 422
+    # duplicate id
+    _req(srv.port, "POST", "/v1/backups/filesystem", {"id": "dup"})
+    app.backup_scheduler.wait("dup")
+    st, out = _req(srv.port, "POST", "/v1/backups/filesystem", {"id": "dup"})
+    assert st == 422
+    # restore while class exists
+    st, out = _req(srv.port, "POST", "/v1/backups/filesystem/dup/restore", {})
+    assert st == 422 and "already exists" in json.dumps(out)
+    # unknown include class
+    st, out = _req(srv.port, "POST", "/v1/backups/filesystem",
+                   {"id": "y", "include": ["Nope"]})
+    assert st == 422
+    # unknown backup id status
+    st, out = _req(srv.port, "GET", "/v1/backups/filesystem/ghost")
+    assert st == 422
+
+
+def test_backup_include_exclude(tmp_path):
+    provider = Provider()
+    provider.register(FilesystemBackupBackend(str(tmp_path / "b")))
+    app = App(config=Config(), data_path=str(tmp_path / "d"), modules=provider)
+    try:
+        for cls in ("A", "B"):
+            app.schema.add_class({
+                "class": cls, "vectorIndexType": "hnsw_tpu",
+                "properties": [{"name": "t", "dataType": ["text"]}]})
+        sched = app.backup_scheduler
+        sched.backup("filesystem", {"id": "only-a", "include": ["A"]})
+        meta = sched.wait("only-a")
+        assert meta["classes"] == ["A"]
+        sched.backup("filesystem", {"id": "not-a", "exclude": ["A"]})
+        assert sched.wait("not-a")["classes"] == ["B"]
+        with pytest.raises(BackupError):
+            sched.backup("filesystem", {"id": "z", "include": ["A"], "exclude": ["B"]})
+    finally:
+        app.shutdown()
+
+
+def test_multinode_backup_restore(tmp_path):
+    """Distributed journey: 2 nodes, shards on both; the coordinator backs
+    up every node's shards; restore brings data back on both nodes."""
+    from tests.test_cluster import make_class, make_cluster, new_obj, teardown_cluster
+
+    nodes = make_cluster(tmp_path, 2)
+    try:
+        shared_root = str(tmp_path / "shared-backups")
+        for n in nodes:
+            p = Provider()
+            p.register(FilesystemBackupBackend(shared_root))
+            sched = BackupScheduler(
+                n.db, n.schema, p, node_name=n.node_name,
+                cluster=n.cluster, node_client=n.node_client,
+            )
+            n.api.backup = sched
+
+        n0, n1 = nodes
+        n0.schema.add_class(make_class(shards=2, replicas=1))
+        idx0 = n0.db.get_index("Dist")
+        objs = [new_obj(i) for i in range(30)]
+        assert all(e is None for e in idx0.put_batch(objs))
+        per_node_before = [
+            sum(s.object_count() for s in n.db.get_index("Dist").shards.values())
+            for n in nodes
+        ]
+        assert sum(per_node_before) == 30 and all(c > 0 for c in per_node_before)
+
+        sched0 = n0.api.backup
+        sched0.backup("filesystem", {"id": "dist1"})
+        assert sched0.wait("dist1")["status"] == "SUCCESS"
+
+        n0.schema.delete_class("Dist")
+        for n in nodes:
+            assert n.db.get_index("Dist") is None
+
+        sched0.restore("filesystem", "dist1", {})
+        assert sched0.wait("dist1", restore=True)["status"] == "SUCCESS"
+
+        for n, want in zip(nodes, per_node_before):
+            idx = n.db.get_index("Dist")
+            assert idx is not None
+            got = sum(s.object_count() for s in idx.shards.values())
+            assert got == want
+        res = n1.db.get_index("Dist").object_vector_search(objs[5].vector, k=3)
+        assert res[0][0].obj.uuid == objs[5].uuid
+    finally:
+        teardown_cluster(nodes)

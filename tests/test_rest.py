@@ -215,9 +215,12 @@ def test_unknown_route_and_method(port):
     assert st == 405
 
 
-def test_backup_not_configured(port):
-    st, _ = _req(port, "POST", "/v1/backups/filesystem", {"id": "b1"})
-    assert st == 501
+def test_backup_backend_not_enabled(port):
+    # backup subsystem exists, but the backend module isn't enabled:
+    # a clear 422, not a 501 stub
+    st, body = _req(port, "POST", "/v1/backups/filesystem", {"id": "b1"})
+    assert st == 422
+    assert "backend module" in json.dumps(body)
 
 
 def test_apikey_auth(tmp_path):

@@ -50,6 +50,7 @@ _RE_SHARD_FILE = re.compile(r"^/indices/([^/]+)/shards/([^/:]+)/files/(.+)$")
 _RE_REPL_OP = re.compile(r"^/replicas/indices/([^/]+)/shards/([^/:]+)/objects(:[a-z]+)?$")
 _RE_REPL_OBJ = re.compile(r"^/replicas/indices/([^/]+)/shards/([^/:]+)/objects/([0-9a-fA-F-]+):digest$")
 _RE_TX = re.compile(r"^/schema/transactions/([^/]+)/(open|commit|abort)$")
+_RE_BACKUP = re.compile(r"^/backups/([^/]+)/([^/:]+):(shards|restore-shards)$")
 
 
 class _StagedTx:
@@ -74,6 +75,7 @@ class ClusterApi:
         self.tx = tx_participant
         self.cluster = cluster_state
         self.node_name = node_name
+        self.backup = None  # BackupScheduler, set by node wiring
         self._staged: dict[str, _StagedTx] = {}
         self._staged_lock = threading.Lock()
 
@@ -280,6 +282,19 @@ class _Handler(BaseHTTPRequestHandler):
                     api.tx.abort(tx_id)
             except Exception as e:  # validation failures => reject the tx
                 return self._json(409, {"error": str(e)})
+            return self._json(200, {"status": "ok"})
+
+        m = _RE_BACKUP.match(path)
+        if m and method == "POST":
+            if api.backup is None:
+                return self._json(501, {"error": "backup not configured on this node"})
+            backend, bid, action = m.groups()
+            body = self._body_json()
+            classes = body.get("classes") or []
+            if action == "shards":
+                files = api.backup.backup_local(backend, bid, classes)
+                return self._json(200, {"files": files})
+            api.backup.restore_local(backend, bid, classes)
             return self._json(200, {"status": "ok"})
 
         m = _RE_REPL_OBJ.match(path)

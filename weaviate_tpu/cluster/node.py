@@ -81,7 +81,9 @@ class ClusterNode:
             self.advertise = f"{host}:{self.server.port}"
         else:
             self.advertise = self.server.address
-        self.node_client = NodeClient()
+        # shard-file transfer (scaler, backup) moves whole shards in one
+        # call: give it a transfer-sized timeout, not an RPC-sized one
+        self.node_client = NodeClient(timeout=600.0)
         self.replica_coord = ReplicaCoordinator(
             node_name,
             self.cluster,

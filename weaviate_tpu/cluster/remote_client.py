@@ -249,6 +249,21 @@ class NodeClient:
         if status != 200:
             raise RemoteError(status, raw.decode("utf-8", "replace"))
 
+    def backup_shards(self, host: str, backend: str, backup_id: str,
+                      classes: list) -> dict:
+        data = self.http.json(
+            host, "POST", f"/backups/{backend}/{backup_id}:shards",
+            {"classes": classes},
+        )
+        return data.get("files", {})
+
+    def restore_shards(self, host: str, backend: str, backup_id: str,
+                       classes: list) -> None:
+        self.http.json(
+            host, "POST", f"/backups/{backend}/{backup_id}:restore-shards",
+            {"classes": classes},
+        )
+
     def create_shard(self, host: str, class_name: str, shard: str) -> None:
         self.http.json(host, "POST", f"/indices/{class_name}/shards/{shard}:create")
 

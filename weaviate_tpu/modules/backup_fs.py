@@ -66,5 +66,24 @@ class FilesystemBackupBackend(Module, BackupBackend):
         except FileNotFoundError:
             return None
 
+    def put_file(self, backup_id: str, key: str, src_path: str) -> None:
+        import shutil
+
+        full = self._path(backup_id, key)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        tmp = full + ".tmp"
+        with open(src_path, "rb") as src, open(tmp, "wb") as dst:
+            shutil.copyfileobj(src, dst, length=1 << 20)
+            dst.flush()
+            os.fsync(dst.fileno())
+        os.replace(tmp, full)
+
+    def fetch_to_file(self, backup_id: str, key: str, dst_path: str) -> None:
+        import shutil
+
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        with open(self._path(backup_id, key), "rb") as src, open(dst_path, "wb") as dst:
+            shutil.copyfileobj(src, dst, length=1 << 20)
+
     def home_id(self, backup_id: str) -> str:
         return self._path(backup_id)

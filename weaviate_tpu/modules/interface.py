@@ -85,5 +85,18 @@ class BackupBackend(abc.ABC):
     @abc.abstractmethod
     def read_meta(self, backup_id: str) -> Optional[dict]: ...
 
+    def put_file(self, backup_id: str, key: str, src_path: str) -> None:
+        """Streamed upload; default reads fully (override for real streaming)."""
+        with open(src_path, "rb") as f:
+            self.put_object(backup_id, key, f.read())
+
+    def fetch_to_file(self, backup_id: str, key: str, dst_path: str) -> None:
+        """Streamed download; default materializes (override to stream)."""
+        import os as _os
+
+        _os.makedirs(_os.path.dirname(dst_path), exist_ok=True)
+        with open(dst_path, "wb") as f:
+            f.write(self.get_object(backup_id, key))
+
     def home_id(self, backup_id: str) -> str:
         return backup_id

@@ -55,4 +55,7 @@ class Ref2VecCentroid(Module, Vectorizer):
         return np.mean(np.stack(vectors), axis=0)
 
     def vectorize_text(self, texts: Sequence[str]) -> np.ndarray:
-        raise NotImplementedError("ref2vec-centroid cannot embed text (no nearText)")
+        from weaviate_tpu.modules.provider import ModuleError
+
+        # ValueError-family so the API layer reports 422, not a 500
+        raise ModuleError("ref2vec-centroid cannot embed text (no nearText)")

@@ -27,11 +27,14 @@ class ContextionaryVectorizer(Module, Vectorizer, GraphQLArguments):
             raise ModuleError(
                 "text2vec-contextionary requires CONTEXTIONARY_URL (host:port)"
             )
+        import threading
+
         self.url = url
         self.timeout = timeout
         self._channel = None
         self._vectorize = None
         self._meta = None
+        self._connect_lock = threading.Lock()
 
     @property
     def name(self) -> str:
@@ -43,21 +46,25 @@ class ContextionaryVectorizer(Module, Vectorizer, GraphQLArguments):
     def _connect(self):
         if self._channel is not None:
             return
-        import grpc
+        with self._connect_lock:
+            if self._channel is not None:
+                return
+            import grpc
 
-        from weaviate_tpu.modules import contextionary_pb2 as pb
+            from weaviate_tpu.modules import contextionary_pb2 as pb
 
-        self._channel = grpc.insecure_channel(self.url)
-        self._vectorize = self._channel.unary_unary(
-            f"{_SERVICE}/Vectorize",
-            request_serializer=pb.VectorizeRequest.SerializeToString,
-            response_deserializer=pb.VectorizeReply.FromString,
-        )
-        self._meta = self._channel.unary_unary(
-            f"{_SERVICE}/Meta",
-            request_serializer=pb.MetaRequest.SerializeToString,
-            response_deserializer=pb.MetaReply.FromString,
-        )
+            channel = grpc.insecure_channel(self.url)
+            self._vectorize = channel.unary_unary(
+                f"{_SERVICE}/Vectorize",
+                request_serializer=pb.VectorizeRequest.SerializeToString,
+                response_deserializer=pb.VectorizeReply.FromString,
+            )
+            self._meta = channel.unary_unary(
+                f"{_SERVICE}/Meta",
+                request_serializer=pb.MetaRequest.SerializeToString,
+                response_deserializer=pb.MetaReply.FromString,
+            )
+            self._channel = channel  # assign last: publishes the stubs
 
     def meta(self) -> dict:
         try:

@@ -117,8 +117,18 @@ class App:
         self.graphql = GraphQLExecutor(self.traverser, self.aggregator, self.schema, self.db)
         self.authenticator = Authenticator(self.config.auth)
         self.authorizer = Authorizer(self.config.authz)
-        # populated by later subsystems (backup scheduler, classifier)
-        self.backup_scheduler = None
+        from weaviate_tpu.usecases.backup import BackupScheduler
+
+        if self.cluster_node is not None:
+            self.backup_scheduler = BackupScheduler(
+                self.db, self.schema, self.modules,
+                node_name=self.cluster_node.node_name,
+                cluster=self.cluster_node.cluster,
+                node_client=self.cluster_node.node_client,
+            )
+            self.cluster_node.api.backup = self.backup_scheduler
+        else:
+            self.backup_scheduler = BackupScheduler(self.db, self.schema, self.modules)
         self.classifier = None
         self.cluster = self.cluster_node  # /v1/nodes aggregation source
 

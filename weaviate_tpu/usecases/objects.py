@@ -155,8 +155,21 @@ class ObjectsManager:
             return None
         merged = dict(cur.properties)
         merged.update(new_props)
+        before = StorObj(class_name=cd.name, uuid=uuid, properties=cur.properties)
         preview = StorObj(class_name=cd.name, uuid=uuid, properties=merged)
-        return self.modules.vectorize_object(cd, preview)
+        # only recompute when the edit changes what the module would embed —
+        # a PATCH of non-vectorized props must not clobber a custom vector
+        try:
+            old_vec = self.modules.vectorize_object(cd, before)
+            new_vec = self.modules.vectorize_object(cd, preview)
+        except Exception:  # ref2vec without db etc.: leave the vector alone
+            return None
+        if old_vec is None and new_vec is None:
+            return None
+        if (old_vec is not None and new_vec is not None
+                and np.array_equal(old_vec, new_vec)):
+            return None
+        return new_vec
 
     def merge(self, uuid: str, class_name: str, props: dict, vector=None,
               cl: Optional[str] = None) -> StorObj:
