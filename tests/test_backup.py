@@ -157,7 +157,7 @@ def test_multinode_backup_restore(tmp_path):
             p.register(FilesystemBackupBackend(shared_root))
             sched = BackupScheduler(
                 n.db, n.schema, p, node_name=n.node_name,
-                cluster=n.cluster, node_client=n.node_client,
+                cluster=n.cluster, node_client=n.transfer_client,
             )
             n.api.backup = sched
 

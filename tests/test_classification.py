@@ -1,0 +1,156 @@
+"""Classification jobs: kNN vote + zero-shot reference assignment over REST.
+
+Reference test model: usecases/classification tests
+(classifier_run_knn.go) — training set with labeled objects, unlabeled
+sources gain the majority label of their k nearest neighbors.
+"""
+
+import json
+import time
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.server import App, RestServer
+
+
+def _req(port, method, path, body=None):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data, method=method)
+    r.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None
+
+
+@pytest.fixture
+def served(tmp_path):
+    app = App(config=Config(), data_path=str(tmp_path / "data"))
+    srv = RestServer(app, port=0)
+    srv.start()
+    yield app, srv
+    srv.stop()
+    app.shutdown()
+
+
+def _wait_job(port, job_id, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st, job = _req(port, "GET", f"/v1/classifications/{job_id}")
+        assert st == 200
+        if job["status"] in ("completed", "failed"):
+            return job
+        time.sleep(0.05)
+    raise TimeoutError("classification job still running")
+
+
+def _cluster_vec(center, i, dim=8):
+    rng = np.random.default_rng(1000 * center + i)
+    v = np.zeros(dim, np.float32)
+    v[center] = 5.0
+    return (v + 0.1 * rng.standard_normal(dim)).astype(np.float32)
+
+
+def test_knn_classification_journey(served):
+    app, srv = served
+    _req(srv.port, "POST", "/v1/schema", {
+        "class": "Article",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "category", "dataType": ["text"]}],
+    })
+    objs = []
+    # labeled training set: 2 clusters
+    for c, label in ((0, "science"), (1, "sports")):
+        for i in range(10):
+            objs.append({"class": "Article", "id": str(uuidlib.uuid4()),
+                         "properties": {"title": f"t{c}{i}", "category": label},
+                         "vector": _cluster_vec(c, i).tolist()})
+    # unlabeled sources near each cluster
+    unlabeled = []
+    for c in (0, 1):
+        for i in range(100, 105):
+            uid = str(uuidlib.uuid4())
+            unlabeled.append((uid, c))
+            objs.append({"class": "Article", "id": uid,
+                         "properties": {"title": f"u{c}{i}"},
+                         "vector": _cluster_vec(c, i).tolist()})
+    st, out = _req(srv.port, "POST", "/v1/batch/objects", {"objects": objs})
+    assert st == 200 and all(o["result"]["status"] == "SUCCESS" for o in out)
+
+    st, job = _req(srv.port, "POST", "/v1/classifications", {
+        "class": "Article", "classifyProperties": ["category"],
+        "basedOnProperties": ["title"], "type": "knn", "settings": {"k": 3},
+    })
+    assert st == 201 and job["status"] == "running"
+    final = _wait_job(srv.port, job["id"])
+    assert final["status"] == "completed", final
+    assert final["meta"]["count"] == 10
+    assert final["meta"]["countSucceeded"] == 10
+
+    for uid, c in unlabeled:
+        st, got = _req(srv.port, "GET", f"/v1/objects/Article/{uid}")
+        want = "science" if c == 0 else "sports"
+        assert got["properties"]["category"] == want
+
+
+def test_zeroshot_classification(served):
+    app, srv = served
+    _req(srv.port, "POST", "/v1/schema", {
+        "class": "Category",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "name", "dataType": ["text"]}],
+    })
+    cat_ids = {}
+    for c, name in ((0, "science"), (1, "sports")):
+        uid = str(uuidlib.uuid4())
+        cat_ids[name] = uid
+        _req(srv.port, "POST", "/v1/objects", {
+            "class": "Category", "id": uid, "properties": {"name": name},
+            "vector": _cluster_vec(c, 0).tolist()})
+    _req(srv.port, "POST", "/v1/schema", {
+        "class": "Story",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "ofCategory", "dataType": ["Category"]}],
+    })
+    story_ids = []
+    for c in (0, 1):
+        for i in range(3):
+            uid = str(uuidlib.uuid4())
+            story_ids.append((uid, c))
+            _req(srv.port, "POST", "/v1/objects", {
+                "class": "Story", "id": uid, "properties": {"title": f"s{c}{i}"},
+                "vector": _cluster_vec(c, 50 + i).tolist()})
+
+    st, job = _req(srv.port, "POST", "/v1/classifications", {
+        "class": "Story", "classifyProperties": ["ofCategory"], "type": "zeroshot",
+    })
+    assert st == 201
+    final = _wait_job(srv.port, job["id"])
+    assert final["status"] == "completed", final
+    assert final["meta"]["countSucceeded"] == 6
+
+    for uid, c in story_ids:
+        st, got = _req(srv.port, "GET", f"/v1/objects/Story/{uid}")
+        want = cat_ids["science" if c == 0 else "sports"]
+        beacon = got["properties"]["ofCategory"][0]["beacon"]
+        assert beacon.endswith(want)
+
+
+def test_classification_validation(served):
+    app, srv = served
+    st, out = _req(srv.port, "POST", "/v1/classifications", {"class": "Nope",
+                   "classifyProperties": ["x"]})
+    assert st == 422
+    st, out = _req(srv.port, "GET", "/v1/classifications/" + str(uuidlib.uuid4()))
+    assert st == 404

@@ -1,0 +1,75 @@
+"""Process entry point smoke test: `python -m weaviate_tpu` serves REST +
+gRPC + metrics and exits cleanly on SIGTERM (cmd/weaviate-server/main.go
+journey)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_main_serves_and_stops(tmp_path):
+    port, gport, mport = _free_port(), _free_port(), _free_port()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PERSISTENCE_DATA_PATH": str(tmp_path / "data"),
+        "PROMETHEUS_MONITORING_ENABLED": "true",
+        "PROMETHEUS_MONITORING_PORT": str(mport),
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "weaviate_tpu",
+         "--host", "127.0.0.1", "--port", str(port), "--grpc-port", str(gport)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 60
+        up = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/.well-known/ready", timeout=2
+                ) as r:
+                    up = r.status == 200
+                    break
+            except OSError:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"server exited early:\n{proc.stdout.read()}"
+                    )
+                time.sleep(0.2)
+        assert up, "server never became ready"
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/v1/meta", timeout=5) as r:
+            meta = json.loads(r.read())
+        assert "version" in meta
+        with urllib.request.urlopen(f"http://127.0.0.1:{mport}/metrics", timeout=5) as r:
+            assert r.status == 200
+
+        # gRPC port is listening
+        s = socket.create_connection(("127.0.0.1", gport), timeout=5)
+        s.close()
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0
+        out = proc.stdout.read()
+        assert "shutdown complete" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -1,0 +1,69 @@
+"""Process entry point: `python -m weaviate_tpu`.
+
+Reference: cmd/weaviate-server/main.go:30 — load config from the
+environment, assemble the whole object graph, serve REST (+ metrics when
+enabled) and gRPC until SIGTERM/SIGINT, then shut down cleanly.
+
+Flags mirror the reference's swagger flags where they matter:
+    --host (default 0.0.0.0), --port (default 8080; PORT env also honored),
+    --grpc-port (default GRPC_PORT env / 50051), --data-path (overrides
+    PERSISTENCE_DATA_PATH). Everything else comes from the env-var surface
+    (usecases/config/environment.go twin in weaviate_tpu/config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="weaviate-tpu", description=__doc__)
+    ap.add_argument("--host", default=os.environ.get("HOST", "0.0.0.0"))
+    ap.add_argument("--port", type=int, default=int(os.environ.get("PORT", "8080")))
+    ap.add_argument("--grpc-port", type=int, default=None)
+    ap.add_argument("--data-path", default=None)
+    args = ap.parse_args(argv)
+
+    from weaviate_tpu.config import load_config
+    from weaviate_tpu.server import App, RestServer
+    from weaviate_tpu.server.grpc_server import GrpcServer
+    from weaviate_tpu.version import __version__
+
+    config = load_config()
+    app = App(config=config, data_path=args.data_path)
+    rest = RestServer(app, host=args.host, port=args.port)
+    grpc_port = args.grpc_port if args.grpc_port is not None else config.grpc_port
+    grpc_srv = GrpcServer(app, host=args.host, port=grpc_port)
+
+    rest.start()
+    grpc_srv.start()
+    parts = [f"REST http://{args.host}:{rest.port}", f"gRPC {args.host}:{grpc_srv.port}"]
+    if getattr(rest, "_metrics_httpd", None) is not None:
+        parts.append(f"metrics :{rest.metrics_port}")
+    if app.cluster_node is not None:
+        parts.append(f"clusterapi {app.cluster_node.address}")
+    print(f"weaviate-tpu {__version__} serving " + ", ".join(parts), flush=True)
+
+    stop = threading.Event()
+
+    def handle(signum, frame):
+        print(f"received signal {signum}, shutting down", flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    stop.wait()
+
+    grpc_srv.stop()
+    rest.stop()
+    app.shutdown()
+    print("shutdown complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

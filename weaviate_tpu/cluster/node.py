@@ -81,9 +81,11 @@ class ClusterNode:
             self.advertise = f"{host}:{self.server.port}"
         else:
             self.advertise = self.server.address
+        self.node_client = NodeClient()  # lightweight RPCs (status, schema)
         # shard-file transfer (scaler, backup) moves whole shards in one
-        # call: give it a transfer-sized timeout, not an RPC-sized one
-        self.node_client = NodeClient(timeout=600.0)
+        # call: a transfer-sized timeout, kept OFF the status path so an
+        # unreachable peer can't stall /v1/nodes for minutes
+        self.transfer_client = NodeClient(timeout=600.0)
         self.replica_coord = ReplicaCoordinator(
             node_name,
             self.cluster,
@@ -94,7 +96,7 @@ class ClusterNode:
         self.db.set_replication(
             Replicator(self.replica_coord), Finder(self.replica_coord)
         )
-        self.schema.scaler = Scaler(node_name, self.cluster, self.node_client, self.db)
+        self.schema.scaler = Scaler(node_name, self.cluster, self.transfer_client, self.db)
 
     # -- addressing ----------------------------------------------------------
 

@@ -472,6 +472,20 @@ class Shard:
 
         shutil.rmtree(self.path, ignore_errors=True)
 
+    def paused_writes(self):
+        """Hold the shard's write lock around a file copy: no write, flush,
+        or WAL truncation can interleave (the reference's pause-compaction-
+        and-commitlog window, adapters/repos/db/backup.go)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            with self._lock:
+                self.flush()
+                yield
+
+        return _ctx()
+
     def list_files(self) -> list[str]:
         """Files to copy for a backup (shard_backup.go ListBackupFiles)."""
         out = self.store.list_files()

@@ -49,6 +49,12 @@ class Vectorizer(abc.ABC):
     def vectorize_text(self, texts: Sequence[str]) -> np.ndarray:
         """Embed raw query texts -> [len(texts), D] float32."""
 
+    def vectorize_input(self, class_def, obj, module_cfg: dict):
+        """The canonical embedding input for `obj` (corpus string, beacon
+        list, ...), or None if undeterminable. Lets callers skip embedding
+        when an edit didn't change what would be embedded."""
+        return None
+
 
 class GraphQLArguments(abc.ABC):
     """near-args the module contributes to Get/Explore

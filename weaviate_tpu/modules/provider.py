@@ -149,6 +149,14 @@ class Provider:
                 base = base * (base_norm / n)
         return base.astype(np.float32)
 
+    def vectorization_input(self, class_def, obj):
+        """Canonical embedding input for change detection, or None."""
+        vec = self._vectorizer_for(class_def)
+        if vec is None:
+            return None
+        mod_cfg = self._class_module_cfg(class_def, class_def.vectorizer)
+        return vec.vectorize_input(class_def, obj, mod_cfg)
+
     def vectorize_texts(self, class_def, texts: Sequence[str]) -> np.ndarray:
         vec = self._vectorizer_for(class_def)
         if vec is None:

@@ -54,6 +54,16 @@ class Ref2VecCentroid(Module, Vectorizer):
             return None
         return np.mean(np.stack(vectors), axis=0)
 
+    def vectorize_input(self, class_def, obj, module_cfg: dict):
+        ref_props = module_cfg.get("referenceProperties") or [
+            p.name for p in class_def.properties if p.primitive_type() is None
+        ]
+        beacons = []
+        for pname in sorted(ref_props):
+            for ref in obj.properties.get(pname) or []:
+                beacons.append(ref.get("beacon", "") if isinstance(ref, dict) else str(ref))
+        return tuple(beacons)
+
     def vectorize_text(self, texts: Sequence[str]) -> np.ndarray:
         from weaviate_tpu.modules.provider import ModuleError
 

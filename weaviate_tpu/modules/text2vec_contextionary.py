@@ -100,6 +100,9 @@ class ContextionaryVectorizer(Module, Vectorizer, GraphQLArguments):
             return None
         return self.vectorize_text([corpus])[0]
 
+    def vectorize_input(self, class_def, obj, module_cfg: dict):
+        return corpus_from_object(class_def, obj, module_cfg, self.name)
+
     def shutdown(self) -> None:
         if self._channel is not None:
             self._channel.close()

@@ -85,3 +85,6 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments):
 
     def vectorize_text(self, texts: Sequence[str]) -> np.ndarray:
         return np.stack([self._embed(t) for t in texts])
+
+    def vectorize_input(self, class_def, obj, module_cfg: dict):
+        return corpus_from_object(class_def, obj, module_cfg, self._name)

@@ -124,12 +124,14 @@ class App:
                 self.db, self.schema, self.modules,
                 node_name=self.cluster_node.node_name,
                 cluster=self.cluster_node.cluster,
-                node_client=self.cluster_node.node_client,
+                node_client=self.cluster_node.transfer_client,
             )
             self.cluster_node.api.backup = self.backup_scheduler
         else:
             self.backup_scheduler = BackupScheduler(self.db, self.schema, self.modules)
-        self.classifier = None
+        from weaviate_tpu.usecases.classification import Classifier
+
+        self.classifier = Classifier(self.db, self.schema)
         self.cluster = self.cluster_node  # /v1/nodes aggregation source
 
     # -- meta ----------------------------------------------------------------

@@ -85,19 +85,20 @@ class BackupScheduler:
     def backup(self, backend_name: str, body: dict) -> dict:
         backend = self._backend(backend_name)
         backup_id = body.get("id") or f"backup-{int(time.time())}"
-        with self._lock:
-            running = self._status.get(backup_id)
-            if running is not None and running["status"] in (STATUS_STARTED, STATUS_TRANSFERRING):
-                raise BackupError(f"backup {backup_id!r} is already running")
         if backend.read_meta(backup_id) is not None:
             raise BackupError(f"backup {backup_id!r} already exists")
         classes = self._classes(body)
         if not classes:
             raise BackupError("nothing to back up: no classes selected")
-        payload = self._set_status(
-            self._status, backup_id, STATUS_STARTED,
-            backend=backend_name, classes=classes,
-        )
+        # check-and-reserve atomically: a concurrent request with the same
+        # id must lose here, not interleave file writes
+        with self._lock:
+            running = self._status.get(backup_id)
+            if running is not None and running["status"] in (STATUS_STARTED, STATUS_TRANSFERRING):
+                raise BackupError(f"backup {backup_id!r} is already running")
+            payload = {"id": backup_id, "status": STATUS_STARTED, "error": None,
+                       "path": "", "backend": backend_name, "classes": classes}
+            self._status[backup_id] = payload
         t = threading.Thread(
             target=self._run_backup, args=(backend, backend_name, backup_id, classes),
             daemon=True, name=f"backup-{backup_id}",
@@ -116,19 +117,22 @@ class BackupScheduler:
             if idx is None:
                 continue
             for sname, shard in idx.shards.items():
-                shard.flush()
-                base = shard.path
-                rels = []
-                for root, _, files in os.walk(base):
-                    for fn in files:
-                        full = os.path.join(root, fn)
-                        rel = os.path.relpath(full, base)
-                        rels.append(rel)
-                        backend.put_file(
-                            backup_id,
-                            f"{self.node_name}/{cname}/{sname}/{rel}",
-                            full,
-                        )
+                # copy under the shard's write lock: a concurrent memtable
+                # flush would otherwise add a segment missing from this
+                # listing while truncating the WAL we are about to copy
+                with shard.paused_writes():
+                    base = shard.path
+                    rels = []
+                    for root, _, files in os.walk(base):
+                        for fn in files:
+                            full = os.path.join(root, fn)
+                            rel = os.path.relpath(full, base)
+                            rels.append(rel)
+                            backend.put_file(
+                                backup_id,
+                                f"{self.node_name}/{cname}/{sname}/{rel}",
+                                full,
+                            )
                 manifest.setdefault(cname, {})[sname] = sorted(rels)
         return manifest
 
@@ -208,19 +212,18 @@ class BackupScheduler:
         ]
         if not classes:
             raise BackupError("nothing to restore: no classes selected")
-        with self._lock:
-            running = self._restore_status.get(backup_id)
-            if running is not None and running["status"] in (STATUS_STARTED, STATUS_TRANSFERRING):
-                raise BackupError(f"restore of {backup_id!r} is already running")
         for c in classes:
             if self.schema.get_class(c) is not None:
                 raise BackupError(
                     f"cannot restore: class {c!r} already exists (delete it first)"
                 )
-        payload = self._set_status(
-            self._restore_status, backup_id, STATUS_STARTED,
-            backend=backend_name, classes=classes,
-        )
+        with self._lock:
+            running = self._restore_status.get(backup_id)
+            if running is not None and running["status"] in (STATUS_STARTED, STATUS_TRANSFERRING):
+                raise BackupError(f"restore of {backup_id!r} is already running")
+            payload = {"id": backup_id, "status": STATUS_STARTED, "error": None,
+                       "path": "", "backend": backend_name, "classes": classes}
+            self._restore_status[backup_id] = payload
         t = threading.Thread(
             target=self._run_restore,
             args=(backend, backend_name, backup_id, meta, classes),

@@ -1,0 +1,263 @@
+"""Classification: kNN + zero-shot, as async jobs.
+
+Reference: usecases/classification/ — classifier_run_knn.go (kNN vote over
+a training set: objects that already carry the target property), zero-shot
+(assign the nearest object of the reference property's target class), run as
+background jobs polled via GET /v1/classifications/{id}
+(classifier.go Schedule + status persistence).
+
+TPU-first restructuring: the reference classifies source-by-source, each
+doing its own vector search. Here the whole run is batched — all source
+vectors against the training matrix in chunked numpy/BLAS matmuls (and the
+per-source assignment is a vectorized argpartition + vote), the same
+batch-first shape as the query path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuidlib
+from typing import Optional
+
+import numpy as np
+
+from weaviate_tpu.entities.filters import LocalFilter
+
+STATUS_RUNNING = "running"
+STATUS_COMPLETED = "completed"
+STATUS_FAILED = "failed"
+
+TYPE_KNN = "knn"
+TYPE_ZEROSHOT = "zeroshot"
+
+_MAX_TRAINING = 100_000
+_CHUNK = 4096
+
+
+class ClassificationError(ValueError):
+    pass
+
+
+class Classifier:
+    def __init__(self, db, schema):
+        self.db = db
+        self.schema = schema
+        self._jobs: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # -- API (classifications REST handlers) ---------------------------------
+
+    def schedule(self, body: dict) -> dict:
+        class_name = body.get("class")
+        if not class_name:
+            raise ClassificationError("classification requires 'class'")
+        resolved = self.schema.resolve_class_name(class_name)
+        if resolved is None or self.db.get_index(resolved) is None:
+            raise ClassificationError(f"class {class_name!r} not found")
+        classify_props = body.get("classifyProperties") or []
+        if not classify_props:
+            raise ClassificationError("classifyProperties must not be empty")
+        cd = self.schema.get_class(resolved)
+        for p in classify_props:
+            if cd.get_property(p) is None:
+                raise ClassificationError(f"classifyProperty {p!r} not in schema")
+        ctype = body.get("type") or TYPE_KNN
+        if ctype not in (TYPE_KNN, TYPE_ZEROSHOT):
+            raise ClassificationError(f"unknown classification type {ctype!r}")
+        settings = body.get("settings") or {}
+        k = int(settings.get("k", 3))
+        filters = body.get("filters") or {}
+
+        job_id = str(uuidlib.uuid4())
+        job = {
+            "id": job_id,
+            "class": resolved,
+            "classifyProperties": classify_props,
+            "basedOnProperties": body.get("basedOnProperties") or [],
+            "type": ctype,
+            "settings": {"k": k},
+            "status": STATUS_RUNNING,
+            "meta": {"started": int(time.time() * 1000), "completed": 0,
+                     "count": 0, "countSucceeded": 0, "countFailed": 0},
+            "error": None,
+        }
+        with self._lock:
+            self._jobs[job_id] = job
+        t = threading.Thread(
+            target=self._run, args=(job, ctype, resolved, classify_props, k, filters),
+            daemon=True, name=f"classification-{job_id}",
+        )
+        t.start()
+        return dict(job)
+
+    def get(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            return dict(job) if job else None
+
+    # -- job body ------------------------------------------------------------
+
+    def _run(self, job, ctype, class_name, classify_props, k, filters) -> None:
+        try:
+            if ctype == TYPE_KNN:
+                counts = self._run_knn(class_name, classify_props, k, filters)
+            else:
+                counts = self._run_zeroshot(class_name, classify_props, filters)
+            with self._lock:
+                job["meta"].update(
+                    completed=int(time.time() * 1000),
+                    count=counts[0], countSucceeded=counts[1],
+                    countFailed=counts[0] - counts[1],
+                )
+                job["status"] = STATUS_COMPLETED
+        except Exception as e:  # noqa: BLE001 — job error -> failed status
+            with self._lock:
+                job["status"] = STATUS_FAILED
+                job["error"] = str(e)
+
+    @staticmethod
+    def _prop_value_key(val) -> Optional[str]:
+        """Normalize a property value to a vote key (beacon for refs)."""
+        if val is None:
+            return None
+        if isinstance(val, list):
+            if not val:
+                return None
+            first = val[0]
+            if isinstance(first, dict):
+                return first.get("beacon")
+            return str(first)
+        return str(val)
+
+    def _fetch(self, idx, flt: Optional[LocalFilter], limit: int):
+        return idx.object_search(limit=limit, flt=flt, include_vector=True)
+
+    def _run_knn(self, class_name, classify_props, k, filters) -> tuple[int, int]:
+        """classifier_run_knn.go semantics, batched: training set = objects
+        whose classify property is already set; each unclassified source gets
+        the majority vote of its k nearest training objects."""
+        idx = self.db.get_index(class_name)
+        train_flt = LocalFilter.from_dict(filters.get("trainingSetWhere"))
+        source_flt = LocalFilter.from_dict(filters.get("sourceWhere"))
+
+        rows = self._fetch(idx, train_flt, _MAX_TRAINING)
+        train_vecs, train_vals = [], []
+        for r in rows:
+            key = self._prop_value_key(r.obj.properties.get(classify_props[0]))
+            if key is not None and r.obj.vector is not None:
+                train_vecs.append(np.asarray(r.obj.vector, np.float32))
+                # values per prop: vote key tuple
+                train_vals.append(tuple(
+                    self._prop_value_key(r.obj.properties.get(p)) for p in classify_props
+                ))
+        if not train_vecs:
+            raise ClassificationError(
+                "no training data: no objects have the classify properties set"
+            )
+        train = np.stack(train_vecs)  # [T, D]
+        kk = min(k, train.shape[0])
+
+        sources = [
+            r.obj for r in self._fetch(idx, source_flt, _MAX_TRAINING)
+            if self._prop_value_key(r.obj.properties.get(classify_props[0])) is None
+            and r.obj.vector is not None
+        ]
+        total = succeeded = 0
+        for off in range(0, len(sources), _CHUNK):
+            batch = sources[off : off + _CHUNK]
+            q = np.stack([np.asarray(o.vector, np.float32) for o in batch])  # [B, D]
+            # [B, T] squared L2 via the matmul identity (one BLAS call)
+            d = (
+                (q ** 2).sum(1, keepdims=True)
+                - 2.0 * q @ train.T
+                + (train ** 2).sum(1)[None, :]
+            )
+            nn = np.argpartition(d, kk - 1, axis=1)[:, :kk]  # [B, kk]
+            for bi, obj in enumerate(batch):
+                total += 1
+                votes: dict[tuple, int] = {}
+                for ti in nn[bi]:
+                    votes[train_vals[ti]] = votes.get(train_vals[ti], 0) + 1
+                winner = max(votes, key=votes.get)
+                try:
+                    self._assign(idx, obj, classify_props, winner)
+                    succeeded += 1
+                except Exception:  # noqa: BLE001 — per-object failure counted
+                    pass
+        return total, succeeded
+
+    def _run_zeroshot(self, class_name, classify_props, filters) -> tuple[int, int]:
+        """Zero-shot: each classify property must be a reference; assign the
+        vector-nearest object of the property's target class."""
+        idx = self.db.get_index(class_name)
+        cd = self.schema.get_class(class_name)
+        source_flt = LocalFilter.from_dict(filters.get("sourceWhere"))
+
+        targets_per_prop: dict[str, tuple[np.ndarray, list[str]]] = {}
+        for p in classify_props:
+            prop = cd.get_property(p)
+            if prop is None or prop.primitive_type() is not None:
+                raise ClassificationError(
+                    f"zeroshot classifyProperty {p!r} must be a reference property"
+                )
+            target_class = prop.data_type[0]
+            tidx = self.db.get_index(target_class)
+            if tidx is None:
+                raise ClassificationError(f"target class {target_class!r} not found")
+            vecs, beacons = [], []
+            for r in self._fetch(tidx, None, _MAX_TRAINING):
+                if r.obj.vector is not None:
+                    vecs.append(np.asarray(r.obj.vector, np.float32))
+                    beacons.append(
+                        f"weaviate://localhost/{target_class}/{r.obj.uuid}"
+                    )
+            if not vecs:
+                raise ClassificationError(
+                    f"zeroshot: target class {target_class!r} has no vectors"
+                )
+            targets_per_prop[p] = (np.stack(vecs), beacons)
+
+        sources = [
+            r.obj for r in self._fetch(idx, source_flt, _MAX_TRAINING)
+            if self._prop_value_key(r.obj.properties.get(classify_props[0])) is None
+            and r.obj.vector is not None
+        ]
+        total = succeeded = 0
+        for off in range(0, len(sources), _CHUNK):
+            batch = sources[off : off + _CHUNK]
+            q = np.stack([np.asarray(o.vector, np.float32) for o in batch])
+            winners_per_prop = {}
+            for p, (tv, beacons) in targets_per_prop.items():
+                d = (
+                    (q ** 2).sum(1, keepdims=True)
+                    - 2.0 * q @ tv.T
+                    + (tv ** 2).sum(1)[None, :]
+                )
+                winners_per_prop[p] = [beacons[i] for i in np.argmin(d, axis=1)]
+            for bi, obj in enumerate(batch):
+                total += 1
+                try:
+                    props = {
+                        p: [{"beacon": winners_per_prop[p][bi]}]
+                        for p in classify_props
+                    }
+                    idx.merge_object(obj.uuid, props)
+                    succeeded += 1
+                except Exception:  # noqa: BLE001
+                    pass
+        return total, succeeded
+
+    def _assign(self, idx, obj, classify_props, winner: tuple) -> None:
+        cd = self.schema.get_class(idx.class_name)
+        props = {}
+        for p, val in zip(classify_props, winner):
+            if val is None:
+                continue
+            prop = cd.get_property(p)
+            if prop is not None and prop.primitive_type() is None:
+                props[p] = [{"beacon": val}]
+            else:
+                props[p] = val
+        if props:
+            idx.merge_object(obj.uuid, props)

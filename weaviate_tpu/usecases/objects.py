@@ -158,18 +158,15 @@ class ObjectsManager:
         before = StorObj(class_name=cd.name, uuid=uuid, properties=cur.properties)
         preview = StorObj(class_name=cd.name, uuid=uuid, properties=merged)
         # only recompute when the edit changes what the module would embed —
-        # a PATCH of non-vectorized props must not clobber a custom vector
-        try:
-            old_vec = self.modules.vectorize_object(cd, before)
-            new_vec = self.modules.vectorize_object(cd, preview)
-        except Exception:  # ref2vec without db etc.: leave the vector alone
+        # a PATCH of non-vectorized props must not clobber a custom vector.
+        # Inputs are compared instead of embeddings: one (zero, usually)
+        # vectorizer call, and embedder outages surface as errors rather
+        # than silently keeping a stale vector.
+        input_before = self.modules.vectorization_input(cd, before)
+        input_after = self.modules.vectorization_input(cd, preview)
+        if input_before is not None and input_before == input_after:
             return None
-        if old_vec is None and new_vec is None:
-            return None
-        if (old_vec is not None and new_vec is not None
-                and np.array_equal(old_vec, new_vec)):
-            return None
-        return new_vec
+        return self.modules.vectorize_object(cd, preview)
 
     def merge(self, uuid: str, class_name: str, props: dict, vector=None,
               cl: Optional[str] = None) -> StorObj:
