@@ -347,13 +347,14 @@ struct Index {
   bool remove(uint64_t doc_id) {
     auto it = by_doc.find(doc_id);
     if (it == by_doc.end()) return false;
-    if (!tombstone[it->second]) {
-      tombstone[it->second] = 1;
+    const uint32_t internal = it->second;  // read before erase invalidates it
+    if (!tombstone[internal]) {
+      tombstone[internal] = 1;
       --live;
     }
     by_doc.erase(it);
     // move entrypoint if it was deleted (findNewGlobalEntrypoint, delete.go:422)
-    if (it->second == entrypoint) {
+    if (internal == entrypoint) {
       for (int32_t l = max_level; l >= 0; --l) {
         for (uint32_t i = 0; i < n_nodes(); ++i) {
           if (!tombstone[i] && levels[i] >= l) {
@@ -363,8 +364,133 @@ struct Index {
           }
         }
       }
+      entrypoint = UINT32_MAX;
+      max_level = -1;
     }
     return true;
+  }
+
+  // Tombstone cleanup cycle (CleanUpTombstonedNodes, delete.go:177):
+  // 1. reassign: every live node that links to a tombstoned neighbor
+  //    bridges THROUGH it (adopting the deleted node's live neighbors)
+  //    and re-prunes by the selection heuristic — the connectivity-repair
+  //    role of delete.go:271 reassignNeighbor, done via 2-hop adoption
+  //    instead of a full re-search (bounded work per node, same effect:
+  //    paths that crossed the deleted node stay connected);
+  // 2. move the entrypoint to the highest live node (delete.go:422);
+  // 3. physically compact every array, remapping internal ids — memory is
+  //    actually reclaimed and deleted nodes are no longer traversed.
+  // Returns the number of nodes physically removed.
+  int64_t cleanup() {
+    const uint32_t n = n_nodes();
+    uint32_t n_tombs = 0;
+    for (uint32_t i = 0; i < n; ++i)
+      if (tombstone[i]) ++n_tombs;
+    if (n_tombs == 0) return 0;
+
+    // 1. bridge + re-prune
+    std::vector<uint32_t> pool;
+    std::vector<Candidate> cands;
+    std::vector<uint32_t> kept;
+    for (uint32_t i = 0; i < n; ++i) {
+      if (tombstone[i]) continue;
+      for (size_t l = 0; l < links[i].size(); ++l) {
+        auto& nl = links[i][l];
+        bool dirty = false;
+        for (uint32_t nb : nl)
+          if (tombstone[nb]) {
+            dirty = true;
+            break;
+          }
+        if (!dirty) continue;
+        // bridge TRANSITIVELY through tombstone chains: a whole deleted
+        // cluster between this node and the nearest live nodes must not
+        // orphan it (1-hop adoption would, when a tombstone's neighbors
+        // are themselves tombstones). Bounded expansion keeps the cycle
+        // linear in practice.
+        pool.clear();
+        std::vector<uint32_t> stack;
+        std::unordered_map<uint32_t, uint8_t> chain_seen;
+        for (uint32_t nb : nl) {
+          if (!tombstone[nb]) {
+            pool.push_back(nb);
+          } else if (chain_seen.emplace(nb, 1).second) {
+            stack.push_back(nb);
+          }
+        }
+        size_t expanded = 0;
+        while (!stack.empty() && expanded < 4096) {
+          const uint32_t t = stack.back();
+          stack.pop_back();
+          ++expanded;
+          if (l < links[t].size()) {
+            for (uint32_t nb2 : links[t][l]) {
+              if (nb2 == i) continue;
+              if (!tombstone[nb2]) {
+                pool.push_back(nb2);
+              } else if (chain_seen.emplace(nb2, 1).second) {
+                stack.push_back(nb2);
+              }
+            }
+          }
+        }
+        std::sort(pool.begin(), pool.end());
+        pool.erase(std::unique(pool.begin(), pool.end()), pool.end());
+        cands.clear();
+        for (uint32_t p : pool) cands.push_back({dist(vec(i), vec(p)), p});
+        select_heuristic(vec(i), cands, cap_at(static_cast<int32_t>(l)), kept);
+        nl.assign(kept.begin(), kept.end());
+      }
+    }
+
+    // 2. new entrypoint among live nodes
+    entrypoint = UINT32_MAX;
+    max_level = -1;
+    for (uint32_t i = 0; i < n; ++i) {
+      if (!tombstone[i] && levels[i] > max_level) {
+        max_level = levels[i];
+        entrypoint = i;
+      }
+    }
+
+    // 3. physical compaction with id remap
+    std::vector<uint32_t> remap(n, UINT32_MAX);
+    uint32_t next = 0;
+    for (uint32_t i = 0; i < n; ++i)
+      if (!tombstone[i]) remap[i] = next++;
+    const uint32_t n_new = next;
+
+    std::vector<float> new_vectors(static_cast<size_t>(n_new) * dim);
+    std::vector<uint64_t> new_doc_ids(n_new);
+    std::vector<int32_t> new_levels(n_new);
+    std::vector<std::vector<std::vector<uint32_t>>> new_links(n_new);
+    for (uint32_t i = 0; i < n; ++i) {
+      const uint32_t j = remap[i];
+      if (j == UINT32_MAX) continue;
+      std::memcpy(new_vectors.data() + static_cast<size_t>(j) * dim, vec(i),
+                  sizeof(float) * dim);
+      new_doc_ids[j] = doc_ids[i];
+      new_levels[j] = levels[i];
+      new_links[j].resize(links[i].size());
+      for (size_t l = 0; l < links[i].size(); ++l) {
+        auto& dst = new_links[j][l];
+        dst.reserve(links[i][l].size());
+        for (uint32_t nb : links[i][l])
+          if (remap[nb] != UINT32_MAX) dst.push_back(remap[nb]);
+      }
+    }
+    vectors = std::move(new_vectors);
+    doc_ids = std::move(new_doc_ids);
+    levels = std::move(new_levels);
+    links = std::move(new_links);
+    tombstone.assign(n_new, 0);
+    visited.assign(n_new, 0);
+    visit_epoch = 0;
+    by_doc.clear();
+    for (uint32_t i = 0; i < n_new; ++i) by_doc[doc_ids[i]] = i;
+    live = n_new;
+    entrypoint = entrypoint == UINT32_MAX ? UINT32_MAX : remap[entrypoint];
+    return static_cast<int64_t>(n) - n_new;
   }
 
   // -- binary snapshot (save/load) ---------------------------------------
@@ -523,6 +649,10 @@ int32_t hnsw_flat_search(void* h, const float* q, int32_t k, const uint64_t* all
   SortedU64 a{allow, allow_n};
   return static_cast<Index*>(h)->flat(q, k, a, out_ids, out_dists);
 }
+
+int64_t hnsw_cleanup(void* h) { return static_cast<Index*>(h)->cleanup(); }
+
+int64_t hnsw_node_count(void* h) { return static_cast<Index*>(h)->n_nodes(); }
 
 int32_t hnsw_save(void* h, const char* path) {
   return static_cast<Index*>(h)->save(path) ? 1 : 0;

@@ -118,3 +118,100 @@ def test_search_by_vector_distance(tmp_path, rng):
 def test_manhattan_rejected(tmp_path):
     with pytest.raises(vi.ConfigValidationError):
         make(tmp_path, vi.DISTANCE_MANHATTAN)
+
+
+def test_tombstone_cleanup_churn(tmp_path, rng):
+    """delete.go:177-422 parity: after delete-heavy churn + cleanup, node
+    count shrinks back (memory reclaimed), recall stays high, and deleted
+    docs never resurface."""
+    n, d, k = 3000, 24, 10
+    idx = make(tmp_path, efConstruction=64, maxConnections=16)
+    idx._CLEANUP_MIN_TOMBS = 10**9  # exercise the EXPLICIT cycle here
+    vecs = rng.standard_normal((n, d)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    n_phys_initial = idx.node_count()
+    assert n_phys_initial == n
+
+    # churn: delete 60%, in several waves with interleaved re-adds
+    deleted = set()
+    for wave in range(3):
+        victims = rng.choice(
+            [i for i in range(n) if i not in deleted], size=600, replace=False
+        )
+        idx.delete(*victims.tolist())
+        deleted.update(int(v) for v in victims)
+        # interleave some fresh inserts so cleanup runs on a live graph
+        fresh = rng.standard_normal((100, d)).astype(np.float32)
+        base = n + wave * 100
+        idx.add_batch(np.arange(base, base + 100), fresh)
+        vecs = np.concatenate([vecs, fresh])
+
+    removed = idx.cleanup_tombstones()
+    assert removed > 0
+    live = len(idx)
+    assert idx.node_count() == live  # every tombstone physically gone
+    assert live == n + 300 - len(deleted)
+
+    # recall over the surviving set stays high after the repair
+    live_ids = np.array(
+        [i for i in range(vecs.shape[0]) if i not in deleted], dtype=np.int64
+    )
+    live_vecs = vecs[live_ids]
+    queries = rng.standard_normal((40, d)).astype(np.float32)
+    hits = 0
+    for q in queries:
+        ids, _ = idx.search_by_vector(q, k)
+        assert not (set(int(x) for x in ids) & deleted)  # no resurrections
+        dd = ((live_vecs - q) ** 2).sum(1)
+        want = set(live_ids[np.argsort(dd)[:k]].tolist())
+        hits += len(want & set(int(x) for x in ids))
+    recall = hits / (len(queries) * k)
+    assert recall >= 0.95, recall
+
+    # the index keeps working for inserts + searches after compaction
+    idx.add(99_999, vecs[0])
+    ids, dists = idx.search_by_vector(vecs[0], 2)
+    assert 99_999 in set(int(x) for x in ids)
+
+
+def test_cleanup_auto_trigger(tmp_path, rng):
+    """Crossing the tombstone threshold runs the cycle inline."""
+    idx = make(tmp_path, efConstruction=32, maxConnections=8)
+    idx._CLEANUP_MIN_TOMBS = 50  # shrink the threshold for the test
+    vecs = rng.standard_normal((300, 8)).astype(np.float32)
+    idx.add_batch(np.arange(300), vecs)
+    idx.delete(*range(200))  # 200 tombs > max(50, live=100)
+    assert idx.node_count() == 100  # auto-cleanup fired
+    assert len(idx) == 100
+
+
+def test_cleanup_all_deleted(tmp_path, rng):
+    idx = make(tmp_path)
+    vecs = rng.standard_normal((50, 8)).astype(np.float32)
+    idx.add_batch(np.arange(50), vecs)
+    idx.delete(*range(50))
+    idx.cleanup_tombstones()
+    assert idx.node_count() == 0 and len(idx) == 0
+    ids, _ = idx.search_by_vector(vecs[0], 5)
+    assert len(ids) == 0
+    # and it accepts new data afterwards
+    idx.add_batch(np.arange(100, 110), vecs[:10])
+    ids, dists = idx.search_by_vector(vecs[3], 1)
+    assert ids[0] == 103 and dists[0] < 1e-5
+
+
+def test_cleanup_triggers_on_readd_churn(tmp_path, rng):
+    """Regression: update-heavy workloads (re-adds tombstone old nodes
+    without any delete() call) must still trigger the cleanup cycle, or
+    physical node count grows without bound."""
+    idx = make(tmp_path, efConstruction=32, maxConnections=8)
+    idx._CLEANUP_MIN_TOMBS = 64
+    base = rng.standard_normal((100, 8)).astype(np.float32)
+    idx.add_batch(np.arange(100), base)
+    for round_i in range(5):
+        idx.add_batch(np.arange(100), base + 0.01 * (round_i + 1))
+    assert len(idx) == 100
+    # 500 updates => 500 tombstones without cleanup; bounded with it
+    assert idx.node_count() < 100 + 200
+    ids, dists = idx.search_by_vector(base[7] + 0.05, 1)
+    assert ids[0] == 7

@@ -78,6 +78,10 @@ def _load_lib() -> ctypes.CDLL:
         lib.hnsw_flat_search.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int32, u64p,
                                          ctypes.c_int64, u64p, f32p]
         lib.hnsw_flat_search.restype = ctypes.c_int32
+        lib.hnsw_cleanup.argtypes = [ctypes.c_void_p]
+        lib.hnsw_cleanup.restype = ctypes.c_int64
+        lib.hnsw_node_count.argtypes = [ctypes.c_void_p]
+        lib.hnsw_node_count.restype = ctypes.c_int64
         lib.hnsw_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.hnsw_save.restype = ctypes.c_int32
         lib.hnsw_load.argtypes = [ctypes.c_char_p]
@@ -188,6 +192,7 @@ class HnswIndex(VectorIndex):
             if self._log is not None:
                 self._log.append_add(int(doc_id), v)
             self._lib.hnsw_add(self._h, int(doc_id), _f32p(v))
+            self._maybe_cleanup()  # re-adds tombstone the old node
 
     def add_batch(self, doc_ids: Sequence[int], vectors: np.ndarray) -> None:
         vectors = np.ascontiguousarray(vectors, dtype=np.float32)
@@ -203,6 +208,20 @@ class HnswIndex(VectorIndex):
             if self._log is not None:
                 self._log.append_add_batch(ids, vectors)
             self._lib.hnsw_add_batch(self._h, len(ids), _u64p(ids), _f32p(vectors))
+            self._maybe_cleanup()  # re-adds tombstone the old nodes
+
+    # tombstone pressure that triggers CleanUpTombstonedNodes inline (the
+    # reference runs it on a cyclemanager timer, delete.go:177 — here the
+    # write path that crosses the threshold pays for the cycle). Counted
+    # natively (physical nodes - live), so re-add tombstones and tombstones
+    # replayed from the log all count.
+    _CLEANUP_MIN_TOMBS = 1024
+
+    def _maybe_cleanup(self) -> None:
+        phys = int(self._lib.hnsw_node_count(self._h))
+        live = int(self._lib.hnsw_size(self._h))
+        if phys - live >= max(self._CLEANUP_MIN_TOMBS, live):
+            self._lib.hnsw_cleanup(self._h)
 
     def delete(self, *doc_ids: int) -> None:
         with self._lock:
@@ -212,6 +231,26 @@ class HnswIndex(VectorIndex):
                 if self._log is not None:
                     self._log.append_delete(int(d))
                 self._lib.hnsw_delete(self._h, int(d))
+            self._maybe_cleanup()
+
+    def cleanup_tombstones(self) -> int:
+        """Reassign neighbors of deleted nodes, move the entrypoint, and
+        physically remove them (delete.go:177-422). -> nodes removed."""
+        with self._lock:
+            if self._h is None:
+                return 0
+            return int(self._lib.hnsw_cleanup(self._h))
+
+    def compact(self) -> None:
+        """Uniform compaction surface with the TPU index: cleanup +
+        condense the delta log into a fresh snapshot."""
+        self.cleanup_tombstones()
+        self.flush()
+
+    def node_count(self) -> int:
+        """Physical node count incl. tombstones (test/metrics surface)."""
+        with self._lock:
+            return int(self._lib.hnsw_node_count(self._h)) if self._h else 0
 
     def contains(self, doc_id: int) -> bool:
         with self._lock:
