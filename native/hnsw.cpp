@@ -354,20 +354,21 @@ struct Index {
     }
     by_doc.erase(it);
     // move entrypoint if it was deleted (findNewGlobalEntrypoint, delete.go:422)
-    if (internal == entrypoint) {
-      for (int32_t l = max_level; l >= 0; --l) {
-        for (uint32_t i = 0; i < n_nodes(); ++i) {
-          if (!tombstone[i] && levels[i] >= l) {
-            entrypoint = i;
-            max_level = levels[i];
-            return true;
-          }
-        }
-      }
-      entrypoint = UINT32_MAX;
-      max_level = -1;
-    }
+    if (internal == entrypoint) find_new_entrypoint();
     return true;
+  }
+
+  // findNewGlobalEntrypoint (delete.go:422): highest live node, or none.
+  void find_new_entrypoint() {
+    entrypoint = UINT32_MAX;
+    max_level = -1;
+    const uint32_t n = n_nodes();
+    for (uint32_t i = 0; i < n; ++i) {
+      if (!tombstone[i] && levels[i] > max_level) {
+        max_level = levels[i];
+        entrypoint = i;
+      }
+    }
   }
 
   // Tombstone cleanup cycle (CleanUpTombstonedNodes, delete.go:177):
@@ -444,14 +445,7 @@ struct Index {
     }
 
     // 2. new entrypoint among live nodes
-    entrypoint = UINT32_MAX;
-    max_level = -1;
-    for (uint32_t i = 0; i < n; ++i) {
-      if (!tombstone[i] && levels[i] > max_level) {
-        max_level = levels[i];
-        entrypoint = i;
-      }
-    }
+    find_new_entrypoint();
 
     // 3. physical compaction with id remap
     std::vector<uint32_t> remap(n, UINT32_MAX);

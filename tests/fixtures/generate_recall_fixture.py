@@ -1,0 +1,58 @@
+"""Regenerate the committed recall fixture bit-identically.
+
+Reference: adapters/repos/db/vector/hnsw/generate_recall_datasets.go + the
+hnswlib cross-check (test_recall_hnswlib.py) — a frozen dataset with exact
+ground truth that every index implementation is measured against
+(recall_test.go:32,137).
+
+The data is CLUSTERED (gaussian mixture), not uniform: uniform random
+high-dim data makes ANN trivially easy and PQ codebooks meaningless; the
+mixture gives the fixture teeth. Ground truth is exact float64 brute force.
+
+Run from the repo root:  python tests/fixtures/generate_recall_fixture.py
+"""
+
+import os
+
+import numpy as np
+
+N, D, NQ, K = 8192, 32, 200, 100
+N_CLUSTERS = 64
+SEED = 20260729
+
+
+def generate():
+    rng = np.random.default_rng(SEED)
+    centers = rng.standard_normal((N_CLUSTERS, D)).astype(np.float64) * 4.0
+    assign = rng.integers(0, N_CLUSTERS, N)
+    vectors = centers[assign] + rng.standard_normal((N, D))
+    q_assign = rng.integers(0, N_CLUSTERS, NQ)
+    queries = centers[q_assign] + rng.standard_normal((NQ, D)) * 1.2
+
+    # exact ground truth in float64 (l2-squared)
+    gt = np.empty((NQ, K), np.int32)
+    for i in range(NQ):
+        d = ((vectors - queries[i]) ** 2).sum(1)
+        gt[i] = np.argsort(d, kind="stable")[:K]
+
+    # cosine ground truth on the same data (normalized)
+    vn = vectors / np.linalg.norm(vectors, axis=1, keepdims=True)
+    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    gt_cos = np.empty((NQ, K), np.int32)
+    sims = qn @ vn.T
+    for i in range(NQ):
+        gt_cos[i] = np.argsort(-sims[i], kind="stable")[:K]
+
+    return (
+        vectors.astype(np.float32),
+        queries.astype(np.float32),
+        gt,
+        gt_cos,
+    )
+
+
+if __name__ == "__main__":
+    vectors, queries, gt, gt_cos = generate()
+    out = os.path.join(os.path.dirname(__file__), "recall_fixture.npz")
+    np.savez_compressed(out, vectors=vectors, queries=queries, gt=gt, gt_cos=gt_cos)
+    print(f"wrote {out}: vectors {vectors.shape}, queries {queries.shape}, gt {gt.shape}")

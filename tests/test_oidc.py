@@ -1,0 +1,146 @@
+"""OIDC validation against a fake issuer (discovery + JWKS key server).
+
+Reference test model: usecases/auth/authentication/oidc tests — a local
+key server stands in for the identity provider; tokens are minted with
+`cryptography` and verified by the pure-python RS256 path.
+"""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+from weaviate_tpu.auth.auth import Authenticator, Principal, UnauthorizedError
+from weaviate_tpu.auth.oidc import OIDCValidator
+from weaviate_tpu.config.config import AuthConfig
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode("ascii")
+
+
+class FakeIssuer:
+    def __init__(self):
+        self.keys = {"key-1": rsa.generate_private_key(public_exponent=65537, key_size=2048)}
+        self.jwks_fetches = 0
+
+        issuer_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/.well-known/openid-configuration":
+                    body = json.dumps({
+                        "issuer": issuer_self.url,
+                        "jwks_uri": f"{issuer_self.url}/jwks",
+                    }).encode()
+                elif self.path == "/jwks":
+                    issuer_self.jwks_fetches += 1
+                    keys = []
+                    for kid, priv in issuer_self.keys.items():
+                        pub = priv.public_key().public_numbers()
+                        keys.append({
+                            "kty": "RSA", "kid": kid, "alg": "RS256",
+                            "n": _b64url(pub.n.to_bytes((pub.n.bit_length() + 7) // 8, "big")),
+                            "e": _b64url(pub.e.to_bytes(3, "big").lstrip(b"\x00")),
+                        })
+                    body = json.dumps({"keys": keys}).encode()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def mint(self, kid="key-1", priv=None, **claims) -> str:
+        header = {"alg": "RS256", "typ": "JWT", "kid": kid}
+        base = {"iss": self.url, "sub": "alice", "aud": "wv-client",
+                "exp": time.time() + 3600}
+        base.update(claims)
+        signing = f"{_b64url(json.dumps(header).encode())}.{_b64url(json.dumps(base).encode())}"
+        key = priv or self.keys.get(kid) or next(iter(self.keys.values()))
+        sig = key.sign(signing.encode("ascii"), padding.PKCS1v15(), hashes.SHA256())
+        return f"{signing}.{_b64url(sig)}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def issuer():
+    iss = FakeIssuer()
+    yield iss
+    iss.close()
+
+
+def make_validator(issuer, **cfg_kw):
+    cfg = AuthConfig()
+    cfg.oidc.enabled = True
+    cfg.oidc.issuer = issuer.url
+    cfg.oidc.client_id = cfg_kw.pop("client_id", "wv-client")
+    cfg.oidc.username_claim = cfg_kw.pop("username_claim", "sub")
+    cfg.oidc.groups_claim = cfg_kw.pop("groups_claim", "groups")
+    return OIDCValidator(cfg.oidc), cfg
+
+
+def test_valid_token(issuer):
+    v, _ = make_validator(issuer)
+    p = v(issuer.mint(groups=["admins"]))
+    assert p.username == "alice"
+    assert p.groups == ["admins"]
+
+
+def test_forged_signature_rejected(issuer):
+    v, _ = make_validator(issuer)
+    attacker = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    with pytest.raises(UnauthorizedError, match="unknown key|signature"):
+        v(issuer.mint(kid="key-1", priv=attacker))
+
+
+def test_expired_and_claims_rejected(issuer):
+    v, _ = make_validator(issuer)
+    with pytest.raises(UnauthorizedError, match="expired"):
+        v(issuer.mint(exp=time.time() - 3600))
+    with pytest.raises(UnauthorizedError, match="issuer"):
+        v(issuer.mint(iss="https://evil.example"))
+    with pytest.raises(UnauthorizedError, match="audience"):
+        v(issuer.mint(aud="other-client"))
+    with pytest.raises(UnauthorizedError, match="alg|malformed"):
+        v("e30." + _b64url(b'{"sub":"x"}') + ".sig")  # alg-less header
+
+
+def test_key_rotation_refetches(issuer):
+    v, _ = make_validator(issuer)
+    assert v(issuer.mint()).username == "alice"
+    fetches = issuer.jwks_fetches
+    # rotate: new kid appears at the issuer
+    issuer.keys["key-2"] = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    import weaviate_tpu.auth.oidc as oidc_mod
+
+    v._last_fetch -= oidc_mod._REFRESH_COOLDOWN + 1  # skip the cooldown
+    assert v(issuer.mint(kid="key-2")).username == "alice"
+    assert issuer.jwks_fetches == fetches + 1
+
+
+def test_authenticator_integration(issuer):
+    v, cfg = make_validator(issuer)
+    auth = Authenticator(cfg, oidc_validator=v)
+    p = auth.principal_from_bearer(issuer.mint())
+    assert isinstance(p, Principal) and p.username == "alice"
+    with pytest.raises(UnauthorizedError):
+        auth.principal_from_bearer("garbage")

@@ -124,6 +124,7 @@ class HnswIndex(VectorIndex):
         self._lock = threading.RLock()
         self.dim: Optional[int] = None
         self._h = None
+        self._cleanup_running = threading.Semaphore(1)  # one cycle at a time
         self._snapshot_path = os.path.join(shard_path, "hnsw.snapshot")
         self._log = VectorLog(os.path.join(shard_path, "hnsw.log")) if persist else None
         if persist:
@@ -218,10 +219,26 @@ class HnswIndex(VectorIndex):
     _CLEANUP_MIN_TOMBS = 1024
 
     def _maybe_cleanup(self) -> None:
+        """Kick the cleanup cycle off-thread when tombstone pressure crosses
+        the threshold: the triggering write returns immediately instead of
+        eating the O(n) repair inline (the reference's cyclemanager role).
+        Searches still serialize with the cycle on the index lock — the
+        native engine is single-writer by design — but no single caller is
+        singled out to pay for it."""
         phys = int(self._lib.hnsw_node_count(self._h))
         live = int(self._lib.hnsw_size(self._h))
-        if phys - live >= max(self._CLEANUP_MIN_TOMBS, live):
-            self._lib.hnsw_cleanup(self._h)
+        if phys - live < max(self._CLEANUP_MIN_TOMBS, live):
+            return
+        if self._cleanup_running.acquire(blocking=False):
+            def run():
+                try:
+                    with self._lock:
+                        if self._h is not None:
+                            self._lib.hnsw_cleanup(self._h)
+                finally:
+                    self._cleanup_running.release()
+
+            threading.Thread(target=run, daemon=True, name="hnsw-cleanup").start()
 
     def delete(self, *doc_ids: int) -> None:
         with self._lock:

@@ -115,7 +115,14 @@ class App:
             max_concurrent=self.config.maximum_concurrent_get_requests)
         self.aggregator = Aggregator(self.db, self.schema, self.explorer)
         self.graphql = GraphQLExecutor(self.traverser, self.aggregator, self.schema, self.db)
-        self.authenticator = Authenticator(self.config.auth)
+        oidc_validator = None
+        if self.config.auth.oidc.enabled:
+            from weaviate_tpu.auth.oidc import OIDCValidator
+
+            oidc_validator = OIDCValidator(self.config.auth.oidc)
+        self.authenticator = Authenticator(
+            self.config.auth, oidc_validator=oidc_validator
+        )
         self.authorizer = Authorizer(self.config.authz)
         from weaviate_tpu.usecases.backup import BackupScheduler
 
