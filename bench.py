@@ -1,19 +1,30 @@
-"""Headline benchmark: batched kNN QPS on a SIFT1M-shaped workload.
+"""Headline benchmark: batched kNN on a SIFT1M-shaped workload.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Workload mirrors BASELINE.md config #1/#5: 1M x 128 float32 clustered
-vectors (SIFT1M shape and cluster structure), L2, k=10, 256..1024-query
-batches — the reference's SIFT harness (test/benchmark/benchmark_sift.go:
-l2, efC=64, maxConn=64) and the gRPC 256-query batched-kNN config.
+vectors (SIFT1M shape and cluster structure), L2, k=10, 16384-query batches
+— the reference's SIFT harness (test/benchmark/benchmark_sift.go: l2,
+efC=64, maxConn=64) scaled to the batch-first serving path.
 
-vs_baseline = TPU QPS / CPU-HNSW QPS at recall@10 >= 0.95. The CPU baseline
-is our native C++ HNSW engine (the same role the reference's Go HNSW plays),
-measured on the same data distribution and cached in baseline_cpu.json
-(re-measure with BENCH_MEASURE_CPU=1 — it builds a graph, which takes
-minutes and doesn't affect query QPS, so it is not re-run every bench).
-TPU recall@10 is measured against exact ground truth every run and must be
->= 0.95 (it is 1.0: the device index is exact at f32).
+The measured serving path is the depth-2 PIPELINED dispatch (the gRPC
+BatchSearch shape: batch i+1's upload hides behind batch i's compute).
+Recall@10 is measured against exact numpy float32 ground truth on 1024
+queries every run; the device path is a fast-scan + exact-rescore (recall
+1.0 measured).
+
+vs_baseline = TPU QPS / CPU-HNSW QPS at recall@10 >= 0.95, where the CPU
+baseline is the native C++ HNSW engine (the role the reference's Go HNSW
+plays) measured on the SAME n=1M data with a MULTI-THREADED (OpenMP) query
+loop on this host's cores, cached in baseline_cpu.json (re-measure with
+BENCH_MEASURE_CPU=1; the graph build takes ~1h at 1M and does not affect
+query QPS). Because the bench host exposes a single CPU core, the baseline
+file also carries an 8-core linear extrapolation (the CPU's best case);
+the ratio against that appears as vs_baseline_8core_equiv so both the
+measured-hardware and scaled-CPU comparisons are visible.
+
+BENCH_MATRIX=1 additionally measures BASELINE.md configs 2-5 (cosine,
+filtered, PQ, gRPC 256-query batch latency) and writes bench_matrix.json.
 """
 
 import json
@@ -27,11 +38,12 @@ N = int(os.environ.get("BENCH_N", 1_000_000))
 DIM = int(os.environ.get("BENCH_DIM", 128))
 B = int(os.environ.get("BENCH_BATCH", 16384))
 K = 10
-N_QUERY_BATCHES = int(os.environ.get("BENCH_QUERY_BATCHES", 6))
-N_GT = 64  # queries used for recall ground truth
+N_QUERY_BATCHES = int(os.environ.get("BENCH_QUERY_BATCHES", 8))
+N_GT = int(os.environ.get("BENCH_GT", 1024))  # queries with exact ground truth
 N_CLUSTERS = 1024
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline_cpu.json")
-CPU_N = int(os.environ.get("BENCH_CPU_N", 100_000))
+MATRIX_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_matrix.json")
+CPU_N = int(os.environ.get("BENCH_CPU_N", 1_000_000))
 
 
 def log(msg):
@@ -46,23 +58,49 @@ def make_data(n, dim, rng):
     return vecs
 
 
-def exact_gt(vecs, queries, k):
-    gt = []
-    for q in queries:
-        d = ((vecs - q) ** 2).sum(1)
-        gt.append(np.argpartition(d, k)[:k][np.argsort(d[np.argpartition(d, k)[:k]])])
-    return gt
+def exact_gt(vecs, queries, k, metric="l2"):
+    """Exact numpy ground truth via chunked BLAS matmul (f32)."""
+    out = []
+    norms = (vecs.astype(np.float32) ** 2).sum(1)
+    step = 256
+    for s in range(0, len(queries), step):
+        q = queries[s : s + step].astype(np.float32)
+        if metric == "l2":
+            d = (q ** 2).sum(1, keepdims=True) - 2.0 * (q @ vecs.T) + norms[None, :]
+        else:  # cosine on normalized rows
+            d = 1.0 - q @ vecs.T
+        part = np.argpartition(d, k, axis=1)[:, :k]
+        for i in range(q.shape[0]):
+            row = part[i][np.argsort(d[i, part[i]], kind="stable")]
+            out.append(row)
+    return out
+
+
+def recall_at_k(ids, gt, k):
+    hits = 0
+    for i, want in enumerate(gt):
+        hits += len(set(int(x) for x in ids[i][:k]) & set(want.tolist()))
+    return hits / (len(gt) * k)
 
 
 def measure_cpu_baseline(rng):
-    """CPU HNSW (native C++ engine) QPS at recall@10 >= 0.95 on CPU_N points,
-    reference SIFT params (efC=64, maxConn=64), ef swept upward to recall."""
+    """CPU HNSW (native C++ engine) QPS at recall@10 >= 0.95 on CPU_N points
+    (default 1M — same data size the TPU is measured on), reference SIFT
+    params (efC=64, maxConn=64), ef swept upward until recall.
+
+    The query loop is MULTI-THREADED: hnsw_search_batch fans queries over an
+    OpenMP parallel-for with per-thread visited lists (the reference serves
+    queries on all cores via goroutines). On hosts with fewer than 8 cores
+    the baseline is additionally extrapolated LINEARLY to 8 cores — the
+    CPU's best case (HNSW query scaling is sublinear in practice), recorded
+    separately so both comparisons stay visible."""
     from weaviate_tpu.entities import vectorindex as vi
     from weaviate_tpu.index.hnsw import HnswIndex
 
+    cores = os.cpu_count() or 1
     vecs = make_data(CPU_N, DIM, rng)
-    queries = rng.standard_normal((256, DIM), dtype=np.float32) * 0.1 + vecs[
-        rng.integers(0, CPU_N, 256)
+    queries = rng.standard_normal((512, DIM), dtype=np.float32) * 0.1 + vecs[
+        rng.integers(0, CPU_N, 512)
     ]
     cfg = vi.HnswUserConfig.from_dict(
         {"distance": vi.DISTANCE_L2, "efConstruction": 64, "maxConnections": 64}, "hnsw"
@@ -73,23 +111,24 @@ def measure_cpu_baseline(rng):
     idx.add_batch(np.arange(CPU_N), vecs)
     build_s = time.perf_counter() - t0
     log(f"built in {build_s:.0f}s ({CPU_N/build_s:.0f} vec/s)")
-    gt = exact_gt(vecs, queries[:32], K)
+    gt = exact_gt(vecs, queries[:64], K)
     result = None
     for ef in (64, 128, 256, 512, 1024):
         idx.config.ef = ef
+        idx.search_by_vectors(queries[:64], K)  # warm caches
         t0 = time.perf_counter()
         ids, _ = idx.search_by_vectors(queries, K)
-        qps = 256 / (time.perf_counter() - t0)
-        hits = sum(
-            len(set(int(x) for x in ids[i][:K]) & set(gt[i].tolist())) for i in range(32)
-        )
-        recall = hits / (32 * K)
-        log(f"  ef={ef}: {qps:.0f} QPS, recall@10={recall:.3f}")
+        qps = len(queries) / (time.perf_counter() - t0)
+        recall = recall_at_k(ids, gt, K)
+        log(f"  ef={ef}: {qps:.0f} QPS ({cores} cores), recall@10={recall:.3f}")
         result = {"ef": ef, "qps": qps, "recall": recall}
         if recall >= 0.95:
             break
     out = {
-        "comparator": "native C++ HNSW (weaviate_tpu.index.hnsw), single-thread",
+        "comparator": (
+            "native C++ HNSW (weaviate_tpu.index.hnsw), multi-threaded "
+            f"(OpenMP batch query loop over {cores} core(s))"
+        ),
         "n": CPU_N,
         "dim": DIM,
         "k": K,
@@ -97,15 +136,185 @@ def measure_cpu_baseline(rng):
         "maxConnections": 64,
         "build_seconds": round(build_s, 1),
         "qps": round(result["qps"], 1),
+        "cores": cores,
+        "qps_8core_equiv": round(result["qps"] * max(1.0, 8.0 / cores), 1),
         "recall": round(result["recall"], 4),
         "ef": result["ef"],
-        "note": "measured at n=%d; HNSW QPS decreases with n, so using it as the 1M baseline is conservative in the TPU's favor"
-        % CPU_N,
+        "note": (
+            f"multi-threaded, n={CPU_N}, measured on {cores} core(s); "
+            "qps_8core_equiv = linear extrapolation to 8 cores (the CPU's "
+            "best case)"
+        ),
     }
     with open(BASELINE_FILE, "w") as f:
         json.dump(out, f, indent=1)
-    log(f"wrote {BASELINE_FILE}: {out['qps']} QPS @ recall {out['recall']}")
+    log(f"wrote {BASELINE_FILE}: {out['qps']} QPS measured / {out['qps_8core_equiv']} 8-core-equiv")
     return out
+
+
+def _build_index(vecs, metric="l2-squared", pq=None):
+    from weaviate_tpu.entities import vectorindex as vi
+    from weaviate_tpu.index.tpu import TpuVectorIndex
+
+    d = {"distance": metric}
+    if pq:
+        d["pq"] = pq
+    cfg = vi.HnswUserConfig.from_dict(d, "hnsw_tpu")
+    idx = TpuVectorIndex(cfg, "/tmp/bench_shard", persist=False)
+    t0 = time.perf_counter()
+    idx.add_batch(np.arange(len(vecs)), vecs)
+    idx.flush()
+    return idx, time.perf_counter() - t0
+
+
+def _measure_pipelined(idx, queries, k, n_batches):
+    """Depth-2 pipelined dispatch — the serving path."""
+    idx.search_by_vectors(queries, k)  # compile + warm
+    t0 = time.perf_counter()
+    pending = idx.search_by_vectors_async(queries, k)
+    for _ in range(n_batches - 1):
+        nxt = idx.search_by_vectors_async(queries, k)
+        pending()
+        pending = nxt
+    pending()
+    per_batch = (time.perf_counter() - t0) / n_batches
+    return queries.shape[0] / per_batch, per_batch
+
+
+def _measure_sync(idx, queries, k, n_batches):
+    idx.search_by_vectors(queries, k)
+    times = []
+    ids = None
+    for _ in range(n_batches):
+        t0 = time.perf_counter()
+        ids, _ = idx.search_by_vectors(queries, k)
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    return queries.shape[0] / med, med, ids
+
+
+def run_matrix(rng, vecs, queries, idx_l2, gt):
+    """BASELINE.md configs 2-5."""
+    from weaviate_tpu.storage.bitmap import Bitmap
+
+    results = {}
+
+    # config 3: filtered ANN (10% allowList -> masked device bitmap path)
+    log("matrix: filtered ANN (10% allowList)...")
+    mask = rng.random(N) < 0.10
+    allow = Bitmap(np.nonzero(mask)[0].astype(np.uint64))
+    idx_l2.search_by_vectors(queries, K, allow_list=allow)
+    t0 = time.perf_counter()
+    ids, _ = idx_l2.search_by_vectors(queries, K, allow_list=allow)
+    f_time = time.perf_counter() - t0
+    sub = np.nonzero(mask)[0]
+    gt_f = exact_gt(vecs[sub], queries[:128], K)
+    sentinel = np.iinfo(np.uint64).max
+    hits = sum(
+        len(set(int(x) for x in ids[i][:K] if x != sentinel)
+            & set(sub[gt_f[i]].tolist()))
+        for i in range(128)
+    )
+    results["filtered_10pct"] = {
+        "qps": round(B / f_time, 1),
+        "recall@10": round(hits / (128 * K), 4),
+    }
+
+    # config 4: PQ-compressed (segments=32, device LUT scan + f32 rescoring)
+    log("matrix: PQ (segments=32, rescored)...")
+    idx_pq, _ = _build_index(vecs, pq={"enabled": False, "segments": 32, "centroids": 256})
+    t0 = time.perf_counter()
+    idx_pq.compress()
+    fit_s = time.perf_counter() - t0
+    qps_pq, med_pq, ids_pq = _measure_sync(idx_pq, queries, K, 4)
+    results["pq_seg32_rescored"] = {
+        "qps": round(qps_pq, 1),
+        "recall@10": round(recall_at_k(ids_pq, gt, K), 4),
+        "fit_seconds": round(fit_s, 1),
+    }
+    idx_pq.drop()
+    del idx_pq
+
+    # config 2: cosine (glove-100-angular shape)
+    log("matrix: cosine d=100...")
+    vecs_cos = make_data(N, 100, rng)
+    vecs_cos /= np.linalg.norm(vecs_cos, axis=1, keepdims=True)
+    q_cos = vecs_cos[rng.integers(0, N, B)] + 0.05 * rng.standard_normal((B, 100), dtype=np.float32)
+    idx_cos, _ = _build_index(vecs_cos, metric="cosine")
+    qps_cos, med_cos, ids_cos = _measure_sync(idx_cos, q_cos, K, 4)
+    qn = q_cos[:128] / np.linalg.norm(q_cos[:128], axis=1, keepdims=True)
+    gt_cos = exact_gt(vecs_cos, qn, K, metric="cosine")
+    results["cosine_d100"] = {
+        "qps": round(qps_cos, 1),
+        "recall@10": round(recall_at_k(ids_cos, gt_cos, K), 4),
+    }
+    idx_cos.drop()
+    del idx_cos
+
+    # config 5: gRPC 256-query batched kNN end-to-end (p50 latency)
+    log("matrix: gRPC 256-query batch e2e (n=100k objects)...")
+    results["grpc_batch256"] = _grpc_e2e(rng)
+
+    with open(MATRIX_FILE, "w") as f:
+        json.dump(results, f, indent=1)
+    log(f"wrote {MATRIX_FILE}: {json.dumps(results)}")
+    return results
+
+
+def _grpc_e2e(rng, n=100_000):
+    """Full-stack 256-query BatchSearch over real gRPC (serialization + REST
+    object store hydration included), p50 batch latency."""
+    import tempfile
+    import uuid as uuidlib
+
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server import App
+    from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
+
+    app = App(data_path=tempfile.mkdtemp(prefix="benchgrpc"))
+    app.schema.add_class({
+        "class": "Bench", "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "tag", "dataType": ["text"]}],
+    })
+    idx = app.db.get_index("Bench")
+    vecs = make_data(n, DIM, rng)
+    from weaviate_tpu.entities.storobj import StorObj
+
+    objs = [
+        StorObj(class_name="Bench", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"tag": f"t{i % 32}"}, vector=vecs[i])
+        for i in range(n)
+    ]
+    t0 = time.perf_counter()
+    for s in range(0, n, 10_000):
+        idx.put_batch(objs[s : s + 10_000])
+    import_s = time.perf_counter() - t0
+    srv = GrpcServer(app, port=0)
+    srv.start()
+    client = SearchClient(f"127.0.0.1:{srv.port}")
+    qs = vecs[rng.integers(0, n, 256)] + 0.05 * rng.standard_normal((256, DIM), dtype=np.float32)
+    req = pb.BatchSearchRequest(requests=[
+        pb.SearchRequest(class_name="Bench", limit=K,
+                         near_vector=pb.NearVectorParams(vector=q.tolist()))
+        for q in qs
+    ])
+    client.batch_search(req)  # warm
+    lats = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        reply = client.batch_search(req)
+        lats.append(time.perf_counter() - t0)
+    p50 = float(np.median(lats))
+    ok = sum(1 for r in reply.replies if len(r.results) == K)
+    client.close()
+    srv.stop()
+    app.shutdown()
+    return {
+        "n": n, "batch": 256, "p50_ms": round(p50 * 1000, 1),
+        "qps_e2e": round(256 / p50, 1), "complete_replies": ok,
+        "import_seconds": round(import_s, 1),
+    }
 
 
 def main():
@@ -116,80 +325,62 @@ def main():
 
     import jax
 
-    from weaviate_tpu.entities import vectorindex as vi
-    from weaviate_tpu.index.tpu import TpuVectorIndex
-
     log(f"generating {N}x{DIM} clustered vectors...")
     vecs = make_data(N, DIM, rng)
     queries = rng.standard_normal((B, DIM), dtype=np.float32) * 0.1 + vecs[
         rng.integers(0, N, B)
     ]
 
-    cfg = vi.HnswUserConfig.from_dict({"distance": vi.DISTANCE_L2}, "hnsw_tpu")
-    idx = TpuVectorIndex(cfg, "/tmp/bench_shard", persist=False)
-
-    t0 = time.perf_counter()
-    idx.add_batch(np.arange(N), vecs)
-    idx.flush()
-    import_s = time.perf_counter() - t0
+    idx, import_s = _build_index(vecs)
     log(f"import: {import_s:.1f}s ({N/import_s:.0f} vec/s) on {jax.devices()[0]}")
 
-    # warmup + compile
-    ids, dists = idx.search_by_vectors(queries, K)
+    qps_sync, med, ids = _measure_sync(idx, queries, K, N_QUERY_BATCHES)
+    log(f"TPU batched kNN (sync): {qps_sync:.0f} QPS (median {med*1000:.1f} ms / {B}-query batch)")
 
-    # median per-batch time: the relay's per-call latency is noisy (2x swings
-    # between runs); the median reflects steady-state device throughput
-    times = []
-    for _ in range(N_QUERY_BATCHES):
-        t0 = time.perf_counter()
-        ids, dists = idx.search_by_vectors(queries, K)
-        times.append(time.perf_counter() - t0)
-    med = float(np.median(times))
-    log(
-        f"TPU batched kNN (sync): {B/med:.0f} QPS (median {med*1000:.1f} ms, "
-        f"min {min(times)*1000:.1f} ms / {B}-query batch)"
-    )
+    qps_pipe, per_batch = _measure_pipelined(idx, queries, K, N_QUERY_BATCHES)
+    log(f"TPU batched kNN (pipelined, serving path): {qps_pipe:.0f} QPS ({per_batch*1000:.1f} ms/batch)")
 
-    # depth-2 pipelined throughput: dispatch batch i+1 before finalizing
-    # batch i so the host->device query upload hides behind device compute
-    t0 = time.perf_counter()
-    pending = idx.search_by_vectors_async(queries, K)
-    for _ in range(N_QUERY_BATCHES - 1):
-        nxt = idx.search_by_vectors_async(queries, K)
-        pending()
-        pending = nxt
-    pending()
-    pipel = (time.perf_counter() - t0) / N_QUERY_BATCHES
-    qps = B / med  # headline = sync path (the one recall is measured on)
-    log(f"TPU batched kNN (pipelined): {B/pipel:.0f} QPS ({pipel*1000:.1f} ms/batch)")
-
+    log(f"computing exact ground truth on {N_GT} queries...")
     gt = exact_gt(vecs, queries[:N_GT], K)
-    hits = sum(len(set(int(x) for x in ids[i][:K]) & set(gt[i].tolist())) for i in range(N_GT))
-    recall = hits / (N_GT * K)
-    log(f"recall@10 = {recall:.4f}")
+    recall = recall_at_k(ids, gt, K)
+    log(f"recall@10 = {recall:.4f} ({N_GT} queries)")
 
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
             cpu = json.load(f)
         cpu_qps = cpu["qps"]
-        base_note = f"CPU HNSW ef={cpu['ef']}"
+        cpu_8core = cpu.get("qps_8core_equiv", cpu_qps)
+        cores = cpu.get("cores", "?")
+        base_note = (
+            f"CPU HNSW n={cpu['n']} ef={cpu['ef']} multi-threaded on "
+            f"{cores} core(s)"
+        )
     else:
-        # fallback: numpy brute force, single queries
         nb = 4
         t0 = time.perf_counter()
         for i in range(nb):
             d = ((vecs - queries[i]) ** 2).sum(1)
             np.argpartition(d, K)[:K]
-        cpu_qps = nb / (time.perf_counter() - t0)
+        cpu_qps = cpu_8core = nb / (time.perf_counter() - t0)
         base_note = "numpy brute force"
-    log(f"baseline ({base_note}): {cpu_qps:.1f} QPS")
+    log(f"baseline ({base_note}): {cpu_qps:.1f} QPS measured, {cpu_8core:.1f} 8-core-equiv")
 
     out = {
-        "metric": f"batched kNN QPS (N={N}, d={DIM}, k={K}, batch={B}, L2, recall@10={recall:.3f}, baseline={base_note})",
-        "value": round(qps, 1),
+        "metric": (
+            f"pipelined batched kNN QPS (N={N}, d={DIM}, k={K}, batch={B}, L2, "
+            f"recall@10={recall:.3f} on {N_GT} queries vs exact GT, "
+            f"baseline={base_note})"
+        ),
+        "value": round(qps_pipe, 1),
         "unit": "qps",
-        "vs_baseline": round(qps / cpu_qps, 1),
+        "vs_baseline": round(qps_pipe / cpu_qps, 1),
+        "vs_baseline_8core_equiv": round(qps_pipe / cpu_8core, 1),
+        "sync_qps": round(qps_sync, 1),
     }
+
+    if os.environ.get("BENCH_MATRIX"):
+        run_matrix(rng, vecs, queries, idx, gt)
+
     print(json.dumps(out))
 
 

@@ -4,5 +4,5 @@ set -e
 cd "$(dirname "$0")"
 OUT_DIR="../weaviate_tpu/_native"
 mkdir -p "$OUT_DIR"
-g++ -O3 -march=native -std=c++17 -shared -fPIC -o "$OUT_DIR/libhnsw.so" hnsw.cpp
+g++ -O3 -march=native -std=c++17 -fopenmp -shared -fPIC -o "$OUT_DIR/libhnsw.so" hnsw.cpp
 echo "built $OUT_DIR/libhnsw.so"

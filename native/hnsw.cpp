@@ -76,6 +76,22 @@ struct CmpMax {  // max-heap by distance
 using MinHeap = std::priority_queue<Candidate, std::vector<Candidate>, CmpMin>;
 using MaxHeap = std::priority_queue<Candidate, std::vector<Candidate>, CmpMax>;
 
+// Epoch-versioned visited list (visited/list_set.go:34), one per searching
+// thread so batch searches can run in parallel over a read-only graph.
+struct Visited {
+  std::vector<uint32_t> v;
+  uint32_t epoch = 0;
+  void begin(size_t n) {
+    if (v.size() < n) v.resize(n, 0);
+    if (++epoch == 0) {
+      std::fill(v.begin(), v.end(), 0);
+      epoch = 1;
+    }
+  }
+  inline bool seen(uint32_t i) const { return v[i] == epoch; }
+  inline void mark(uint32_t i) { v[i] = epoch; }
+};
+
 struct Index {
   int32_t dim;
   Metric metric;
@@ -94,9 +110,7 @@ struct Index {
   uint32_t entrypoint = UINT32_MAX;
   int32_t max_level = -1;
 
-  // epoch-versioned visited list (visited/list_set.go:34)
-  std::vector<uint32_t> visited;
-  uint32_t visit_epoch = 0;
+  Visited vis_main;  // writer-path visited list (insert is single-threaded)
 
   int64_t live = 0;
 
@@ -118,16 +132,6 @@ struct Index {
 
   inline int32_t cap_at(int32_t level) const { return level == 0 ? 2 * max_conn : max_conn; }
 
-  void begin_visit() {
-    if (++visit_epoch == 0) {  // wrapped: reset
-      std::fill(visited.begin(), visited.end(), 0);
-      visit_epoch = 1;
-    }
-    visited.resize(doc_ids.size(), 0);
-  }
-  inline bool seen(uint32_t i) { return visited[i] == visit_epoch; }
-  inline void mark(uint32_t i) { visited[i] = visit_epoch; }
-
   int32_t random_level() {
     std::uniform_real_distribution<double> u(0.0, 1.0);
     double r = u(rng);
@@ -140,11 +144,12 @@ struct Index {
   // allow/tombstones are applied to RESULT admission only; traversal crosses
   // every node.
   void search_layer(const float* q, uint32_t ep, int32_t ef, int32_t level,
-                    const SortedU64& allow, bool skip_tombs, MaxHeap& results) {
-    begin_visit();
+                    const SortedU64& allow, bool skip_tombs, MaxHeap& results,
+                    Visited& vis) {
+    vis.begin(doc_ids.size());
     MinHeap candidates;
     const float dep = dist(q, vec(ep));
-    mark(ep);
+    vis.mark(ep);
     candidates.push({dep, ep});
     const bool ep_ok = (!skip_tombs || !tombstone[ep]) && (!allow.active() || allow.contains(doc_ids[ep]));
     if (ep_ok) results.push({dep, ep});
@@ -157,8 +162,8 @@ struct Index {
       candidates.pop();
       if (level < static_cast<int32_t>(links[c.id].size())) {
         for (uint32_t nb : links[c.id][level]) {
-          if (seen(nb)) continue;
-          mark(nb);
+          if (vis.seen(nb)) continue;
+          vis.mark(nb);
           const float dn = dist(q, vec(nb));
           const bool admit = (!skip_tombs || !tombstone[nb]) &&
                              (!allow.active() || allow.contains(doc_ids[nb]));
@@ -262,7 +267,8 @@ struct Index {
     SortedU64 no_filter;
     for (int32_t l = std::min(lvl, max_level); l >= 0; --l) {
       MaxHeap res;
-      search_layer(v, ep, ef_construction, l, no_filter, /*skip_tombs=*/false, res);
+      search_layer(v, ep, ef_construction, l, no_filter, /*skip_tombs=*/false, res,
+                   vis_main);
       std::vector<Candidate> cands;
       cands.reserve(res.size());
       while (!res.empty()) {
@@ -287,7 +293,7 @@ struct Index {
   }
 
   int32_t knn(const float* q, int32_t k, int32_t ef, const SortedU64& allow,
-              uint64_t* out_ids, float* out_dists) {
+              uint64_t* out_ids, float* out_dists, Visited& vis) {
     if (entrypoint == UINT32_MAX || live == 0) return 0;
     if (ef < k) ef = k;
     uint32_t ep = entrypoint;
@@ -309,7 +315,7 @@ struct Index {
       }
     }
     MaxHeap res;
-    search_layer(q, ep, ef, 0, allow, /*skip_tombs=*/true, res);
+    search_layer(q, ep, ef, 0, allow, /*skip_tombs=*/true, res, vis);
     while (static_cast<int32_t>(res.size()) > k) res.pop();
     const int32_t n = static_cast<int32_t>(res.size());
     for (int32_t i = n - 1; i >= 0; --i) {
@@ -478,8 +484,7 @@ struct Index {
     levels = std::move(new_levels);
     links = std::move(new_links);
     tombstone.assign(n_new, 0);
-    visited.assign(n_new, 0);
-    visit_epoch = 0;
+    vis_main = Visited{};
     by_doc.clear();
     for (uint32_t i = 0; i < n_new; ++i) by_doc[doc_ids[i]] = i;
     live = n_new;
@@ -621,20 +626,30 @@ int64_t hnsw_size(void* h) { return static_cast<Index*>(h)->live; }
 
 int32_t hnsw_search(void* h, const float* q, int32_t k, int32_t ef, const uint64_t* allow,
                     int64_t allow_n, uint64_t* out_ids, float* out_dists) {
+  Index* ix = static_cast<Index*>(h);
   SortedU64 a{allow, allow_n};
-  return static_cast<Index*>(h)->knn(q, k, ef, a, out_ids, out_dists);
+  return ix->knn(q, k, ef, a, out_ids, out_dists, ix->vis_main);
 }
 
-// batch search: out arrays are [b, k]; returns counts per query in out_counts
+// Batch search: out arrays are [b, k]; per-query result counts in out_counts.
+// Parallelized with OpenMP over queries — the graph is read-only during
+// search (the Python layer serializes writes), and each thread carries its
+// own visited list, the multi-core query loop the reference gets from
+// goroutine-per-request concurrency.
 void hnsw_search_batch(void* h, const float* qs, int32_t b, int32_t k, int32_t ef,
                        const uint64_t* allow, int64_t allow_n, uint64_t* out_ids,
                        float* out_dists, int32_t* out_counts) {
   Index* ix = static_cast<Index*>(h);
   SortedU64 a{allow, allow_n};
-  for (int32_t i = 0; i < b; ++i) {
-    out_counts[i] = ix->knn(qs + static_cast<size_t>(i) * ix->dim, k, ef, a,
-                            out_ids + static_cast<size_t>(i) * k,
-                            out_dists + static_cast<size_t>(i) * k);
+#pragma omp parallel
+  {
+    Visited vis;
+#pragma omp for schedule(dynamic, 8)
+    for (int32_t i = 0; i < b; ++i) {
+      out_counts[i] = ix->knn(qs + static_cast<size_t>(i) * ix->dim, k, ef, a,
+                              out_ids + static_cast<size_t>(i) * k,
+                              out_dists + static_cast<size_t>(i) * k, vis);
+    }
   }
 }
 

@@ -274,3 +274,35 @@ def test_aggregate_api_direct(tmp_path):
     assert out["when"]["maximum"].startswith("2023-05-01")
     assert out["n"]["median"] == 2.0
     db.shutdown()
+
+
+def test_introspection_schema(gql):
+    """__schema reflects the live data schema per class/property (the
+    reference rebuilds its GraphQL schema on every schema change)."""
+    ex = gql[0]
+    res = ex.execute(
+        "{ __schema { queryType { name } types { name kind fields { name } } } }"
+    )
+    assert "errors" not in res, res
+    sch = res["data"]["__schema"]
+    assert sch["queryType"]["name"] == "WeaviateQuery"
+    by_name = {t["name"]: t for t in sch["types"]}
+    assert "Article" in by_name
+    fields = {f["name"] for f in by_name["Article"]["fields"]}
+    assert {"title", "wordCount", "_additional"} <= fields
+    assert "GetObjectsObj" in by_name
+    assert {f["name"] for f in by_name["GetObjectsObj"]["fields"]} >= {"Article"}
+
+
+def test_introspection_type_lookup(gql):
+    ex = gql[0]
+    res = ex.execute(
+        '{ __type(name: "Article") { name kind fields { name type { kind name ofType { name } } } } }'
+    )
+    assert "errors" not in res, res
+    t = res["data"]["__type"]
+    assert t["name"] == "Article" and t["kind"] == "OBJECT"
+    ftypes = {f["name"]: f["type"] for f in t["fields"]}
+    assert ftypes["wordCount"]["name"] == "Int"
+    res2 = ex.execute('{ __type(name: "Nope") { name } }')
+    assert res2["data"]["__type"] is None

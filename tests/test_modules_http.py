@@ -1,0 +1,447 @@
+"""HTTP/SaaS module family: sidecar vectorizers, readers (qna/sum/ner/
+spellcheck), generative, media, and cloud backup backends — all driven
+against in-process fake services (the reference tests these modules against
+testcontainer sidecars; the fakes play that role here)."""
+
+import base64
+import json
+import threading
+import uuid as uuidlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.modules import Provider
+from weaviate_tpu.modules.text2vec_local import LocalTextVectorizer
+
+
+class FakeService:
+    """One fake server covering every sidecar + SaaS route."""
+
+    def __init__(self):
+        self.local = LocalTextVectorizer(dim=32)
+        self.requests = []
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _send(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/meta":
+                    return self._send({"model": "fake"})
+                self._send({}, 404)
+
+            def do_POST(self):
+                body = self._body()
+                svc.requests.append((self.path, body, dict(self.headers)))
+                if self.path == "/vectors":
+                    key = body.get("text") or body.get("image") or ""
+                    return self._send(
+                        {"vector": svc.local.vectorize_text([key])[0].tolist()})
+                if self.path == "/answers":
+                    has = "quantum" in body.get("text", "")
+                    return self._send({
+                        "answer": "qubits" if has else None,
+                        "certainty": 0.9 if has else None, "property": "body"})
+                if self.path == "/sum":
+                    return self._send({"summary": body.get("text", "")[:10] + "..."})
+                if self.path == "/ner":
+                    return self._send({"tokens": [
+                        {"entity": "MISC", "word": w}
+                        for w in body.get("text", "").split()[:2]]})
+                if self.path == "/spellcheck":
+                    return self._send({
+                        "text": body.get("text", ""), "didYouMean": "quantum",
+                        "numberOfCorrections": 1})
+                if self.path == "/vectorize":
+                    texts = body.get("texts") or []
+                    images = body.get("images") or []
+                    return self._send({
+                        "textVectors": [svc.local.vectorize_text([t])[0].tolist()
+                                        for t in texts],
+                        "imageVectors": [svc.local.vectorize_text([i])[0].tolist()
+                                         for i in images]})
+                if self.path == "/v1/embeddings":  # openai
+                    return self._send({"data": [
+                        {"index": i,
+                         "embedding": svc.local.vectorize_text([t])[0].tolist()}
+                        for i, t in enumerate(body.get("input", []))]})
+                if self.path == "/v1/embed":  # cohere
+                    return self._send({"embeddings": [
+                        svc.local.vectorize_text([t])[0].tolist()
+                        for t in body.get("texts", [])]})
+                if self.path.startswith("/pipeline/feature-extraction/"):  # hf
+                    return self._send([
+                        svc.local.vectorize_text([t])[0].tolist()
+                        for t in body.get("inputs", [])])
+                if self.path == "/v1/chat/completions":  # generative
+                    prompt = body["messages"][0]["content"]
+                    return self._send({"choices": [{"message": {
+                        "content": f"GEN[{prompt[:30]}]"}}]})
+                self._send({"error": "no route"}, 404)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture(scope="module")
+def svc():
+    s = FakeService()
+    yield s
+    s.close()
+
+
+def make_doc_class(vectorizer="text2vec-transformers"):
+    return ClassDef(
+        name="Doc",
+        properties=[Property(name="title", data_type=["text"]),
+                    Property(name="body", data_type=["text"])],
+        vectorizer=vectorizer,
+    )
+
+
+def obj(title, body="", cls="Doc"):
+    return StorObj(class_name=cls, uuid=str(uuidlib.uuid4()),
+                   properties={"title": title, "body": body})
+
+
+def test_transformers_vectorizer(svc):
+    from weaviate_tpu.modules.text2vec_http import TransformersVectorizer
+
+    v = TransformersVectorizer(svc.url)
+    vecs = v.vectorize_text(["hello world"])
+    assert vecs.shape == (1, 32)
+    out = v.vectorize_object(make_doc_class(), obj("quantum", "qubits"), {})
+    assert out is not None and out.shape == (32,)
+    assert v.meta().get("model") == "fake"
+
+
+def test_saas_vectorizers(svc):
+    from weaviate_tpu.modules.text2vec_http import (
+        CohereVectorizer,
+        HuggingFaceVectorizer,
+        OpenAIVectorizer,
+    )
+
+    oa = OpenAIVectorizer("sk-test", base_url=f"{svc.url}/v1")
+    assert oa.vectorize_text(["a", "b"]).shape == (2, 32)
+    # auth header actually sent
+    path, _, headers = svc.requests[-1]
+    assert headers.get("Authorization") == "Bearer sk-test"
+
+    co = CohereVectorizer("co-test", base_url=f"{svc.url}/v1")
+    assert co.vectorize_text(["a"]).shape == (1, 32)
+    hf = HuggingFaceVectorizer("hf-test", base_url=svc.url)
+    assert hf.vectorize_text(["a"]).shape == (1, 32)
+
+
+def _mk_app(tmp_path, provider):
+    from weaviate_tpu.server import App
+
+    return App(config=Config(), data_path=str(tmp_path / "data"), modules=provider)
+
+
+def test_qna_answer_through_graphql(svc, tmp_path):
+    from weaviate_tpu.modules.readers import QnATransformers
+
+    p = Provider()
+    p.register(LocalTextVectorizer())
+    p.register(QnATransformers(svc.url))
+    app = _mk_app(tmp_path, p)
+    try:
+        app.schema.add_class({
+            "class": "Doc", "vectorizer": "text2vec-local",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "title", "dataType": ["text"]},
+                           {"name": "body", "dataType": ["text"]}]})
+        app.objects.add({"class": "Doc", "properties": {
+            "title": "physics", "body": "quantum computers use qubits"}})
+        app.objects.add({"class": "Doc", "properties": {
+            "title": "baking", "body": "bread needs flour"}})
+        res = app.graphql.execute(
+            '{ Get { Doc(ask: {question: "what do quantum computers use?"},'
+            ' nearText: {concepts: ["quantum"]}, limit: 1)'
+            ' { title _additional { answer { result hasAnswer certainty } } } } }'
+        )
+        assert "errors" not in res, res
+        hit = res["data"]["Get"]["Doc"][0]
+        assert hit["title"] == "physics"
+        assert hit["_additional"]["answer"]["result"] == "qubits"
+        assert hit["_additional"]["answer"]["hasAnswer"] is True
+    finally:
+        app.shutdown()
+
+
+def test_generative_and_sum_and_ner(svc, tmp_path):
+    from weaviate_tpu.modules.readers import (
+        GenerativeOpenAI,
+        NerTransformers,
+        SumTransformers,
+    )
+
+    p = Provider()
+    p.register(LocalTextVectorizer())
+    p.register(GenerativeOpenAI("sk-gen", base_url=f"{svc.url}/v1"))
+    p.register(SumTransformers(svc.url))
+    p.register(NerTransformers(svc.url))
+    app = _mk_app(tmp_path, p)
+    try:
+        app.schema.add_class({
+            "class": "Doc", "vectorizer": "text2vec-local",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "title", "dataType": ["text"]},
+                           {"name": "body", "dataType": ["text"]}]})
+        app.objects.add({"class": "Doc", "properties": {
+            "title": "physics news", "body": "quantum entanglement discovery"}})
+        res = app.graphql.execute(
+            '{ Get { Doc(limit: 1) { title _additional {'
+            ' generate(singleResult: {prompt: "Summarize {title}"}) { singleResult }'
+            ' summary(properties: ["body"]) { property result }'
+            ' tokens { entity word } } } } }'
+        )
+        assert "errors" not in res, res
+        add = res["data"]["Get"]["Doc"][0]["_additional"]
+        assert add["generate"]["singleResult"].startswith("GEN[Summarize physics news")
+        assert add["summary"][0]["property"] == "body"
+        assert add["tokens"][0]["word"] == "physics"
+    finally:
+        app.shutdown()
+
+
+def test_media_modules(svc):
+    from weaviate_tpu.modules.media import Img2VecNeural, Multi2VecClip
+
+    img_b64 = base64.b64encode(b"\x89PNGfake").decode()
+    img_cls = ClassDef(name="Pic", vectorizer="img2vec-neural",
+                       properties=[Property(name="image", data_type=["blob"])])
+    pic = StorObj(class_name="Pic", uuid=str(uuidlib.uuid4()),
+                  properties={"image": img_b64})
+
+    iv = Img2VecNeural(svc.url)
+    v = iv.vectorize_object(img_cls, pic, {})
+    assert v.shape == (32,)
+
+    clip = Multi2VecClip(svc.url)
+    both_cls = ClassDef(name="Pic", vectorizer="multi2vec-clip",
+                        properties=[Property(name="caption", data_type=["text"]),
+                                    Property(name="image", data_type=["blob"])])
+    both = StorObj(class_name="Pic", uuid=str(uuidlib.uuid4()),
+                   properties={"caption": "a cat", "image": img_b64})
+    v2 = clip.vectorize_object(both_cls, both, {})
+    assert v2.shape == (32,)
+    assert abs(float(np.linalg.norm(v2)) - 1.0) < 1e-5
+    assert clip.vectorize_text(["a dog"]).shape == (1, 32)
+
+
+def test_near_image_query(svc, tmp_path):
+    from weaviate_tpu.modules.media import Img2VecNeural
+
+    p = Provider()
+    p.register(Img2VecNeural(svc.url))
+    app = _mk_app(tmp_path, p)
+    try:
+        app.schema.add_class({
+            "class": "Pic", "vectorizer": "img2vec-neural",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "image", "dataType": ["blob"]},
+                           {"name": "label", "dataType": ["text"]}]})
+        imgs = {}
+        for label in ("cat", "dog", "fish"):
+            b64 = base64.b64encode(f"IMG-{label}".encode()).decode()
+            imgs[label] = b64
+            app.objects.add({"class": "Pic",
+                             "properties": {"image": b64, "label": label}})
+        q = json.dumps(imgs["dog"])
+        res = app.graphql.execute(
+            '{ Get { Pic(nearImage: {image: %s}, limit: 1) { label } } }' % q)
+        assert "errors" not in res, res
+        assert res["data"]["Get"]["Pic"][0]["label"] == "dog"
+    finally:
+        app.shutdown()
+
+
+class FakeBlobStore:
+    """One fake server speaking enough S3 / GCS / Azure REST for the backends."""
+
+    def __init__(self):
+        self.objects = {}
+        self.auth_headers = []
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                store.objects[self.path.split("?")[0]] = self.rfile.read(n)
+                store.auth_headers.append(dict(self.headers))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_POST(self):  # gcs upload
+                n = int(self.headers.get("Content-Length") or 0)
+                store.objects[self.path] = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def do_GET(self):
+                data = store.objects.get(self.path.split("?")[0])
+                # gcs read paths differ from upload paths: match by suffix
+                if data is None:
+                    for k, v in store.objects.items():
+                        if k.split("name=")[-1] == self.path.split("/o/")[-1].split("?")[0]:
+                            data = v
+                            break
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_s3_backend_sigv4():
+    from weaviate_tpu.modules.backup_cloud import S3BackupBackend
+
+    store = FakeBlobStore()
+    try:
+        be = S3BackupBackend(bucket="bk", access_key="AKIATEST",
+                             secret_key="secret", endpoint=store.url)
+        be.put_object("b1", "node-0/C/s/vector.log", b"\x01\x02\x03")
+        assert be.get_object("b1", "node-0/C/s/vector.log") == b"\x01\x02\x03"
+        be.write_meta("b1", {"status": "SUCCESS"})
+        assert be.read_meta("b1")["status"] == "SUCCESS"
+        assert be.read_meta("ghost") is None
+        # SigV4 headers present on writes
+        h = store.auth_headers[-1]
+        assert h.get("Authorization", "").startswith("AWS4-HMAC-SHA256 Credential=AKIATEST/")
+        assert "x-amz-content-sha256" in {k.lower() for k in h}
+    finally:
+        store.close()
+
+
+def test_gcs_and_azure_backends():
+    from weaviate_tpu.modules.backup_cloud import AzureBackupBackend, GCSBackupBackend
+
+    store = FakeBlobStore()
+    try:
+        gcs = GCSBackupBackend(bucket="bk", token="tok", base_url=store.url)
+        gcs.write_meta("g1", {"status": "SUCCESS"})
+        assert gcs.read_meta("g1")["status"] == "SUCCESS"
+
+        az = AzureBackupBackend(account="acct", container="c",
+                                sas_token="sv=x&sig=y", base_url=store.url)
+        az.put_object("a1", "f.bin", b"zz")
+        assert az.get_object("a1", "f.bin") == b"zz"
+        az.write_meta("a1", {"status": "SUCCESS"})
+        assert az.read_meta("a1")["status"] == "SUCCESS"
+        assert az.read_meta("ghost") is None
+    finally:
+        store.close()
+
+
+def test_build_provider_full_registry(svc, monkeypatch):
+    from weaviate_tpu.modules.provider import build_provider
+
+    monkeypatch.setenv("TRANSFORMERS_INFERENCE_API", svc.url)
+    monkeypatch.setenv("QNA_INFERENCE_API", svc.url)
+    monkeypatch.setenv("SUM_INFERENCE_API", svc.url)
+    monkeypatch.setenv("NER_INFERENCE_API", svc.url)
+    monkeypatch.setenv("SPELLCHECK_INFERENCE_API", svc.url)
+    monkeypatch.setenv("IMAGE_INFERENCE_API", svc.url)
+    monkeypatch.setenv("CLIP_INFERENCE_API", svc.url)
+    monkeypatch.setenv("OPENAI_APIKEY", "sk")
+    monkeypatch.setenv("COHERE_APIKEY", "co")
+    monkeypatch.setenv("HUGGINGFACE_APIKEY", "hf")
+    monkeypatch.setenv("BACKUP_S3_BUCKET", "b")
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "k")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "s")
+    monkeypatch.setenv("BACKUP_GCS_BUCKET", "b")
+    monkeypatch.setenv("BACKUP_GCS_TOKEN", "t")
+    monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", "a")
+    monkeypatch.setenv("BACKUP_AZURE_CONTAINER", "c")
+    monkeypatch.setenv("AZURE_STORAGE_SAS_TOKEN", "sas")
+    c = Config()
+    c.enable_modules = [
+        "text2vec-local", "text2vec-contextionary", "text2vec-transformers",
+        "text2vec-openai", "text2vec-cohere", "text2vec-huggingface",
+        "ref2vec-centroid", "img2vec-neural", "multi2vec-clip",
+        "qna-transformers", "sum-transformers", "ner-transformers",
+        "text-spellcheck", "generative-openai",
+        "backup-filesystem", "backup-s3", "backup-gcs", "backup-azure",
+    ]
+    c.contextionary_url = "127.0.0.1:1"
+    p = build_provider(c)
+    assert len(p.names()) == 18
+    assert set(p.additional_properties()) >= {
+        "answer", "generate", "summary", "tokens", "spellCheck"}
+
+
+def test_ask_drives_retrieval(svc, tmp_path):
+    """Regression: ask{question} must vectorize the question and retrieve
+    relevant objects (not hand arbitrary doc-id-ordered objects to qna)."""
+    from weaviate_tpu.modules.readers import QnATransformers
+
+    p = Provider()
+    p.register(LocalTextVectorizer())
+    p.register(QnATransformers(svc.url))
+    app = _mk_app(tmp_path, p)
+    try:
+        app.schema.add_class({
+            "class": "Doc", "vectorizer": "text2vec-local",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "title", "dataType": ["text"]},
+                           {"name": "body", "dataType": ["text"]}]})
+        # many irrelevant docs FIRST (lower doc ids), relevant one last
+        for i in range(10):
+            app.objects.add({"class": "Doc", "properties": {
+                "title": f"cooking {i}", "body": f"recipe number {i}"}})
+        app.objects.add({"class": "Doc", "properties": {
+            "title": "physics", "body": "quantum computers use qubits"}})
+        res = app.graphql.execute(
+            '{ Get { Doc(ask: {question: "quantum computers"}, limit: 1)'
+            ' { title _additional { answer { result } } } } }')
+        assert "errors" not in res, res
+        hit = res["data"]["Get"]["Doc"][0]
+        assert hit["title"] == "physics"
+        assert hit["_additional"]["answer"]["result"] == "qubits"
+    finally:
+        app.shutdown()

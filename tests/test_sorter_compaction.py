@@ -1,0 +1,165 @@
+"""LSM-backed sorter pushdown + background pair compaction.
+
+Reference: adapters/repos/db/sorter/ (sort keys extracted from LSM, only
+the returned page hydrated) and lsmkv/segment_group_compaction.go
+(background pair merges keep the segment stack bounded).
+"""
+
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.db import DB
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+from weaviate_tpu.storage.lsm import STRATEGY_REPLACE, Store
+
+
+def make_class():
+    return ClassDef(
+        name="Sortable",
+        properties=[
+            Property(name="title", data_type=["text"]),
+            Property(name="rank", data_type=["int"]),
+            Property(name="score", data_type=["number"]),
+        ],
+        vector_index_type="hnsw_tpu",
+    )
+
+
+@pytest.fixture
+def idx(tmp_path):
+    db = DB(str(tmp_path / "data"))
+    index = db.add_class(make_class(), parse_and_validate_config("hnsw_tpu", {}))
+    objs = []
+    rng = np.random.default_rng(3)
+    for i in range(40):
+        objs.append(StorObj(
+            class_name="Sortable", uuid=str(uuidlib.UUID(int=i + 1)),
+            properties={
+                "title": f"title {chr(97 + (i * 7) % 26)}{i}",
+                "rank": (i * 13) % 40,
+                # every 5th object has no score: missing-last semantics
+                **({"score": float((i * 31) % 17)} if i % 5 else {}),
+            },
+            vector=rng.standard_normal(4).astype(np.float32),
+        ))
+    index.put_batch(objs)
+    yield index
+    db.shutdown()
+
+
+def test_sort_pushdown_numeric(idx):
+    res = idx.object_search(10, sort=[{"path": ["rank"], "order": "asc"}])
+    ranks = [r.obj.properties["rank"] for r in res]
+    assert ranks == sorted(ranks)
+    assert ranks[0] == 0
+
+    res = idx.object_search(10, sort=[{"path": ["rank"], "order": "desc"}])
+    ranks = [r.obj.properties["rank"] for r in res]
+    assert ranks == sorted(ranks, reverse=True)
+    assert ranks[0] == 39
+
+
+def test_sort_pushdown_string_and_paging(idx):
+    res = idx.object_search(40, sort=[{"path": ["title"], "order": "asc"}])
+    titles = [r.obj.properties["title"] for r in res]
+    assert titles == sorted(titles)
+    # paging: offset walks the same global order
+    page2 = idx.object_search(5, offset=5, sort=[{"path": ["title"], "order": "asc"}])
+    assert [r.obj.properties["title"] for r in page2] == titles[5:10]
+
+
+def test_sort_missing_values_last(idx):
+    res = idx.object_search(40, sort=[{"path": ["score"], "order": "asc"}])
+    scores = [r.obj.properties.get("score") for r in res]
+    present = [s for s in scores if s is not None]
+    assert present == sorted(present)
+    # all missing values trail, in both directions
+    assert all(s is None for s in scores[len(present):])
+    res_d = idx.object_search(40, sort=[{"path": ["score"], "order": "desc"}])
+    scores_d = [r.obj.properties.get("score") for r in res_d]
+    assert scores_d[: len(present)] == sorted(present, reverse=True)
+
+
+def test_sort_special_keys(idx):
+    res = idx.object_search(40, sort=[{"path": ["_id"], "order": "asc"}])
+    uuids = [r.obj.uuid for r in res]
+    assert uuids == sorted(uuids)
+
+
+def test_pair_compaction_bounds_segments(tmp_path):
+    store = Store(str(tmp_path / "lsm"))
+    b = store.create_or_load_bucket("obj", STRATEGY_REPLACE)
+    # create many segments via repeated flushes (with deletes interleaved)
+    for round_i in range(12):
+        for i in range(20):
+            b.put(f"k{round_i}-{i}".encode(), f"v{round_i}-{i}".encode())
+        if round_i % 3 == 0 and round_i > 0:
+            b.delete(f"k{round_i - 1}-0".encode())
+        b.flush_memtable()
+    assert b.segment_count() == 12
+    merges = store.compact_once(max_segments=4)
+    assert merges > 0
+    assert b.segment_count() <= 4
+    # every live key still resolves, deletes stay deleted
+    assert b.get(b"k7-3") == b"v7-3"
+    assert b.get(b"k0-0") == b"v0-0"
+    assert b.get(b"k8-0") is None  # deleted in round 9
+    store.shutdown()
+
+
+def test_compaction_cycle_thread(tmp_path):
+    import time
+
+    store = Store(str(tmp_path / "lsm"))
+    b = store.create_or_load_bucket("obj", STRATEGY_REPLACE)
+    for round_i in range(10):
+        b.put(f"r{round_i}".encode(), b"x")
+        b.flush_memtable()
+    store.start_compaction_cycle(interval=0.05, max_segments=3)
+    deadline = time.time() + 10
+    while time.time() < deadline and b.segment_count() > 3:
+        time.sleep(0.05)
+    assert b.segment_count() <= 3
+    assert b.get(b"r7") == b"x"
+    store.shutdown()
+
+
+def test_pair_compaction_survives_restart(tmp_path):
+    """Regression: the merged oldest pair must keep its position in the
+    filename-ordered load sequence — a fresh counter name would make the
+    oldest data load as newest after restart, resurrecting stale values
+    and deleted keys."""
+    root = str(tmp_path / "lsm")
+    store = Store(root)
+    b = store.create_or_load_bucket("obj", STRATEGY_REPLACE)
+    b.put(b"k", b"v1")
+    b.flush_memtable()          # 00000000.seg holds k=v1
+    b.put(b"other", b"x")
+    b.flush_memtable()
+    b.put(b"k", b"v2")          # newer segment overrides
+    b.put(b"dead", b"soon")
+    b.flush_memtable()
+    b.delete(b"dead")
+    b.flush_memtable()
+    while b.segment_count() > 2:
+        assert b.compact_pair()
+    assert b.get(b"k") == b"v2"
+    assert b.get(b"dead") is None
+    store.shutdown()
+
+    store2 = Store(root)
+    b2 = store2.create_or_load_bucket("obj", STRATEGY_REPLACE)
+    assert b2.get(b"k") == b"v2"        # not resurrected to v1
+    assert b2.get(b"dead") is None      # delete survives restart
+    assert b2.get(b"other") == b"x"
+    store2.shutdown()
+
+
+def test_sort_with_cursor_rejected(idx):
+    with pytest.raises(ValueError):
+        idx.object_search(5, sort=[{"path": ["rank"]}],
+                          cursor_after=str(uuidlib.UUID(int=1)))

@@ -440,6 +440,7 @@ class _Handler(BaseHTTPRequestHandler):
                     0,
                     bool(body.get("includeVector", False)),
                     body.get("cursorAfter"),
+                    body.get("sort"),
                 )
                 return self._json(200, {"results": wire.results_to_wire(rows)})
             if method == "POST" and op == ":deletebyfilter":

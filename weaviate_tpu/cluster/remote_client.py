@@ -141,6 +141,7 @@ class RemoteIndex:
         self, class_name: str, shard: str, limit: int,
         flt: Optional[LocalFilter], keyword_ranking: Optional[dict],
         include_vector: bool, cursor_after: Optional[str],
+        sort: Optional[list] = None,
     ) -> list[SearchResult]:
         host = self._host(class_name, shard)
         data = self.http.json(
@@ -151,6 +152,7 @@ class RemoteIndex:
                 "keywordRanking": keyword_ranking,
                 "includeVector": include_vector,
                 "cursorAfter": cursor_after,
+                "sort": sort,
             },
         )
         return wire.results_from_wire(data.get("results", []))

@@ -272,17 +272,24 @@ class ClassIndex:
         offset: int = 0,
         include_vector: bool = False,
         cursor_after: Optional[str] = None,
+        sort: Optional[list[dict]] = None,
     ) -> list[SearchResult]:
+        if sort and cursor_after is not None:
+            raise ValueError(
+                "sort cannot be combined with the 'after' cursor (cursor "
+                "pagination is uuid-ordered)"
+            )
         targets = self._all_shard_targets()
 
         def run(name, shard):
             if shard is not None:
                 return shard.object_search(
-                    limit + offset, flt, keyword_ranking, 0, include_vector, cursor_after
+                    limit + offset, flt, keyword_ranking, 0, include_vector,
+                    cursor_after, sort,
                 )
             return self.remote.search_shard_objects(
                 self.class_name, name, limit + offset, flt, keyword_ranking,
-                include_vector, cursor_after,
+                include_vector, cursor_after, sort,
             )
 
         if len(targets) == 1:
@@ -292,6 +299,11 @@ class ClassIndex:
             rows = [r for f in futs for r in f.result()]
         if keyword_ranking:
             rows.sort(key=lambda r: -(r.score or 0.0))
+        elif sort:
+            # class-level merge of per-shard sorted pages (index.go merge)
+            from weaviate_tpu.db.sorter import sort_results
+
+            rows = sort_results(rows, sort)
         elif cursor_after is not None:
             rows.sort(key=lambda r: r.obj.uuid)
         return rows[offset : offset + limit]

@@ -95,6 +95,8 @@ class Shard:
             self.inverted, class_def, geo_search=self._geo_search
         )
         self.bm25 = BM25Searcher(self.inverted, class_def, invert_cfg)
+        # background per-bucket pair compaction (segment_group_compaction.go)
+        self.store.start_compaction_cycle()
         self.status = STATUS_READY
         self._deleted: dict[str, int] = {}  # uuid -> deletion ms (digests)
         self._lock = threading.RLock()
@@ -387,6 +389,7 @@ class Shard:
         offset: int = 0,
         include_vector: bool = False,
         cursor_after: Optional[str] = None,
+        sort: Optional[list[dict]] = None,
     ) -> list[SearchResult]:
         """BM25 / filter-only / list search (search.go objectSearch)."""
         if keyword_ranking:
@@ -421,6 +424,17 @@ class Shard:
         if cursor_after is not None:
             # cursor iteration is by uuid ordering (reference cursor api)
             return self._list_after(doc_ids, cursor_after, limit, include_vector)
+        if sort:
+            # LSM-backed sort (adapters/repos/db/sorter/): order ALL matching
+            # doc ids by sort keys without full hydration, page afterwards
+            from weaviate_tpu.db.sorter import Sorter
+
+            ordered = Sorter(self).sort_doc_ids(
+                [int(i) for i in doc_ids], sort, offset + limit
+            )
+            take = np.asarray(ordered[offset : offset + limit], dtype=np.int64)
+            objs = self.objects_by_doc_ids([int(i) for i in take], include_vector)
+            return [SearchResult(obj=o, shard=self.name) for o in objs if o is not None]
         take = doc_ids[offset : offset + limit]
         objs = self.objects_by_doc_ids([int(i) for i in take], include_vector)
         return [SearchResult(obj=o, shard=self.name) for o in objs if o is not None]

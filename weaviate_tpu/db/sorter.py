@@ -1,0 +1,120 @@
+"""LSM-backed sorter: order doc ids by property values without hydrating
+full result objects.
+
+Reference: adapters/repos/db/sorter/ — sorting a large filtered result set
+must not decode every matching object into a full API object; the sorter
+extracts just the sort keys from the LSM object bucket (partial storobj
+decode: the vector — the bulk of the payload — is skipped), orders doc ids,
+and only the page being returned gets hydrated.
+
+Missing values sort last regardless of direction (the reference's nil
+handling), and `_id`/creation/update-time sort keys are served without
+touching the property JSON at all.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Sequence
+
+from weaviate_tpu.entities.storobj import StorObj
+
+_SPECIAL = {"_id", "_creationTimeUnix", "_lastUpdateTimeUnix", "id"}
+
+
+def _sort_key(obj: StorObj, path: str):
+    if path in ("_id", "id"):
+        return obj.uuid
+    if path == "_creationTimeUnix":
+        return obj.creation_time_unix
+    if path == "_lastUpdateTimeUnix":
+        return obj.last_update_time_unix
+    v = obj.properties.get(path)
+    if isinstance(v, list):
+        return v[0] if v else None
+    return v
+
+
+def sort_results(rows, sort: list[dict]):
+    """Merge-order hydrated SearchResults by the sort spec (the class-level
+    merge of per-shard sorted pages, index.go merge semantics)."""
+    for spec in reversed(sort):
+        path = spec.get("path") or spec.get("property") or ""
+        if isinstance(path, list):
+            path = path[0] if path else ""
+        desc = (spec.get("order") or "asc").lower() == "desc"
+        present = [r for r in rows if _sort_key(r.obj, path) is not None]
+        missing = [r for r in rows if _sort_key(r.obj, path) is None]
+        sample = _sort_key(present[0].obj, path) if present else None
+        if isinstance(sample, str):
+            present.sort(key=lambda r: str(_sort_key(r.obj, path)), reverse=desc)
+        else:
+            present.sort(
+                key=lambda r: float(_sort_key(r.obj, path)), reverse=desc
+            )
+        rows = present + missing
+    return rows
+
+
+class Sorter:
+    def __init__(self, shard):
+        self.shard = shard
+
+    def sort_doc_ids(
+        self,
+        doc_ids: Sequence[int],
+        sort: list[dict],
+        limit: Optional[int] = None,
+    ) -> list[int]:
+        """Order `doc_ids` by the sort spec [{path|property, order}];
+        -> the first `limit` ids (all when None)."""
+        keyed = []
+        for d in doc_ids:
+            key = self.shard.docid_lookup.get(struct.pack("<Q", int(d)))
+            if key is None:
+                continue
+            raw = self.shard.objects.get(key)
+            if raw is None:
+                continue
+            obj = StorObj.from_binary(raw, include_vector=False)
+            keyed.append((d, obj))
+        for spec in reversed(sort):
+            path = spec.get("path") or spec.get("property") or ""
+            if isinstance(path, list):
+                path = path[0] if path else ""
+            desc = (spec.get("order") or "asc").lower() == "desc"
+            # missing values last in both directions: sort by (is_missing, key)
+            def k(pair, _path=path, _desc=desc):
+                v = _sort_key(pair[1], _path)
+                if v is None:
+                    return (1, "")
+                if isinstance(v, bool):
+                    v = int(v)
+                if isinstance(v, (int, float)):
+                    return (0, -v if _desc else v)
+                s = str(v)
+                return (0, s)
+
+            # numeric keys handle desc by negation; string keys need a
+            # reverse pass of their own — split the stable sort per type
+            def k_str(pair, _path=path):
+                v = _sort_key(pair[1], _path)
+                return v is None, str(v) if v is not None else ""
+
+            sample = next(
+                (
+                    _sort_key(o, path)
+                    for _, o in keyed
+                    if _sort_key(o, path) is not None
+                ),
+                None,
+            )
+            if isinstance(sample, str):
+                present = [p for p in keyed if _sort_key(p[1], path) is not None]
+                missing = [p for p in keyed if _sort_key(p[1], path) is None]
+                present.sort(key=lambda p: str(_sort_key(p[1], path)), reverse=desc)
+                keyed = present + missing
+            else:
+                keyed.sort(key=k)
+        ordered = [int(d) for d, _ in keyed]
+        return ordered[:limit] if limit is not None else ordered

@@ -62,6 +62,25 @@ class GraphQLExecutor:
                     data[sel.out_name] = self._exec_aggregate(sel)
                 elif sel.name == "Explore":
                     data[sel.out_name] = self._exec_explore(sel)
+                elif sel.name == "__schema":
+                    from weaviate_tpu.graphql.introspection import (
+                        build_introspection,
+                        project_tree,
+                    )
+
+                    data[sel.out_name] = project_tree(
+                        build_introspection(self.schema), sel.selections
+                    )
+                elif sel.name == "__type":
+                    from weaviate_tpu.graphql.introspection import (
+                        find_type,
+                        project_tree,
+                    )
+
+                    name = str(sel.args.get("name", ""))
+                    data[sel.out_name] = project_tree(
+                        find_type(self.schema, name), sel.selections
+                    )
                 else:
                     errors.append({"message": f"unknown root field {sel.name!r}"})
             except Exception as e:
@@ -80,10 +99,44 @@ class GraphQLExecutor:
                 raise GraphQLParseError("expected class field under Get")
             params = self._get_params(class_field)
             results = self.traverser.get_class(params)
+            self._resolve_module_additionals(class_field, params, results)
             out[class_field.out_name] = [
                 self._project(r, class_field.selections, params) for r in results
             ]
         return out
+
+    def _module_provider(self):
+        return getattr(getattr(self.traverser, "explorer", None), "modules", None)
+
+    def _resolve_module_additionals(self, class_field: Field, params: GetParams,
+                                    results) -> None:
+        """Batch-resolve module-provided _additional props (answer, generate,
+        summary, tokens, spellCheck, ...) once per query and attach the
+        per-result payloads (modulecapabilities/additional.go dispatch)."""
+        provider = self._module_provider()
+        if provider is None or not results:
+            return
+        module_props = set(provider.additional_properties())
+        if not module_props:
+            return
+        for sel in class_field.selections:
+            if not (isinstance(sel, Field) and sel.name == "_additional"):
+                continue
+            for sub in sel.selections:
+                if not isinstance(sub, Field) or sub.name not in module_props:
+                    continue
+                if sub.name == "answer":
+                    prop_params = _plain(params.ask) if params.ask else {}
+                elif sub.name == "spellCheck":
+                    concepts = (params.near_text or {}).get("concepts") or []
+                    if isinstance(concepts, str):
+                        concepts = [concepts]
+                    prop_params = {"text": " ".join(str(c) for c in concepts)}
+                else:
+                    prop_params = {k: _plain(v) for k, v in sub.args.items()}
+                values = provider.resolve_additional(sub.name, results, prop_params)
+                for r, v in zip(results, values):
+                    r.additional[sub.name] = v
 
     def _get_params(self, f: Field) -> GetParams:
         a = {k: _plain(v) for k, v in f.args.items()}
@@ -95,6 +148,8 @@ class GraphQLExecutor:
             near_vector=a.get("nearVector"),
             near_object=a.get("nearObject"),
             near_text=a.get("nearText"),
+            near_image=a.get("nearImage"),
+            ask=a.get("ask"),
             keyword_ranking=a.get("bm25"),
             hybrid=a.get("hybrid"),
             sort=self._as_list(a.get("sort")),

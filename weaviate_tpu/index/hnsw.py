@@ -48,7 +48,7 @@ def _load_lib() -> ctypes.CDLL:
                 raise ImportError(f"native hnsw source not found at {_SRC_PATH}")
             os.makedirs(_NATIVE_DIR, exist_ok=True)
             subprocess.run(
-                ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+                ["g++", "-O3", "-march=native", "-std=c++17", "-fopenmp", "-shared", "-fPIC",
                  "-o", _SO_PATH, _SRC_PATH],
                 check=True,
                 capture_output=True,

@@ -163,6 +163,45 @@ class Provider:
             raise ModuleError(f"class {class_def.name!r} has no vectorizer")
         return vec.vectorize_text(list(texts))
 
+    # -- module additional properties (modulecapabilities/additional.go) -----
+
+    def additional_property_module(self, prop: str):
+        from weaviate_tpu.modules.interface import AdditionalProperties
+
+        for m in self._modules.values():
+            if isinstance(m, AdditionalProperties) and prop in m.additional_properties():
+                return m
+        return None
+
+    def additional_properties(self) -> list[str]:
+        from weaviate_tpu.modules.interface import AdditionalProperties
+
+        out = []
+        for m in self._modules.values():
+            if isinstance(m, AdditionalProperties):
+                out.extend(m.additional_properties())
+        return sorted(set(out))
+
+    def resolve_additional(self, prop: str, results, params: dict):
+        mod = self.additional_property_module(prop)
+        if mod is None:
+            raise ModuleError(f"no enabled module resolves _additional.{prop!r}")
+        return mod.resolve_additional(prop, results, params)
+
+    # -- media query vectors ---------------------------------------------------
+
+    def vectorize_image_query(self, class_def, near_image: dict) -> np.ndarray:
+        """nearImage -> query vector via the class's (media) vectorizer."""
+        vec = self._vectorizer_for(class_def)
+        if vec is None or not hasattr(vec, "vectorize_image"):
+            raise ModuleError(
+                f"class {class_def.name!r} has no image-capable vectorizer"
+            )
+        image = near_image.get("image") or ""
+        if not image:
+            raise ModuleError("nearImage requires {image: <base64>}")
+        return np.asarray(vec.vectorize_image(image), dtype=np.float32)
+
     # -- backup backends -----------------------------------------------------
 
     def backup_backend(self, name: str) -> Optional[BackupBackend]:
@@ -207,6 +246,91 @@ def build_provider(config) -> Optional[Provider]:
 
             p.register(FilesystemBackupBackend(
                 getattr(config, "backup_filesystem_path", "") or "./backups"))
+        elif name == "text2vec-transformers":
+            from weaviate_tpu.modules.text2vec_http import TransformersVectorizer
+
+            p.register(TransformersVectorizer(_env("TRANSFORMERS_INFERENCE_API")))
+        elif name == "text2vec-openai":
+            from weaviate_tpu.modules.text2vec_http import OpenAIVectorizer
+
+            p.register(OpenAIVectorizer(
+                _env("OPENAI_APIKEY"),
+                model=_env("OPENAI_EMBEDDING_MODEL") or "text-embedding-3-small",
+                base_url=_env("OPENAI_BASE_URL") or "https://api.openai.com/v1"))
+        elif name == "text2vec-cohere":
+            from weaviate_tpu.modules.text2vec_http import CohereVectorizer
+
+            p.register(CohereVectorizer(
+                _env("COHERE_APIKEY"),
+                base_url=_env("COHERE_BASE_URL") or "https://api.cohere.ai/v1"))
+        elif name == "text2vec-huggingface":
+            from weaviate_tpu.modules.text2vec_http import HuggingFaceVectorizer
+
+            p.register(HuggingFaceVectorizer(
+                _env("HUGGINGFACE_APIKEY"),
+                base_url=_env("HUGGINGFACE_BASE_URL")
+                or "https://api-inference.huggingface.co"))
+        elif name == "qna-transformers":
+            from weaviate_tpu.modules.readers import QnATransformers
+
+            p.register(QnATransformers(_env("QNA_INFERENCE_API")))
+        elif name == "sum-transformers":
+            from weaviate_tpu.modules.readers import SumTransformers
+
+            p.register(SumTransformers(_env("SUM_INFERENCE_API")))
+        elif name == "ner-transformers":
+            from weaviate_tpu.modules.readers import NerTransformers
+
+            p.register(NerTransformers(_env("NER_INFERENCE_API")))
+        elif name == "text-spellcheck":
+            from weaviate_tpu.modules.readers import TextSpellcheck
+
+            p.register(TextSpellcheck(_env("SPELLCHECK_INFERENCE_API")))
+        elif name == "generative-openai":
+            from weaviate_tpu.modules.readers import GenerativeOpenAI
+
+            p.register(GenerativeOpenAI(
+                _env("OPENAI_APIKEY"),
+                model=_env("OPENAI_GENERATIVE_MODEL") or "gpt-4o-mini",
+                base_url=_env("OPENAI_BASE_URL") or "https://api.openai.com/v1"))
+        elif name == "img2vec-neural":
+            from weaviate_tpu.modules.media import Img2VecNeural
+
+            p.register(Img2VecNeural(_env("IMAGE_INFERENCE_API")))
+        elif name == "multi2vec-clip":
+            from weaviate_tpu.modules.media import Multi2VecClip
+
+            p.register(Multi2VecClip(_env("CLIP_INFERENCE_API")))
+        elif name == "backup-s3":
+            from weaviate_tpu.modules.backup_cloud import S3BackupBackend
+
+            p.register(S3BackupBackend(
+                bucket=_env("BACKUP_S3_BUCKET"),
+                access_key=_env("AWS_ACCESS_KEY_ID"),
+                secret_key=_env("AWS_SECRET_ACCESS_KEY"),
+                region=_env("AWS_REGION") or "us-east-1",
+                endpoint=_env("BACKUP_S3_ENDPOINT"),
+                path_prefix=_env("BACKUP_S3_PATH")))
+        elif name == "backup-gcs":
+            from weaviate_tpu.modules.backup_cloud import GCSBackupBackend
+
+            p.register(GCSBackupBackend(
+                bucket=_env("BACKUP_GCS_BUCKET"), token=_env("BACKUP_GCS_TOKEN"),
+                base_url=_env("BACKUP_GCS_ENDPOINT") or "https://storage.googleapis.com"))
+        elif name == "backup-azure":
+            from weaviate_tpu.modules.backup_cloud import AzureBackupBackend
+
+            p.register(AzureBackupBackend(
+                account=_env("AZURE_STORAGE_ACCOUNT"),
+                container=_env("BACKUP_AZURE_CONTAINER"),
+                sas_token=_env("AZURE_STORAGE_SAS_TOKEN"),
+                base_url=_env("AZURE_BLOB_ENDPOINT")))
         else:
             raise ModuleError(f"unknown module {name!r} in ENABLE_MODULES")
     return p
+
+
+def _env(name: str) -> str:
+    import os
+
+    return os.environ.get(name, "")

@@ -46,7 +46,15 @@ _PRECISION = jax.lax.Precision.HIGHEST
 
 
 def _matmul(q: Array, x: Array) -> Array:
-    return jnp.matmul(q, x.T, preferred_element_type=jnp.float32, precision=_PRECISION)
+    # bf16 operands ride the MXU natively (one pass, f32 accumulation via
+    # preferred_element_type) — forcing HIGHEST there would decompose into
+    # multi-pass f32 and throw away the bf16 store's speed advantage
+    precision = (
+        jax.lax.Precision.DEFAULT
+        if (q.dtype == jnp.bfloat16 or x.dtype == jnp.bfloat16)
+        else _PRECISION
+    )
+    return jnp.matmul(q, x.T, preferred_element_type=jnp.float32, precision=precision)
 
 
 def _dot_dists(q: Array, x: Array, x_sq_norms: Array | None) -> Array:

@@ -639,43 +639,100 @@ class Bucket:
             self._wal.flush()
             os.fsync(self._wal.fileno())
 
+    def segment_count(self) -> int:
+        with self._lock:
+            return len(self._segments)
+
+    def compact_pair(self) -> bool:
+        """Merge the two OLDEST segments into one — the incremental unit of
+        the background cycle (reference: segment_group_compaction.go merges
+        adjacent same-level pairs). The merged pair sits at the bottom of
+        the stack, so tombstones/net-deletes can be dropped safely.
+
+        Two invariants matter here:
+        - the merged segment REPLACES the oldest pair member's FILENAME
+          (write-then-rename), because restart loads segments in filename
+          order — a fresh counter name would make the oldest data load as
+          newest and resurrect stale/deleted keys;
+        - the merge itself (decode + sorted rewrite) runs OUTSIDE the bucket
+          lock — segments are immutable mmaps, so readers proceed; only the
+          head snapshot and the final list swap are locked.
+        -> True if a merge happened."""
+        with self._lock:
+            if len(self._segments) < 2:
+                return False
+            pair = self._segments[:2]
+        items = self._merge_segment_items(pair)  # immutable inputs: lock-free
+        tmp_path = pair[0].path + ".compact.tmp"
+        Segment.write(tmp_path, self.strategy, items)
+        with self._lock:
+            if self._segments[:2] != pair:
+                # the stack changed under us (drop/another compaction): abort
+                try:
+                    os.remove(tmp_path)
+                    os.remove(tmp_path + ".bloom")
+                except FileNotFoundError:
+                    pass
+                return False
+            keep_path = pair[0].path
+            for seg in pair:
+                seg.close()
+            os.replace(tmp_path, keep_path)
+            try:
+                os.replace(tmp_path + ".bloom", keep_path + ".bloom")
+            except FileNotFoundError:
+                pass
+            os.remove(pair[1].path)
+            try:
+                os.remove(pair[1].path + ".bloom")
+            except FileNotFoundError:
+                pass
+            self._segments = [Segment(keep_path)] + self._segments[2:]
+            return True
+
+    def _merge_segment_items(self, segments) -> list[tuple[bytes, bytes]]:
+        """Net-merge `segments` (oldest first) per strategy, dropping
+        tombstoned state — callers only merge bottom-of-stack runs."""
+        merged: dict[bytes, bytes] = {}
+        if self.strategy == STRATEGY_REPLACE:
+            for seg in segments:
+                merged.update(seg.items_raw())
+            # drop tombstones: nothing older remains below this run
+            items = sorted((k, v) for k, v in merged.items() if v != _TOMBSTONE)
+        elif self.strategy == STRATEGY_SET:
+            acc: dict[bytes, tuple[set, set]] = {}
+            for seg in segments:
+                for k, raw in seg.items_raw():
+                    adds, dels = _dec_set(raw)
+                    cur = acc.get(k, (set(), set()))
+                    cur = (cur[0] - dels | adds, set())  # net state
+                    acc[k] = cur
+            items = sorted((k, _enc_set(a, d)) for k, (a, d) in acc.items() if a or d)
+        elif self.strategy == STRATEGY_MAP:
+            accm: dict[bytes, dict[bytes, Optional[bytes]]] = {}
+            for seg in segments:
+                for k, raw in seg.items_raw():
+                    accm.setdefault(k, {}).update(_dec_map(raw))
+            items = sorted(
+                (k, _enc_map({s: v for s, v in m.items() if v is not None}))
+                for k, m in accm.items()
+                if any(v is not None for v in m.values())
+            )
+        else:
+            accr: dict[bytes, Bitmap] = {}
+            for seg in segments:
+                for k, raw in seg.items_raw():
+                    adds, dels = _dec_roaring(raw)
+                    accr[k] = accr.get(k, Bitmap()).and_not(dels).or_(adds)
+            items = sorted((k, _enc_roaring(bm, Bitmap())) for k, bm in accr.items() if len(bm))
+        return items
+
     def compact(self) -> None:
         """Merge all segments into one (full compaction)."""
         with self._lock:
             if len(self._segments) < 2:
                 return
-            merged: dict[bytes, bytes] = {}
-            if self.strategy == STRATEGY_REPLACE:
-                for seg in self._segments:
-                    merged.update(seg.items_raw())
-                # drop tombstones at full compaction (nothing older remains)
-                items = sorted((k, v) for k, v in merged.items() if v != _TOMBSTONE)
-            elif self.strategy == STRATEGY_SET:
-                acc: dict[bytes, tuple[set, set]] = {}
-                for seg in self._segments:
-                    for k, raw in seg.items_raw():
-                        adds, dels = _dec_set(raw)
-                        cur = acc.get(k, (set(), set()))
-                        cur = (cur[0] - dels | adds, set())  # full merge: net state
-                        acc[k] = cur
-                items = sorted((k, _enc_set(a, d)) for k, (a, d) in acc.items() if a or d)
-            elif self.strategy == STRATEGY_MAP:
-                accm: dict[bytes, dict[bytes, Optional[bytes]]] = {}
-                for seg in self._segments:
-                    for k, raw in seg.items_raw():
-                        accm.setdefault(k, {}).update(_dec_map(raw))
-                items = sorted(
-                    (k, _enc_map({s: v for s, v in m.items() if v is not None}))
-                    for k, m in accm.items()
-                    if any(v is not None for v in m.values())
-                )
-            else:
-                accr: dict[bytes, Bitmap] = {}
-                for seg in self._segments:
-                    for k, raw in seg.items_raw():
-                        adds, dels = _dec_roaring(raw)
-                        accr[k] = accr.get(k, Bitmap()).and_not(dels).or_(adds)
-                items = sorted((k, _enc_roaring(bm, Bitmap())) for k, bm in accr.items() if len(bm))
+            items = self._merge_segment_items(self._segments)
             seg_path = os.path.join(self.path, f"{self._seg_counter:08d}.seg")
             Segment.write(seg_path, self.strategy, items)
             self._seg_counter += 1
@@ -731,11 +788,50 @@ class Bucket:
 class Store:
     """Named-bucket container (lsmkv.Store, store.go:111)."""
 
+    # background cycle defaults (reference: cyclemanager-driven
+    # segment_group_compaction.go); tunable via env
+    MAX_SEGMENTS = int(os.environ.get("PERSISTENCE_LSM_MAX_SEGMENTS", "8"))
+    COMPACTION_INTERVAL = float(os.environ.get("PERSISTENCE_LSM_COMPACTION_INTERVAL", "30"))
+
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._buckets: dict[str, Bucket] = {}
         self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._cycle_thread: Optional[threading.Thread] = None
+
+    def start_compaction_cycle(self, interval: Optional[float] = None,
+                               max_segments: Optional[int] = None) -> None:
+        """Background per-bucket pair compaction: whenever a bucket's
+        segment stack grows past max_segments, merge oldest pairs until it
+        fits (segment_group_compaction.go's cycle, simplified to a single
+        level)."""
+        if self._cycle_thread is not None:
+            return
+        iv = interval if interval is not None else self.COMPACTION_INTERVAL
+        max_segs = max_segments if max_segments is not None else self.MAX_SEGMENTS
+
+        def loop():
+            while not self._stop.wait(iv):
+                try:
+                    self.compact_once(max_segs)
+                except Exception:  # noqa: BLE001 — the cycle must survive
+                    pass
+
+        self._cycle_thread = threading.Thread(
+            target=loop, daemon=True, name="lsm-compaction"
+        )
+        self._cycle_thread.start()
+
+    def compact_once(self, max_segments: Optional[int] = None) -> int:
+        """One compaction sweep (also the test/CLI entry): -> merges done."""
+        max_segs = max_segments if max_segments is not None else self.MAX_SEGMENTS
+        merges = 0
+        for b in list(self._buckets.values()):
+            while b.segment_count() > max_segs and b.compact_pair():
+                merges += 1
+        return merges
 
     def create_or_load_bucket(self, name: str, strategy: str, **kw) -> Bucket:
         with self._lock:
@@ -755,6 +851,7 @@ class Store:
             b.flush()
 
     def shutdown(self) -> None:
+        self._stop.set()
         for b in list(self._buckets.values()):
             b.shutdown()
 

@@ -36,6 +36,8 @@ class GetParams:
     near_vector: Optional[dict] = None       # {vector, certainty?, distance?}
     near_object: Optional[dict] = None       # {id|beacon, certainty?, distance?}
     near_text: Optional[dict] = None         # module-resolved {concepts, ...}
+    near_image: Optional[dict] = None         # module-resolved {image: b64}
+    ask: Optional[dict] = None                # qna module {question, properties}
     keyword_ranking: Optional[dict] = None   # {query, properties?}
     hybrid: Optional[dict] = None            # {query, alpha?, vector?, fusionType?}
     sort: list[dict] = field(default_factory=list)  # [{path, order}]
@@ -106,12 +108,28 @@ class Explorer:
             if vec is None:
                 raise TraverserError("nearText: vectorizer returned no vector")
             return np.asarray(vec, dtype=np.float32)
+        ni = params.near_image
+        if ni is not None:
+            if self.modules is None:
+                raise TraverserError("nearImage requires an image vectorizer module")
+            cd = self.schema.get_class(idx.class_name)
+            return self.modules.vectorize_image_query(cd, ni)
+        ask = params.ask
+        if ask is not None and ask.get("question"):
+            # Ask retrieval (qna module semantics): the question is embedded
+            # like a nearText concept so answers come from relevant objects
+            if self.modules is None:
+                raise TraverserError("ask requires a vectorizer module")
+            cd = self.schema.get_class(idx.class_name)
+            vec = self.modules.vectorize_query(cd, {"concepts": [ask["question"]]})
+            return np.asarray(vec, dtype=np.float32)
         return None
 
     def _near_threshold(self, params: GetParams, idx) -> Optional[float]:
         """certainty/distance -> target distance. certainty is defined only
         for cosine (d = 2(1-c)); the reference rejects it elsewhere."""
-        src = params.near_vector or params.near_object or params.near_text or {}
+        src = (params.near_vector or params.near_object or params.near_text
+               or params.near_image or {})
         if src.get("distance") is not None:
             return float(src["distance"])
         if src.get("certainty") is not None:
@@ -216,13 +234,17 @@ class Explorer:
                     include_vector=inc_vec,
                 )[0][params.offset :]
             else:
+                # sort pushdown: shards order doc ids via the LSM-backed
+                # sorter and hydrate only the requested page
                 res = idx.object_search(
                     limit,
                     flt=params.filters,
                     offset=params.offset,
                     include_vector=inc_vec,
                     cursor_after=params.after,
+                    sort=params.sort or None,
                 )
+                return self._postprocess(params, res, skip_sort=bool(params.sort))
         return self._postprocess(params, res)
 
     # -- hybrid (explorer.go:227, hybrid/searcher.go) ------------------------
@@ -263,8 +285,9 @@ class Explorer:
 
     # -- post-processing: sort, group ----------------------------------------
 
-    def _postprocess(self, params: GetParams, res: list[SearchResult]) -> list[SearchResult]:
-        if params.sort:
+    def _postprocess(self, params: GetParams, res: list[SearchResult],
+                     skip_sort: bool = False) -> list[SearchResult]:
+        if params.sort and not skip_sort:
             res = self._sort(params.sort, res)
         if params.group is not None:
             res = self._group(params.group, res)
