@@ -1,0 +1,158 @@
+"""Python client library driven against a real in-process server — the
+acceptance-test role the reference's generated client plays
+(test/acceptance via client/)."""
+
+import time
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.client import Client, ClientError
+from weaviate_tpu.config import Config
+from weaviate_tpu.server import App, RestServer
+
+UUID1 = str(uuidlib.UUID(int=1))
+
+
+@pytest.fixture(scope="module")
+def client(tmp_path_factory):
+    c = Config()
+    c.enable_modules = ["text2vec-local", "backup-filesystem"]
+    c.backup_filesystem_path = str(tmp_path_factory.mktemp("bk"))
+    app = App(config=c, data_path=str(tmp_path_factory.mktemp("data")))
+    srv = RestServer(app, port=0)
+    srv.start()
+    cl = Client(f"http://127.0.0.1:{srv.port}")
+    yield cl
+    srv.stop()
+    app.shutdown()
+
+
+def test_liveness_meta(client):
+    assert client.is_ready() and client.is_live()
+    assert "version" in client.get_meta()
+
+
+def test_schema_and_crud(client):
+    client.schema.create_class({
+        "class": "Book",
+        "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "pages", "dataType": ["int"]}],
+    })
+    assert any(c["class"] == "Book" for c in client.schema.get()["classes"])
+    client.schema.add_property("Book", {"name": "isbn", "dataType": ["text"]})
+
+    uid = client.data_object.create(
+        {"title": "Snow Crash", "pages": 440}, "Book", uuid=UUID1,
+        vector=np.arange(4, dtype=float).tolist())
+    assert uid == UUID1
+    got = client.data_object.get_by_id(UUID1, "Book", with_vector=True)
+    assert got["properties"]["title"] == "Snow Crash"
+    assert len(got["vector"]) == 4
+    assert client.data_object.exists(UUID1, "Book")
+
+    client.data_object.update({"pages": 441}, "Book", UUID1)
+    assert client.data_object.get_by_id(UUID1, "Book")["properties"]["pages"] == 441
+    client.data_object.replace({"title": "Snow Crash 2", "pages": 500}, "Book",
+                               UUID1, vector=[1.0, 2.0, 3.0, 4.0])
+    got = client.data_object.get_by_id(UUID1, "Book")
+    assert got["properties"]["title"] == "Snow Crash 2"
+
+    shards = client.schema.get_class_shards("Book")
+    assert shards and shards[0]["status"] == "READY"
+
+    client.data_object.delete(UUID1, "Book")
+    assert client.data_object.get_by_id(UUID1, "Book") is None
+
+
+def test_batch_and_query_builder(client):
+    client.schema.create_class({
+        "class": "Film",
+        "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "year", "dataType": ["int"]}],
+    })
+    rng = np.random.default_rng(5)
+    objs = [{"class": "Film", "id": str(uuidlib.UUID(int=100 + i)),
+             "properties": {"title": f"film about topic {i}", "year": 1990 + i},
+             "vector": rng.standard_normal(8).tolist()} for i in range(20)]
+    out = client.batch.create_objects(objs)
+    assert all(o["result"]["status"] == "SUCCESS" for o in out)
+
+    res = (client.query.get("Film", ["title", "year"])
+           .with_near_vector({"vector": objs[7]["vector"]})
+           .with_limit(3)
+           .with_additional(["id", "distance"])
+           .do())
+    assert res[0]["_additional"]["id"] == objs[7]["id"]
+    assert res[0]["_additional"]["distance"] < 1e-5
+
+    res = (client.query.get("Film", ["title", "year"])
+           .with_where({"operator": "LessThan", "path": ["year"], "valueInt": 1995})
+           .with_sort({"path": ["year"], "order": "desc"})
+           .with_limit(10)
+           .do())
+    years = [r["year"] for r in res]
+    assert years == sorted(years, reverse=True) and max(years) < 1995
+
+    res = (client.query.get("Film", ["title"])
+           .with_bm25("topic 7", properties=["title"]).with_limit(3).do())
+    assert any("7" in r["title"] for r in res)
+
+    agg = client.query.aggregate("Film", "meta { count }")
+    assert agg[0]["meta"]["count"] == 20
+
+    dry = client.batch.delete_objects(
+        "Film", {"operator": "GreaterThan", "path": ["year"], "valueInt": 2005},
+        dry_run=True)
+    assert dry["results"]["matches"] == 4
+    out = client.batch.delete_objects(
+        "Film", {"operator": "GreaterThan", "path": ["year"], "valueInt": 2005})
+    assert out["results"]["successful"] == 4
+
+
+def test_neartext_and_refs(client):
+    client.schema.create_class({
+        "class": "Note", "vectorizer": "text2vec-local",
+        "vectorIndexConfig": {"distance": "cosine"},
+        "properties": [{"name": "text", "dataType": ["text"]}],
+    })
+    a = client.data_object.create({"text": "gradient descent optimizer"}, "Note")
+    client.data_object.create({"text": "pizza dough hydration"}, "Note")
+    res = (client.query.get("Note", ["text"])
+           .with_near_text({"concepts": ["gradient descent"]})
+           .with_limit(1).with_additional("id").do())
+    assert res[0]["_additional"]["id"] == a
+
+    client.schema.create_class({
+        "class": "Author",
+        "properties": [{"name": "name", "dataType": ["text"]},
+                       {"name": "wrote", "dataType": ["Note"]}],
+    })
+    au = client.data_object.create({"name": "ada"}, "Author")
+    client.data_object.reference_add("Author", au, "wrote", "Note", a)
+    got = client.data_object.get_by_id(au, "Author")
+    assert got["properties"]["wrote"][0]["beacon"].endswith(a)
+
+
+def test_backup_via_client(client):
+    client.backup.create("filesystem", "clibak", include=["Note"])
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = client.backup.status("filesystem", "clibak")
+        if st["status"] in ("SUCCESS", "FAILED"):
+            break
+        time.sleep(0.05)
+    assert st["status"] == "SUCCESS"
+
+
+def test_nodes_and_errors(client):
+    nodes = client.cluster.get_nodes_status()
+    assert nodes and nodes[0]["status"] == "HEALTHY"
+    with pytest.raises(ClientError) as ei:
+        client.schema.create_class({"class": "Book"})  # duplicate
+    assert ei.value.status == 422

@@ -163,3 +163,72 @@ def test_sort_with_cursor_rejected(idx):
     with pytest.raises(ValueError):
         idx.object_search(5, sort=[{"path": ["rank"]}],
                           cursor_after=str(uuidlib.UUID(int=1)))
+
+
+def test_sort_mixed_types_no_crash(tmp_path):
+    """Regression: auto-schema drift can leave one property holding numbers
+    in some objects and strings in others — sorting must order by type rank
+    instead of raising."""
+    from weaviate_tpu.db.sorter import sort_results
+    from weaviate_tpu.db.shard import SearchResult
+
+    rows = []
+    for i, v in enumerate([3, "apple", None, 1.5, {"lat": 2}, "zebra", 7]):
+        props = {} if v is None else {"mixed": v}
+        rows.append(SearchResult(obj=StorObj(
+            class_name="M", uuid=str(uuidlib.UUID(int=i + 1)), properties=props)))
+    out = sort_results(rows, [{"path": ["mixed"], "order": "asc"}])
+    vals = [r.obj.properties.get("mixed") for r in out]
+    assert vals[:2] == [1.5, 3] or vals[:3] == [1.5, 3, 7]  # numbers first, ordered
+    assert vals[-1] is None  # missing last
+    out_d = sort_results(rows, [{"path": ["mixed"], "order": "desc"}])
+    vals_d = [r.obj.properties.get("mixed") for r in out_d]
+    assert vals_d[0] == 7 and vals_d[-1] is None
+
+
+def test_backup_during_write_load_with_compaction(tmp_path):
+    """Regression: a backup must not race the background compaction cycle
+    (segment files deleted mid-copy) nor sweep half-written tmp files."""
+    import threading
+    import time
+
+    from weaviate_tpu.modules import Provider
+    from weaviate_tpu.modules.backup_fs import FilesystemBackupBackend
+    from weaviate_tpu.usecases.backup import BackupScheduler
+    from weaviate_tpu.schema import SchemaManager
+
+    db = DB(str(tmp_path / "data"))
+    mgr = SchemaManager(str(tmp_path / "schema.json"), migrator=db)
+    mgr.add_class({
+        "class": "Busy", "vectorIndexType": "hnsw_tpu",
+        "properties": [{"name": "t", "dataType": ["text"]}]})
+    idx = db.get_index("Busy")
+    shard = next(iter(idx.shards.values()))
+    # churn writer creating many segments + aggressive compaction cycle
+    shard.store.start_compaction_cycle(interval=0.01, max_segments=2)
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            idx.put_object(StorObj(class_name="Busy", uuid=str(uuidlib.uuid4()),
+                                   properties={"t": f"x{i}"}))
+            if i % 5 == 0:
+                shard.store.flush_all()
+            i += 1
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    p = Provider()
+    p.register(FilesystemBackupBackend(str(tmp_path / "bk")))
+    sched = BackupScheduler(db, mgr, p)
+    try:
+        for n in range(3):
+            sched.backup("filesystem", {"id": f"load{n}"})
+            final = sched.wait(f"load{n}", timeout=60)
+            assert final["status"] == "SUCCESS", final
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        db.shutdown()

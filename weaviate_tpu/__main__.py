@@ -39,6 +39,18 @@ def main(argv=None) -> int:
     grpc_port = args.grpc_port if args.grpc_port is not None else config.grpc_port
     grpc_srv = GrpcServer(app, host=args.host, port=grpc_port)
 
+    stop = threading.Event()
+
+    def handle(signum, frame):
+        print(f"received signal {signum}, shutting down", flush=True)
+        stop.set()
+
+    # handlers BEFORE the listeners come up: a supervisor that signals the
+    # moment readiness flips must hit the graceful path, not the default
+    # action
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+
     rest.start()
     grpc_srv.start()
     parts = [f"REST http://{args.host}:{rest.port}", f"gRPC {args.host}:{grpc_srv.port}"]
@@ -47,15 +59,6 @@ def main(argv=None) -> int:
     if app.cluster_node is not None:
         parts.append(f"clusterapi {app.cluster_node.address}")
     print(f"weaviate-tpu {__version__} serving " + ", ".join(parts), flush=True)
-
-    stop = threading.Event()
-
-    def handle(signum, frame):
-        print(f"received signal {signum}, shutting down", flush=True)
-        stop.set()
-
-    signal.signal(signal.SIGTERM, handle)
-    signal.signal(signal.SIGINT, handle)
     stop.wait()
 
     grpc_srv.stop()

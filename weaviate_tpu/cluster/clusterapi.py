@@ -353,12 +353,14 @@ class _Handler(BaseHTTPRequestHandler):
                 shard = api._shard(cname, sname)
                 if shard is None:
                     raise KeyError(f"shard {cname}/{sname}")
-                shard.flush()
-                base = shard.path
-                rels = []
-                for root, _, files in os.walk(base):
-                    for fn in files:
-                        rels.append(os.path.relpath(os.path.join(root, fn), base))
+                with shard.paused_writes():
+                    base = shard.path
+                    rels = []
+                    for root, _, files in os.walk(base):
+                        for fn in files:
+                            if fn.endswith(".tmp"):
+                                continue
+                            rels.append(os.path.relpath(os.path.join(root, fn), base))
                 return self._json(200, {"files": sorted(rels)})
             if op == ":create" and method == "POST":
                 idx = api.db.get_index(cname)

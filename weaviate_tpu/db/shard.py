@@ -495,8 +495,9 @@ class Shard:
         @contextlib.contextmanager
         def _ctx():
             with self._lock:
-                self.flush()
-                yield
+                with self.store.compaction_paused():
+                    self.flush()
+                    yield
 
         return _ctx()
 

@@ -7,19 +7,20 @@ extracts just the sort keys from the LSM object bucket (partial storobj
 decode: the vector — the bulk of the payload — is skipped), orders doc ids,
 and only the page being returned gets hydrated.
 
-Missing values sort last regardless of direction (the reference's nil
-handling), and `_id`/creation/update-time sort keys are served without
-touching the property JSON at all.
+One comparator serves both the shard-level id sort and the class-level merge
+of per-shard sorted pages: missing values sort LAST regardless of direction
+(the reference's nil handling), and mixed-type property values (auto-schema
+drift, geo/phone dicts) order by a type rank instead of raising — numbers,
+then strings, then everything else by its JSON rendering.
 """
 
 from __future__ import annotations
 
+import json
 import struct
 from typing import Optional, Sequence
 
 from weaviate_tpu.entities.storobj import StorObj
-
-_SPECIAL = {"_id", "_creationTimeUnix", "_lastUpdateTimeUnix", "id"}
 
 
 def _sort_key(obj: StorObj, path: str):
@@ -35,25 +36,57 @@ def _sort_key(obj: StorObj, path: str):
     return v
 
 
-def sort_results(rows, sort: list[dict]):
-    """Merge-order hydrated SearchResults by the sort spec (the class-level
-    merge of per-shard sorted pages, index.go merge semantics)."""
+def _spec_path(spec: dict) -> str:
+    path = spec.get("path") or spec.get("property") or ""
+    if isinstance(path, list):
+        path = path[0] if path else ""
+    return str(path)
+
+
+class _Reversed:
+    """Inverts comparison for descending string/json keys (numbers negate
+    instead, but str has no negation)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def _typed_key(value, desc: bool):
+    """Total-order key for arbitrary property values: (missing, type_rank,
+    comparable). Safe under mixed types; missing last in BOTH directions."""
+    if value is None:
+        return (1, 0, 0)
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, (int, float)):
+        return (0, 0, -float(value) if desc else float(value))
+    if isinstance(value, str):
+        return (0, 1, _Reversed(value) if desc else value)
+    rendered = json.dumps(value, sort_keys=True, default=str)
+    return (0, 2, _Reversed(rendered) if desc else rendered)
+
+
+def _order(pairs: list, key_of, sort: list[dict]) -> list:
+    """Stable multi-spec ordering: apply specs from last to first."""
     for spec in reversed(sort):
-        path = spec.get("path") or spec.get("property") or ""
-        if isinstance(path, list):
-            path = path[0] if path else ""
+        path = _spec_path(spec)
         desc = (spec.get("order") or "asc").lower() == "desc"
-        present = [r for r in rows if _sort_key(r.obj, path) is not None]
-        missing = [r for r in rows if _sort_key(r.obj, path) is None]
-        sample = _sort_key(present[0].obj, path) if present else None
-        if isinstance(sample, str):
-            present.sort(key=lambda r: str(_sort_key(r.obj, path)), reverse=desc)
-        else:
-            present.sort(
-                key=lambda r: float(_sort_key(r.obj, path)), reverse=desc
-            )
-        rows = present + missing
-    return rows
+        pairs.sort(key=lambda p: _typed_key(key_of(p, path), desc))
+    return pairs
+
+
+def sort_results(rows, sort: list[dict]):
+    """Class-level merge of per-shard sorted pages (index.go merge role):
+    re-order hydrated SearchResults by the same comparator the shards used."""
+    return _order(list(rows), lambda r, path: _sort_key(r.obj, path), sort)
 
 
 class Sorter:
@@ -77,44 +110,7 @@ class Sorter:
             if raw is None:
                 continue
             obj = StorObj.from_binary(raw, include_vector=False)
-            keyed.append((d, obj))
-        for spec in reversed(sort):
-            path = spec.get("path") or spec.get("property") or ""
-            if isinstance(path, list):
-                path = path[0] if path else ""
-            desc = (spec.get("order") or "asc").lower() == "desc"
-            # missing values last in both directions: sort by (is_missing, key)
-            def k(pair, _path=path, _desc=desc):
-                v = _sort_key(pair[1], _path)
-                if v is None:
-                    return (1, "")
-                if isinstance(v, bool):
-                    v = int(v)
-                if isinstance(v, (int, float)):
-                    return (0, -v if _desc else v)
-                s = str(v)
-                return (0, s)
-
-            # numeric keys handle desc by negation; string keys need a
-            # reverse pass of their own — split the stable sort per type
-            def k_str(pair, _path=path):
-                v = _sort_key(pair[1], _path)
-                return v is None, str(v) if v is not None else ""
-
-            sample = next(
-                (
-                    _sort_key(o, path)
-                    for _, o in keyed
-                    if _sort_key(o, path) is not None
-                ),
-                None,
-            )
-            if isinstance(sample, str):
-                present = [p for p in keyed if _sort_key(p[1], path) is not None]
-                missing = [p for p in keyed if _sort_key(p[1], path) is None]
-                present.sort(key=lambda p: str(_sort_key(p[1], path)), reverse=desc)
-                keyed = present + missing
-            else:
-                keyed.sort(key=k)
-        ordered = [int(d) for d, _ in keyed]
+            keyed.append((int(d), obj))
+        _order(keyed, lambda p, path: _sort_key(p[1], path), sort)
+        ordered = [d for d, _ in keyed]
         return ordered[:limit] if limit is not None else ordered

@@ -100,8 +100,12 @@ class GraphQLExecutor:
             params = self._get_params(class_field)
             results = self.traverser.get_class(params)
             self._resolve_module_additionals(class_field, params, results)
+            # per-query ref cache (refcache/ role): N results pointing at the
+            # same referenced object hit storage once, not N times
+            ref_cache: dict[str, object] = {}
             out[class_field.out_name] = [
-                self._project(r, class_field.selections, params) for r in results
+                self._project(r, class_field.selections, params, ref_cache)
+                for r in results
             ]
         return out
 
@@ -190,7 +194,8 @@ class GraphQLExecutor:
 
     # -- result projection ---------------------------------------------------
 
-    def _project(self, r, sels: list, params: GetParams) -> dict:
+    def _project(self, r, sels: list, params: GetParams,
+                 ref_cache: Optional[dict] = None) -> dict:
         obj = r.obj
         row: dict[str, Any] = {}
         for s in sels:
@@ -202,7 +207,7 @@ class GraphQLExecutor:
             value = obj.properties.get(s.name)
             if s.selections and isinstance(value, list):
                 # cross-reference: resolve beacons via inline fragments
-                row[s.out_name] = self._resolve_refs(value, s.selections)
+                row[s.out_name] = self._resolve_refs(value, s.selections, ref_cache)
             elif s.selections and isinstance(value, dict):
                 row[s.out_name] = {
                     sub.out_name: value.get(sub.name)
@@ -213,12 +218,19 @@ class GraphQLExecutor:
                 row[s.out_name] = value
         return row
 
-    def _resolve_refs(self, beacons: list, sels: list) -> list:
+    def _resolve_refs(self, beacons: list, sels: list,
+                      ref_cache: Optional[dict] = None) -> list:
         out = []
         frags = [s for s in sels if isinstance(s, InlineFragment)]
         for b in beacons:
             beacon = b.get("beacon") if isinstance(b, dict) else None
             if beacon is None:
+                continue
+            if ref_cache is not None and beacon in ref_cache:
+                obj = ref_cache[beacon]
+                if obj is None:
+                    continue
+                self._project_ref(obj, frags, out)
                 continue
             parts = beacon.split("weaviate://")[-1].split("/")
             # host/Class/uuid or host/uuid (legacy)
@@ -231,20 +243,26 @@ class GraphQLExecutor:
                     obj = tidx.object_by_uuid(target_uuid, include_vector=False)
             else:
                 obj, idx = self.db.object_by_uuid_any_class(target_uuid, False)
+            if ref_cache is not None:
+                ref_cache[beacon] = obj
             if obj is None:
                 continue
-            for frag in frags:
-                if frag.type_name == obj.class_name:
-                    row = {
-                        sub.out_name: obj.properties.get(sub.name)
-                        for sub in frag.selections
-                        if isinstance(sub, Field) and sub.name != "_additional"
-                    }
-                    for sub in frag.selections:
-                        if isinstance(sub, Field) and sub.name == "_additional":
-                            row[sub.out_name] = {"id": obj.uuid}
-                    out.append(row)
+            self._project_ref(obj, frags, out)
         return out
+
+    @staticmethod
+    def _project_ref(obj, frags, out: list) -> None:
+        for frag in frags:
+            if frag.type_name == obj.class_name:
+                row = {
+                    sub.out_name: obj.properties.get(sub.name)
+                    for sub in frag.selections
+                    if isinstance(sub, Field) and sub.name != "_additional"
+                }
+                for sub in frag.selections:
+                    if isinstance(sub, Field) and sub.name == "_additional":
+                        row[sub.out_name] = {"id": obj.uuid}
+                out.append(row)
 
     def _additional(self, r, sels: list, params: GetParams) -> dict:
         obj = r.obj

@@ -742,7 +742,10 @@ class TpuVectorIndex(VectorIndex):
             return 0
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return 0
-        return int(min(max(4 * k, 32), 128, max(self.n, 1)))
+        r = int(min(max(4 * k, 32), 128, max(self.n, 1)))
+        # no candidate slack over k => the fast pass would pick the FINAL set
+        # at reduced precision; fall back to the HIGHEST-precision scan
+        return r if r >= 2 * k else 0
 
     def _prep_queries(self, vectors: np.ndarray) -> tuple[np.ndarray, int]:
         q = np.asarray(vectors, dtype=np.float32)

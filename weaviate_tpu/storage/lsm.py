@@ -677,11 +677,15 @@ class Bucket:
             keep_path = pair[0].path
             for seg in pair:
                 seg.close()
-            os.replace(tmp_path, keep_path)
+            # bloom BEFORE segment: a crash in between pairs the old segment
+            # with a new bloom (false positives only — harmless); the other
+            # order pairs the merged segment with a stale bloom, turning
+            # bloom misses into silent data loss
             try:
                 os.replace(tmp_path + ".bloom", keep_path + ".bloom")
             except FileNotFoundError:
                 pass
+            os.replace(tmp_path, keep_path)
             os.remove(pair[1].path)
             try:
                 os.remove(pair[1].path + ".bloom")
@@ -800,6 +804,21 @@ class Store:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._cycle_thread: Optional[threading.Thread] = None
+        # held by backup/scale-out file copies: the compaction cycle must not
+        # delete or replace segment files mid-copy (the reference's
+        # pause-compaction window, adapters/repos/db/backup.go)
+        self._compaction_gate = threading.Lock()
+
+    def compaction_paused(self):
+        """Context manager: block the compaction sweep for the duration."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            with self._compaction_gate:
+                yield
+
+        return _ctx()
 
     def start_compaction_cycle(self, interval: Optional[float] = None,
                                max_segments: Optional[int] = None) -> None:
@@ -828,9 +847,10 @@ class Store:
         """One compaction sweep (also the test/CLI entry): -> merges done."""
         max_segs = max_segments if max_segments is not None else self.MAX_SEGMENTS
         merges = 0
-        for b in list(self._buckets.values()):
-            while b.segment_count() > max_segs and b.compact_pair():
-                merges += 1
+        with self._compaction_gate:
+            for b in list(self._buckets.values()):
+                while b.segment_count() > max_segs and b.compact_pair():
+                    merges += 1
         return merges
 
     def create_or_load_bucket(self, name: str, strategy: str, **kw) -> Bucket:

@@ -125,6 +125,8 @@ class BackupScheduler:
                     rels = []
                     for root, _, files in os.walk(base):
                         for fn in files:
+                            if fn.endswith(".tmp"):
+                                continue  # in-flight compaction/flush scratch
                             full = os.path.join(root, fn)
                             rel = os.path.relpath(full, base)
                             rels.append(rel)

@@ -38,18 +38,20 @@ class Scaler:
             shard = idx.shards.get(shard_name)
             if shard is None:
                 continue
-            shard.flush()
-            base = shard.path
-            rels = []
-            for root, _, files in os.walk(base):
-                for fn in files:
-                    rels.append(os.path.relpath(os.path.join(root, fn), base))
-            for target in added:
-                host = self.cluster.node_address(target)
-                if host is None:
-                    continue
-                self.nodes.create_shard(host, class_name, shard_name)
-                for rel in rels:
-                    with open(os.path.join(base, rel), "rb") as f:
-                        self.nodes.upload_file(host, class_name, shard_name, rel, f.read())
-                self.nodes.reload_shard(host, class_name, shard_name)
+            with shard.paused_writes():  # no flush/compaction mid-copy
+                base = shard.path
+                rels = []
+                for root, _, files in os.walk(base):
+                    for fn in files:
+                        if fn.endswith(".tmp"):
+                            continue
+                        rels.append(os.path.relpath(os.path.join(root, fn), base))
+                for target in added:
+                    host = self.cluster.node_address(target)
+                    if host is None:
+                        continue
+                    self.nodes.create_shard(host, class_name, shard_name)
+                    for rel in rels:
+                        with open(os.path.join(base, rel), "rb") as f:
+                            self.nodes.upload_file(host, class_name, shard_name, rel, f.read())
+                    self.nodes.reload_shard(host, class_name, shard_name)
