@@ -199,6 +199,10 @@ def run_matrix(rng, vecs, queries, idx_l2, gt):
 
     results = {}
 
+    def flush():
+        with open(MATRIX_FILE, "w") as f:
+            json.dump(results, f, indent=1)
+
     # config 3: filtered ANN (10% allowList -> masked device bitmap path)
     log("matrix: filtered ANN (10% allowList)...")
     mask = rng.random(N) < 0.10
@@ -219,6 +223,7 @@ def run_matrix(rng, vecs, queries, idx_l2, gt):
         "qps": round(B / f_time, 1),
         "recall@10": round(hits / (128 * K), 4),
     }
+    flush()
 
     # config 4: PQ-compressed (segments=32, device LUT scan + f32 rescoring)
     log("matrix: PQ (segments=32, rescored)...")
@@ -232,6 +237,7 @@ def run_matrix(rng, vecs, queries, idx_l2, gt):
         "recall@10": round(recall_at_k(ids_pq, gt, K), 4),
         "fit_seconds": round(fit_s, 1),
     }
+    flush()
     idx_pq.drop()
     del idx_pq
 
@@ -248,20 +254,19 @@ def run_matrix(rng, vecs, queries, idx_l2, gt):
         "qps": round(qps_cos, 1),
         "recall@10": round(recall_at_k(ids_cos, gt_cos, K), 4),
     }
+    flush()
     idx_cos.drop()
     del idx_cos
 
     # config 5: gRPC 256-query batched kNN end-to-end (p50 latency)
-    log("matrix: gRPC 256-query batch e2e (n=100k objects)...")
+    log("matrix: gRPC 256-query batch e2e (n=50k objects)...")
     results["grpc_batch256"] = _grpc_e2e(rng)
-
-    with open(MATRIX_FILE, "w") as f:
-        json.dump(results, f, indent=1)
+    flush()
     log(f"wrote {MATRIX_FILE}: {json.dumps(results)}")
     return results
 
 
-def _grpc_e2e(rng, n=100_000):
+def _grpc_e2e(rng, n=50_000):
     """Full-stack 256-query BatchSearch over real gRPC (serialization + REST
     object store hydration included), p50 batch latency."""
     import tempfile

@@ -1,0 +1,89 @@
+"""Failure detection: disk-pressure READONLY automation + phase metrics.
+
+Reference: entities/storagestate / shard_status.go (READONLY on disk
+pressure) and shard_read.go:236-287 (filtered-vector phase instrumentation).
+"""
+
+import uuid as uuidlib
+from collections import namedtuple
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.db import DB
+from weaviate_tpu.db.shard import ShardReadOnlyError
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+from weaviate_tpu.monitoring.disk import DiskMonitor
+
+Usage = namedtuple("Usage", "total used free")
+
+
+def make_db_with_data(tmp_path, metrics=None):
+    db = DB(str(tmp_path / "data"), metrics=metrics)
+    cd = ClassDef(name="D", properties=[
+        Property(name="t", data_type=["text"]),
+        Property(name="n", data_type=["int"])])
+    idx = db.add_class(cd, parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"}))
+    rng = np.random.default_rng(1)
+    idx.put_batch([
+        StorObj(class_name="D", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"t": f"x{i}", "n": i},
+                vector=rng.standard_normal(4).astype(np.float32))
+        for i in range(20)
+    ])
+    return db, idx
+
+
+def test_disk_pressure_flips_readonly(tmp_path, monkeypatch):
+    db, idx = make_db_with_data(tmp_path)
+    try:
+        mon = DiskMonitor(db, warning_pct=80, readonly_pct=90, interval=9999)
+        monkeypatch.setattr(
+            "weaviate_tpu.monitoring.disk.shutil.disk_usage",
+            lambda p: Usage(100, 85, 15))
+        mon.check_once()  # warning zone: still writable
+        assert all(s.status == "READY" for s in idx.shards.values())
+
+        monkeypatch.setattr(
+            "weaviate_tpu.monitoring.disk.shutil.disk_usage",
+            lambda p: Usage(100, 95, 5))
+        mon.check_once()
+        assert all(s.status == "READONLY" for s in idx.shards.values())
+        assert mon.readonly_triggered
+        with pytest.raises(Exception) as ei:
+            idx.put_object(StorObj(class_name="D", uuid=str(uuidlib.uuid4()),
+                                   properties={"t": "nope"}))
+        assert isinstance(ei.value, ShardReadOnlyError)
+        # reads still work
+        res = idx.object_search(5)
+        assert len(res) == 5
+
+        # operator re-activation (shard status update API semantics)
+        for s in idx.shards.values():
+            s.set_status("READY")
+        idx.put_object(StorObj(class_name="D", uuid=str(uuidlib.uuid4()),
+                               properties={"t": "ok"}))
+    finally:
+        db.shutdown()
+
+
+def test_filtered_search_phase_metrics(tmp_path):
+    from weaviate_tpu.monitoring import Metrics
+
+    m = Metrics()
+    db, idx = make_db_with_data(tmp_path, metrics=m)
+    try:
+        flt = LocalFilter.from_dict(
+            {"operator": "LessThan", "path": ["n"], "valueInt": 10})
+        q = np.random.default_rng(2).standard_normal((1, 4)).astype(np.float32)
+        idx.object_vector_search(q, k=3, flt=flt)
+        text = m.expose().decode()
+        assert "weaviate_filtered_vector_filter_durations_ms_count" in text
+        assert "weaviate_filtered_vector_search_durations_ms_count" in text
+        assert "weaviate_filtered_vector_objects_durations_ms_count" in text
+        assert 'weaviate_vector_index_operations_total{class_name="D"' in text
+    finally:
+        db.shutdown()

@@ -194,3 +194,21 @@ def test_pq_immutable_disable(tmp_path, data):
     off = _cfg(enabled=False, segments=8, centroids=64)
     with pytest.raises(vi.ConfigValidationError):
         idx.update_user_config(off)
+
+
+def test_compressed_large_k(tmp_path, rng):
+    """Regression: k larger than the per-chunk candidate quota must widen
+    the pool instead of crashing the final top_k."""
+    from weaviate_tpu.entities import vectorindex as vi
+    from weaviate_tpu.index.tpu import TpuVectorIndex
+
+    cfg = vi.HnswUserConfig.from_dict(
+        {"distance": "l2-squared",
+         "pq": {"enabled": False, "segments": 8, "centroids": 64}}, "hnsw_tpu")
+    idx = TpuVectorIndex(cfg, str(tmp_path), persist=False)
+    data = rng.standard_normal((2000, 16)).astype(np.float32)
+    idx.add_batch(np.arange(2000), data)
+    idx.compress()
+    ids, dists = idx.search_by_vectors(data[:4], 300)
+    assert ids.shape[1] == 300
+    assert ids[0][0] == 0 and dists[0][0] < 1.0

@@ -146,6 +146,17 @@ def lut_scan_block(codes_block: Array, lut: Array) -> Array:
 class ProductQuantizer:
     """Codebook container + fit/encode (ProductQuantizer, ssdhelpers)."""
 
+    def recon_sq_norms(self, codes) -> "np.ndarray":
+        """||recon(code)||^2 per row: segments occupy disjoint dims, so the
+        square norm is the sum of the chosen centroids' square norms —
+        precomputable once per encode, feeding the reconstruction-matmul
+        distance d = ||q||^2 - 2 q.recon + ||recon||^2."""
+        import numpy as np
+
+        cent_sq = (self.codebook.astype(np.float64) ** 2).sum(-1)  # [M, C]
+        rows = np.asarray(codes, dtype=np.int64)                   # [n, M]
+        return cent_sq[np.arange(self.segments)[None, :], rows].sum(1).astype(np.float32)
+
     def __init__(self, dim: int, segments: int, centroids: int, metric: str,
                  encoder: str = vi.PQ_ENCODER_KMEANS,
                  distribution: str = vi.PQ_DISTRIBUTION_LOG_NORMAL):

@@ -348,26 +348,51 @@ class Shard:
         include_vector: bool = False,
     ) -> list[list[SearchResult]]:
         """Batched vector search (shard_read.go:223 objectVectorSearch),
-        [B, D] queries in one device dispatch -> per-query hydrated results."""
+        [B, D] queries in one device dispatch -> per-query hydrated results.
+        Phase timings land in the filtered-vector breakdown histograms
+        (shard_read.go:236-287 instrumentation parity): filter build,
+        device search, hydration."""
+        m = self.metrics
+        cls = self.class_def.name
+        t0 = time.perf_counter()
         allow = self.build_allow_list(flt)
+        if m is not None and flt is not None:
+            m.filtered_vector_filter.labels(cls, self.name).observe(
+                (time.perf_counter() - t0) * 1000.0)
         if allow is not None and len(allow) == 0:
             b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
             return [[] for _ in range(b)]
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
+        t1 = time.perf_counter()
         if target_distance is not None:
             out: list[list[SearchResult]] = []
             for row in q:
-                ids, dists = self.vector_index.search_by_vector_distance(
+                ids_1, dists_1 = self.vector_index.search_by_vector_distance(
                     row, target_distance, max_limit=k, allow_list=allow
                 )
-                out.append(self._hydrate(ids, dists, include_vector))
+                out.append(self._hydrate(ids_1, dists_1, include_vector))
+            if m is not None:
+                m.filtered_vector_search.labels(cls, self.name).observe(
+                    (time.perf_counter() - t1) * 1000.0)
+                m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
+                m.query_dimensions.labels("nearVector", "search", cls).inc(
+                    int(q.shape[0] * q.shape[1]))
             return out
         ids, dists = self.vector_index.search_by_vectors(q, k, allow)
-        return [
+        t2 = time.perf_counter()
+        hydrated = [
             self._hydrate(ids[i], dists[i], include_vector) for i in range(ids.shape[0])
         ]
+        if m is not None:
+            m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
+            m.filtered_vector_objects.labels(cls, self.name).observe(
+                (time.perf_counter() - t2) * 1000.0)
+            m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
+            m.query_dimensions.labels("nearVector", "search", cls).inc(
+                int(q.shape[0] * q.shape[1]))
+        return hydrated
 
     def _hydrate(self, ids, dists, include_vector: bool) -> list[SearchResult]:
         valid = ~np.isinf(np.asarray(dists, dtype=np.float32))

@@ -235,6 +235,99 @@ _PQ_SCAN_CHUNK = 32768
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "r_chunk", "metric", "use_allow", "exact", "active_chunks",
+        "do_rescore",
+    ),
+)
+def _search_pq_recon(codes, recon_norms, tombs, n, codebook, rescore_store, q,
+                     allow_words, k, r_chunk, metric, use_allow, exact=False,
+                     active_chunks=None, do_rescore=True):
+    """PQ scan the MXU way: asymmetric ADC distance equals the distance to
+    the RECONSTRUCTED row (segments are disjoint dims), so each chunk's
+    codes gather their centroids into a [chunk, D] block that feeds one
+    bf16 matmul — identical math to the LUT scan
+    (product_quantization.go:56-75 LookUp) at systolic-array throughput
+    instead of per-element gather rates. ||recon||^2 is precomputed at
+    encode time. Matmul metrics only (manhattan/hamming keep the LUT path).
+
+    Candidate handling is collect-then-rescore: each chunk emits its top
+    r_chunk (k-selection stays SMALL — large-k PartialReduce/top_k are the
+    dominant cost on TPU), the per-chunk winners concatenate into one
+    [B, nchunks*r_chunk] pool, and the pool is exact-rescored against the
+    on-device bf16 rescore copy in the SAME program before the final
+    top-k. No cross-chunk merge sorts, no host round trip."""
+    cap, m = codes.shape
+    _, c, ds = codebook.shape
+    chunk = min(cap, _SCAN_CHUNK)
+    nchunks = cap // chunk
+    if active_chunks is not None:
+        nchunks = max(1, min(nchunks, active_chunks))
+    b = q.shape[0]
+    flat_cb = codebook.reshape(m * c, ds).astype(jnp.bfloat16)
+    seg_off = (jnp.arange(m, dtype=jnp.int32) * c)[None, :]
+
+    ext = nchunks * chunk
+    codes_c = codes[:ext].reshape(nchunks, chunk, m)
+    norms_c = recon_norms[:ext].reshape(nchunks, chunk)
+    tombs_c = tombs[:ext].reshape(nchunks, chunk)
+    allow_c = allow_words[: ext // 32].reshape(nchunks, chunk // 32) if use_allow else None
+
+    qd = q.astype(jnp.bfloat16)
+    q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+
+    def step(_, xs):
+        ci, codes_l, norms_l, tombs_l = xs[0], xs[1], xs[2], xs[3]
+        base = ci * chunk
+        idx = codes_l.astype(jnp.int32) + seg_off          # [chunk, M]
+        recon = jnp.take(flat_cb, idx, axis=0)             # [chunk, M, ds]
+        recon = recon.reshape(chunk, m * ds)
+        qx = jnp.matmul(qd, recon.T, preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.DEFAULT)
+        if metric == vi.DISTANCE_L2:
+            d = jnp.maximum(q_sq - 2.0 * qx + norms_l[None, :], 0.0)
+        elif metric == vi.DISTANCE_DOT:
+            d = -qx
+        else:  # cosine: queries normalized; recon approximates unit rows
+            d = 1.0 - qx
+        valid = jnp.logical_and(jnp.arange(chunk) + base < n, jnp.logical_not(tombs_l))
+        if use_allow:
+            valid = jnp.logical_and(valid, bitmap_to_mask(xs[4], chunk))
+        d = jnp.where(valid[None, :], d, jnp.inf)
+        if exact:
+            neg, li = jax.lax.top_k(-d, r_chunk)
+            td = -neg
+        else:
+            td, li = jax.lax.approx_min_k(d, r_chunk, recall_target=0.95)
+        return None, (td, li + base)
+
+    xs = [jnp.arange(nchunks), codes_c, norms_c, tombs_c]
+    if use_allow:
+        xs.append(allow_c)
+    _, (tds, lis) = jax.lax.scan(step, None, tuple(xs))  # [nchunks, B, r_chunk]
+    pool = nchunks * r_chunk
+    cand_d = jnp.moveaxis(tds, 0, 1).reshape(b, pool)
+    cand_i = jnp.moveaxis(lis, 0, 1).reshape(b, pool)
+    if do_rescore:
+        safe = jnp.clip(cand_i, 0, cap - 1)
+        cand = jnp.take(rescore_store, safe, axis=0).astype(jnp.float32)
+        qf = q.astype(jnp.float32)[:, None, :]
+        if metric == vi.DISTANCE_L2:
+            ed = jnp.sum((cand - qf) ** 2, axis=-1)
+        elif metric == vi.DISTANCE_DOT:
+            ed = -jnp.sum(cand * qf, axis=-1)
+        else:
+            ed = 1.0 - jnp.sum(cand * qf, axis=-1)
+        cand_d = jnp.where(jnp.isinf(cand_d), jnp.inf, ed)
+    neg, pos = jax.lax.top_k(-cand_d, k)
+    top = -neg
+    final = jnp.take_along_axis(cand_i, pos, axis=1)
+    final = jnp.where(jnp.isinf(top), -1, final).astype(jnp.int32)
+    return _pack(top, final)
+
+
+@functools.partial(
     jax.jit, static_argnames=("r", "use_allow", "exact", "active_chunks")
 )
 def _search_pq(codes, tombs, n, lut, allow_words, r, use_allow, exact=False,
@@ -284,27 +377,32 @@ def _search_pq(codes, tombs, n, lut, allow_words, r, use_allow, exact=False,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _rescore_candidates(cand_vecs, q, cand_valid, k, metric):
-    """Exact float rescoring of PQ candidates: cand_vecs [B, R, D] (gathered
-    host-side from the full-precision row store), q [B, D] -> packed top-k
-    (dists, positions-into-R). Elementwise per-pair distances — R is small so
-    this is VPU work overlapping the next batch's scan."""
+def _rescore_on_device(rescore_store, q, slots, k, metric):
+    """PQ rescoring without the host round trip: gather the top-R candidate
+    rows from the on-device bf16 rescore copy and score them at f32. The
+    gather + [B, R] elementwise pass is microseconds of device time; the
+    old path shipped [B, R, D] float rows (gigabytes at serving batch
+    sizes) through the host per batch."""
+    cap = rescore_store.shape[0]
+    safe = jnp.clip(slots, 0, cap - 1)
+    cand = jnp.take(rescore_store, safe, axis=0).astype(jnp.float32)  # [B,R,D]
     qf = q.astype(jnp.float32)[:, None, :]
-    cf = cand_vecs.astype(jnp.float32)
     if metric == vi.DISTANCE_L2:
-        d = jnp.sum((cf - qf) ** 2, axis=-1)
+        d = jnp.sum((cand - qf) ** 2, axis=-1)
     elif metric == vi.DISTANCE_DOT:
-        d = -jnp.sum(cf * qf, axis=-1)
+        d = -jnp.sum(cand * qf, axis=-1)
     elif metric == vi.DISTANCE_COSINE:
-        d = 1.0 - jnp.sum(cf * qf, axis=-1)
+        d = 1.0 - jnp.sum(cand * qf, axis=-1)
     elif metric == vi.DISTANCE_MANHATTAN:
-        d = jnp.sum(jnp.abs(cf - qf), axis=-1)
+        d = jnp.sum(jnp.abs(cand - qf), axis=-1)
     else:
-        d = jnp.sum((cf != qf).astype(jnp.float32), axis=-1)
-    d = jnp.where(cand_valid, d, jnp.inf)
+        d = jnp.sum((cand != qf).astype(jnp.float32), axis=-1)
+    d = jnp.where(slots >= 0, d, jnp.inf)
     neg, pos = jax.lax.top_k(-d, k)
     top = -neg
-    return _pack(top, jnp.where(jnp.isinf(top), -1, pos).astype(jnp.int32))
+    final = jnp.take_along_axis(slots, pos, axis=1)
+    final = jnp.where(jnp.isinf(top), -1, final).astype(jnp.int32)
+    return _pack(top, final)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
@@ -458,6 +556,8 @@ class TpuVectorIndex(VectorIndex):
         self.compressed = False
         self._pq = None                     # ProductQuantizer
         self._codes = None                  # device [capacity, M]
+        self._rescore_dev = None            # device bf16 [capacity, D]
+        self._recon_norms = None            # device f32 [capacity] ||recon||^2
         self._host_vecs: Optional[np.ndarray] = None  # np [capacity, D] f32
         self._pq_path = os.path.join(shard_path, "pq.npz")
         self._restoring = False
@@ -515,6 +615,9 @@ class TpuVectorIndex(VectorIndex):
                 hv = np.zeros((cap, self.dim), np.float32)
                 hv[: self.capacity] = self._host_vecs
                 self._host_vecs = hv
+                if self._rescore_dev is not None:
+                    self._rescore_dev = _grow_store(self._rescore_dev, cap)
+                self._recon_norms = _grow_1d(self._recon_norms, cap, jnp.float32(0))
             else:
                 self._store = _grow_store(self._store, cap)
                 self._sq_norms = _grow_1d(self._sq_norms, cap, jnp.float32(0))
@@ -539,6 +642,15 @@ class TpuVectorIndex(VectorIndex):
             if self.compressed:
                 codes = self._pq.encode(chunk)  # [_CHUNK, M]
                 self._codes = _write_rows(self._codes, jnp.asarray(codes), start + off)
+                self._recon_norms = _write_norms(
+                    self._recon_norms,
+                    jnp.asarray(self._pq.recon_sq_norms(codes)),
+                    start + off,
+                )
+                if self._rescore_dev is not None:
+                    self._rescore_dev = _write_rows(
+                        self._rescore_dev, jnp.asarray(chunk, jnp.bfloat16), start + off
+                    )
             else:
                 self._store = _write_rows(self._store, jnp.asarray(chunk, self.dtype), start + off)
                 if self.metric == vi.DISTANCE_L2:
@@ -663,6 +775,26 @@ class TpuVectorIndex(VectorIndex):
         hv = np.zeros((self.capacity, self.dim), np.float32)
         hv[: self.n] = vecs_n
         self._host_vecs = hv
+        self._recon_norms = jax.device_put(
+            jnp.asarray(
+                np.concatenate([
+                    pq.recon_sq_norms(codes),
+                    np.zeros(self.capacity - self.n, np.float32),
+                ])
+            ),
+            self.device,
+        )
+        # bf16 rescore copy stays in HBM: the candidate rescoring pass then
+        # never crosses the host boundary (half the f32 footprint the codes
+        # just replaced; disable via pq.rescore=false for memory-tightest)
+        if self.config.pq.rescore:
+            full_rs = np.zeros((self.capacity, self.dim), np.float32)
+            full_rs[: self.n] = vecs_n
+            self._rescore_dev = jax.device_put(
+                jnp.asarray(full_rs, jnp.bfloat16), self.device
+            )
+        else:
+            self._rescore_dev = None
         self._store = None
         self._sq_norms = None
         self._pq = pq
@@ -820,52 +952,89 @@ class TpuVectorIndex(VectorIndex):
         from weaviate_tpu.compress.pq import build_lut
 
         pqc = self.config.pq
-        rescore = pqc.rescore
-        # default candidate depth: 0.975+ recall at R=128 and ~1.0 at R=256
-        # on clustered data (see tests/test_pq.py); 8k/200 buckets to 256
-        r_cfg = pqc.rescore_limit or max(8 * k, 200)
-        # clamp to the scan chunk: per-chunk top-r can't select more rows
-        # than one chunk holds
-        r = min(_bucket_b(r_cfg) if rescore else k, self.n, _PQ_SCAN_CHUNK)
-        allow_words = self._allow_words(allow_list) if allow_list is not None else None
-        lut = build_lut(jnp.asarray(q), self._pq._dev_codebook(), self.metric)
-        packed = np.asarray(
-            _search_pq(
-                self._codes,
-                self._tombs,
-                self.n,
-                lut,
-                allow_words if allow_words is not None else jnp.zeros((self.capacity // 32,), jnp.uint32),
-                r,
-                allow_words is not None,
-                getattr(self.config, "exact_topk", False),
-                -(-self.n // _PQ_SCAN_CHUNK),
-            )
+        rescore = pqc.rescore and self._rescore_dev is not None
+        if self.metric == vi.DISTANCE_HAMMING:
+            # exact-equality tests against a bf16 copy count every dim as a
+            # mismatch; the LUT distance is already the hamming ADC estimate
+            rescore = False
+        # per-chunk candidate depth: selection cost on TPU grows sharply
+        # with k, so each chunk contributes a SMALL top-r and the rescored
+        # pool is nchunks * r_chunk deep. Sized so the pool stays >= 512
+        # regardless of chunk count (64/chunk over a 1M store; deeper per
+        # chunk when the store fits fewer chunks).
+        nchunks_eff = max(1, -(-self.n // _SCAN_CHUNK))
+        pool_target = pqc.rescore_limit or 1024
+        r_chunk = min(
+            max(2 * k, -(-pool_target // nchunks_eff), 64), 256, self.n
         )
-        top, slots = _unpack(packed)  # padded [bb, R]
-        if not rescore:
+        # the concatenated pool must cover k (final top_k rejects k > pool)
+        r_chunk = max(r_chunk, min(-(-k // nchunks_eff), self.n))
+        r = min(_bucket_b(max(8 * k, 200)) if rescore else k, self.n, _PQ_SCAN_CHUNK)
+        allow_words = self._allow_words(allow_list) if allow_list is not None else None
+        words = (allow_words if allow_words is not None
+                 else jnp.zeros((self.capacity // 32,), jnp.uint32))
+        if self.metric in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+            packed = np.asarray(
+                _search_pq_recon(
+                    self._codes,
+                    self._recon_norms,
+                    self._tombs,
+                    self.n,
+                    self._pq._dev_codebook(),
+                    (self._rescore_dev if rescore
+                     else jnp.zeros((1, self.dim), jnp.bfloat16)),
+                    jnp.asarray(q),
+                    words,
+                    min(k, self.live),
+                    r_chunk,
+                    self.metric,
+                    allow_words is not None,
+                    getattr(self.config, "exact_topk", False),
+                    -(-self.n // _SCAN_CHUNK),
+                    rescore,
+                )
+            )
+            top, slots = _unpack(packed)
+            top, slots = top[:b], slots[:b]
+            if not rescore and self.metric == vi.DISTANCE_COSINE:
+                pass  # recon path already emits 1 - dot directly
+            ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
+            return ids[:, :k], top[:, :k]
+        else:
+            lut = build_lut(jnp.asarray(q), self._pq._dev_codebook(), self.metric)
+            packed = np.asarray(
+                _search_pq(
+                    self._codes,
+                    self._tombs,
+                    self.n,
+                    lut,
+                    words,
+                    r,
+                    allow_words is not None,
+                    getattr(self.config, "exact_topk", False),
+                    -(-self.n // _PQ_SCAN_CHUNK),
+                )
+            )
+        if not rescore or self._rescore_dev is None:
+            top, slots = _unpack(packed)
             top, slots = top[:b], slots[:b]
             if self.metric == vi.DISTANCE_COSINE:
                 top = np.where(np.isinf(top), top, top + 1.0)
             ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
             return ids[:, :k], top[:, :k]
-        # gather candidates' float rows host-side, exact-rescore on device
-        # (padded batch throughout: one compiled shape per (bb, R, k))
-        safe = np.clip(slots, 0, None)
-        cand_vecs = self._host_vecs[safe]  # [bb, R, D]
+        # exact rescoring entirely on device against the bf16 rescore copy
+        _, slots_np = _unpack(packed)
         packed2 = np.asarray(
-            _rescore_candidates(
-                jnp.asarray(cand_vecs),
+            _rescore_on_device(
+                self._rescore_dev,
                 jnp.asarray(q),
-                jnp.asarray(slots >= 0),
+                jnp.asarray(slots_np),
                 min(k, r),
                 self.metric,
             )
         )
-        dists, pos = _unpack(packed2)
-        dists, pos, slots = dists[:b], pos[:b], slots[:b]
-        row = np.arange(b)[:, None]
-        final_slots = np.where(pos >= 0, slots[row, np.clip(pos, 0, None)], -1)
+        dists, final_slots = _unpack(packed2)
+        dists, final_slots = dists[:b], final_slots[:b]
         ids = np.where(final_slots >= 0, self._slot_to_doc[np.clip(final_slots, 0, None)], -1)
         return ids, dists
 
@@ -1030,6 +1199,8 @@ class TpuVectorIndex(VectorIndex):
             self.compressed = False
             self._pq = None
             self._codes = None
+            self._rescore_dev = None
+            self._recon_norms = None
             self._host_vecs = None
             self.dim = None
             self.capacity = 0
@@ -1067,6 +1238,8 @@ class TpuVectorIndex(VectorIndex):
             self.compressed = False
             self._pq = None
             self._codes = None
+            self._rescore_dev = None
+            self._recon_norms = None
             self._host_vecs = None
             try:
                 os.remove(self._pq_path)

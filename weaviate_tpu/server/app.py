@@ -140,6 +140,15 @@ class App:
 
         self.classifier = Classifier(self.db, self.schema)
         self.cluster = self.cluster_node  # /v1/nodes aggregation source
+        # disk-pressure failure detection (storagestate READONLY automation)
+        from weaviate_tpu.monitoring.disk import DiskMonitor
+
+        self.disk_monitor = DiskMonitor(
+            self.db,
+            warning_pct=self.config.disk_use.warning_percentage,
+            readonly_pct=self.config.disk_use.readonly_percentage,
+        )
+        self.disk_monitor.start()
 
     # -- meta ----------------------------------------------------------------
 
@@ -152,6 +161,7 @@ class App:
         }
 
     def shutdown(self) -> None:
+        self.disk_monitor.shutdown()
         if self.cluster_node is not None:
             self.cluster_node.shutdown()
         else:

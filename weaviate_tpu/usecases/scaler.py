@@ -38,20 +38,35 @@ class Scaler:
             shard = idx.shards.get(shard_name)
             if shard is None:
                 continue
-            with shard.paused_writes():  # no flush/compaction mid-copy
-                base = shard.path
+            # snapshot the shard files to local scratch UNDER the write
+            # pause (bounded by local disk speed), then stream to the new
+            # replicas with writes already flowing again — a slow peer must
+            # not stall the shard for the whole transfer
+            import shutil
+            import tempfile
+
+            scratch = tempfile.mkdtemp(prefix=f"scale-{shard_name}-")
+            try:
                 rels = []
-                for root, _, files in os.walk(base):
-                    for fn in files:
-                        if fn.endswith(".tmp"):
-                            continue
-                        rels.append(os.path.relpath(os.path.join(root, fn), base))
+                with shard.paused_writes():
+                    base = shard.path
+                    for root, _, files in os.walk(base):
+                        for fn in files:
+                            if fn.endswith(".tmp"):
+                                continue
+                            rel = os.path.relpath(os.path.join(root, fn), base)
+                            rels.append(rel)
+                            dst = os.path.join(scratch, rel)
+                            os.makedirs(os.path.dirname(dst), exist_ok=True)
+                            shutil.copy2(os.path.join(base, rel), dst)
                 for target in added:
                     host = self.cluster.node_address(target)
                     if host is None:
                         continue
                     self.nodes.create_shard(host, class_name, shard_name)
                     for rel in rels:
-                        with open(os.path.join(base, rel), "rb") as f:
+                        with open(os.path.join(scratch, rel), "rb") as f:
                             self.nodes.upload_file(host, class_name, shard_name, rel, f.read())
                     self.nodes.reload_shard(host, class_name, shard_name)
+            finally:
+                shutil.rmtree(scratch, ignore_errors=True)
