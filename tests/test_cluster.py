@@ -408,3 +408,39 @@ def test_nodes_status_aggregation(cluster3):
     assert {s["name"] for s in statuses} == {"node-0", "node-1", "node-2"}
     total = sum(s["stats"]["objectCount"] for s in statuses if "stats" in s)
     assert total == 12
+
+
+def test_late_joiner_syncs_schema(tmp_path):
+    """startup_cluster_sync.go: a node joining AFTER classes were created
+    adopts the cluster schema at startup instead of waiting for the next
+    DDL transaction."""
+    names = ["node-0", "node-1", "node-2"]
+    early = [ClusterNode(str(tmp_path / n), n, node_names=names) for n in names[:2]]
+    try:
+        for n in early:
+            n.start()
+        early[0].join({early[1].node_name: early[1].address})
+        early[1].join({early[0].node_name: early[0].address})
+        early[0].schema.add_class(make_class(shards=3))
+        assert early[1].schema.get_class("Dist") is not None
+
+        # node-2 starts later with an empty disk
+        late = ClusterNode(str(tmp_path / "node-2"), "node-2", node_names=names)
+        late.start()
+        late.join({n.node_name: n.address for n in early})
+        for n in early:
+            n.cluster.register("node-2", late.address)
+        assert late.schema.get_class("Dist") is None
+        adopted = late.sync_schema()
+        assert adopted == 1
+        assert late.schema.get_class("Dist") is not None
+        # and it now serves its shard of the ring
+        assert late.db.get_index("Dist") is not None
+        idx0 = early[0].db.get_index("Dist")
+        objs = [new_obj(i) for i in range(30)]
+        assert all(e is None for e in idx0.put_batch(objs))
+        res = late.db.get_index("Dist").object_vector_search(objs[3].vector, k=1)
+        assert res[0][0].obj.uuid == objs[3].uuid
+        late.shutdown()
+    finally:
+        teardown_cluster(early)

@@ -131,6 +131,35 @@ class ClusterNode:
         for name, host in peers.items():
             self.cluster.register(name, host)
 
+    def sync_schema(self) -> int:
+        """Startup cluster schema sync (startup_cluster_sync.go /
+        read_consensus.go): adopt classes the cluster already has that this
+        node is missing — a node (re)joining with an empty or stale disk
+        must serve the cluster's schema without waiting for the next DDL
+        transaction. Local classes are never overwritten (divergence is the
+        operator's call, CLUSTER_IGNORE_SCHEMA_SYNC semantics).
+        -> number of classes adopted."""
+        from weaviate_tpu.entities.schema import ClassDef
+
+        adopted = 0
+        for name in self.cluster.all_names():
+            if name == self.node_name:
+                continue
+            host = self.cluster.node_address(name)
+            if host is None:
+                continue
+            try:
+                remote = self.node_client.schema(host)
+            except Exception:  # noqa: BLE001 — peer down: try the next one
+                continue
+            for cd_dict in remote.get("classes", []):
+                cname = cd_dict.get("class")
+                if cname and self.schema.get_class(cname) is None:
+                    self.schema.apply_add_class(ClassDef.from_dict(cd_dict))
+                    adopted += 1
+            break  # first reachable peer is the consensus source
+        return adopted
+
     # -- /v1/nodes cluster aggregation (usecases/nodes/handler.go) -----------
 
     def nodes_status(self) -> list[dict]:

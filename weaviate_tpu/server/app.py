@@ -67,6 +67,8 @@ class App:
             )
             self.cluster_node.start()
             self.cluster_node.join(peers)
+            if not cl_cfg.ignore_schema_sync:
+                self.cluster_node.sync_schema()
             self.db = self.cluster_node.db
             self.schema = self.cluster_node.schema
         else:
