@@ -302,3 +302,121 @@ def test_backup_fs_backend(tmp_path):
     assert be.read_meta("nope") is None
     with pytest.raises(ValueError):
         be.put_object("b1", "../escape", b"x")
+
+
+# -- explanation additional props (explain.py) -------------------------------
+# reference: modules/text2vec-contextionary/additional/{nearestneighbors,
+# sempath, interpretation, projector}, payload shapes in additional/models
+
+
+def _mk_results(vectorizer, texts):
+    """SearchResult-shaped rows with module-vectorized objects."""
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.usecases.traverser import SearchResult
+
+    rows = []
+    for i, t in enumerate(texts):
+        vec = vectorizer.vectorize_text([t])[0]
+        obj = StorObj(class_name="Doc", uuid=str(uuidlib.UUID(int=i + 1)),
+                      properties={"body": t}, vector=vec)
+        rows.append(SearchResult(obj=obj, distance=0.1 * i))
+    return rows
+
+
+def test_explain_nearest_neighbors_and_interpretation():
+    v = LocalTextVectorizer()
+    results = _mk_results(v, [
+        "quantum qubits entanglement physics",
+        "bread flour yeast baking oven",
+    ])
+    nn = v.resolve_additional("nearestNeighbors", results, {"limit": 3})
+    assert len(nn) == 2
+    concepts0 = [x["concept"] for x in nn[0]["neighbors"]]
+    assert len(concepts0) == 3
+    # a quantum doc's nearest concepts come from its own wordlist, not bread's
+    assert set(concepts0) <= {"quantum", "qubits", "entanglement", "physics"}
+    assert nn[0]["neighbors"][0]["distance"] <= nn[0]["neighbors"][-1]["distance"]
+
+    interp = v.resolve_additional("interpretation", results, {})
+    src = interp[1]["source"]
+    assert {s["concept"] for s in src} == {"bread", "flour", "yeast", "baking", "oven"}
+    assert all(0.0 <= s["weight"] <= 1.0 and s["occurrence"] == 1 for s in src)
+
+
+def test_explain_semantic_path_requires_neartext():
+    from weaviate_tpu.modules.provider import ModuleError
+
+    v = LocalTextVectorizer()
+    results = _mk_results(v, ["quantum qubits computing"])
+    with pytest.raises(ModuleError):
+        v.resolve_additional("semanticPath", results, {})
+
+    out = v.resolve_additional(
+        "semanticPath", results, {"near_text": {"concepts": ["quantum physics"]}})
+    path = out[0]["path"]
+    assert len(path) >= 1
+    for el in path:
+        assert "concept" in el and "distanceToQuery" in el and "distanceToResult" in el
+    # the walk moves toward the result: last element is closest to it
+    assert path[-1]["distanceToResult"] <= path[0]["distanceToResult"] + 1e-6
+    # neighbors in the path link distances both ways
+    if len(path) > 1:
+        assert "distanceToNext" in path[0] and "distanceToPrevious" in path[-1]
+
+
+def test_explain_feature_projection_tsne():
+    v = LocalTextVectorizer()
+    # two tight clusters of texts -> the 2-D projection must separate them
+    results = _mk_results(v, [
+        "quantum qubits entanglement", "quantum qubits physics",
+        "bread flour yeast", "bread flour oven",
+    ])
+    fp = v.resolve_additional("featureProjection", results, {"dimensions": 2})
+    pts = np.array([x["vector"] for x in fp])
+    assert pts.shape == (4, 2)
+    import itertools
+
+    def d(i, j):
+        return float(np.linalg.norm(pts[i] - pts[j]))
+
+    intra = max(d(0, 1), d(2, 3))
+    inter = min(d(i, j) for i, j in itertools.product((0, 1), (2, 3)))
+    assert inter > intra, (pts, intra, inter)
+    # deterministic: same inputs, same layout
+    fp2 = v.resolve_additional("featureProjection", results, {"dimensions": 2})
+    np.testing.assert_allclose(pts, np.array([x["vector"] for x in fp2]))
+
+
+def test_explain_props_graphql_e2e(neartext_app):
+    """featureProjection + nearestNeighbors + semanticPath through the full
+    GraphQL stack (vector fetch is triggered by the selection alone)."""
+    app, srv = neartext_app
+    _req(srv.port, "POST", "/v1/schema", {
+        "class": "XDoc",
+        "vectorizer": "text2vec-local",
+        "vectorIndexConfig": {"distance": "cosine"},
+        "properties": [{"name": "body", "dataType": ["text"]}],
+    })
+    payloads = [{"class": "XDoc", "id": str(uuidlib.UUID(int=100 + i)),
+                 "properties": {"body": b}}
+                for i, b in enumerate([
+                    "quantum qubits entanglement computing",
+                    "quantum hardware error correction",
+                    "sourdough bread flour yeast",
+                ])]
+    st, out = _req(srv.port, "POST", "/v1/batch/objects", {"objects": payloads})
+    assert st == 200
+
+    q = ('{ Get { XDoc(nearText: {concepts: ["quantum"]}, limit: 3) { body '
+         '_additional { nearestNeighbors { neighbors { concept distance } } '
+         'semanticPath { path { concept distanceToQuery distanceToResult } } '
+         'featureProjection(dimensions: 2) { vector } } } } }')
+    st, res = _req(srv.port, "POST", "/v1/graphql", {"query": q})
+    assert st == 200 and not res.get("errors"), res
+    hits = res["data"]["Get"]["XDoc"]
+    assert len(hits) == 3
+    for h in hits:
+        add = h["_additional"]
+        assert add["nearestNeighbors"]["neighbors"]
+        assert add["semanticPath"]["path"]
+        assert len(add["featureProjection"]["vector"]) == 2

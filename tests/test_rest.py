@@ -246,3 +246,43 @@ def test_apikey_auth(tmp_path):
     finally:
         srv.stop()
         app.shutdown()
+
+
+def test_pprof_surface(port):
+    """/debug/pprof endpoints (configure_api.go:25 always-mounts pprof;
+    ours is a sys._current_frames() sampler — monitoring/profiling.py)."""
+    st, idx = _req(port, "GET", "/debug/pprof/", raw=True)
+    assert st == 200 and b"profile" in idx
+
+    st, dump = _req(port, "GET", "/debug/pprof/goroutine", raw=True)
+    assert st == 200 and b"thread" in dump
+    # the HTTP worker thread serving this very request is in the dump
+    assert b"_dispatch" in dump or b"h_pprof_goroutine" in dump
+
+    # short CPU profile while a busy thread runs -> its frames show up
+    import threading, time as _t
+
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(i * i for i in range(1000))
+
+    t = threading.Thread(target=spin, name="spinner", daemon=True)
+    t.start()
+    try:
+        st, prof = _req(port, "GET", "/debug/pprof/profile?seconds=0.3&hz=200", raw=True)
+    finally:
+        stop.set()
+        t.join()
+    assert st == 200
+    assert b"spin" in prof, prof[:400]
+
+    # heap: first call arms tracemalloc, second returns a report
+    st, h1 = _req(port, "GET", "/debug/pprof/heap", raw=True)
+    assert st == 200
+    st, h2 = _req(port, "GET", "/debug/pprof/heap?limit=5", raw=True)
+    assert st == 200 and (b"total tracked" in h2 or b"armed" in h2)
+
+    st, cl = _req(port, "GET", "/debug/pprof/cmdline", raw=True)
+    assert st == 200 and cl

@@ -152,12 +152,19 @@ class ClusterNode:
                 remote = self.node_client.schema(host)
             except Exception:  # noqa: BLE001 — peer down: try the next one
                 continue
-            for cd_dict in remote.get("classes", []):
+            classes = remote.get("classes", [])
+            if not classes:
+                # a reachable peer with an EMPTY schema is not consensus —
+                # it may be another fresh joiner; keep looking for a peer
+                # that actually holds classes (read_consensus.go compares
+                # payloads instead of trusting the first response)
+                continue
+            for cd_dict in classes:
                 cname = cd_dict.get("class")
                 if cname and self.schema.get_class(cname) is None:
                     self.schema.apply_add_class(ClassDef.from_dict(cd_dict))
                     adopted += 1
-            break  # first reachable peer is the consensus source
+            break  # first peer with a non-empty schema is the source
         return adopted
 
     # -- /v1/nodes cluster aggregation (usecases/nodes/handler.go) -----------

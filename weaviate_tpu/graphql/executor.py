@@ -131,6 +131,11 @@ class GraphQLExecutor:
                     continue
                 if sub.name == "answer":
                     prop_params = _plain(params.ask) if params.ask else {}
+                elif sub.name == "semanticPath":
+                    # sempath/builder.go: the path starts at the nearText
+                    # query concepts, so the resolver needs them
+                    prop_params = {k: _plain(v) for k, v in sub.args.items()}
+                    prop_params["near_text"] = _plain(params.near_text) if params.near_text else None
                 elif sub.name == "spellCheck":
                     concepts = (params.near_text or {}).get("concepts") or []
                     if isinstance(concepts, str):
@@ -138,7 +143,9 @@ class GraphQLExecutor:
                     prop_params = {"text": " ".join(str(c) for c in concepts)}
                 else:
                     prop_params = {k: _plain(v) for k, v in sub.args.items()}
-                values = provider.resolve_additional(sub.name, results, prop_params)
+                class_def = self.schema.get_schema().classes.get(params.class_name)
+                values = provider.resolve_additional(
+                    sub.name, results, prop_params, class_def=class_def)
                 for r, v in zip(results, values):
                     r.additional[sub.name] = v
 
@@ -184,11 +191,18 @@ class GraphQLExecutor:
             out["operands"] = [self._convert_where(o) for o in out["operands"]]
         return out
 
+    # _additional props whose module resolvers need the result vectors
+    # (explain.py: neighbors/path/interpretation/projection all score
+    # against the object embedding)
+    _VECTOR_HUNGRY_PROPS = frozenset(
+        {"vector", "featureProjection", "nearestNeighbors", "semanticPath",
+         "interpretation"})
+
     def _selection_wants_vector(self, sels: list) -> bool:
         for s in sels:
             if isinstance(s, Field) and s.name == "_additional":
                 for sub in s.selections:
-                    if isinstance(sub, Field) and sub.name == "vector":
+                    if isinstance(sub, Field) and sub.name in self._VECTOR_HUNGRY_PROPS:
                         return True
         return False
 

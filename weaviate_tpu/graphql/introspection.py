@@ -100,6 +100,11 @@ def build_introspection(schema) -> dict:
         _field("explainScore", _t("String", "SCALAR")),
         _field("creationTimeUnix", _t("String", "SCALAR")),
         _field("lastUpdateTimeUnix", _t("String", "SCALAR")),
+        # module-provided explanation props (class_builder_fields.go:590-620)
+        _field("featureProjection", _t("FeatureProjection")),
+        _field("nearestNeighbors", _t("NearestNeighbors")),
+        _field("semanticPath", _t("SemanticPath")),
+        _field("interpretation", _t("Interpretation")),
     ]
 
     types: list[dict] = [
@@ -110,6 +115,35 @@ def build_introspection(schema) -> dict:
         ]),
         _obj_type("AdditionalProps", additional_fields,
                   "_additional result metadata"),
+        _obj_type("FeatureProjection", [
+            _field("vector", _list_of(_t("Float", "SCALAR"))),
+        ]),
+        _obj_type("NearestNeighbors", [
+            _field("neighbors", _list_of(_t("NearestNeighbor"))),
+        ]),
+        _obj_type("NearestNeighbor", [
+            _field("concept", _t("String", "SCALAR")),
+            _field("distance", _t("Float", "SCALAR")),
+            _field("vector", _list_of(_t("Float", "SCALAR"))),
+        ]),
+        _obj_type("SemanticPath", [
+            _field("path", _list_of(_t("SemanticPathElement"))),
+        ]),
+        _obj_type("SemanticPathElement", [
+            _field("concept", _t("String", "SCALAR")),
+            _field("distanceToNext", _t("Float", "SCALAR")),
+            _field("distanceToPrevious", _t("Float", "SCALAR")),
+            _field("distanceToQuery", _t("Float", "SCALAR")),
+            _field("distanceToResult", _t("Float", "SCALAR")),
+        ]),
+        _obj_type("Interpretation", [
+            _field("source", _list_of(_t("InterpretationSource"))),
+        ]),
+        _obj_type("InterpretationSource", [
+            _field("concept", _t("String", "SCALAR")),
+            _field("occurrence", _t("Int", "SCALAR")),
+            _field("weight", _t("Float", "SCALAR")),
+        ]),
     ]
 
     get_fields, agg_fields = [], []

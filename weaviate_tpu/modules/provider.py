@@ -165,9 +165,16 @@ class Provider:
 
     # -- module additional properties (modulecapabilities/additional.go) -----
 
-    def additional_property_module(self, prop: str):
+    def additional_property_module(self, prop: str, class_def=None):
         from weaviate_tpu.modules.interface import AdditionalProperties
 
+        # the class's own vectorizer wins: explain props score against the
+        # class's embedding space, so another module's vocab vectors would
+        # be a different dimensionality/geometry entirely
+        if class_def is not None:
+            own = self._modules.get(getattr(class_def, "vectorizer", "") or "")
+            if isinstance(own, AdditionalProperties) and prop in own.additional_properties():
+                return own
         for m in self._modules.values():
             if isinstance(m, AdditionalProperties) and prop in m.additional_properties():
                 return m
@@ -182,8 +189,8 @@ class Provider:
                 out.extend(m.additional_properties())
         return sorted(set(out))
 
-    def resolve_additional(self, prop: str, results, params: dict):
-        mod = self.additional_property_module(prop)
+    def resolve_additional(self, prop: str, results, params: dict, class_def=None):
+        mod = self.additional_property_module(prop, class_def)
         if mod is None:
             raise ModuleError(f"no enabled module resolves _additional.{prop!r}")
         return mod.resolve_additional(prop, results, params)

@@ -15,13 +15,14 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from weaviate_tpu.modules.explain import SemanticExplainer
 from weaviate_tpu.modules.interface import GraphQLArguments, Module, Vectorizer
 from weaviate_tpu.modules.provider import ModuleError, corpus_from_object
 
 _SERVICE = "/weaviatetpu.modules.v1.Vectorizer"
 
 
-class ContextionaryVectorizer(Module, Vectorizer, GraphQLArguments):
+class ContextionaryVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplainer):
     def __init__(self, url: str, timeout: float = 30.0):
         if not url:
             raise ModuleError(

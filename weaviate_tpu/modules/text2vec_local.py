@@ -23,13 +23,14 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from weaviate_tpu.modules.explain import SemanticExplainer
 from weaviate_tpu.modules.interface import GraphQLArguments, Module, Vectorizer
 from weaviate_tpu.modules.provider import corpus_from_object
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
 
 
-class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments):
+class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplainer):
     def __init__(self, name: str = "text2vec-local", dim: int = 256):
         self._name = name
         self.dim = dim

@@ -1,0 +1,119 @@
+"""Runtime profiling endpoints (the reference's pprof surface).
+
+Reference: net/http/pprof is always mounted (adapters/handlers/rest/
+configure_api.go:25) and setupGoProfiling (configure_api.go:679) turns on
+block/mutex profiling from env flags. The Go runtime ships a sampling
+profiler; Python does not — so the CPU profile here is a built-in wall-clock
+stack sampler over `sys._current_frames()` (the same technique py-spy uses,
+in-process): thread-aware, low overhead at the default 100 Hz, and needs no
+instrumentation of the profiled code.
+
+Endpoints (all GET, mounted on the main REST port like the reference):
+  /debug/pprof/            index
+  /debug/pprof/profile     sample all threads for ?seconds=N (default 5,
+                           ?hz=100) -> collapsed-stack text (flamegraph
+                           input format: "frame;frame;frame count")
+  /debug/pprof/goroutine   one-shot dump of every live thread's stack
+  /debug/pprof/heap        tracemalloc top allocation sites (?limit=30);
+                           first call arms tracemalloc and reports that
+  /debug/pprof/cmdline     process argv
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+
+
+class StackSampler:
+    """Wall-clock sampling profiler over sys._current_frames()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # one profile run at a time
+
+    def profile(self, seconds: float = 5.0, hz: int = 100) -> str:
+        seconds = max(0.05, min(float(seconds), 30.0))
+        hz = max(1, min(int(hz), 1000))
+        interval = 1.0 / hz
+        counts: dict[tuple, int] = {}
+        own = threading.get_ident()
+        if not self._lock.acquire(timeout=1.0):
+            raise RuntimeError("another profile is already running")
+        try:
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == own:
+                        continue
+                    stack = []
+                    f = frame
+                    while f is not None and len(stack) < 64:
+                        code = f.f_code
+                        stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}:{code.co_name}")
+                        f = f.f_back
+                    key = tuple(reversed(stack))
+                    counts[key] = counts.get(key, 0) + 1
+                time.sleep(interval)
+        finally:
+            self._lock.release()
+        lines = [
+            f"{';'.join(stack)} {n}"
+            for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])
+        ]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def thread_dump() -> str:
+    """All live threads with their current stacks (pprof /goroutine twin)."""
+    names = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        t = names.get(tid)
+        label = t.name if t else "?"
+        daemon = " daemon" if t is not None and t.daemon else ""
+        out.append(f"thread {tid} [{label}]{daemon}:")
+        out.extend(line.rstrip("\n") for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+_heap_armed = False
+
+
+def heap_profile(limit: int = 30) -> str:
+    """tracemalloc top allocation sites; arms tracing on first call (the
+    price of not paying tracemalloc overhead when nobody is profiling)."""
+    import tracemalloc
+
+    global _heap_armed
+    limit = max(1, min(int(limit), 200))
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(16)
+        _heap_armed = True
+        return (
+            "tracemalloc armed by this request; allocations are tracked "
+            "from now on — call /debug/pprof/heap again after the workload\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:limit]
+    total = sum(s.size for s in snap.statistics("filename"))
+    out = [f"total tracked: {total / 1024:.1f} KiB; top {len(stats)} by line:"]
+    for s in stats:
+        out.append(f"  {s.size / 1024:10.1f} KiB  {s.count:8d} blocks  {s.traceback}")
+    return "\n".join(out) + "\n"
+
+
+def cmdline() -> str:
+    return "\x00".join(sys.argv) + "\n"
+
+
+def index() -> str:
+    return (
+        "/debug/pprof/\n"
+        "  profile?seconds=5&hz=100  sampled CPU profile (collapsed stacks)\n"
+        "  goroutine                 all thread stacks\n"
+        "  heap?limit=30             tracemalloc top allocation sites\n"
+        "  cmdline                   process argv\n"
+    )

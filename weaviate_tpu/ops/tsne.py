@@ -1,0 +1,124 @@
+"""t-SNE feature projection on device.
+
+The reference's `featureProjection` additional prop runs go-tsne over the
+result set's vectors (modules/text2vec-contextionary/additional/projector/
+projector.go). Result sets are small (tens to a few hundred rows), so this
+is a latency problem, not a throughput one: the implementation below keeps
+the O(n^2 d) affinity/gradient math as dense [n, n] matrix ops and jits the
+whole gradient descent as one `lax.fori_loop` program — one device dispatch
+per projection, no per-iteration host round trips.
+
+Determinism: Y is initialized from the top principal components of X (no
+RNG), so the same result set always projects to the same layout — the
+property the reference gets by seeding go-tsne.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def _affinities(x: np.ndarray, perplexity: float) -> np.ndarray:
+    """Symmetrized t-SNE input affinities P (numpy: n is tiny and the
+    per-point sigma binary search is branchy host logic)."""
+    n = x.shape[0]
+    d2 = np.square(x[:, None, :] - x[None, :, :]).sum(-1)
+    target = np.log(max(perplexity, 1.0001))
+    p = np.zeros((n, n), dtype=np.float64)
+    for i in range(n):
+        lo, hi = 1e-20, 1e20
+        beta = 1.0
+        di = np.delete(d2[i], i)
+        for _ in range(50):
+            w = np.exp(-di * beta)
+            s = w.sum()
+            if s <= 0:
+                h = 0.0
+            else:
+                pi = w / s
+                h = -(pi * np.log(np.maximum(pi, 1e-30))).sum()
+            if abs(h - target) < 1e-5:
+                break
+            if h > target:
+                lo = beta
+                beta = beta * 2 if hi >= 1e20 else (beta + hi) / 2
+            else:
+                hi = beta
+                beta = beta / 2 if lo <= 1e-20 else (beta + lo) / 2
+        w = np.exp(-d2[i] * beta)
+        w[i] = 0.0
+        s = w.sum()
+        p[i] = w / s if s > 0 else 0.0
+    p = (p + p.T) / (2.0 * n)
+    return np.maximum(p, 1e-12).astype(np.float32)
+
+
+@functools.lru_cache(maxsize=8)
+def _tsne_program(n: int, dims: int, iterations: int, learning_rate: float):
+    import jax
+    import jax.numpy as jnp
+
+    exaggeration_until = max(1, iterations // 4)
+
+    @jax.jit
+    def run(p, y0):
+        def step(i, carry):
+            y, vel = carry
+            pe = jnp.where(i < exaggeration_until, p * 12.0, p)
+            diff = y[:, None, :] - y[None, :, :]          # [n, n, dims]
+            q_num = 1.0 / (1.0 + jnp.sum(diff ** 2, axis=-1))
+            q_num = q_num * (1.0 - jnp.eye(n))
+            q = jnp.maximum(q_num / jnp.sum(q_num), 1e-12)
+            g = 4.0 * jnp.sum(((pe - q) * q_num)[:, :, None] * diff, axis=1)
+            mom = jnp.where(i < exaggeration_until, 0.5, 0.8)
+            vel = mom * vel - learning_rate * g
+            y = y + vel
+            return y - jnp.mean(y, axis=0, keepdims=True), vel
+
+        y, _ = jax.lax.fori_loop(
+            0, iterations, step, (y0, jnp.zeros_like(y0))
+        )
+        return y
+
+    return run
+
+
+def tsne_project(
+    vectors: np.ndarray,
+    dims: int = 2,
+    perplexity: float = 0.0,
+    iterations: int = 100,
+    learning_rate: float = 25.0,
+) -> np.ndarray:
+    """Project [n, d] float vectors to [n, dims] with exact t-SNE.
+
+    perplexity <= 0 selects the reference's auto rule: min(5, n-1)
+    (projector.go defaultPerplexity-style guard for small result sets).
+    n < 2 short-circuits (a single point projects to the origin).
+    """
+    import jax.numpy as jnp
+
+    x = np.asarray(vectors, dtype=np.float32)
+    n = x.shape[0]
+    if n == 0:
+        return np.zeros((0, dims), dtype=np.float32)
+    if n == 1:
+        return np.zeros((1, dims), dtype=np.float32)
+    if perplexity <= 0:
+        perplexity = float(min(5, n - 1))
+    perplexity = float(min(perplexity, n - 1))
+
+    p = _affinities(x, perplexity)
+
+    # deterministic PCA init scaled small (the usual 1e-4 t-SNE convention)
+    xc = x - x.mean(axis=0, keepdims=True)
+    _, _, vt = np.linalg.svd(xc, full_matrices=False)
+    comps = vt[:dims] if vt.shape[0] >= dims else np.pad(vt, ((0, dims - vt.shape[0]), (0, 0)))
+    y0 = (xc @ comps.T).astype(np.float32)
+    scale = np.abs(y0).max()
+    y0 = y0 / (scale * 1e4) if scale > 0 else y0
+
+    run = _tsne_program(n, dims, int(iterations), float(learning_rate))
+    return np.asarray(run(jnp.asarray(p), jnp.asarray(y0)), dtype=np.float32)

@@ -40,6 +40,12 @@ class App:
         else:
             self.metrics = noop_metrics()
 
+        # pprof twin: one sampler per process (profile runs are serialized
+        # by its lock the way pprof serializes CPU profiles)
+        from weaviate_tpu.monitoring.profiling import StackSampler
+
+        self.stack_sampler = StackSampler()
+
         # distributed deployments (CLUSTER_HOSTNAME/CLUSTER_JOIN set) build
         # the full cluster graph: membership, cluster-API listener, schema
         # 2PC, replication, scaler (configure_api.go startupRoutine's

@@ -98,6 +98,12 @@ for _m, _p, _n in [
     ("POST", r"/v1/graphql/batch", "graphql_batch"),
     ("GET", r"/v1/nodes", "nodes"),
     ("GET", r"/metrics", "metrics"),
+    # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
+    ("GET", r"/debug/pprof/?", "pprof_index"),
+    ("GET", r"/debug/pprof/profile", "pprof_profile"),
+    ("GET", r"/debug/pprof/goroutine", "pprof_goroutine"),
+    ("GET", r"/debug/pprof/heap", "pprof_heap"),
+    ("GET", r"/debug/pprof/cmdline", "pprof_cmdline"),
     ("POST", r"/v1/backups/(?P<backend>[^/]+)", "backup_create"),
     ("GET", r"/v1/backups/(?P<backend>[^/]+)/(?P<id>[^/]+)", "backup_status"),
     ("POST", r"/v1/backups/(?P<backend>[^/]+)/(?P<id>[^/]+)/restore", "backup_restore"),
@@ -171,6 +177,10 @@ class Handler(BaseHTTPRequestHandler):
             parsed = urlparse(self.path)
             self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             name, mt = ROUTES.match(self.command, parsed.path)
+            # unlike the reference's unauthenticated DefaultServeMux
+            # side-mount (configure_api.go:25), pprof goes through the same
+            # authorizer as the data plane — thread stacks and CPU profiles
+            # are not for anonymous remote clients
             if name not in ("live", "ready", "openid", "metrics"):
                 principal = self._principal()
                 verb = _WRITE_METHODS.get(self.command, "get")
@@ -216,6 +226,38 @@ class Handler(BaseHTTPRequestHandler):
     def h_metrics(self):
         self._reply(200, raw=self.app.metrics.expose(),
                     content_type="text/plain; version=0.0.4")
+
+    # -- profiling (monitoring/profiling.py; pprof surface) ------------------
+
+    def h_pprof_index(self):
+        from weaviate_tpu.monitoring import profiling
+
+        self._reply(200, raw=profiling.index().encode(), content_type="text/plain")
+
+    def h_pprof_profile(self):
+        from weaviate_tpu.monitoring import profiling
+
+        text = self.app.stack_sampler.profile(
+            seconds=float(self.query.get("seconds", 5)),
+            hz=int(self.query.get("hz", 100)),
+        )
+        self._reply(200, raw=text.encode(), content_type="text/plain")
+
+    def h_pprof_goroutine(self):
+        from weaviate_tpu.monitoring import profiling
+
+        self._reply(200, raw=profiling.thread_dump().encode(), content_type="text/plain")
+
+    def h_pprof_heap(self):
+        from weaviate_tpu.monitoring import profiling
+
+        text = profiling.heap_profile(limit=int(self.query.get("limit", 30)))
+        self._reply(200, raw=text.encode(), content_type="text/plain")
+
+    def h_pprof_cmdline(self):
+        from weaviate_tpu.monitoring import profiling
+
+        self._reply(200, raw=profiling.cmdline().encode(), content_type="text/plain")
 
     # -- schema --------------------------------------------------------------
 
