@@ -91,7 +91,8 @@ def test_knn_classification_journey(served):
         "class": "Article", "classifyProperties": ["category"],
         "basedOnProperties": ["title"], "type": "knn", "settings": {"k": 3},
     })
-    assert st == 201 and job["status"] == "running"
+    # the async job may already have finished on a fast machine
+    assert st == 201 and job["status"] in ("running", "completed")
     final = _wait_job(srv.port, job["id"])
     assert final["status"] == "completed", final
     assert final["meta"]["count"] == 10

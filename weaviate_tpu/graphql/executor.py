@@ -123,6 +123,7 @@ class GraphQLExecutor:
         module_props = set(provider.additional_properties())
         if not module_props:
             return
+        class_def = self.schema.get_class(params.class_name)
         for sel in class_field.selections:
             if not (isinstance(sel, Field) and sel.name == "_additional"):
                 continue
@@ -143,7 +144,6 @@ class GraphQLExecutor:
                     prop_params = {"text": " ".join(str(c) for c in concepts)}
                 else:
                     prop_params = {k: _plain(v) for k, v in sub.args.items()}
-                class_def = self.schema.get_schema().classes.get(params.class_name)
                 values = provider.resolve_additional(
                     sub.name, results, prop_params, class_def=class_def)
                 for r, v in zip(results, values):

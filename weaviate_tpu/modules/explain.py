@@ -75,7 +75,13 @@ class SemanticExplainer(AdditionalProperties):
         without the memo a sidecar-backed vectorizer would pay one full
         vocab embedding round-trip per prop. Query concepts (extra_texts,
         a handful of words) are embedded per call and appended."""
-        key = tuple(getattr(r.obj, "uuid", id(r)) for r in results)
+        # update-time in the key: a PATCHed object must not serve the vocab
+        # of its pre-edit text from the memo
+        key = tuple(
+            (getattr(r.obj, "uuid", id(r)),
+             getattr(r.obj, "last_update_time_unix", 0))
+            for r in results
+        )
         memo = getattr(self, "_vocab_memo", None)
         if memo is not None and memo[0] == key:
             words, vecs = memo[1]

@@ -168,13 +168,22 @@ class Provider:
     def additional_property_module(self, prop: str, class_def=None):
         from weaviate_tpu.modules.interface import AdditionalProperties
 
-        # the class's own vectorizer wins: explain props score against the
-        # class's embedding space, so another module's vocab vectors would
-        # be a different dimensionality/geometry entirely
-        if class_def is not None:
+        from weaviate_tpu.modules.explain import EXPLAIN_PROPS
+
+        # explain props score against the class's embedding space, so only
+        # the class's OWN vectorizer may resolve them — another module's
+        # vocab vectors would be a different dimensionality/geometry
+        # entirely (crash or nonsense). Space-independent props (answer,
+        # summary, generate, ...) keep the any-module fallback.
+        if class_def is not None and prop in EXPLAIN_PROPS:
             own = self._modules.get(getattr(class_def, "vectorizer", "") or "")
             if isinstance(own, AdditionalProperties) and prop in own.additional_properties():
                 return own
+            raise ModuleError(
+                f"_additional.{prop!r} needs the class's vectorizer module; "
+                f"class {getattr(class_def, 'name', '?')!r} has "
+                f"{getattr(class_def, 'vectorizer', 'none') or 'none'!r}"
+            )
         for m in self._modules.values():
             if isinstance(m, AdditionalProperties) and prop in m.additional_properties():
                 return m

@@ -79,19 +79,14 @@ def thread_dump() -> str:
     return "\n".join(out) + "\n"
 
 
-_heap_armed = False
-
-
 def heap_profile(limit: int = 30) -> str:
     """tracemalloc top allocation sites; arms tracing on first call (the
     price of not paying tracemalloc overhead when nobody is profiling)."""
     import tracemalloc
 
-    global _heap_armed
     limit = max(1, min(int(limit), 200))
     if not tracemalloc.is_tracing():
         tracemalloc.start(16)
-        _heap_armed = True
         return (
             "tracemalloc armed by this request; allocations are tracked "
             "from now on — call /debug/pprof/heap again after the workload\n"

@@ -42,6 +42,7 @@ STRATEGIES = (STRATEGY_REPLACE, STRATEGY_SET, STRATEGY_MAP, STRATEGY_ROARINGSET)
 _SEG_MAGIC = b"WTSG"
 _WAL_MAGIC = b"WTWL"
 _TOMBSTONE = b"\x00__wt_tombstone__"
+_MISSING = object()  # distinguishes absent map subkeys from None tombstones
 
 # WAL record ops
 _W_PUT = 1          # replace put / set add / map put
@@ -103,14 +104,25 @@ class BloomFilter:
 
 
 class _MemReplace:
+    """approx_bytes is maintained INCREMENTALLY on every mutation in all
+    four memtable strategies: the flush check runs it once per write, so a
+    recompute-on-read implementation turns bulk import into O(n^2) (the
+    reference keeps a running size too, lsmkv memtable `size` field)."""
+
     def __init__(self):
         self.data: dict[bytes, bytes] = {}  # value or _TOMBSTONE
+        self._bytes = 0
 
     def put(self, k, v):
+        old = self.data.get(k)
+        if old is None:
+            self._bytes += len(k) + len(v)
+        else:
+            self._bytes += len(v) - len(old)
         self.data[k] = v
 
     def delete(self, k):
-        self.data[k] = _TOMBSTONE
+        self.put(k, _TOMBSTONE)
 
     def get(self, k):
         return self.data.get(k)
@@ -119,78 +131,123 @@ class _MemReplace:
         return len(self.data)
 
     def approx_bytes(self):
-        return sum(len(k) + len(v) for k, v in self.data.items())
+        return self._bytes
 
 
 class _MemSet:
     def __init__(self):
         self.adds: dict[bytes, set[bytes]] = {}
         self.dels: dict[bytes, set[bytes]] = {}
+        self._bytes = 0
 
     def add(self, k, v):
-        self.adds.setdefault(k, set()).add(v)
-        self.dels.get(k, set()).discard(v)
+        s = self.adds.get(k)
+        if s is None:
+            s = self.adds[k] = set()
+            self._bytes += len(k)
+        if v not in s:
+            s.add(v)
+            self._bytes += len(v)
+        d = self.dels.get(k)
+        if d is not None and v in d:
+            d.discard(v)
+            self._bytes -= len(v)
 
     def remove(self, k, v):
-        self.dels.setdefault(k, set()).add(v)
-        self.adds.get(k, set()).discard(v)
+        d = self.dels.get(k)
+        if d is None:
+            d = self.dels[k] = set()
+            self._bytes += len(k)
+        if v not in d:
+            d.add(v)
+            self._bytes += len(v)
+        s = self.adds.get(k)
+        if s is not None and v in s:
+            s.discard(v)
+            self._bytes -= len(v)
 
     def __len__(self):
         return len(self.adds) + len(self.dels)
 
     def approx_bytes(self):
-        t = 0
-        for d in (self.adds, self.dels):
-            for k, s in d.items():
-                t += len(k) + sum(len(v) for v in s)
-        return t
+        return self._bytes
 
 
 class _MemMap:
     def __init__(self):
         # key -> {subkey: value or None(=tombstone)}
         self.data: dict[bytes, dict[bytes, Optional[bytes]]] = {}
+        self._bytes = 0
 
     def put(self, k, sub, v):
-        self.data.setdefault(k, {})[sub] = v
+        m = self.data.get(k)
+        if m is None:
+            m = self.data[k] = {}
+            self._bytes += len(k)
+        old = m.get(sub, _MISSING)
+        if old is _MISSING:
+            self._bytes += len(sub) + len(v or b"")
+        else:
+            self._bytes += len(v or b"") - len(old or b"")
+        m[sub] = v
 
     def delete_pair(self, k, sub):
-        self.data.setdefault(k, {})[sub] = None
+        self.put(k, sub, None)
 
     def __len__(self):
         return len(self.data)
 
     def approx_bytes(self):
-        t = 0
-        for k, m in self.data.items():
-            t += len(k) + sum(len(s) + len(v or b"") for s, v in m.items())
-        return t
+        return self._bytes
 
 
 class _MemRoaring:
+    """Mutable int-sets in the memtable (O(1) per doc id); the immutable
+    sorted-array Bitmap exists only at read/flush boundaries — building a
+    Bitmap per write would re-sort the whole key on every object imported
+    (the reference's roaringset memtable mutates sroar bitmaps in place for
+    the same reason)."""
+
     def __init__(self):
-        self.adds: dict[bytes, Bitmap] = {}
-        self.dels: dict[bytes, Bitmap] = {}
+        self.adds: dict[bytes, set[int]] = {}
+        self.dels: dict[bytes, set[int]] = {}
+        self._bytes = 0
 
     def add_many(self, k, ids: Iterable[int]):
-        self.adds[k] = self.adds.get(k, Bitmap()).add_many(ids)
-        if k in self.dels:
-            self.dels[k] = self.dels[k].remove_many(list(ids))
+        ids = [int(i) for i in ids]
+        a = self.adds.get(k)
+        if a is None:
+            a = self.adds[k] = set()
+            self._bytes += len(k)
+        before = len(a)
+        a.update(ids)
+        self._bytes += 8 * (len(a) - before)
+        d = self.dels.get(k)
+        if d is not None:
+            before = len(d)
+            d.difference_update(ids)
+            self._bytes -= 8 * (before - len(d))
 
     def del_many(self, k, ids: Iterable[int]):
-        self.dels[k] = self.dels.get(k, Bitmap()).add_many(ids)
-        if k in self.adds:
-            self.adds[k] = self.adds[k].remove_many(list(ids))
+        ids = [int(i) for i in ids]
+        d = self.dels.get(k)
+        if d is None:
+            d = self.dels[k] = set()
+            self._bytes += len(k)
+        before = len(d)
+        d.update(ids)
+        self._bytes += 8 * (len(d) - before)
+        a = self.adds.get(k)
+        if a is not None:
+            before = len(a)
+            a.difference_update(ids)
+            self._bytes -= 8 * (before - len(a))
 
     def __len__(self):
         return len(self.adds) + len(self.dels)
 
     def approx_bytes(self):
-        t = 0
-        for d in (self.adds, self.dels):
-            for k, bm in d.items():
-                t += len(k) + 8 * len(bm)
-        return t
+        return self._bytes
 
 
 # -- segments ----------------------------------------------------------------
@@ -560,10 +617,10 @@ class Bucket:
                     out = out.and_not(dels).or_(adds)
             madds = self._mem.adds.get(key)
             mdels = self._mem.dels.get(key)
-            if mdels is not None:
-                out = out.and_not(mdels)
-            if madds is not None:
-                out = out.or_(madds)
+            if mdels:
+                out = out.and_not(Bitmap(mdels))
+            if madds:
+                out = out.or_(Bitmap(madds))
             return out
 
     def keys(self) -> list[bytes]:
@@ -617,7 +674,8 @@ class Bucket:
         else:
             keys = set(self._mem.adds) | set(self._mem.dels)
             items = [
-                (k, _enc_roaring(self._mem.adds.get(k, Bitmap()), self._mem.dels.get(k, Bitmap())))
+                (k, _enc_roaring(Bitmap(self._mem.adds.get(k) or ()),
+                                 Bitmap(self._mem.dels.get(k) or ())))
                 for k in sorted(keys)
             ]
         return items
