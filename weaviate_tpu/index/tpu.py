@@ -212,15 +212,11 @@ def _search_full(
         # exact f32 rescoring of the R merged candidates, fully on device:
         # gather [B, R, D] rows and score elementwise (VPU work, one HBM
         # gather — no host round trip)
+        from weaviate_tpu.ops.topk import rescore_distances
+
         safe = jnp.clip(idx, 0, cap - 1)
-        cand = jnp.take(store, safe, axis=0).astype(jnp.float32)  # [B, R, D]
-        qf = q.astype(jnp.float32)[:, None, :]
-        if metric == "l2-squared":
-            ed = jnp.sum((cand - qf) ** 2, axis=-1)
-        elif metric == "dot":
-            ed = -jnp.sum(cand * qf, axis=-1)
-        else:  # cosine (rows pre-normalized)
-            ed = 1.0 - jnp.sum(cand * qf, axis=-1)
+        cand = jnp.take(store, safe, axis=0)  # [B, R, D]
+        ed = rescore_distances(cand, q, metric)
         ed = jnp.where(idx >= 0, ed, jnp.inf)
         neg, pos = jax.lax.top_k(-ed, k)
         top = -neg
@@ -561,6 +557,10 @@ class TpuVectorIndex(VectorIndex):
         self._host_vecs: Optional[np.ndarray] = None  # np [capacity, D] f32
         self._pq_path = os.path.join(shard_path, "pq.npz")
         self._restoring = False
+        # flips true on a Mosaic compile failure of the fused gmin kernel;
+        # searches then stay on the lax.scan kernel permanently
+        self._gmin_broken = False
+        self._gmin_validated = False  # first gmin search succeeded
         self._log = VectorLog(os.path.join(shard_path, "vector.log")) if persist else None
         if self._log is not None:
             self._restore()
@@ -866,6 +866,73 @@ class TpuVectorIndex(VectorIndex):
     def distancer_name(self) -> str:
         return self.metric
 
+    # -- fused group-min fast scan (ops/gmin_scan.py) ------------------------
+
+    def _gmin_rg(self, k: int) -> int:
+        """Groups kept by the fused scan: >= k guarantees exact selection
+        under exact arithmetic (at most k groups hold the true top-k);
+        2k..128 adds slack for bf16 fast-scan ranking error. 0 = shape
+        unsupported, use the legacy scan."""
+        from weaviate_tpu.ops import gmin_scan
+
+        ncols = self.capacity // gmin_scan.G
+        rg = min(max(32, 2 * k), 128, ncols)
+        return rg if rg >= k else 0
+
+    def _use_gmin(self, b: int, k: int) -> bool:
+        if self._gmin_broken or getattr(self.config, "exact_topk", False):
+            return False
+        if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+            return False
+        # pallas tiling wants >= 8 query sublanes; tiny batches stay on the
+        # legacy scan (they're dispatch-latency-bound anyway)
+        if self.capacity < _MIN_CAPACITY or b < 8:
+            return False
+        return self._gmin_rg(k) > 0
+
+    def _search_full_gmin(self, q: np.ndarray, kk: int, allow_words):
+        from weaviate_tpu.ops import gmin_scan
+
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        ncols = self.capacity // gmin_scan.G
+        return gmin_scan.search_gmin(
+            self._store,
+            self._sq_norms,
+            self._tombs,
+            self.n,
+            jnp.asarray(q),
+            allow_words if allow_words is not None
+            else jnp.zeros((self.capacity // 32,), jnp.uint32),
+            allow_words is not None,
+            kk,
+            self.metric,
+            self._gmin_rg(kk),
+            -(-self.n // ncols),  # live store slices only
+            interpret,
+        )
+
+    def _gmin_packed_or_none(self, q: np.ndarray, kk: int, allow_words):
+        """Run the fused scan, or None to use the legacy kernel. Only a
+        failure BEFORE the first success disables the path (a Mosaic
+        compile/shape error on this platform); once validated, errors are
+        real and propagate instead of silently halving throughput."""
+        if not self._use_gmin(q.shape[0], kk):
+            return None
+        try:
+            packed = self._search_full_gmin(q, kk, allow_words)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            if self._gmin_validated:
+                raise
+            self._gmin_broken = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused gmin kernel unavailable (%s: %s); using lax.scan "
+                "kernel for this index", type(e).__name__, e)
+            return None
+        self._gmin_validated = True
+        return packed
+
     def _rescore_r(self, k: int) -> int:
         """Fast-scan candidate depth: 0 disables (exactTopK config or
         non-matmul metrics); otherwise 4k clamped to [32, 128] — selection
@@ -922,22 +989,26 @@ class TpuVectorIndex(VectorIndex):
             else:
                 allow_words = self._allow_words(allow_list) if allow_list is not None else None
                 kk = min(max(k_eff, 1), self.n)
-                packed = np.asarray(
-                    _search_full(
-                        self._store,
-                        self._sq_norms if self.metric == vi.DISTANCE_L2 else None,
-                        self._tombs,
-                        self.n,
-                        jnp.asarray(q),
-                        allow_words if allow_words is not None else jnp.zeros((self.capacity // 32,), jnp.uint32),
-                        kk,
-                        self.metric,
-                        allow_words is not None,
-                        getattr(self.config, "exact_topk", False),
-                        -(-self.n // _SCAN_CHUNK),
-                        self._rescore_r(kk),
+                packed = self._gmin_packed_or_none(q, kk, allow_words)
+                if packed is not None:
+                    packed = np.asarray(packed)
+                else:
+                    packed = np.asarray(
+                        _search_full(
+                            self._store,
+                            self._sq_norms if self.metric == vi.DISTANCE_L2 else None,
+                            self._tombs,
+                            self.n,
+                            jnp.asarray(q),
+                            allow_words if allow_words is not None else jnp.zeros((self.capacity // 32,), jnp.uint32),
+                            kk,
+                            self.metric,
+                            allow_words is not None,
+                            getattr(self.config, "exact_topk", False),
+                            -(-self.n // _SCAN_CHUNK),
+                            self._rescore_r(kk),
+                        )
                     )
-                )
                 top, idx = _unpack(packed)
                 top = top[:b]
                 idx = idx[:b]
@@ -1111,20 +1182,22 @@ class TpuVectorIndex(VectorIndex):
                 return lambda: (ids, dists)
             q, b = self._prep_queries(vectors)
             kk = min(max(min(k, self.live), 1), self.n)
-            packed_dev = _search_full(
-                self._store,
-                self._sq_norms if self.metric == vi.DISTANCE_L2 else None,
-                self._tombs,
-                self.n,
-                jnp.asarray(q),
-                jnp.zeros((self.capacity // 32,), jnp.uint32),
-                kk,
-                self.metric,
-                False,
-                getattr(self.config, "exact_topk", False),
-                -(-self.n // _SCAN_CHUNK),
-                self._rescore_r(kk),
-            )
+            packed_dev = self._gmin_packed_or_none(q, kk, None)
+            if packed_dev is None:
+                packed_dev = _search_full(
+                    self._store,
+                    self._sq_norms if self.metric == vi.DISTANCE_L2 else None,
+                    self._tombs,
+                    self.n,
+                    jnp.asarray(q),
+                    jnp.zeros((self.capacity // 32,), jnp.uint32),
+                    kk,
+                    self.metric,
+                    False,
+                    getattr(self.config, "exact_topk", False),
+                    -(-self.n // _SCAN_CHUNK),
+                    self._rescore_r(kk),
+                )
             slot_to_doc = self._slot_to_doc
 
         def finalize():

@@ -68,6 +68,21 @@ def unpack_topk(packed) -> tuple:
     return packed[:, :k].view("<f4"), packed[:, k:]
 
 
+def rescore_distances(cand: Array, q: Array, metric: str) -> Array:
+    """Exact f32 distances of gathered candidates: cand [B, R, D] vs
+    q [B, D] -> [B, R]. The shared rescore core of the fast-scan kernels
+    (index/tpu.py _search_full and ops/gmin_scan.py)."""
+    from weaviate_tpu.entities import vectorindex as vi
+
+    qf = q.astype(jnp.float32)[:, None, :]
+    c = cand.astype(jnp.float32)
+    if metric == vi.DISTANCE_L2:
+        return jnp.sum((c - qf) ** 2, axis=-1)
+    if metric == vi.DISTANCE_DOT:
+        return -jnp.sum(c * qf, axis=-1)
+    return 1.0 - jnp.sum(c * qf, axis=-1)  # cosine: rows pre-normalized
+
+
 def bitmap_to_mask(bitmap_words: Array, n: int) -> Array:
     """Expand a packed uint32 bitmap [ceil(N/32)] into a bool mask [N].
 
