@@ -161,3 +161,46 @@ def test_metrics_isolated_registries():
     m1.object_count.labels(class_name="A", shard_name="s").set(1)
     assert b"weaviate_object_count" not in m2.expose() or \
         b'class_name="A"' not in m2.expose()
+
+
+def test_vector_index_records_metrics(tmp_path):
+    """The TPU index populates the hnsw metrics.go-parity families on
+    flush/delete (ops, durations, tombstones, size, dimensions)."""
+    import numpy as np
+
+    from weaviate_tpu.entities import vectorindex as vi
+    from weaviate_tpu.index.tpu import TpuVectorIndex
+
+    m = noop_metrics()
+    cfg = vi.HnswUserConfig.from_dict({"distance": "l2-squared"}, "hnsw_tpu")
+    idx = TpuVectorIndex(cfg, str(tmp_path / "C" / "s0"), "s0",
+                         metrics=m, persist=False)
+    vecs = np.random.default_rng(0).standard_normal((64, 8)).astype(np.float32)
+    idx.add_batch(np.arange(64), vecs)
+    idx.flush()
+    idx.delete(0, 1, 2)
+    idx.flush()
+    text = m.expose().decode()
+    assert 'weaviate_vector_index_operations_total{class_name="C",operation="add",shard_name="s0"} 64.0' in text
+    assert 'weaviate_vector_index_tombstones{class_name="C",shard_name="s0"} 3.0' in text
+    assert "weaviate_vector_index_durations_ms_bucket" in text
+    assert 'weaviate_vector_index_size{class_name="C",shard_name="s0"}' in text
+    assert 'weaviate_vector_dimensions_sum{class_name="C"}' in text
+
+
+def test_native_hnsw_records_metrics(tmp_path):
+    import numpy as np
+
+    from weaviate_tpu.entities import vectorindex as vi
+    from weaviate_tpu.index.hnsw import HnswIndex
+
+    m = noop_metrics()
+    cfg = vi.HnswUserConfig.from_dict({"distance": "l2-squared"}, "hnsw")
+    idx = HnswIndex(cfg, str(tmp_path / "C" / "s1"), "s1", metrics=m, persist=False)
+    vecs = np.random.default_rng(0).standard_normal((50, 8)).astype(np.float32)
+    idx.add_batch(np.arange(50), vecs)
+    idx.delete(0)
+    idx.cleanup_tombstones()
+    text = m.expose().decode()
+    assert 'weaviate_vector_index_operations_total{class_name="C",operation="add",shard_name="s1"} 50.0' in text
+    assert "weaviate_vector_index_tombstone_cleanup_threads_total" in text

@@ -89,6 +89,9 @@ class Shard:
         self.invert_cfg = invert_cfg
         self.inverted = InvertedIndex(self.store, class_def)
         self.vector_index = new_vector_index(vector_config, path, name, metrics=metrics)
+        # metric labels must match the shard-level families (the on-disk
+        # class dir is lowercased; see VectorIndex._metric_labels)
+        self.vector_index.class_name = self.class_def.name
         self._geo_indexes: dict[str, object] = {}
         self._init_geo_indexes()
         self.searcher = FilterSearcher(

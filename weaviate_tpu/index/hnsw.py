@@ -18,6 +18,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -120,6 +121,8 @@ class HnswIndex(VectorIndex):
                 f"hnsw native engine supports l2-squared/dot/cosine, not {self.metric}"
             )
         self.shard_path = shard_path
+        self.shard_name = shard_name
+        self.metrics = metrics
         self._lib = _load_lib()
         self._lock = threading.RLock()
         self.dim: Optional[int] = None
@@ -208,7 +211,9 @@ class HnswIndex(VectorIndex):
             self._ensure_handle(int(vectors.shape[1]))
             if self._log is not None:
                 self._log.append_add_batch(ids, vectors)
+            t0 = time.perf_counter()
             self._lib.hnsw_add_batch(self._h, len(ids), _u64p(ids), _f32p(vectors))
+            self._obs_index("add", "graph_insert", t0, ops=len(ids))
             self._maybe_cleanup()  # re-adds tombstone the old nodes
 
     # tombstone pressure that triggers CleanUpTombstonedNodes inline (the
@@ -232,9 +237,9 @@ class HnswIndex(VectorIndex):
         if self._cleanup_running.acquire(blocking=False):
             def run():
                 try:
-                    with self._lock:
-                        if self._h is not None:
-                            self._lib.hnsw_cleanup(self._h)
+                    # through cleanup_tombstones so background cycles land
+                    # in the same metrics as explicit ones
+                    self.cleanup_tombstones()
                 finally:
                     self._cleanup_running.release()
 
@@ -244,10 +249,12 @@ class HnswIndex(VectorIndex):
         with self._lock:
             if self._h is None:
                 return
+            t0 = time.perf_counter()
             for d in doc_ids:
                 if self._log is not None:
                     self._log.append_delete(int(d))
                 self._lib.hnsw_delete(self._h, int(d))
+            self._obs_index("delete", "tombstone", t0, ops=len(doc_ids))
             self._maybe_cleanup()
 
     def cleanup_tombstones(self) -> int:
@@ -256,7 +263,19 @@ class HnswIndex(VectorIndex):
         with self._lock:
             if self._h is None:
                 return 0
-            return int(self._lib.hnsw_cleanup(self._h))
+            t0 = time.perf_counter()
+            removed = int(self._lib.hnsw_cleanup(self._h))
+            self._obs_index("cleanup", "tombstone_cycle", t0)
+            m = self.metrics
+            if m is not None:
+                cls, shard = self._metric_labels()
+                m.vector_index_tombstone_cleanups.labels(cls, shard).inc()
+                m.vector_index_tombstones.labels(cls, shard).set(
+                    max(0, self.node_count_locked() - len(self)))
+            return removed
+
+    def node_count_locked(self) -> int:
+        return int(self._lib.hnsw_node_count(self._h)) if self._h else 0
 
     def compact(self) -> None:
         """Uniform compaction surface with the TPU index: cleanup +

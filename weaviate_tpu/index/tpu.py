@@ -39,6 +39,7 @@ import functools
 import os
 import struct
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -702,7 +703,9 @@ class TpuVectorIndex(VectorIndex):
             self._log.append_delete(doc_id)
 
     def _flush_pending(self) -> None:
+        flushed = bool(self._pending or self._pending_tombs)
         if self._pending:
+            t0 = time.perf_counter()
             rows = np.stack(list(self._pending.values()))
             docs = np.array(list(self._pending.keys()), dtype=np.int64)
             count = rows.shape[0]
@@ -716,13 +719,21 @@ class TpuVectorIndex(VectorIndex):
             self.n += count
             self._pending.clear()
             self._map_cache = None
+            self._obs_index("add", "flush", t0, ops=count)
         if self._pending_tombs:
+            t0 = time.perf_counter()
             idx = np.array(self._pending_tombs, dtype=np.int32)
             pad = _bucket_rows(len(idx))
             padded = np.full(pad, self.capacity + 1, dtype=np.int32)
             padded[: len(idx)] = idx
             self._tombs = _set_tombstones(self._tombs, jnp.asarray(padded))
+            self._obs_index("delete", "apply_tombstones", t0,
+                            ops=len(self._pending_tombs))
             self._pending_tombs.clear()
+        if flushed:
+            # gauges refresh only when state changed: _flush_pending runs at
+            # the top of every search and must stay free on the hot path
+            self._update_index_gauges()
         # pq.enabled set at class creation: compress once enough data exists
         # to fit codebooks (the reference requires an explicit post-import
         # config update; we also honor the declarative form)
@@ -841,6 +852,7 @@ class TpuVectorIndex(VectorIndex):
                 raise ValueError(f"dim mismatch: index has {self.dim}, got {vectors.shape[1]}")
             if self._log is not None:
                 self._log.append_add_batch(doc_arr, vectors)
+            t0 = time.perf_counter()
             count = vectors.shape[0]
             self._ensure_capacity(self.n + count + _CHUNK)
             self._write_block(vectors, self.n)
@@ -850,6 +862,8 @@ class TpuVectorIndex(VectorIndex):
             self.n += count
             self.live += count
             self._map_cache = None
+            self._obs_index("add", "device_write", t0, ops=count)
+            self._update_index_gauges()
 
     def delete(self, *doc_ids: int) -> None:
         with self._lock:
@@ -865,6 +879,21 @@ class TpuVectorIndex(VectorIndex):
 
     def distancer_name(self) -> str:
         return self.metric
+
+    # -- index metrics (hnsw metrics.go / insert_metrics.go parity;
+    # _obs_index/_metric_labels inherited from VectorIndex) ------------------
+
+    def _update_index_gauges(self) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        cls, shard = self._metric_labels()
+        m.vector_index_tombstones.labels(cls, shard).set(self.n - self.live)
+        m.vector_index_size.labels(cls, shard).set(self.capacity)
+        if self.dim:
+            m.vector_dimensions.labels(cls).set(self.live * self.dim)
+            if self.compressed and self._pq is not None:
+                m.vector_segments.labels(cls).set(self.live * self._pq.segments)
 
     # -- fused group-min fast scan (ops/gmin_scan.py) ------------------------
 
@@ -920,6 +949,12 @@ class TpuVectorIndex(VectorIndex):
             return None
         try:
             packed = self._search_full_gmin(q, kk, allow_words)
+            if not self._gmin_validated:
+                # JAX defers device errors to materialization — the first
+                # call blocks here so a runtime fault (not just a compile
+                # error) still lands in this except and falls back; once
+                # validated, results stay unmaterialized for pipelining
+                packed = np.asarray(packed)
         except Exception as e:  # noqa: BLE001 — see docstring
             if self._gmin_validated:
                 raise
