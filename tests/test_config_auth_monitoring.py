@@ -185,7 +185,7 @@ def test_vector_index_records_metrics(tmp_path):
     assert 'weaviate_vector_index_tombstones{class_name="C",shard_name="s0"} 3.0' in text
     assert "weaviate_vector_index_durations_ms_bucket" in text
     assert 'weaviate_vector_index_size{class_name="C",shard_name="s0"}' in text
-    assert 'weaviate_vector_dimensions_sum{class_name="C"}' in text
+    assert 'weaviate_vector_dimensions_sum{class_name="C",shard_name="s0"}' in text
 
 
 def test_native_hnsw_records_metrics(tmp_path):

@@ -445,6 +445,16 @@ class _Handler(BaseHTTPRequestHandler):
                     body.get("sort"),
                 )
                 return self._json(200, {"results": wire.results_to_wire(rows)})
+            if method == "POST" and op == ":aggregations":
+                # remote half of distributed Aggregate (reference:
+                # clusterapi indices.go :aggregations): ship back the
+                # matching objects' raw data; the coordinator runs the same
+                # aggregation math over the concatenated columns, so
+                # median/mode/topOccurrences/groupBy stay exact
+                body = self._body_json()
+                flt = wire.filter_from_wire(body.get("filter"))
+                return self._json(
+                    200, {"objects": wire.objs_to_wire(shard.find_objects(flt))})
             if method == "POST" and op == ":deletebyfilter":
                 body = self._body_json()
                 flt = wire.filter_from_wire(body.get("filter"))

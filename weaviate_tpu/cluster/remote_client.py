@@ -157,6 +157,17 @@ class RemoteIndex:
         )
         return wire.results_from_wire(data.get("results", []))
 
+    def aggregate_shard(self, class_name: str, shard: str,
+                        flt: Optional[LocalFilter]) -> list:
+        """Matching objects of a remote shard for Aggregate (the coordinator
+        concatenates columns and aggregates once — clusterapi :aggregations)."""
+        host = self._host(class_name, shard)
+        data = self.http.json(
+            host, "POST", f"/indices/{class_name}/shards/{shard}/objects:aggregations",
+            {"filter": wire.filter_to_wire(flt)},
+        )
+        return wire.objs_from_wire(data.get("objects", []))
+
     def object_count(self, class_name: str, shard: str) -> int:
         host = self._host(class_name, shard)
         data = self.http.json(

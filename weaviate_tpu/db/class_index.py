@@ -264,6 +264,22 @@ class ClassIndex:
             merged.append(rows[:k])
         return merged
 
+    def aggregate_objects(self, flt=None) -> list[StorObj]:
+        """All matching objects across every physical shard (local reads +
+        remote :aggregations calls) — the data plane of Aggregate
+        (index.go's aggregation scatter-gather)."""
+        targets = self._all_shard_targets()
+
+        def run(name, shard):
+            if shard is not None:
+                return shard.find_objects(flt)
+            return self.remote.aggregate_shard(self.class_name, name, flt)
+
+        if len(targets) == 1:
+            return run(*targets[0])
+        futs = [self._pool.submit(run, n, s) for n, s in targets]
+        return [o for f in futs for o in f.result()]
+
     def object_search(
         self,
         limit: int,

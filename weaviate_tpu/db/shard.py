@@ -88,10 +88,9 @@ class Shard:
         self.counter = Counter(os.path.join(path, "indexcount"))
         self.invert_cfg = invert_cfg
         self.inverted = InvertedIndex(self.store, class_def)
-        self.vector_index = new_vector_index(vector_config, path, name, metrics=metrics)
-        # metric labels must match the shard-level families (the on-disk
-        # class dir is lowercased; see VectorIndex._metric_labels)
-        self.vector_index.class_name = self.class_def.name
+        self.vector_index = new_vector_index(
+            vector_config, path, name, metrics=metrics,
+            class_name=self.class_def.name)
         self._geo_indexes: dict[str, object] = {}
         self._init_geo_indexes()
         self.searcher = FilterSearcher(
@@ -485,10 +484,17 @@ class Shard:
             return self.inverted.all_doc_ids()
         return self.searcher.doc_ids(flt)
 
-    def find_uuids(self, flt: Optional[LocalFilter]) -> list[str]:
+    def find_objects(self, flt: Optional[LocalFilter],
+                     include_vector: bool = True) -> list[StorObj]:
+        """Hydrated objects matching a filter (None = all live) — the data
+        plane shared by Aggregate (local and clusterapi :aggregations) and
+        uuid listing."""
         ids = self.find_doc_ids(flt).to_array()
-        objs = self.objects_by_doc_ids([int(i) for i in ids], include_vector=False)
-        return [o.uuid for o in objs if o is not None]
+        objs = self.objects_by_doc_ids([int(i) for i in ids], include_vector)
+        return [o for o in objs if o is not None]
+
+    def find_uuids(self, flt: Optional[LocalFilter]) -> list[str]:
+        return [o.uuid for o in self.find_objects(flt, include_vector=False)]
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -14,10 +14,12 @@ from weaviate_tpu.index.interface import VectorIndex
 __all__ = ["VectorIndex", "new_vector_index"]
 
 
-def new_vector_index(config, shard_path: str, shard_name: str = "", metrics=None):
+def new_vector_index(config, shard_path: str, shard_name: str = "", metrics=None,
+                     class_name: str = ""):
     """Factory keyed on UserConfig.IndexType() (the discriminator,
     entities/vectorindex/hnsw/config.go:69-71; selection happens in
-    shard.go:134 initVectorIndex in the reference)."""
+    shard.go:134 initVectorIndex in the reference). class_name feeds metric
+    labels (the path-derived fallback is lowercased on disk)."""
     t = config.IndexType()
     if config.skip or t == "noop":
         from weaviate_tpu.index.noop import NoopIndex
@@ -26,11 +28,13 @@ def new_vector_index(config, shard_path: str, shard_name: str = "", metrics=None
     if t in ("hnsw_tpu", "flat"):
         from weaviate_tpu.index.tpu import TpuVectorIndex
 
-        return TpuVectorIndex(config, shard_path, shard_name, metrics=metrics)
+        return TpuVectorIndex(config, shard_path, shard_name, metrics=metrics,
+                              class_name=class_name)
     if t == "hnsw_tpu_mesh":
         from weaviate_tpu.index.mesh import MeshVectorIndex
 
-        return MeshVectorIndex(config, shard_path, shard_name, metrics=metrics)
+        return MeshVectorIndex(config, shard_path, shard_name, metrics=metrics,
+                               class_name=class_name)
     if t == "hnsw":
         try:
             from weaviate_tpu.index.hnsw import HnswIndex
@@ -39,5 +43,6 @@ def new_vector_index(config, shard_path: str, shard_name: str = "", metrics=None
                 "vectorIndexType 'hnsw' requires the native graph engine "
                 f"(weaviate_tpu.index.hnsw): {e}"
             ) from e
-        return HnswIndex(config, shard_path, shard_name, metrics=metrics)
+        return HnswIndex(config, shard_path, shard_name, metrics=metrics,
+                         class_name=class_name)
     raise ValueError(f"unknown vector index type {t!r}")

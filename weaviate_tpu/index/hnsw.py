@@ -113,6 +113,7 @@ class HnswIndex(VectorIndex):
         shard_name: str = "",
         metrics=None,
         persist: bool = True,
+        class_name: str = "",
     ):
         self.config = config
         self.metric = config.distance
@@ -122,6 +123,7 @@ class HnswIndex(VectorIndex):
             )
         self.shard_path = shard_path
         self.shard_name = shard_name
+        self.class_name = class_name  # before _restore (metric labels)
         self.metrics = metrics
         self._lib = _load_lib()
         self._lock = threading.RLock()
@@ -255,6 +257,7 @@ class HnswIndex(VectorIndex):
                     self._log.append_delete(int(d))
                 self._lib.hnsw_delete(self._h, int(d))
             self._obs_index("delete", "tombstone", t0, ops=len(doc_ids))
+            self._set_tombstone_gauge()
             self._maybe_cleanup()
 
     def cleanup_tombstones(self) -> int:
@@ -270,9 +273,19 @@ class HnswIndex(VectorIndex):
             if m is not None:
                 cls, shard = self._metric_labels()
                 m.vector_index_tombstone_cleanups.labels(cls, shard).inc()
-                m.vector_index_tombstones.labels(cls, shard).set(
-                    max(0, self.node_count_locked() - len(self)))
+            self._set_tombstone_gauge()
             return removed
+
+    def _set_tombstone_gauge(self) -> None:
+        """Gauge tracks live tombstone pressure: updated when tombstones are
+        CREATED (delete) and after cleanup removes them — not only
+        post-cleanup, where it would always read ~0."""
+        m = self.metrics
+        if m is None:
+            return
+        cls, shard = self._metric_labels()
+        m.vector_index_tombstones.labels(cls, shard).set(
+            max(0, self.node_count_locked() - len(self)))
 
     def node_count_locked(self) -> int:
         return int(self._lib.hnsw_node_count(self._h)) if self._h else 0

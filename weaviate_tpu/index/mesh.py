@@ -76,11 +76,13 @@ class MeshVectorIndex(VectorIndex):
         persist: bool = True,
         initial_capacity_per_shard: Optional[int] = None,
         dim_hint: Optional[int] = None,
+        class_name: str = "",
     ):
         self.config = config
         self.metric = config.distance
         self.shard_path = shard_path
         self.shard_name = shard_name
+        self.class_name = class_name
         self.metrics = metrics
         self.mesh = mesh if mesh is not None else make_mesh(
             getattr(config, "mesh_devices", 0) or None

@@ -523,11 +523,14 @@ class TpuVectorIndex(VectorIndex):
         metrics=None,
         device=None,
         persist: bool = True,
+        class_name: str = "",
     ):
         self.config = config
         self.metric = config.distance
         self.shard_path = shard_path
         self.shard_name = shard_name
+        # set before _restore: replay-time metrics must carry the right label
+        self.class_name = class_name
         self.metrics = metrics
         self.device = device
         self.dtype = jnp.bfloat16 if getattr(config, "store_dtype", "float32") == "bfloat16" else jnp.float32
@@ -891,9 +894,9 @@ class TpuVectorIndex(VectorIndex):
         m.vector_index_tombstones.labels(cls, shard).set(self.n - self.live)
         m.vector_index_size.labels(cls, shard).set(self.capacity)
         if self.dim:
-            m.vector_dimensions.labels(cls).set(self.live * self.dim)
+            m.vector_dimensions.labels(cls, shard).set(self.live * self.dim)
             if self.compressed and self._pq is not None:
-                m.vector_segments.labels(cls).set(self.live * self._pq.segments)
+                m.vector_segments.labels(cls, shard).set(self.live * self._pq.segments)
 
     # -- fused group-min fast scan (ops/gmin_scan.py) ------------------------
 

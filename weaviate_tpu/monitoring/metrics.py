@@ -99,11 +99,15 @@ class Metrics:
         self.vector_index_size = g(
             "weaviate_vector_index_size", "index capacity (slots)",
             ("class_name", "shard_name"))
+        # per-shard labels so multi-shard classes sum() correctly in prom
+        # (a class-only gauge would be overwritten by whichever shard
+        # flushed last)
         self.vector_dimensions = g(
             "weaviate_vector_dimensions_sum", "tracked vector dimensions",
-            ("class_name",))
+            ("class_name", "shard_name"))
         self.vector_segments = g(
-            "weaviate_vector_segments_sum", "tracked PQ segments", ("class_name",))
+            "weaviate_vector_segments_sum", "tracked PQ segments",
+            ("class_name", "shard_name"))
 
         # LSM (prometheus.go lsm metrics)
         self.lsm_active_segments = g(

@@ -101,13 +101,10 @@ class Aggregator:
                 )
             )
             return [r.obj for r in res]
-        rows = []
-        for shard in idx.shards.values():
-            doc_ids = shard.find_doc_ids(params.filters).to_array()
-            rows.extend(
-                o for o in shard.objects_by_doc_ids([int(i) for i in doc_ids]) if o is not None
-            )
-        return rows
+        # scatter-gather over ALL physical shards (remote included) so a
+        # distributed class aggregates its full data set (index.go +
+        # clusterapi :aggregations)
+        return idx.aggregate_objects(params.filters)
 
     # -- per-group aggregation ----------------------------------------------
 
