@@ -530,3 +530,23 @@ def test_ten_node_cluster_scatter_gather(tmp_path):
         assert out[0]["wordCount"]["sum"] == sum(range(120))
     finally:
         teardown_cluster(nodes)
+
+
+def test_distributed_meta_count_fast_path(cluster3):
+    """include_meta_count with no properties ships per-shard integers over
+    the :aggregations countOnly wire, never objects."""
+    from weaviate_tpu.usecases.aggregator import AggregateParams, Aggregator
+
+    n0, n1, _ = cluster3
+    n0.schema.add_class(make_class("CntDist"))
+    idx0 = n0.db.get_index("CntDist")
+    assert all(e is None for e in idx0.put_batch(
+        [new_obj(i, "CntDist") for i in range(50)]))
+    agg = Aggregator(n1.db, n1.schema)
+    out = agg.aggregate(AggregateParams(class_name="CntDist", include_meta_count=True))
+    assert out == [{"meta": {"count": 50}}]
+    flt = LocalFilter.from_dict(
+        {"operator": "GreaterThanEqual", "path": ["wordCount"], "valueInt": 40})
+    out = agg.aggregate(AggregateParams(
+        class_name="CntDist", include_meta_count=True, filters=flt))
+    assert out == [{"meta": {"count": 10}}]

@@ -164,3 +164,95 @@ def test_persistence(tmp_path, class_def):
     s = FilterSearcher(inv2, class_def)
     got = s.doc_ids(F({"operator": "Equal", "path": ["title"], "valueText": "hello"}))
     assert sorted(got) == [7]
+
+
+def test_missing_filterable_backfill(tmp_path):
+    """INDEX_MISSING_TEXT_FILTERABLE_AT_STARTUP analog: a prop imported with
+    indexFilterable=false gains working where-filters after the startup
+    reindexer backfills its roaring postings
+    (inverted_reindexer_missing_text_filterable.go)."""
+    import uuid as uuidlib
+
+    import numpy as np
+
+    from weaviate_tpu.db.db import DB
+    from weaviate_tpu.entities.filters import LocalFilter
+    from weaviate_tpu.entities.schema import ClassDef, Property
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+
+    db = DB(str(tmp_path / "d"))
+    cd = ClassDef(name="BF", properties=[
+        Property(name="tag", data_type=["text"], index_filterable=False),
+    ], vector_index_type="hnsw_tpu")
+    idx = db.add_class(cd, parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"}))
+    objs = [StorObj(class_name="BF", uuid=str(uuidlib.UUID(int=i + 1)),
+                    properties={"tag": f"t{i % 3}"},
+                    vector=np.zeros(4, np.float32))
+            for i in range(30)]
+    assert all(e is None for e in idx.put_batch(objs))
+
+    flt = LocalFilter.from_dict(
+        {"operator": "Equal", "path": ["tag"], "valueText": "t1"})
+
+    # flip the flag (operator edits the schema) -> buckets exist but empty
+    cd.properties[0].index_filterable = True
+    for shard in idx.shards.values():
+        shard.inverted.update_schema(cd)
+    empty = [o for s in idx.shards.values() for o in s.find_objects(flt)]
+    assert empty == []  # postings missing: the filter silently matches nothing
+
+    rebuilt = db.reindex_missing_filterable()
+    assert rebuilt == {"BF": {"tag": 30}}
+
+    hits = [o for s in idx.shards.values() for o in s.find_objects(flt)]
+    assert {o.properties["tag"] for o in hits} == {"t1"}
+    assert len(hits) == 10
+    # second run is a no-op (detection sees populated buckets)
+    assert db.reindex_missing_filterable() == {}
+    db.shutdown()
+
+
+def test_partial_filterable_backfill(tmp_path):
+    """Flag flipped MID-LIFE: docs written after the flip are indexed live;
+    the reindexer backfills exactly the pre-flip docs (per-doc detection,
+    not all-or-nothing)."""
+    import uuid as uuidlib
+
+    import numpy as np
+
+    from weaviate_tpu.db.db import DB
+    from weaviate_tpu.entities.filters import LocalFilter
+    from weaviate_tpu.entities.schema import ClassDef, Property
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+
+    db = DB(str(tmp_path / "d"))
+    cd = ClassDef(name="PBF", properties=[
+        Property(name="tag", data_type=["text"], index_filterable=False),
+    ], vector_index_type="hnsw_tpu", sharding_config={"desiredCount": 1})
+    idx = db.add_class(cd, parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"}))
+
+    def put(lo, hi):
+        return idx.put_batch([
+            StorObj(class_name="PBF", uuid=str(uuidlib.UUID(int=i + 1)),
+                    properties={"tag": f"t{i % 2}"}, vector=np.zeros(4, np.float32))
+            for i in range(lo, hi)])
+
+    assert all(e is None for e in put(0, 20))     # pre-flip: unindexed
+    cd.properties[0].index_filterable = True
+    for shard in idx.shards.values():
+        shard.inverted.update_schema(cd)
+    assert all(e is None for e in put(20, 30))    # post-flip: indexed live
+
+    flt = LocalFilter.from_dict(
+        {"operator": "Equal", "path": ["tag"], "valueText": "t1"})
+    hits = [o for s in idx.shards.values() for o in s.find_objects(flt, False)]
+    assert len(hits) == 5  # only post-flip docs match before the backfill
+
+    rebuilt = db.reindex_missing_filterable()
+    assert rebuilt == {"PBF": {"tag": 20}}  # exactly the pre-flip docs
+    hits = [o for s in idx.shards.values() for o in s.find_objects(flt, False)]
+    assert len(hits) == 15
+    assert db.reindex_missing_filterable() == {}
+    db.shutdown()

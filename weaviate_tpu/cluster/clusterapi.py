@@ -453,8 +453,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # median/mode/topOccurrences/groupBy stay exact
                 body = self._body_json()
                 flt = wire.filter_from_wire(body.get("filter"))
-                return self._json(
-                    200, {"objects": wire.objs_to_wire(shard.find_objects(flt))})
+                if body.get("countOnly"):
+                    # meta-count aggregations need one integer, not objects
+                    return self._json(
+                        200, {"count": len(shard.find_doc_ids(flt))})
+                return self._json(200, {"objects": wire.objs_to_wire(
+                    shard.find_objects(flt, include_vector=False))})
             if method == "POST" and op == ":deletebyfilter":
                 body = self._body_json()
                 flt = wire.filter_from_wire(body.get("filter"))

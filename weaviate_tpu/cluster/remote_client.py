@@ -157,6 +157,17 @@ class RemoteIndex:
         )
         return wire.results_from_wire(data.get("results", []))
 
+    def count_shard_filtered(self, class_name: str, shard: str,
+                             flt: Optional[LocalFilter]) -> int:
+        """Matching-doc count of a remote shard (meta-count aggregations
+        move one integer, not the object set)."""
+        host = self._host(class_name, shard)
+        data = self.http.json(
+            host, "POST", f"/indices/{class_name}/shards/{shard}/objects:aggregations",
+            {"filter": wire.filter_to_wire(flt), "countOnly": True},
+        )
+        return int(data.get("count", 0))
+
     def aggregate_shard(self, class_name: str, shard: str,
                         flt: Optional[LocalFilter]) -> list:
         """Matching objects of a remote shard for Aggregate (the coordinator

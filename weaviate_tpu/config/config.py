@@ -165,6 +165,7 @@ class Config:
     maximum_concurrent_get_requests: int = 0  # 0 = unlimited
     track_vector_dimensions: bool = False
     reindex_vector_dimensions_at_startup: bool = False
+    index_missing_text_filterable_at_startup: bool = False
     grpc_port: int = 50051
     contextionary_url: str = ""
     backup_filesystem_path: str = ""
@@ -251,6 +252,8 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.track_vector_dimensions = _bool(e, "TRACK_VECTOR_DIMENSIONS")
     cfg.reindex_vector_dimensions_at_startup = _bool(
         e, "REINDEX_VECTOR_DIMENSIONS_AT_STARTUP")
+    cfg.index_missing_text_filterable_at_startup = _bool(
+        e, "INDEX_MISSING_TEXT_FILTERABLE_AT_STARTUP")
     cfg.grpc_port = _int(e, "GRPC_PORT", 50051)
     cfg.contextionary_url = e.get("CONTEXTIONARY_URL", "")
     cfg.backup_filesystem_path = e.get("BACKUP_FILESYSTEM_PATH", "")

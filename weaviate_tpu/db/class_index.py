@@ -264,6 +264,21 @@ class ClassIndex:
             merged.append(rows[:k])
         return merged
 
+    def aggregate_count(self, flt=None) -> int:
+        """Cluster-wide matching-doc count (the meta-count fast path: ships
+        integers, never objects)."""
+        targets = self._all_shard_targets()
+
+        def run(name, shard):
+            if shard is not None:
+                return len(shard.find_doc_ids(flt))
+            return self.remote.count_shard_filtered(self.class_name, name, flt)
+
+        if len(targets) == 1:
+            return run(*targets[0])
+        futs = [self._pool.submit(run, n, s) for n, s in targets]
+        return sum(f.result() for f in futs)
+
     def aggregate_objects(self, flt=None) -> list[StorObj]:
         """All matching objects across every physical shard (local reads +
         remote :aggregations calls) — the data plane of Aggregate
@@ -272,7 +287,9 @@ class ClassIndex:
 
         def run(name, shard):
             if shard is not None:
-                return shard.find_objects(flt)
+                # aggregations read decoded properties only — skipping the
+                # vector halves hydration and keeps it off the wire
+                return shard.find_objects(flt, include_vector=False)
             return self.remote.aggregate_shard(self.class_name, name, flt)
 
         if len(targets) == 1:

@@ -129,3 +129,17 @@ class DB:
     def post_startup(self) -> None:
         for idx in list(self.indexes.values()):
             idx.post_startup()
+
+    def reindex_missing_filterable(self) -> dict[str, dict[str, int]]:
+        """Startup reindexer (INDEX_MISSING_TEXT_FILTERABLE_AT_STARTUP):
+        backfill filterable postings on every local shard. -> per-class
+        {prop: docs} for what was rebuilt."""
+        out: dict[str, dict[str, int]] = {}
+        for idx in list(self.indexes.values()):
+            merged: dict[str, int] = {}
+            for shard in idx.shards.values():
+                for prop, n in shard.reindex_missing_filterable().items():
+                    merged[prop] = merged.get(prop, 0) + n
+            if merged:
+                out[idx.class_name] = merged
+        return out

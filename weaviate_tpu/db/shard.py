@@ -496,6 +496,33 @@ class Shard:
     def find_uuids(self, flt: Optional[LocalFilter]) -> list[str]:
         return [o.uuid for o in self.find_objects(flt, include_vector=False)]
 
+    def reindex_missing_filterable(self) -> dict[str, int]:
+        """Backfill filterable postings for docs indexed before their prop's
+        indexFilterable flag was on (INDEX_MISSING_TEXT_FILTERABLE_AT_STARTUP;
+        reference: inverted_reindexer_missing_text_filterable.go). Detection
+        is per-doc (null-bucket coverage), so partially-indexed props — flag
+        flipped mid-life — backfill exactly their pre-flip docs.
+        -> {prop: docs indexed}."""
+        with self._lock:
+            missing = self.inverted.unindexed_filterable(self.object_count())
+            if not missing:
+                return {}
+            union = None
+            for bm in missing.values():
+                union = bm if union is None else union.or_(bm)
+            doc_ids = [int(i) for i in union.to_array()]
+
+            def rows():
+                step = 512
+                for s in range(0, len(doc_ids), step):
+                    chunk = doc_ids[s : s + step]
+                    objs = self.objects_by_doc_ids(chunk, include_vector=False)
+                    for did, o in zip(chunk, objs):
+                        if o is not None:
+                            yield did, o.properties
+
+            return self.inverted.backfill_filterable(missing, rows())
+
     # -- lifecycle -----------------------------------------------------------
 
     def flush(self) -> None:

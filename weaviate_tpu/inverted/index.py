@@ -101,6 +101,66 @@ class InvertedIndex:
                 lb = self.store.bucket(length_bucket(prop.name))
                 lb.map_put(b"len", did, struct.pack("<I", len(toks)))
 
+    def _filterable_indexed_docs(self, prop_name: str):
+        """Bitmap of docs whose filterable postings exist for the prop: the
+        null bucket gets exactly one entry (TRUE or FALSE) per doc when
+        filterable indexing is active, so its union is the indexed set."""
+        nb = self.store.bucket(null_bucket(prop_name))
+        if nb is None:
+            from weaviate_tpu.storage.bitmap import Bitmap
+
+            return Bitmap()
+        return nb.roaring_get(NULL_TRUE).or_(nb.roaring_get(NULL_FALSE))
+
+    def unindexed_filterable(self, doc_count: int) -> dict[str, object]:
+        """{prop: bitmap of docs MISSING filterable postings} — incremental
+        detection for the startup reindexer
+        (inverted_reindexer_missing_text_filterable.go): a prop written both
+        before and after its indexFilterable flip reports exactly the
+        pre-flip docs, not all-or-nothing."""
+        if doc_count == 0:
+            return {}
+        all_docs = self._all.roaring_get(ALL_DOCS_KEY)
+        out: dict[str, object] = {}
+        for prop in self.class_def.properties:
+            pt = prop.primitive_type()
+            if pt is None or pt.base in (DataType.GEO_COORDINATES, DataType.BLOB):
+                continue
+            if not prop.index_filterable:
+                continue
+            missing = all_docs.and_not(self._filterable_indexed_docs(prop.name))
+            if len(missing):
+                out[prop.name] = missing
+        return out
+
+    def backfill_filterable(self, missing: dict[str, object], rows) -> dict[str, int]:
+        """Index the filterable + null postings for each prop's MISSING docs
+        (missing = unindexed_filterable() result; rows = (doc_id, properties)
+        over the union of missing docs — one hydration pass covers every
+        prop, and already-indexed docs are left untouched).
+        -> {prop: docs indexed}."""
+        targets = [
+            (name,
+             self.store.bucket(filterable_bucket(name)),
+             self.store.bucket(null_bucket(name)),
+             bm)
+            for name, bm in missing.items()
+        ]
+        counts = {name: 0 for name, _, _, _ in targets}
+        for doc_id, properties in rows:
+            toks_by_prop = self.analyzer.analyze(
+                {name: properties.get(name) for name, _, _, _ in targets})
+            for name, fb, nb, bm in targets:
+                if not bm.contains(doc_id):
+                    continue
+                toks = toks_by_prop.get(name)
+                nb.roaring_add_many(NULL_TRUE if toks is None else NULL_FALSE, [doc_id])
+                if toks:
+                    for t in set(toks):
+                        fb.roaring_add_many(t, [doc_id])
+                counts[name] += 1
+        return counts
+
     def delete_object(self, doc_id: int, properties: dict) -> None:
         tokens_by_prop = self.analyzer.analyze(properties)
         self._all.roaring_remove_many(ALL_DOCS_KEY, [doc_id])

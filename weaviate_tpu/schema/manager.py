@@ -267,6 +267,16 @@ class SchemaManager:
                 cur_sh = ShardingConfig.from_dict(cd.sharding_config, len(self.node_names))
                 if new_sh.desired_count != cur_sh.desired_count:
                     raise SchemaValidationError("shardingConfig.desiredCount is immutable")
+            if "properties" in updated:
+                cur_props = [p.to_dict() for p in cd.properties]
+                if updated["properties"] != cur_props:
+                    # silent-ignore would ack a change that never happened;
+                    # reject like the reference's update validation (new
+                    # props go through POST .../properties; index-flag
+                    # migration is the startup reindexer's job)
+                    raise SchemaValidationError(
+                        "properties are immutable on class update; add new "
+                        "properties via POST /v1/schema/{class}/properties")
             payload = {"class": resolved, "updated": updated}
             if self.tx is not None:
                 self.tx.broadcast_commit(TX_UPDATE_CLASS, payload)

@@ -158,6 +158,17 @@ class App:
         )
         self.disk_monitor.start()
 
+        if self.config.index_missing_text_filterable_at_startup:
+            # startup reindexer (inverted_reindexer_missing_text_filterable
+            # analog): backfill filterable postings for props indexed before
+            # their indexFilterable flag was enabled
+            rebuilt = self.db.reindex_missing_filterable()
+            if rebuilt:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "filterable backfill rebuilt: %s", rebuilt)
+
     # -- meta ----------------------------------------------------------------
 
     def meta(self) -> dict:

@@ -61,6 +61,18 @@ class Aggregator:
             raise AggregatorError(f"class {params.class_name!r} not found")
         cd = self.schema.get_class(resolved)
 
+        # meta-count-only fast path: ships per-shard integers instead of
+        # the object set (the reference's unfiltered fast path, generalized
+        # to filtered counts)
+        if (
+            params.include_meta_count
+            and not params.properties
+            and not params.group_by
+            and params.near_vector is None
+            and params.near_object is None
+        ):
+            return [{"meta": {"count": idx.aggregate_count(params.filters)}}]
+
         objs = self._doc_set(idx, params)
 
         if params.group_by:
