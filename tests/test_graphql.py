@@ -306,3 +306,23 @@ def test_introspection_type_lookup(gql):
     assert ftypes["wordCount"]["name"] == "Int"
     res2 = ex.execute('{ __type(name: "Nope") { name } }')
     assert res2["data"]["__type"] is None
+
+
+def test_schema_validation_errors(gql):
+    ex, _, _, _ = gql
+    """Unknown args/props/_additional are errors, not silent nulls — the
+    behavior the reference gets from its generated schema
+    (class_builder_fields.go)."""
+    for q, frag in [
+        ('{ Get { Article(limit: 1) { nosuchprop } } }', "no property"),
+        ('{ Get { Article(nosucharg: 3) { title } } }', "unknown argument"),
+        ('{ Get { Article { _additional { nosuchmeta } } } }', "unknown _additional"),
+        ('{ Aggregate { Article(nosucharg: 1) { meta { count } } } }', "unknown argument"),
+        ('{ Aggregate { Article { nosuchprop { count } } } }', "no property"),
+    ]:
+        out = ex.execute(q)
+        assert out.get("errors"), q
+        assert frag in out["errors"][0]["message"], (q, out["errors"])
+    # known surface still validates clean
+    ok = ex.execute('{ Get { Article(limit: 1) { title _additional { id } } } }')
+    assert not ok.get("errors")

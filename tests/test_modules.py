@@ -420,3 +420,27 @@ def test_explain_props_graphql_e2e(neartext_app):
         assert add["nearestNeighbors"]["neighbors"]
         assert add["semanticPath"]["path"]
         assert len(add["featureProjection"]["vector"]) == 2
+
+
+def test_neartext_aggregate(neartext_app):
+    """Aggregate with nearText restricts the doc set via the module
+    vectorizer (objectLimit semantics) instead of silently counting all."""
+    app, srv = neartext_app
+    _req(srv.port, "POST", "/v1/schema", {
+        "class": "AggT", "vectorizer": "text2vec-local",
+        "vectorIndexConfig": {"distance": "cosine"},
+        "properties": [{"name": "body", "dataType": ["text"]}]})
+    payloads = [{"class": "AggT", "id": str(uuidlib.UUID(int=200 + i)),
+                 "properties": {"body": b}} for i, b in enumerate(
+        ["quantum qubits", "quantum errors", "bread flour", "bread yeast", "running shoes"])]
+    st, _ = _req(srv.port, "POST", "/v1/batch/objects", {"objects": payloads})
+    assert st == 200
+    q = ('{ Aggregate { AggT(nearText: {concepts: ["quantum"]}, objectLimit: 2) '
+         '{ meta { count } } } }')
+    st, res = _req(srv.port, "POST", "/v1/graphql", {"query": q})
+    assert st == 200 and not res.get("errors"), res
+    assert res["data"]["Aggregate"]["AggT"][0]["meta"]["count"] == 2
+    # objectLimit required with nearText
+    q2 = '{ Aggregate { AggT(nearText: {concepts: ["quantum"]}) { meta { count } } } }'
+    st, res2 = _req(srv.port, "POST", "/v1/graphql", {"query": q2})
+    assert res2.get("errors") and "objectLimit" in res2["errors"][0]["message"]

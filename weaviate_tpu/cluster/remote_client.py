@@ -166,7 +166,11 @@ class RemoteIndex:
             host, "POST", f"/indices/{class_name}/shards/{shard}/objects:aggregations",
             {"filter": wire.filter_to_wire(flt), "countOnly": True},
         )
-        return int(data.get("count", 0))
+        if "count" in data:
+            return int(data["count"])
+        # a peer that predates countOnly replies with the object set —
+        # count it rather than silently contributing 0 (rolling upgrades)
+        return len(data.get("objects", []))
 
     def aggregate_shard(self, class_name: str, shard: str,
                         flt: Optional[LocalFilter]) -> list:

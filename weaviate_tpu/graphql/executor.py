@@ -92,11 +92,56 @@ class GraphQLExecutor:
 
     # -- Get -----------------------------------------------------------------
 
+    # Get args the executor itself understands; module near-args are added
+    # per enabled provider (class_builder_fields.go:210-233 arg surface)
+    _GET_ARGS = frozenset({
+        "where", "nearVector", "nearObject", "bm25", "hybrid", "group",
+        "groupBy", "sort", "limit", "offset", "after", "ask",
+        "consistencyLevel",
+    })
+    _BUILTIN_ADDITIONAL = frozenset({
+        "id", "vector", "certainty", "distance", "score", "explainScore",
+        "creationTimeUnix", "lastUpdateTimeUnix", "classification",
+        "isConsistent", "group",
+    })
+
+    def _validate_get_class(self, class_field: Field) -> None:
+        """Schema validation the reference gets from its generated GraphQL
+        schema (class_builder_fields.go): unknown args, unknown properties,
+        and unknown _additional props are errors, not silent nulls."""
+        resolved = self.schema.resolve_class_name(class_field.name)
+        cd = self.schema.get_class(resolved) if resolved else None
+        if cd is None:
+            raise GraphQLParseError(f"class {class_field.name!r} not found")
+        provider = self._module_provider()
+        args_ok = set(self._GET_ARGS)
+        add_ok = set(self._BUILTIN_ADDITIONAL)
+        if provider is not None:
+            args_ok.update(provider.graphql_arguments())
+            add_ok.update(provider.additional_properties())
+        for a in class_field.args:
+            if a not in args_ok:
+                raise GraphQLParseError(
+                    f"unknown argument {a!r} on Get.{class_field.name}")
+        props = {p.name for p in cd.properties}
+        for s in class_field.selections:
+            if not isinstance(s, Field):
+                continue
+            if s.name == "_additional":
+                for sub in s.selections:
+                    if isinstance(sub, Field) and sub.name not in add_ok:
+                        raise GraphQLParseError(
+                            f"unknown _additional prop {sub.name!r}")
+            elif s.name not in props:
+                raise GraphQLParseError(
+                    f"class {class_field.name!r} has no property {s.name!r}")
+
     def _exec_get(self, root: Field) -> dict:
         out = {}
         for class_field in root.selections:
             if not isinstance(class_field, Field):
                 raise GraphQLParseError("expected class field under Get")
+            self._validate_get_class(class_field)
             params = self._get_params(class_field)
             results = self.traverser.get_class(params)
             self._resolve_module_additionals(class_field, params, results)
@@ -326,11 +371,29 @@ class GraphQLExecutor:
 
     # -- Aggregate -----------------------------------------------------------
 
+    _AGGREGATE_ARGS = frozenset({
+        "where", "nearVector", "nearObject", "nearText", "objectLimit",
+        "groupBy", "limit",
+    })
+
     def _exec_aggregate(self, root: Field) -> dict:
         out = {}
         for class_field in root.selections:
             if not isinstance(class_field, Field):
                 continue
+            resolved_name = self.schema.resolve_class_name(class_field.name)
+            cd = self.schema.get_class(resolved_name) if resolved_name else None
+            if cd is None:
+                raise GraphQLParseError(f"class {class_field.name!r} not found")
+            props_ok = {p.name for p in cd.properties} | {"meta", "groupedBy"}
+            for arg in class_field.args:
+                if arg not in self._AGGREGATE_ARGS:
+                    raise GraphQLParseError(
+                        f"unknown argument {arg!r} on Aggregate.{class_field.name}")
+            for s in class_field.selections:
+                if isinstance(s, Field) and s.name not in props_ok:
+                    raise GraphQLParseError(
+                        f"class {class_field.name!r} has no property {s.name!r}")
             a = {k: _plain(v) for k, v in class_field.args.items()}
             prop_aggs: dict[str, list[str]] = {}
             include_meta = False
@@ -356,6 +419,7 @@ class GraphQLExecutor:
                 ),
                 near_vector=a.get("nearVector"),
                 near_object=a.get("nearObject"),
+                near_text=a.get("nearText"),
                 object_limit=a.get("objectLimit"),
                 group_by=self._as_list(gb) if gb else None,
                 properties=prop_aggs,

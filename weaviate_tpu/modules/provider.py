@@ -198,6 +198,17 @@ class Provider:
                 out.extend(m.additional_properties())
         return sorted(set(out))
 
+    def graphql_arguments(self) -> list[str]:
+        """near-args contributed by enabled modules (nearText, nearImage,
+        ...) — feeds GraphQL arg validation (modulecapabilities/graphql.go)."""
+        from weaviate_tpu.modules.interface import GraphQLArguments
+
+        out = []
+        for m in self._modules.values():
+            if isinstance(m, GraphQLArguments):
+                out.extend(m.arguments())
+        return sorted(set(out))
+
     def resolve_additional(self, prop: str, results, params: dict, class_def=None):
         mod = self.additional_property_module(prop, class_def)
         if mod is None:

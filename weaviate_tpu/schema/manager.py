@@ -268,8 +268,14 @@ class SchemaManager:
                 if new_sh.desired_count != cur_sh.desired_count:
                     raise SchemaValidationError("shardingConfig.desiredCount is immutable")
             if "properties" in updated:
+                from weaviate_tpu.entities.schema import Property
+
                 cur_props = [p.to_dict() for p in cd.properties]
-                if updated["properties"] != cur_props:
+                # normalize through Property so a fetch-tweak-PUT payload
+                # with omitted default keys compares equal
+                new_props = [Property.from_dict(p).to_dict()
+                             for p in updated["properties"]]
+                if new_props != cur_props:
                     # silent-ignore would ack a change that never happened;
                     # reject like the reference's update validation (new
                     # props go through POST .../properties; index-flag

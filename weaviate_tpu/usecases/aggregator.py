@@ -33,6 +33,7 @@ class AggregateParams:
     filters: Optional[LocalFilter] = None
     near_vector: Optional[dict] = None
     near_object: Optional[dict] = None
+    near_text: Optional[dict] = None  # resolved via modules in the explorer
     object_limit: Optional[int] = None  # required with near*
     group_by: Optional[list[str]] = None
     properties: dict[str, list[str]] = field(default_factory=dict)  # prop -> aggs
@@ -70,6 +71,7 @@ class Aggregator:
             and not params.group_by
             and params.near_vector is None
             and params.near_object is None
+            and params.near_text is None
         ):
             return [{"meta": {"count": idx.aggregate_count(params.filters)}}]
 
@@ -96,7 +98,11 @@ class Aggregator:
     # -- doc-set selection (filtered / near-restricted / full) ---------------
 
     def _doc_set(self, idx, params: AggregateParams) -> list:
-        if params.near_vector is not None or params.near_object is not None:
+        if (
+            params.near_vector is not None
+            or params.near_object is not None
+            or params.near_text is not None
+        ):
             if params.object_limit is None:
                 raise AggregatorError("near<Media> aggregation requires objectLimit")
             if self.explorer is None:
@@ -108,6 +114,7 @@ class Aggregator:
                     class_name=idx.class_name,
                     near_vector=params.near_vector,
                     near_object=params.near_object,
+                    near_text=params.near_text,
                     filters=params.filters,
                     limit=params.object_limit,
                 )
