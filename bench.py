@@ -341,6 +341,7 @@ def main():
 
     qps_sync, med, ids = _measure_sync(idx, queries, K, N_QUERY_BATCHES)
     log(f"TPU batched kNN (sync): {qps_sync:.0f} QPS (median {med*1000:.1f} ms / {B}-query batch)")
+    log(f"kernel: {'fused gmin (pallas)' if getattr(idx, '_gmin_validated', False) else 'lax.scan'}")
 
     qps_pipe, per_batch = _measure_pipelined(idx, queries, K, N_QUERY_BATCHES)
     log(f"TPU batched kNN (pipelined, serving path): {qps_pipe:.0f} QPS ({per_batch*1000:.1f} ms/batch)")
@@ -349,6 +350,18 @@ def main():
     gt = exact_gt(vecs, queries[:N_GT], K)
     recall = recall_at_k(ids, gt, K)
     log(f"recall@10 = {recall:.4f} ({N_GT} queries)")
+
+    if recall < 0.95 and getattr(idx, "_gmin_validated", False):
+        # the fused kernel missed the recall bar on this platform — a
+        # result we never accept silently: disable it, re-measure on the
+        # lax.scan kernel, and say so
+        log("recall below 0.95 on the fused kernel; re-measuring on lax.scan")
+        idx._gmin_broken = True
+        qps_sync, med, ids = _measure_sync(idx, queries, K, N_QUERY_BATCHES)
+        qps_pipe, per_batch = _measure_pipelined(idx, queries, K, N_QUERY_BATCHES)
+        recall = recall_at_k(ids, gt, K)
+        log(f"kernel: lax.scan (fallback) — ALL reported numbers re-measured")
+        log(f"sync {qps_sync:.0f} QPS / pipelined {qps_pipe:.0f} QPS, recall@10 = {recall:.4f}")
 
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:

@@ -372,9 +372,12 @@ class GraphQLExecutor:
     # -- Aggregate -----------------------------------------------------------
 
     _AGGREGATE_ARGS = frozenset({
-        "where", "nearVector", "nearObject", "nearText", "objectLimit",
-        "groupBy", "limit",
+        "where", "nearVector", "nearObject", "objectLimit", "groupBy", "limit",
     })
+    # module near-args AggregateParams can actually execute; intersected
+    # with the provider's contributed args so Get/Aggregate share one
+    # source of truth without claiming support Aggregate lacks
+    _AGGREGATE_MODULE_ARGS = frozenset({"nearText"})
 
     def _exec_aggregate(self, root: Field) -> dict:
         out = {}
@@ -386,8 +389,13 @@ class GraphQLExecutor:
             if cd is None:
                 raise GraphQLParseError(f"class {class_field.name!r} not found")
             props_ok = {p.name for p in cd.properties} | {"meta", "groupedBy"}
+            args_ok = set(self._AGGREGATE_ARGS)
+            provider = self._module_provider()
+            if provider is not None:
+                args_ok.update(
+                    set(provider.graphql_arguments()) & self._AGGREGATE_MODULE_ARGS)
             for arg in class_field.args:
-                if arg not in self._AGGREGATE_ARGS:
+                if arg not in args_ok:
                     raise GraphQLParseError(
                         f"unknown argument {arg!r} on Aggregate.{class_field.name}")
             for s in class_field.selections:

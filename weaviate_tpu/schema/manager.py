@@ -273,8 +273,12 @@ class SchemaManager:
                 cur_props = [p.to_dict() for p in cd.properties]
                 # normalize through Property so a fetch-tweak-PUT payload
                 # with omitted default keys compares equal
-                new_props = [Property.from_dict(p).to_dict()
-                             for p in updated["properties"]]
+                try:
+                    new_props = [Property.from_dict(p).to_dict()
+                                 for p in updated["properties"]]
+                except (KeyError, TypeError, AttributeError) as e:
+                    raise SchemaValidationError(
+                        f"malformed properties payload: {e}") from e
                 if new_props != cur_props:
                     # silent-ignore would ack a change that never happened;
                     # reject like the reference's update validation (new
