@@ -1,0 +1,78 @@
+"""Quality tests beyond the committed recall fixtures: geo-spatial recall
+and dynamic-ef behavior (reference: recall_geo_spatial_test.go,
+dynamic_ef_test.go)."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.entities import vectorindex as vi
+from weaviate_tpu.index.geo import GeoIndex, haversine_m
+
+
+def test_geo_recall(tmp_path):
+    """kNN + range over 5k random coordinates vs exact haversine ground
+    truth (recall_geo_spatial_test.go's shape, smaller n for CI)."""
+    rng = np.random.default_rng(42)
+    n = 5000
+    lats = rng.uniform(-85, 85, n)
+    lons = rng.uniform(-180, 180, n)
+    g = GeoIndex(str(tmp_path / "geo"), persist=False)
+    for i in range(n):
+        g.add(i, lats[i], lons[i])
+
+    hits = 0
+    total = 0
+    for qi in range(50):
+        qlat, qlon = float(lats[qi * 7] + 0.5), float(lons[qi * 7] - 0.5)
+        d = haversine_m(qlat, qlon, lats, lons)
+        want = set(np.argsort(d)[:10].tolist())
+        ids, dists = g.knn(qlat, qlon, 10)
+        assert list(dists) == sorted(dists)
+        hits += len(set(int(x) for x in ids) & want)
+        total += 10
+        # range query must be EXACT (it's a filter, not an ANN search)
+        radius = float(np.sort(d)[25])
+        got = set(int(x) for x in g.within_range(qlat, qlon, radius))
+        exact = set(np.nonzero(d <= radius)[0].tolist())
+        assert got == exact
+    assert hits / total >= 0.99
+
+
+def test_hnsw_dynamic_ef(tmp_path):
+    """autoEfFromK (search.go:46): ef scales with k between min and max,
+    and a larger dynamic window buys measurably better recall on a hard
+    clustered set (dynamic_ef_test.go's observable behavior)."""
+    from weaviate_tpu.index.hnsw import HnswIndex
+
+    cfg = vi.HnswUserConfig.from_dict(
+        {"distance": "l2-squared", "efConstruction": 16, "maxConnections": 4,
+         "ef": -1, "dynamicEfMin": 10, "dynamicEfMax": 500, "dynamicEfFactor": 8},
+        "hnsw")
+    idx = HnswIndex(cfg, str(tmp_path / "h"), persist=False)
+    # clamp behavior of the ef rule itself
+    assert idx._ef(1) == 10          # below min -> min
+    assert idx._ef(20) == 160        # k*factor in window
+    assert idx._ef(100) == 500       # above max -> max
+    assert idx._ef(600) == 600       # never below k
+
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((16, 16)).astype(np.float32) * 0.05
+    vecs = (centers[rng.integers(0, 16, 8000)]
+            + 0.01 * rng.standard_normal((8000, 16)).astype(np.float32))
+    idx.add_batch(np.arange(8000), vecs)
+    queries = vecs[:128] + 0.002 * rng.standard_normal((128, 16)).astype(np.float32)
+    d = ((queries[:, None, :] - vecs[None, :, :]) ** 2).sum(-1)
+    gt = np.argsort(d, axis=1)[:, :10]
+
+    def recall_with(factor):
+        idx.config.dynamic_ef_factor = factor
+        ids, _ = idx.search_by_vectors(queries, 10)
+        return np.mean([
+            len(set(int(x) for x in ids[i]) & set(gt[i].tolist())) / 10
+            for i in range(len(queries))
+        ])
+
+    r_small = recall_with(1)   # ef = max(k, min) = 10
+    r_large = recall_with(16)  # ef = 160
+    assert r_large >= 0.8, (r_small, r_large)
+    assert r_large > r_small + 0.05, (r_small, r_large)
