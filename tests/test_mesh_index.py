@@ -202,9 +202,68 @@ def test_delete_then_grow_keeps_tombstones(tmp_path, rng):
     idx.shutdown()
 
 
-def test_pq_rejected_on_mesh(tmp_path):
-    with pytest.raises(ConfigValidationError):
-        make_index(tmp_path, pq={"enabled": True})
+def test_pq_on_mesh(tmp_path, rng):
+    """Mesh PQ (compress.go parity): compress -> recall vs brute force,
+    filtered PQ search, post-compress appends encode on write, store
+    downcast to bf16."""
+    import jax.numpy as jnp
+
+    idx = make_index(tmp_path / "pq")
+    vecs = rng.standard_normal((400, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(400), vecs)
+    idx.flush()
+    assert idx.dtype == jnp.float32
+    idx.update_user_config(parse_and_validate_config(
+        "hnsw_tpu_mesh",
+        {"distance": "l2-squared", "pq": {"enabled": True, "segments": 4}}))
+    assert idx.compressed and idx.dtype == jnp.bfloat16
+
+    q = vecs[7] + 0.01
+    ids, dists = idx.search_by_vector(q, 5)
+    want_ids, _ = brute(vecs, np.arange(400), q, 5)
+    assert ids[0] == want_ids[0] == 7
+    assert len(set(int(x) for x in ids) & set(int(x) for x in want_ids)) >= 4
+
+    # filtered PQ search
+    allow = Bitmap(range(100, 200))
+    ids_f, _ = idx.search_by_vectors(vecs[150][None, :] + 0.01, 3, allow_list=allow)
+    assert int(ids_f[0][0]) == 150
+    assert all(100 <= int(x) < 200 for x in ids_f[0])
+
+    # post-compress append is searchable (encode-on-write)
+    nv = rng.standard_normal(DIM).astype(np.float32) * 5.0
+    idx.add(9999, nv)
+    idx.flush()
+    ids2, _ = idx.search_by_vector(nv, 1)
+    assert int(ids2[0]) == 9999
+
+    # delete under PQ
+    idx.delete(7)
+    ids3, _ = idx.search_by_vector(q, 3)
+    assert 7 not in [int(x) for x in ids3]
+
+
+def test_pq_mesh_restart(tmp_path, rng):
+    """Codebook persists; codes re-derive on replay (AddPQ replay parity)."""
+    idx = make_index(tmp_path / "pqr")
+    vecs = rng.standard_normal((300, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(300), vecs)
+    idx.update_user_config(parse_and_validate_config(
+        "hnsw_tpu_mesh",
+        {"distance": "l2-squared", "pq": {"enabled": True, "segments": 4}}))
+    idx.flush()
+    del idx
+
+    idx2 = make_index(tmp_path / "pqr")
+    assert idx2.compressed
+    q = vecs[11] + 0.005
+    ids, _ = idx2.search_by_vector(q, 3)
+    assert int(ids[0]) == 11
+    # compact under PQ keeps searchability
+    idx2.delete(0, 1, 2)
+    idx2.compact()
+    ids2, _ = idx2.search_by_vector(q, 3)
+    assert int(ids2[0]) == 11 and 0 not in [int(x) for x in ids2]
 
 
 def test_search_by_vector_distance(tmp_path, rng):
@@ -286,3 +345,48 @@ def test_mesh_restart_through_db(tmp_path):
     res = idx2.object_vector_search(objs[8].vector, k=5)
     assert all(r.obj.uuid != objs[8].uuid for r in res[0])
     db2.shutdown()
+
+
+def test_pq_mesh_large_k_and_manhattan_guard(tmp_path, rng):
+    """k > r_chunk cap exercises the pool-covers-k clamp; non-matmul
+    metrics refuse to compress instead of silently mis-scoring."""
+    config = parse_and_validate_config(
+        "hnsw_tpu_mesh", {"distance": "l2-squared"})
+    idx = MeshVectorIndex(config, str(tmp_path / "pqk"),
+                          initial_capacity_per_shard=1024)
+    vecs = rng.standard_normal((400, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(400), vecs)
+    idx.update_user_config(parse_and_validate_config(
+        "hnsw_tpu_mesh",
+        {"distance": "l2-squared", "pq": {"enabled": True, "segments": 4}}))
+    ids, dists = idx.search_by_vectors(vecs[:2] + 0.001, 300)
+    real = ids[0][dists[0] != np.inf]
+    assert len(real) >= 300 - 1  # pool covered k
+
+    man = make_index(tmp_path / "man", metric="manhattan")
+    man.add_batch(np.arange(300), rng.standard_normal((300, DIM)).astype(np.float32))
+    with pytest.raises(ConfigValidationError):
+        man.update_user_config(parse_and_validate_config(
+            "hnsw_tpu_mesh",
+            {"distance": "manhattan", "pq": {"enabled": True, "segments": 4}}))
+
+
+def test_pq_mesh_compact_keeps_f32_log(tmp_path, rng):
+    """compact() under PQ rewrites the log from the f32 host copy, not the
+    bf16-downcast device store."""
+    idx = make_index(tmp_path / "pqc")
+    vecs = rng.standard_normal((300, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(300), vecs)
+    idx.update_user_config(parse_and_validate_config(
+        "hnsw_tpu_mesh",
+        {"distance": "l2-squared", "pq": {"enabled": True, "segments": 4}}))
+    idx.delete(0, 1)
+    idx.compact()
+    idx.flush()
+    del idx
+    # replayed vectors are bit-exact f32 originals
+    from weaviate_tpu.index.tpu import VectorLog
+    got = {doc: vec for op, doc, vec in VectorLog.replay(
+        str(tmp_path / "pqc" / "vector.log")) if op == "add"}
+    np.testing.assert_array_equal(got[42], vecs[42])
+    assert 0 not in got and 1 not in got

@@ -23,9 +23,14 @@ shard can restart onto a different mesh size and the replay re-balances.
 
 This replaces the reference's scatter-gather over goroutines+HTTP
 (adapters/repos/db/index.go:967-1046) for the intra-node multi-chip case:
-the collective rides ICI instead of the network. PQ is not yet supported on
-the mesh path (the single-chip index covers it); enabling pq on this type is
-a config error.
+the collective rides ICI instead of the network.
+
+PQ (compress.go parity, mesh-shaped): codes and ||recon||^2 shard like the
+store; each chip runs the reconstruction-matmul scan over its own code
+slab, rescores its local candidates against its local row slab at exact
+f32, and the k best per chip merge over ICI. Compression downcasts an f32
+store to bf16 (the memory move the single-chip index makes by dropping its
+float cache); post-compress appends encode on write.
 """
 
 from __future__ import annotations
@@ -48,7 +53,9 @@ from weaviate_tpu.parallel.mesh_search import (
     mesh_grow_1d,
     mesh_grow_2d,
     mesh_insert_step,
+    mesh_search_pq_step,
     mesh_search_step,
+    mesh_write_rows_step,
     replicated,
     shard_spec,
 )
@@ -94,11 +101,6 @@ class MeshVectorIndex(VectorIndex):
             else jnp.float32
         )
         self._lock = threading.RLock()
-        if config.pq.enabled:
-            raise vi.ConfigValidationError(
-                "pq is not supported on hnsw_tpu_mesh yet; use hnsw_tpu"
-            )
-
         self._init_loc = _pow2_at_least(
             initial_capacity_per_shard or _MIN_LOC, 32
         )
@@ -114,6 +116,15 @@ class MeshVectorIndex(VectorIndex):
         self._doc_to_row: dict[int, int] = {}
         self._pending: dict[int, np.ndarray] = {}
         self._pending_tombs: list[int] = []
+        # PQ state (mesh twin of index/tpu.py compression): codes and
+        # ||recon||^2 are sharded like the store; the (possibly bf16)
+        # store itself stays resident as the per-chip rescore source
+        self.compressed = False
+        self._pq = None
+        self._codes = None          # sharded [n_dev * n_loc, M]
+        self._recon_norms = None    # sharded [n_dev * n_loc] f32
+        self._host_vecs = None      # np [cap, D] f32 (compressed mode only)
+        self._pq_path = os.path.join(shard_path, "pq.npz") if shard_path else ""
         self._restoring = False
         self._log = (
             VectorLog(os.path.join(shard_path, "vector.log")) if persist else None
@@ -135,6 +146,16 @@ class MeshVectorIndex(VectorIndex):
                     self._stage_add(doc_id, vec, log=False)
                 else:
                     self._stage_delete(doc_id, log=False)
+            if self._pq_path and os.path.exists(self._pq_path):
+                from weaviate_tpu.compress.pq import ProductQuantizer
+
+                self._flush_pending()
+                if self.live > 0:
+                    self._enable_pq(
+                        ProductQuantizer.load(self._pq_path),
+                        np.asarray(self._store, dtype=np.float32),
+                        save=False,
+                    )
         finally:
             self._restoring = False
 
@@ -154,6 +175,13 @@ class MeshVectorIndex(VectorIndex):
         self._tombs = jax.device_put(jnp.zeros((cap,), jnp.bool_), sh1)
         self._zero_words = jax.device_put(jnp.zeros((cap // 32,), jnp.uint32), sh1)
         self._slot_to_doc = np.full(cap, -1, dtype=np.int64)
+        if self.compressed and self._pq is not None:
+            # a device reset in compressed mode (compact) re-creates the
+            # code slabs too; _write_balanced re-encodes rows as they land
+            self._codes = jax.device_put(
+                jnp.zeros((cap, self._pq.segments), self._pq.code_dtype), sh2)
+            self._recon_norms = jax.device_put(jnp.zeros((cap,), jnp.float32), sh1)
+            self._host_vecs = np.zeros((cap, dim), np.float32)
 
     def _grow(self, needed_per_shard: int) -> None:
         new_loc = self.n_loc
@@ -165,6 +193,15 @@ class MeshVectorIndex(VectorIndex):
         self._store = mesh_grow_2d(self._store, new_loc, self.mesh)
         self._sq_norms = mesh_grow_1d(self._sq_norms, new_loc, self.mesh)
         self._tombs = mesh_grow_1d(self._tombs, new_loc, self.mesh)
+        if self.compressed:
+            self._codes = mesh_grow_2d(self._codes, new_loc, self.mesh)
+            self._recon_norms = mesh_grow_1d(self._recon_norms, new_loc, self.mesh)
+            hv = np.zeros((self.n_dev * new_loc, self.dim), np.float32)
+            for s in range(self.n_dev):
+                hv[s * new_loc : s * new_loc + old_loc] = self._host_vecs[
+                    s * old_loc : (s + 1) * old_loc
+                ]
+            self._host_vecs = hv
         cap = self.n_dev * new_loc
         self._zero_words = jax.device_put(
             jnp.zeros((cap // 32,), jnp.uint32), shard_spec(self.mesh)
@@ -264,6 +301,15 @@ class MeshVectorIndex(VectorIndex):
             padded[: len(idx)] = idx
             self._tombs = mesh_delete_step(self._tombs, jnp.asarray(padded), self.mesh)
             self._pending_tombs.clear()
+        # declarative pq.enabled compresses once enough data exists to fit
+        # codebooks (same trigger as the single-chip index)
+        if (
+            self.config.pq.enabled
+            and not self.compressed
+            and not self._restoring
+            and self.live >= max(256, self.config.pq.centroids)
+        ):
+            self._compress_locked()
 
     def _write_balanced(self, docs: np.ndarray, rows: np.ndarray) -> None:
         """Land [count, D] rows across slabs in whole-mesh insert steps."""
@@ -304,6 +350,26 @@ class MeshVectorIndex(VectorIndex):
                 self.metric == vi.DISTANCE_L2,
                 self.mesh,
             )
+            if self.compressed:
+                # post-compress appends also land codes + recon norms (the
+                # single-chip index's encode-on-write parity)
+                code_chunks = self._pq.encode(
+                    chunks.reshape(-1, self.dim)
+                ).reshape(self.n_dev, c, self._pq.segments)
+                norm_chunks = self._pq.recon_sq_norms(
+                    code_chunks.reshape(-1, self._pq.segments)
+                ).reshape(self.n_dev, c).astype(np.float32)
+                self._codes, self._recon_norms = mesh_write_rows_step(
+                    self._codes,
+                    self._recon_norms,
+                    jax.device_put(jnp.asarray(code_chunks),
+                                   shard_spec(self.mesh, None, None)),
+                    jax.device_put(jnp.asarray(norm_chunks),
+                                   shard_spec(self.mesh, None)),
+                    jnp.asarray(offsets),
+                    jnp.asarray(takes),
+                    self.mesh,
+                )
             for s in range(self.n_dev):
                 take = len(taken[s])
                 if not take:
@@ -313,7 +379,67 @@ class MeshVectorIndex(VectorIndex):
                 d = docs[taken[s]]
                 self._slot_to_doc[grows] = d
                 self._doc_to_row.update(zip(d.tolist(), grows.tolist()))
+                if self.compressed:
+                    self._host_vecs[grows] = rows[taken[s]]
                 self._counts[s] += take
+
+    # -- product quantization (mesh twin of index/tpu.py compression) --------
+
+    def compress(self) -> None:
+        with self._lock:
+            self._flush_pending()
+            self._compress_locked()
+
+    def _compress_locked(self) -> None:
+        from weaviate_tpu.compress.pq import ProductQuantizer
+
+        if self.compressed:
+            return
+        if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+            # the mesh PQ kernel is the reconstruction matmul; the LUT path
+            # the single-chip index keeps for manhattan/hamming has no mesh
+            # twin, and silently-wrong distances are worse than an error
+            raise vi.ConfigValidationError(
+                f"pq on hnsw_tpu_mesh supports l2-squared/dot/cosine, "
+                f"not {self.metric}")
+        if self.live == 0:
+            raise RuntimeError("compress requires imported vectors to fit on")
+        host = np.asarray(self._store, dtype=np.float32)  # [cap, D] gather
+        occupied = self._slot_to_doc >= 0
+        pq = ProductQuantizer(
+            dim=self.dim,
+            segments=self.config.pq.segments,
+            centroids=self.config.pq.centroids,
+            metric=self.metric,
+            encoder=self.config.pq.encoder.type,
+            distribution=self.config.pq.encoder.distribution,
+        )
+        pq.fit(host[occupied])
+        self._enable_pq(pq, host, save=True)
+
+    def _enable_pq(self, pq, host: np.ndarray, save: bool) -> None:
+        """Shard codes + ||recon||^2 over the mesh. Dead/padding rows encode
+        garbage but are masked by tombs/high-water in the kernel. The store
+        itself stays resident as the per-chip rescore source, downcast to
+        bf16 when it was f32 (the single-chip index's drop-the-float-cache
+        memory move, mesh-shaped); the full-precision rows move to host RAM
+        so compact()'s log rewrite never re-persists bf16-rounded data
+        (tpu.py _host_vecs parity)."""
+        codes = pq.encode(host)                       # [cap, M]
+        norms = pq.recon_sq_norms(codes).astype(np.float32)
+        self._pq = pq
+        self._codes = jax.device_put(jnp.asarray(codes), shard_spec(self.mesh, None))
+        self._recon_norms = jax.device_put(jnp.asarray(norms), shard_spec(self.mesh))
+        self._host_vecs = np.array(host, dtype=np.float32)
+        if self.dtype == jnp.float32:
+            self.dtype = jnp.bfloat16
+            self._store = jax.jit(
+                lambda s: s.astype(jnp.bfloat16),
+                out_shardings=shard_spec(self.mesh, None),
+            )(self._store)
+        self.compressed = True
+        if save and self._pq_path:
+            pq.save(self._pq_path)
 
     # -- VectorIndex ---------------------------------------------------------
 
@@ -415,6 +541,37 @@ class MeshVectorIndex(VectorIndex):
             words = self._allow_words(allow_list) if use_allow else self._zero_words
             from weaviate_tpu.ops.topk import unpack_topk
 
+            if self.compressed:
+                nchunks_eff = max(1, self.n_loc // chunk)
+                pool_target = self.config.pq.rescore_limit or 1024
+                r_chunk = min(
+                    max(2 * kk, -(-pool_target // nchunks_eff), 64), 256, chunk)
+                # the concatenated per-chip pool must cover k (tpu.py:1080)
+                r_chunk = max(r_chunk, min(-(-kk // nchunks_eff), chunk))
+                packed = np.asarray(
+                    mesh_search_pq_step(
+                        self._codes,
+                        self._recon_norms,
+                        self._tombs,
+                        jnp.asarray(self._counts.astype(np.int32)),
+                        words,
+                        self._pq._dev_codebook(),
+                        self._store,
+                        jnp.asarray(q),
+                        kk,
+                        r_chunk,
+                        self.metric,
+                        use_allow,
+                        getattr(self.config, "exact_topk", False),
+                        self.config.pq.rescore,
+                        self.mesh,
+                    )
+                )
+                top, rows = unpack_topk(packed)
+                top, rows = top[:b], rows[:b]
+                ids = np.where(rows >= 0, self._slot_to_doc[np.clip(rows, 0, None)], -1)
+                return ids.astype(np.uint64), top.astype(np.float32)
+
             packed = np.asarray(
                 mesh_search_step(
                     self._store,
@@ -467,11 +624,13 @@ class MeshVectorIndex(VectorIndex):
     def update_user_config(self, updated: vi.HnswUserConfig) -> None:
         with self._lock:
             vi.validate_config_update(self.config, updated)
-            if updated.pq.enabled:
-                raise vi.ConfigValidationError(
-                    "pq is not supported on hnsw_tpu_mesh yet"
-                )
+            was_enabled = self.config.pq.enabled
             self.config = updated
+            # pq.enabled flipped on triggers compression (compress.go)
+            if updated.pq.enabled and not was_enabled and not self.compressed:
+                self._flush_pending()
+                if self.live > 0:
+                    self._compress_locked()
 
     def flush(self) -> None:
         with self._lock:
@@ -491,7 +650,11 @@ class MeshVectorIndex(VectorIndex):
                 return
             rows = np.array(sorted(self._doc_to_row.values()), dtype=np.int64)
             docs = self._slot_to_doc[rows]
-            store_host = np.asarray(self._store, dtype=np.float32)[rows]
+            # compressed mode rewrites the log from the f32 host copy — the
+            # device store is bf16 by then and must not degrade durable data
+            src = self._host_vecs if self.compressed else np.asarray(
+                self._store, dtype=np.float32)
+            store_host = np.asarray(src, dtype=np.float32)[rows]
             if self._log is not None:
                 self._log.rewrite(zip(docs.tolist(), store_host))
             dim = self.dim
@@ -519,6 +682,15 @@ class MeshVectorIndex(VectorIndex):
                     pass
                 self._log = None
             self._store = self._sq_norms = self._tombs = None
+            self._codes = self._recon_norms = None
+            self._host_vecs = None
+            self._pq = None
+            self.compressed = False
+            if self._pq_path:
+                try:
+                    os.remove(self._pq_path)
+                except FileNotFoundError:
+                    pass
             self.dim = None
             self.n_loc = 0
             self.live = 0
@@ -536,4 +708,7 @@ class MeshVectorIndex(VectorIndex):
                 self._log.close()
 
     def list_files(self) -> list[str]:
-        return [self._log.path] if self._log is not None else []
+        out = [self._log.path] if self._log is not None else []
+        if self._pq_path and os.path.exists(self._pq_path):
+            out.append(self._pq_path)  # backups must carry the codebook
+        return out

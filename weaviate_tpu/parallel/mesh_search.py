@@ -150,6 +150,148 @@ def mesh_search_step(
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=("k", "r_chunk", "metric", "use_allow", "exact",
+                     "do_rescore", "mesh"),
+)
+def mesh_search_pq_step(
+    codes, recon_norms, tombs, n_per_shard, allow_words, codebook,
+    rescore_store, queries, k, r_chunk, metric, use_allow, exact,
+    do_rescore, mesh,
+):
+    """Mesh twin of the single-chip PQ reconstruction scan
+    (index/tpu.py _search_pq_recon): each chip scans its OWN code slab —
+    gather centroids per chunk into a [chunk, D] block, one bf16 matmul,
+    collect per-chunk top-r — then exact-rescores its local candidate pool
+    against its local rescore slab and keeps a local top-k; the cross-chip
+    merge all_gathers k (dist, global-row) pairs per chip over ICI and
+    reselects. Rescored distances are exact f32, so the final merge is
+    exact.
+
+    codes:        [n_dev * n_loc, M] sharded P('shard', None)
+    recon_norms:  [n_dev * n_loc] f32 sharded (||reconstruction||^2)
+    tombs:        [n_dev * n_loc] bool sharded
+    n_per_shard:  [n_dev] int32 replicated
+    allow_words:  [n_dev * n_loc / 32] uint32 sharded
+    codebook:     [M, C, ds] f32 replicated
+    rescore_store:[n_dev * n_loc, D] sharded (bf16/f32 row copy)
+    -> packed [B, 2k] i32 replicated; rows are global (slab + shard*n_loc).
+    """
+    n_dev = mesh.devices.size
+    n_loc = codes.shape[0] // n_dev
+    m = codes.shape[1]
+    _, c, ds = codebook.shape
+    chunk = min(n_loc, _MESH_SCAN_CHUNK)
+    nchunks = n_loc // chunk
+
+    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb, rs_l, q):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        n_mine = n_all[my]
+        b = q.shape[0]
+        flat_cb = cb.reshape(m * c, ds).astype(jnp.bfloat16)
+        seg_off = (jnp.arange(m, dtype=jnp.int32) * c)[None, :]
+        codes_c = codes_l.reshape(nchunks, chunk, m)
+        norms_c = norms_l.reshape(nchunks, chunk)
+        tombs_c = tombs_l.reshape(nchunks, chunk)
+        allow_c = allow_l.reshape(nchunks, chunk // 32) if use_allow else None
+        qd = q.astype(jnp.bfloat16)
+        q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+
+        def step(_, xs):
+            ci, cl, nl, tl = xs[0], xs[1], xs[2], xs[3]
+            base = ci * chunk
+            idx = cl.astype(jnp.int32) + seg_off
+            recon = jnp.take(flat_cb, idx, axis=0).reshape(chunk, m * ds)
+            qx = jnp.matmul(qd, recon.T, preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.DEFAULT)
+            if metric == "l2-squared":
+                d = jnp.maximum(q_sq - 2.0 * qx + nl[None, :], 0.0)
+            elif metric == "dot":
+                d = -qx
+            else:
+                d = 1.0 - qx
+            valid = jnp.logical_and(jnp.arange(chunk) + base < n_mine,
+                                    jnp.logical_not(tl))
+            if use_allow:
+                valid = jnp.logical_and(valid, bitmap_to_mask(xs[4], chunk))
+            d = jnp.where(valid[None, :], d, jnp.inf)
+            if exact:
+                neg, li = jax.lax.top_k(-d, r_chunk)
+                td = -neg
+            else:
+                td, li = jax.lax.approx_min_k(d, r_chunk, recall_target=0.95)
+            return None, (td, li + base)
+
+        xs = [jnp.arange(nchunks), codes_c, norms_c, tombs_c]
+        if use_allow:
+            xs.append(allow_c)
+        _, (tds, lis) = jax.lax.scan(step, None, tuple(xs))
+        pool = nchunks * r_chunk
+        cand_d = jnp.moveaxis(tds, 0, 1).reshape(b, pool)
+        cand_i = jnp.moveaxis(lis, 0, 1).reshape(b, pool)
+        if do_rescore:
+            from weaviate_tpu.ops.topk import rescore_distances
+
+            safe = jnp.clip(cand_i, 0, n_loc - 1)
+            cand = jnp.take(rs_l, safe, axis=0)
+            ed = rescore_distances(cand, q, metric)
+            cand_d = jnp.where(jnp.isinf(cand_d), jnp.inf, ed)
+        neg, pos = jax.lax.top_k(-cand_d, k)
+        d_top = -neg
+        i_top = jnp.take_along_axis(cand_i, pos, axis=1)
+        i_glob = jnp.where(jnp.isinf(d_top), -1, i_top + my * n_loc)
+        d_all = jax.lax.all_gather(d_top, SHARD_AXIS, axis=1, tiled=True)
+        i_all = jax.lax.all_gather(i_glob, SHARD_AXIS, axis=1, tiled=True)
+        neg, pos = jax.lax.top_k(-d_all, k)
+        d_fin = -neg
+        i_fin = jnp.take_along_axis(i_all, pos, axis=1)
+        i_fin = jnp.where(jnp.isinf(d_fin), -1, i_fin).astype(jnp.int32)
+        return pack_topk(d_fin, i_fin)
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
+            P(SHARD_AXIS), P(), P(SHARD_AXIS, None), P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(codes, recon_norms, tombs, n_per_shard, allow_words, codebook,
+      rescore_store, queries)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh",), donate_argnums=(0, 1)
+)
+def mesh_write_rows_step(arr2d, arr1d, chunks2d, vals1d, offsets, takes, mesh):
+    """Generic whole-mesh append for an arbitrary-dtype sharded matrix plus
+    a per-row f32 vector (codes + recon_norms on the PQ path): each chip
+    with takes[my] > 0 lands its chunk at its own offset."""
+
+    def shard_fn(a2_l, a1_l, ch_l, v1_l, offs, tks):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        off = offs[my]
+        active = tks[my] > 0
+        written2 = jax.lax.dynamic_update_slice(
+            a2_l, ch_l[0].astype(a2_l.dtype), (off, 0))
+        written1 = jax.lax.dynamic_update_slice(a1_l, v1_l[0], (off,))
+        return (jnp.where(active, written2, a2_l),
+                jnp.where(active, written1, a1_l))
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None, None),
+            P(SHARD_AXIS, None), P(), P(),
+        ),
+        out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS)),
+        check_vma=False,
+    )(arr2d, arr1d, chunks2d, vals1d, offsets, takes)
+
+
+@functools.partial(
     jax.jit, static_argnames=("use_norms", "mesh"), donate_argnums=(0, 1)
 )
 def mesh_insert_step(store, sq_norms, chunks, offsets, takes, use_norms, mesh):
