@@ -155,3 +155,44 @@ def test_classification_validation(served):
     assert st == 422
     st, out = _req(srv.port, "GET", "/v1/classifications/" + str(uuidlib.uuid4()))
     assert st == 404
+
+
+def test_classification_additional_metadata(served):
+    """Classified objects carry _additional.classification (id, scope,
+    classifiedFields, basedOn — entities/additional/classification.go)."""
+    app, srv = served
+    _req(srv.port, "POST", "/v1/schema", {
+        "class": "Article",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "category", "dataType": ["text"]}],
+    })
+    objs = []
+    for c, label in ((0, "science"), (1, "sports")):
+        for i in range(8):
+            objs.append({"class": "Article", "id": str(uuidlib.uuid4()),
+                         "properties": {"title": f"t{c}{i}", "category": label},
+                         "vector": _cluster_vec(c, i).tolist()})
+    uid = str(uuidlib.uuid4())
+    objs.append({"class": "Article", "id": uid,
+                 "properties": {"title": "u0"},
+                 "vector": _cluster_vec(0, 99).tolist()})
+    st, _ = _req(srv.port, "POST", "/v1/batch/objects", {"objects": objs})
+    assert st == 200
+    st, job = _req(srv.port, "POST", "/v1/classifications", {
+        "class": "Article", "classifyProperties": ["category"],
+        "basedOnProperties": ["title"], "type": "knn", "settings": {"k": 3}})
+    assert st == 201
+    job_id = job["id"]
+    final = _wait_job(srv.port, job_id)
+    assert final["status"] == "completed"
+    q = ('{ Get { Article(where: {path: ["title"], operator: Equal, valueText: "u0"}) '
+         '{ category _additional { classification { id scope classifiedFields } } } } }')
+    st, res = _req(srv.port, "POST", "/v1/graphql", {"query": q})
+    assert st == 200 and not res.get("errors"), res
+    hits = res["data"]["Get"]["Article"]
+    assert hits and hits[0]["category"]
+    cls = hits[0]["_additional"]["classification"]
+    assert cls["id"] == job_id
+    assert cls["scope"] == ["category"]
+    assert cls["classifiedFields"] == ["category"]

@@ -146,6 +146,7 @@ class ClusterApi:
             got = shard.merge_object(
                 op["uuid"], op.get("properties") or {}, vec,
                 update_time=op.get("updateTime"),
+                meta=op.get("meta"),
             )
             return got is not None
         if kind == "overwrite":
@@ -403,7 +404,8 @@ class _Handler(BaseHTTPRequestHandler):
                     if body.get("vector") is not None
                     else None
                 )
-                got = shard.merge_object(uid, body.get("properties") or {}, vec)
+                got = shard.merge_object(uid, body.get("properties") or {}, vec,
+                                         meta=body.get("meta"))
                 if got is None:
                     return self._json(404, {"error": "not found"})
                 return self._json(200, {"object": wire.obj_to_wire(got)})

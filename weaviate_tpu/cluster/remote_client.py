@@ -82,7 +82,8 @@ class RemoteIndex:
         return bool(data.get("deleted"))
 
     def merge_object(self, class_name: str, shard: str, uuid: str,
-                     props: dict, vector=None) -> Optional[StorObj]:
+                     props: dict, vector=None,
+                     meta: Optional[dict] = None) -> Optional[StorObj]:
         host = self._host(class_name, shard)
         data = self.http.json(
             host, "POST",
@@ -90,6 +91,7 @@ class RemoteIndex:
             {
                 "properties": props,
                 "vector": np.asarray(vector, np.float32).tolist() if vector is not None else None,
+                "meta": meta,
             },
         )
         if data["_status"] == 404:

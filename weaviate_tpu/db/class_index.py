@@ -139,16 +139,19 @@ class ClassIndex:
         return self.remote.delete_object(self.class_name, name, uuid)
 
     def merge_object(
-        self, uuid: str, props: dict, vector=None, cl: Optional[str] = None
+        self, uuid: str, props: dict, vector=None, cl: Optional[str] = None,
+        meta: Optional[dict] = None
     ) -> Optional[StorObj]:
         name = self.shard_for(uuid)
         if self._replicated(name):
-            ok = self.replicator.merge_object(self.class_name, name, uuid, props, vector, cl)
+            ok = self.replicator.merge_object(
+                self.class_name, name, uuid, props, vector, cl, meta=meta)
             return self.object_by_uuid(uuid, cl=cl) if ok else None
         shard = self._local_shard(name)
         if shard is not None:
-            return shard.merge_object(uuid, props, vector)
-        return self.remote.merge_object(self.class_name, name, uuid, props, vector)
+            return shard.merge_object(uuid, props, vector, meta=meta)
+        return self.remote.merge_object(
+            self.class_name, name, uuid, props, vector, meta=meta)
 
     # -- batch (index.go:424 putObjectBatch, groups by PhysicalShard) --------
 

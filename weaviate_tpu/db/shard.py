@@ -284,10 +284,12 @@ class Shard:
         return self._deleted.get(uuid)
 
     def merge_object(self, uuid: str, props: dict, vector=None,
-                     update_time: Optional[int] = None) -> Optional[StorObj]:
+                     update_time: Optional[int] = None,
+                     meta: Optional[dict] = None) -> Optional[StorObj]:
         """PATCH semantics (objects.Manager.MergeObject): shallow-merge props.
         update_time is coordinator-stamped on replicated merges (see
-        put_object preserve_times)."""
+        put_object preserve_times). meta merges into the object's underscore
+        metadata (classification stamps, entities/storobj meta json)."""
         with self._lock:
             raw = self.objects.get(_uuid_bytes(uuid))
             if raw is None:
@@ -296,6 +298,8 @@ class Shard:
             merged = dict(obj.properties)
             merged.update(props)
             obj.properties = merged
+            if meta:
+                obj.meta = {**obj.meta, **meta}
             if vector is not None:
                 obj.vector = np.asarray(vector, dtype=np.float32)
             if update_time is not None:

@@ -358,6 +358,15 @@ class GraphQLExecutor:
                 add[s.out_name] = str(obj.last_update_time_unix)
             elif n == "group":
                 add[s.out_name] = r.additional.get("group")
+            elif n == "classification":
+                # stamped at classification time (usecases/classification.py
+                # _class_meta; entities/additional/classification.go shape),
+                # projected to the selected subfields
+                payload = (obj.meta or {}).get("classification")
+                subs = [x.name for x in s.selections if isinstance(x, Field)]
+                if payload is not None and subs:
+                    payload = {k2: v2 for k2, v2 in payload.items() if k2 in subs}
+                add[s.out_name] = payload
             elif n == "isConsistent":
                 add[s.out_name] = True
             else:

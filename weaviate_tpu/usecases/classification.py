@@ -101,9 +101,9 @@ class Classifier:
     def _run(self, job, ctype, class_name, classify_props, k, filters) -> None:
         try:
             if ctype == TYPE_KNN:
-                counts = self._run_knn(class_name, classify_props, k, filters)
+                counts = self._run_knn(class_name, classify_props, k, filters, job)
             else:
-                counts = self._run_zeroshot(class_name, classify_props, filters)
+                counts = self._run_zeroshot(class_name, classify_props, filters, job)
             with self._lock:
                 job["meta"].update(
                     completed=int(time.time() * 1000),
@@ -133,7 +133,7 @@ class Classifier:
     def _fetch(self, idx, flt: Optional[LocalFilter], limit: int):
         return idx.object_search(limit=limit, flt=flt, include_vector=True)
 
-    def _run_knn(self, class_name, classify_props, k, filters) -> tuple[int, int]:
+    def _run_knn(self, class_name, classify_props, k, filters, job) -> tuple[int, int]:
         """classifier_run_knn.go semantics, batched: training set = objects
         whose classify property is already set; each unclassified source gets
         the majority vote of its k nearest training objects."""
@@ -181,13 +181,13 @@ class Classifier:
                     votes[train_vals[ti]] = votes.get(train_vals[ti], 0) + 1
                 winner = max(votes, key=votes.get)
                 try:
-                    self._assign(idx, obj, classify_props, winner)
+                    self._assign(idx, obj, classify_props, winner, job)
                     succeeded += 1
                 except Exception:  # noqa: BLE001 — per-object failure counted
                     pass
         return total, succeeded
 
-    def _run_zeroshot(self, class_name, classify_props, filters) -> tuple[int, int]:
+    def _run_zeroshot(self, class_name, classify_props, filters, job) -> tuple[int, int]:
         """Zero-shot: each classify property must be a reference; assign the
         vector-nearest object of the property's target class."""
         idx = self.db.get_index(class_name)
@@ -242,13 +242,30 @@ class Classifier:
                         p: [{"beacon": winners_per_prop[p][bi]}]
                         for p in classify_props
                     }
-                    idx.merge_object(obj.uuid, props)
+                    idx.merge_object(obj.uuid, props,
+                                     meta=self._class_meta(job, sorted(props)))
                     succeeded += 1
                 except Exception:  # noqa: BLE001
                     pass
         return total, succeeded
 
-    def _assign(self, idx, obj, classify_props, winner: tuple) -> None:
+    @staticmethod
+    def _class_meta(job, fields: list[str]) -> dict:
+        """The _additional.classification payload stamped on each classified
+        object (entities/additional/classification.go shape; completed is an
+        RFC3339 timestamp like the reference's strfmt.DateTime)."""
+        from datetime import datetime, timezone
+
+        return {"classification": {
+            "id": job["id"],
+            "scope": job["classifyProperties"],
+            "classifiedFields": fields,
+            "basedOn": job["basedOnProperties"] or None,
+            "completed": datetime.now(timezone.utc).isoformat(
+                timespec="milliseconds").replace("+00:00", "Z"),
+        }}
+
+    def _assign(self, idx, obj, classify_props, winner: tuple, job) -> None:
         cd = self.schema.get_class(idx.class_name)
         props = {}
         for p, val in zip(classify_props, winner):
@@ -260,4 +277,5 @@ class Classifier:
             else:
                 props[p] = val
         if props:
-            idx.merge_object(obj.uuid, props)
+            idx.merge_object(obj.uuid, props,
+                             meta=self._class_meta(job, sorted(props)))

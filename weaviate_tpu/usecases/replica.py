@@ -204,11 +204,13 @@ class Replicator:
         return bool(res[0]) if res else False
 
     def merge_object(self, class_name: str, shard: str, uuid: str, props: dict,
-                     vector=None, level: Optional[str] = None) -> bool:
+                     vector=None, level: Optional[str] = None,
+                     meta: Optional[dict] = None) -> bool:
         import time
 
         op = {"op": "merge", "uuid": uuid, "properties": props,
               "vector": list(map(float, vector)) if vector is not None else None,
+              "meta": meta,
               "updateTime": int(time.time() * 1000)}
         res = self._run(class_name, shard, [op], level)
         return bool(res[0]) if res else False
