@@ -550,3 +550,34 @@ def test_distributed_meta_count_fast_path(cluster3):
     out = agg.aggregate(AggregateParams(
         class_name="CntDist", include_meta_count=True, filters=flt))
     assert out == [{"meta": {"count": 10}}]
+
+
+def test_is_consistent_probe(tmp_path):
+    """_additional.isConsistent digest-compares replicas (finder.go
+    CheckConsistency): consistent after an ALL write, inconsistent when a
+    replica holds a stale copy, consistent again after read repair."""
+    nodes = make_cluster(tmp_path, 2)
+    try:
+        n0, n1 = nodes
+        n0.schema.add_class(make_class("Cons", shards=1, replicas=2))
+        idx0 = n0.db.get_index("Cons")
+        obj = new_obj(5, "Cons")
+        idx0.put_object(obj, cl="ALL")
+        shard = idx0.shard_for(obj.uuid)
+        assert idx0.is_consistent(obj.uuid, idx0.object_by_uuid(
+            obj.uuid).last_update_time_unix)
+
+        # make node-1's replica stale: bump the copy on node-0 only
+        sh0 = n0.db.get_index("Cons")._local_shard(shard)
+        sh1 = n1.db.get_index("Cons")._local_shard(shard)
+        assert sh0 is not None and sh1 is not None
+        newer = sh0.merge_object(obj.uuid, {"title": "edited"},
+                                 update_time=obj.last_update_time_unix + 5000)
+        assert not idx0.is_consistent(obj.uuid, newer.last_update_time_unix)
+
+        # a QUORUM read repairs the stale replica; probe flips back
+        got = idx0.object_by_uuid(obj.uuid, cl="QUORUM")
+        assert got.properties["title"] == "edited"
+        assert idx0.is_consistent(obj.uuid, got.last_update_time_unix)
+    finally:
+        teardown_cluster(nodes)

@@ -267,6 +267,14 @@ class ClassIndex:
             merged.append(rows[:k])
         return merged
 
+    def is_consistent(self, uuid: str, update_time: int) -> bool:
+        """_additional.isConsistent: replicated shards digest-compare every
+        replica; unreplicated objects are trivially consistent."""
+        name = self.shard_for(uuid)
+        if not self._replicated(name):
+            return True
+        return self.finder.check_consistency(self.class_name, name, uuid, update_time)
+
     def aggregate_count(self, flt=None) -> int:
         """Cluster-wide matching-doc count (the meta-count fast path: ships
         integers, never objects)."""

@@ -145,6 +145,7 @@ class GraphQLExecutor:
             params = self._get_params(class_field)
             results = self.traverser.get_class(params)
             self._resolve_module_additionals(class_field, params, results)
+            self._resolve_is_consistent(class_field, params, results)
             # per-query ref cache (refcache/ role): N results pointing at the
             # same referenced object hit storage once, not N times
             ref_cache: dict[str, object] = {}
@@ -156,6 +157,39 @@ class GraphQLExecutor:
 
     def _module_provider(self):
         return getattr(getattr(self.traverser, "explorer", None), "modules", None)
+
+    def _resolve_is_consistent(self, class_field: Field, params: GetParams,
+                               results) -> None:
+        """Batch isConsistent resolution (finder.go CheckConsistency):
+        resolve the class once and fan the per-row digest probes out in
+        parallel — the per-row sequential form costs N_results x N_replicas
+        network roundtrips."""
+        wanted = any(
+            isinstance(sel, Field) and sel.name == "_additional"
+            and any(isinstance(x, Field) and x.name == "isConsistent"
+                    for x in sel.selections)
+            for sel in class_field.selections
+        )
+        if not wanted or not results:
+            return
+        resolved = self.schema.resolve_class_name(params.class_name)
+        cidx = self.db.get_index(resolved) if resolved else None
+        if cidx is None or cidx.finder is None:
+            for r in results:
+                r.additional["isConsistent"] = True
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        def probe(r):
+            return cidx.is_consistent(r.obj.uuid, r.obj.last_update_time_unix)
+
+        if len(results) == 1:
+            verdicts = [probe(results[0])]
+        else:
+            with ThreadPoolExecutor(max_workers=min(16, len(results))) as pool:
+                verdicts = list(pool.map(probe, results))
+        for r, v in zip(results, verdicts):
+            r.additional["isConsistent"] = v
 
     def _resolve_module_additionals(self, class_field: Field, params: GetParams,
                                     results) -> None:
@@ -361,14 +395,15 @@ class GraphQLExecutor:
             elif n == "classification":
                 # stamped at classification time (usecases/classification.py
                 # _class_meta; entities/additional/classification.go shape),
-                # projected to the selected subfields
+                # projected to the selected subfields with aliases honored
                 payload = (obj.meta or {}).get("classification")
-                subs = [x.name for x in s.selections if isinstance(x, Field)]
+                subs = [x for x in s.selections if isinstance(x, Field)]
                 if payload is not None and subs:
-                    payload = {k2: v2 for k2, v2 in payload.items() if k2 in subs}
+                    payload = {x.out_name: payload.get(x.name) for x in subs}
                 add[s.out_name] = payload
             elif n == "isConsistent":
-                add[s.out_name] = True
+                # batch-resolved once per query (_resolve_is_consistent)
+                add[s.out_name] = r.additional.get("isConsistent", True)
             else:
                 add[s.out_name] = r.additional.get(n)
         return add

@@ -223,6 +223,21 @@ class Finder:
     def __init__(self, coord: ReplicaCoordinator):
         self.coord = coord
 
+    def check_consistency(self, class_name: str, shard: str, uuid: str,
+                          update_time: int) -> bool:
+        """True when every reachable replica's digest agrees with the given
+        updateTime (the _additional.isConsistent probe, finder.go
+        CheckConsistency). Unreachable replicas count as inconsistent —
+        the honest answer when agreement cannot be confirmed."""
+        for p in self.coord.participants(class_name, shard):
+            try:
+                d = p.digest(class_name, shard, uuid)
+            except Exception:  # noqa: BLE001 — unreachable replica
+                return False
+            if not d.get("exists") or d.get("updateTime", 0) != update_time:
+                return False
+        return True
+
     def get_object(self, class_name: str, shard: str, uuid: str,
                    level: Optional[str] = None,
                    include_vector: bool = True) -> Optional[StorObj]:
