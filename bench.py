@@ -322,12 +322,39 @@ def _grpc_e2e(rng, n=50_000):
     }
 
 
+def _probe_device(timeout_s: int = 180) -> None:
+    """Fail fast with a diagnosis when the TPU relay is wedged: a hung
+    device claim would otherwise block the whole bench until the caller's
+    timeout with no explanation. The probe runs in a subprocess because a
+    hung PJRT init cannot be interrupted in-process."""
+    import subprocess
+    import sys as _sys
+
+    import jax
+
+    if (jax.config.jax_platforms or "").startswith("cpu"):
+        return  # CPU smoke runs need no relay probe
+    code = "import jax; x = jax.numpy.ones((8, 8)); (x @ x).block_until_ready(); print('ok')"
+    try:
+        proc = subprocess.run([_sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=timeout_s)
+        if proc.returncode == 0 and "ok" in proc.stdout:
+            return
+        detail = (proc.stderr or proc.stdout)[-500:]
+    except subprocess.TimeoutExpired:
+        detail = f"device claim still hung after {timeout_s}s"
+    log(f"FATAL: TPU device unreachable ({detail}); refusing to hang — "
+        "this is an infrastructure failure, not a benchmark result")
+    raise SystemExit(3)
+
+
 def main():
     rng = np.random.default_rng(7)
     if os.environ.get("BENCH_MEASURE_CPU"):
         measure_cpu_baseline(rng)
         return
 
+    _probe_device()
     import jax
 
     log(f"generating {N}x{DIM} clustered vectors...")

@@ -175,6 +175,13 @@ class ClusterApi:
             "updateTime": obj.last_update_time_unix,
         }
 
+    def digest_many(self, class_name: str, shard_name: str,
+                    uuids: list[str]) -> list[dict]:
+        """Batch digest (finder.go DigestObjects): one request covers every
+        uuid — consistency probes cost one roundtrip per replica, not one
+        per object."""
+        return [self.digest(class_name, shard_name, u) for u in uuids]
+
     # -- node status (usecases/nodes) ----------------------------------------
 
     def node_status(self) -> dict:
@@ -306,6 +313,10 @@ class _Handler(BaseHTTPRequestHandler):
         if m and method == "POST":
             cname, sname, op = m.group(1), m.group(2), m.group(3)
             body = self._body_json()
+            if op == ":digest":
+                return self._json(200, {
+                    "digests": api.digest_many(cname, sname, body.get("uuids") or [])
+                })
             if op == ":overwrite":
                 shard = api._shard(cname, sname)
                 if shard is None:

@@ -229,6 +229,17 @@ class ReplicationClient:
             f"/replicas/indices/{class_name}/shards/{shard}/objects/{uuid}:digest",
         )
 
+    def digest_many(self, host: str, class_name: str, shard: str,
+                    uuids: Sequence[str]) -> list[dict]:
+        """Batch digest: one roundtrip for the whole uuid list
+        (finder.go DigestObjects)."""
+        data = self.http.json(
+            host, "POST",
+            f"/replicas/indices/{class_name}/shards/{shard}/objects:digest",
+            {"uuids": list(uuids)},
+        )
+        return data.get("digests", [])
+
     def overwrite(self, host: str, class_name: str, shard: str,
                   objs: Sequence[StorObj], deletes=None) -> None:
         self.http.json(

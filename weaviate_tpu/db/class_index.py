@@ -270,10 +270,26 @@ class ClassIndex:
     def is_consistent(self, uuid: str, update_time: int) -> bool:
         """_additional.isConsistent: replicated shards digest-compare every
         replica; unreplicated objects are trivially consistent."""
-        name = self.shard_for(uuid)
-        if not self._replicated(name):
-            return True
-        return self.finder.check_consistency(self.class_name, name, uuid, update_time)
+        return self.are_consistent([(uuid, update_time)])[0]
+
+    def are_consistent(self, pairs: list[tuple[str, int]]) -> list[bool]:
+        """Batch isConsistent (finder.go CheckConsistency/DigestObjects):
+        pairs grouped by shard, one digest request per replica per shard."""
+        out = [True] * len(pairs)
+        if self.finder is None:
+            return out
+        groups: dict[str, list[int]] = {}
+        for i, (u, _) in enumerate(pairs):
+            name = self.shard_for(u)
+            if self.finder is not None and len(
+                    self.sharding_state.belongs_to_nodes(name)) > 1:
+                groups.setdefault(name, []).append(i)
+        for name, idxs in groups.items():
+            verdicts = self.finder.check_consistency_many(
+                self.class_name, name, [pairs[i] for i in idxs])
+            for i, v in zip(idxs, verdicts):
+                out[i] = v
+        return out
 
     def aggregate_count(self, flt=None) -> int:
         """Cluster-wide matching-doc count (the meta-count fast path: ships

@@ -175,19 +175,9 @@ class GraphQLExecutor:
         resolved = self.schema.resolve_class_name(params.class_name)
         cidx = self.db.get_index(resolved) if resolved else None
         if cidx is None or cidx.finder is None:
-            for r in results:
-                r.additional["isConsistent"] = True
-            return
-        from concurrent.futures import ThreadPoolExecutor
-
-        def probe(r):
-            return cidx.is_consistent(r.obj.uuid, r.obj.last_update_time_unix)
-
-        if len(results) == 1:
-            verdicts = [probe(results[0])]
-        else:
-            with ThreadPoolExecutor(max_workers=min(16, len(results))) as pool:
-                verdicts = list(pool.map(probe, results))
+            return  # _additional defaults isConsistent to True
+        verdicts = cidx.are_consistent(
+            [(r.obj.uuid, r.obj.last_update_time_unix) for r in results])
         for r, v in zip(results, verdicts):
             r.additional["isConsistent"] = v
 

@@ -75,6 +75,11 @@ class _Participant:
             return self.local.digest(class_name, shard, uuid)
         return self.client.digest(self.host, class_name, shard, uuid)
 
+    def digest_many(self, class_name, shard, uuids):
+        if self.local is not None:
+            return self.local.digest_many(class_name, shard, list(uuids))
+        return self.client.digest_many(self.host, class_name, shard, uuids)
+
     def fetch(self, class_name, shard, uuid) -> Optional[StorObj]:
         if self.local is not None:
             s = self.local._shard(class_name, shard)
@@ -229,14 +234,31 @@ class Finder:
         updateTime (the _additional.isConsistent probe, finder.go
         CheckConsistency). Unreachable replicas count as inconsistent —
         the honest answer when agreement cannot be confirmed."""
+        return self.check_consistency_many(
+            class_name, shard, [(uuid, update_time)])[0]
+
+    def check_consistency_many(
+        self, class_name: str, shard: str,
+        pairs: list[tuple[str, int]],
+    ) -> list[bool]:
+        """Batch isConsistent: ONE digest request per replica covers every
+        (uuid, updateTime) pair (finder.go DigestObjects shape) — a page of
+        results costs R roundtrips, not rows x R."""
+        if not pairs:
+            return []
+        uuids = [u for u, _ in pairs]
+        verdicts = [True] * len(pairs)
         for p in self.coord.participants(class_name, shard):
             try:
-                d = p.digest(class_name, shard, uuid)
+                digests = p.digest_many(class_name, shard, uuids)
             except Exception:  # noqa: BLE001 — unreachable replica
-                return False
-            if not d.get("exists") or d.get("updateTime", 0) != update_time:
-                return False
-        return True
+                return [False] * len(pairs)
+            by_uuid = {d.get("uuid"): d for d in digests}
+            for i, (u, t) in enumerate(pairs):
+                d = by_uuid.get(u)
+                if d is None or not d.get("exists") or d.get("updateTime", 0) != t:
+                    verdicts[i] = False
+        return verdicts
 
     def get_object(self, class_name: str, shard: str, uuid: str,
                    level: Optional[str] = None,
