@@ -445,3 +445,27 @@ def test_ask_drives_retrieval(svc, tmp_path):
         assert hit["_additional"]["answer"]["result"] == "qubits"
     finally:
         app.shutdown()
+
+
+def test_qna_openai(svc):
+    """qna-openai: extractive answers via the chat-completions API."""
+    import uuid as _uuid
+
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.modules.readers import QnAOpenAI
+    from weaviate_tpu.usecases.traverser import SearchResult
+
+    mod = QnAOpenAI("sk-qna", base_url=f"{svc.url}/v1")
+    rows = [SearchResult(obj=StorObj(
+        class_name="D", uuid=str(_uuid.uuid4()),
+        properties={"body": "the GEN answer lives here"}))]
+    out = mod.resolve_additional("answer", rows, {"question": "where?"})
+    assert out[0]["hasAnswer"] and out[0]["result"].startswith("GEN[")
+    # auth header reached the API
+    assert any(h.get("Authorization") == "Bearer sk-qna"
+               for _, _, h in svc.requests)
+
+    with pytest.raises(Exception):
+        mod.resolve_additional("answer", rows, {})  # question required
+    with pytest.raises(Exception):
+        QnAOpenAI("")  # api key required

@@ -301,6 +301,13 @@ def build_provider(config) -> Optional[Provider]:
             from weaviate_tpu.modules.readers import QnATransformers
 
             p.register(QnATransformers(_env("QNA_INFERENCE_API")))
+        elif name == "qna-openai":
+            from weaviate_tpu.modules.readers import QnAOpenAI
+
+            p.register(QnAOpenAI(
+                _env("OPENAI_APIKEY"),
+                model=_env("QNA_OPENAI_MODEL") or "gpt-4o-mini",
+                base_url=_env("OPENAI_BASE_URL") or "https://api.openai.com/v1"))
         elif name == "sum-transformers":
             from weaviate_tpu.modules.readers import SumTransformers
 

@@ -257,3 +257,74 @@ class GenerativeOpenAI(Module, AdditionalProperties):
                 for i in range(len(results))
             ]
         raise ModuleError("generate requires singleResult{prompt} or groupedResult{task}")
+
+
+class QnAOpenAI(Module, AdditionalProperties):
+    """qna-openai: extractive question answering through the OpenAI
+    completions API (modules/qna-openai — the SaaS twin of
+    qna-transformers; same `ask`/`_additional.answer` surface)."""
+
+    def __init__(self, api_key: str, model: str = "gpt-4o-mini",
+                 base_url: str = "https://api.openai.com/v1", timeout: float = 60.0):
+        if not api_key:
+            raise ModuleError("qna-openai requires OPENAI_APIKEY")
+        self.api_key = api_key
+        self.model = model
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    @property
+    def name(self) -> str:
+        return "qna-openai"
+
+    @property
+    def module_type(self) -> str:
+        return "qna"
+
+    def meta(self) -> dict:
+        return {"type": "qna", "provider": "openai", "model": self.model}
+
+    def additional_properties(self) -> list[str]:
+        return ["answer"]
+
+    def _ask(self, text: str, question: str) -> Optional[str]:
+        reply = http_json(
+            f"{self.base_url}/chat/completions",
+            {"model": self.model,
+             "messages": [{
+                 "role": "user",
+                 "content": (
+                     "Answer strictly from the text; reply with the exact "
+                     "answer span only, or the single word NONE if the text "
+                     f"does not answer it.\n\nText: {text}\n\n"
+                     f"Question: {question}"
+                 ),
+             }]},
+            headers={"Authorization": f"Bearer {self.api_key}"},
+            timeout=self.timeout,
+        )
+        choices = reply.get("choices") or []
+        if not choices:
+            raise ModuleError(f"qna-openai returned no choices: {reply}")
+        answer = (choices[0].get("message", {}).get("content") or "").strip()
+        return None if not answer or answer.upper() == "NONE" else answer
+
+    def resolve_additional(self, prop: str, results, params: dict):
+        question = (params or {}).get("question", "")
+        if not question:
+            raise ModuleError("_additional.answer requires ask{question}")
+        properties = (params or {}).get("properties")
+        out = []
+        for r in results:
+            answer = self._ask(_text_of(r.obj, properties), question)
+            pos = -1
+            if answer:
+                pos = _text_of(r.obj, properties).find(answer)
+            out.append({
+                "result": answer,
+                "hasAnswer": answer is not None,
+                "property": None,
+                "startPosition": max(pos, 0),
+                "endPosition": (pos + len(answer)) if answer and pos >= 0 else 0,
+            })
+        return out
