@@ -64,6 +64,25 @@ class Provider:
         self._modules: dict[str, Module] = {}
 
     def register(self, module: Module) -> None:
+        from weaviate_tpu.modules.explain import EXPLAIN_PROPS
+        from weaviate_tpu.modules.interface import AdditionalProperties
+
+        if isinstance(module, AdditionalProperties):
+            # explain props are class-vectorizer-scoped by dispatch
+            # (additional_property_module), so sharing them is expected;
+            # any other overlap means first-registered silently wins — warn
+            mine = set(module.additional_properties()) - set(EXPLAIN_PROPS)
+            for other in self._modules.values():
+                if not isinstance(other, AdditionalProperties):
+                    continue
+                clash = mine & set(other.additional_properties())
+                if clash:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "modules %r and %r both resolve _additional props %s; "
+                        "%r (registered first) wins",
+                        other.name, module.name, sorted(clash), other.name)
         self._modules[module.name] = module
 
     def get(self, name: str) -> Optional[Module]:

@@ -316,12 +316,18 @@ class QnAOpenAI(Module, AdditionalProperties):
         properties = (params or {}).get("properties")
         out = []
         for r in results:
-            answer = self._ask(_text_of(r.obj, properties), question)
+            text = _text_of(r.obj, properties)
+            answer = self._ask(text, question)
             pos = -1
             if answer:
-                pos = _text_of(r.obj, properties).find(answer)
+                # case-insensitive span location: models routinely change
+                # capitalization of an otherwise-exact extract
+                pos = text.lower().find(answer.lower())
             out.append({
+                # same payload shape as qna-transformers (certainty always
+                # present) so switching modules never breaks clients
                 "result": answer,
+                "certainty": None,
                 "hasAnswer": answer is not None,
                 "property": None,
                 "startPosition": max(pos, 0),
