@@ -169,3 +169,31 @@ def test_docid_counter(tmp_path):
     # crash-restart must never reuse
     c2 = Counter(p, reserve=10)
     assert c2.get_and_inc() >= 8
+
+
+def test_idle_memtable_flush(tmp_path):
+    """PERSISTENCE_FLUSH_IDLE_MEMTABLES_AFTER: the background cycle flushes
+    write-quiet memtables so crash recovery never replays an old WAL
+    (lsmkv FlushAfterIdle)."""
+    import time as _t
+
+    store = Store(str(tmp_path / "s"), memtable_max_bytes=1 << 30,
+                  flush_idle_seconds=0.2)
+    b = store.create_or_load_bucket("r", STRATEGY_REPLACE)
+    assert b.memtable_max_bytes == 1 << 30  # store default propagated
+    t0 = _t.monotonic()
+    b.put(b"k", b"v")
+    assert len(b._mem)  # still in the memtable
+    # not idle yet — unless a CI stall already burned the window
+    if _t.monotonic() - t0 < 0.2:
+        assert store.flush_idle_once() == 0
+    _t.sleep(0.25)
+    assert store.flush_idle_once() >= 1 or not len(b._mem)
+    assert not len(b._mem) and b.segment_count() >= 1
+    assert b.get(b"k") == b"v"
+    # fresh writes reset the idle clock
+    t1 = _t.monotonic()
+    b.put(b"k2", b"v2")
+    if _t.monotonic() - t1 < 0.2:
+        assert store.flush_idle_once() == 0
+    store.shutdown()

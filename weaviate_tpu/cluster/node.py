@@ -39,6 +39,7 @@ class ClusterNode:
         metrics=None,
         default_vectorizer: str = "none",
         tolerate_node_failures: bool = False,
+        store_opts=None,
     ):
         os.makedirs(data_path, exist_ok=True)
         self.node_name = node_name
@@ -51,6 +52,7 @@ class ClusterNode:
             remote_client=self.remote_index,
             metrics=metrics,
             node_names=self.node_names,
+            store_opts=store_opts,
         )
         self.tx_manager = TxManager(
             self.cluster, tolerate_node_failures=tolerate_node_failures

@@ -38,6 +38,7 @@ class ClassIndex:
         invert_cfg: Optional[dict] = None,
         replicator=None,
         finder=None,
+        store_opts: Optional[dict] = None,
     ):
         self.class_def = class_def
         self.class_name = class_def.name
@@ -49,6 +50,7 @@ class ClassIndex:
         self.finder = finder          # usecases/replica.Finder (consistent reads)
         self.metrics = metrics
         self.invert_cfg = invert_cfg
+        self.store_opts = store_opts
         self.sharding_state = sharding_state or ShardingState(
             class_def.name, ShardingConfig(desired_count=1), [node_name]
         )
@@ -67,6 +69,7 @@ class ClassIndex:
             self.vector_config,
             metrics=self.metrics,
             invert_cfg=self.invert_cfg,
+            store_opts=self.store_opts,
         )
         self.shards[name] = s
         return s

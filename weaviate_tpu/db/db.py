@@ -28,6 +28,7 @@ class DB:
         node_names: Optional[list[str]] = None,
         replicator=None,
         finder=None,
+        store_opts: Optional[dict] = None,
     ):
         self.root_path = root_path
         self.node_name = node_name
@@ -36,6 +37,7 @@ class DB:
         self.replicator = replicator
         self.finder = finder
         self.metrics = metrics
+        self.store_opts = store_opts  # LSM tuning (memtable size, idle flush)
         self.indexes: dict[str, ClassIndex] = {}
         self._lock = threading.RLock()
         os.makedirs(root_path, exist_ok=True)
@@ -67,6 +69,7 @@ class DB:
                 invert_cfg=getattr(class_def, "inverted_index_config", None),
                 replicator=self.replicator,
                 finder=self.finder,
+                store_opts=self.store_opts,
             )
             self.indexes[class_def.name] = idx
             return idx

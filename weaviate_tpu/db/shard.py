@@ -74,13 +74,14 @@ class Shard:
         vector_config,
         metrics=None,
         invert_cfg: Optional[dict] = None,
+        store_opts: Optional[dict] = None,
     ):
         self.name = name
         self.path = path
         self.class_def = class_def
         self.metrics = metrics
         os.makedirs(path, exist_ok=True)
-        self.store = Store(os.path.join(path, "lsm"))
+        self.store = Store(os.path.join(path, "lsm"), **(store_opts or {}))
         # objects bucket keyed by uuid bytes; docid bucket docID -> uuid bytes
         # (reference: helpers.ObjectsBucketLSM + docid lookup)
         self.objects = self.store.create_or_load_bucket("objects", STRATEGY_REPLACE)

@@ -70,6 +70,7 @@ class App:
                 bind_port=cl_cfg.data_bind_port,
                 metrics=self.metrics,
                 default_vectorizer=self.config.default_vectorizer_module,
+                store_opts=self._store_opts(),
             )
             self.cluster_node.start()
             self.cluster_node.join(peers)
@@ -79,7 +80,8 @@ class App:
             self.schema = self.cluster_node.schema
         else:
             self.cluster_node = None
-            self.db = DB(path, metrics=self.metrics)
+            self.db = DB(path, metrics=self.metrics,
+                         store_opts=self._store_opts())
             self.schema = SchemaManager(
                 os.path.join(path, "schema.json"), migrator=self.db,
                 default_vectorizer=self.config.default_vectorizer_module)
@@ -168,6 +170,15 @@ class App:
 
                 logging.getLogger(__name__).info(
                     "filterable backfill rebuilt: %s", rebuilt)
+
+    def _store_opts(self) -> dict:
+        """LSM tuning from env (PERSISTENCE_MEMTABLES_MAX_SIZE_MB,
+        PERSISTENCE_FLUSH_IDLE_MEMTABLES_AFTER — environment.go surface)."""
+        p = self.config.persistence
+        return {
+            "memtable_max_bytes": int(p.memtables_max_size_mb) * 1024 * 1024,
+            "flush_idle_seconds": float(p.flush_idle_memtables_after),
+        }
 
     # -- meta ----------------------------------------------------------------
 

@@ -22,10 +22,12 @@ from __future__ import annotations
 
 import bisect
 import io
+import logging
 import mmap
 import os
 import struct
 import threading
+import time
 from typing import Iterable, Iterator, Optional
 
 import numpy as np
@@ -413,6 +415,7 @@ class Bucket:
         self.strategy = strategy
         self.memtable_max_bytes = memtable_max_bytes
         self.sync_writes = sync_writes
+        self._last_write = time.monotonic()
         self._lock = threading.RLock()
         os.makedirs(path, exist_ok=True)
         self._segments: list[Segment] = []  # oldest..newest
@@ -451,6 +454,7 @@ class Bucket:
         for p in parts:
             _write_frame(buf, p)
         self._wal.write(buf.getvalue())
+        self._last_write = time.monotonic()
         if self.sync_writes:
             self._wal.flush()
             os.fsync(self._wal.fileno())
@@ -855,8 +859,16 @@ class Store:
     MAX_SEGMENTS = int(os.environ.get("PERSISTENCE_LSM_MAX_SEGMENTS", "8"))
     COMPACTION_INTERVAL = float(os.environ.get("PERSISTENCE_LSM_COMPACTION_INTERVAL", "30"))
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, memtable_max_bytes: Optional[int] = None,
+                 flush_idle_seconds: Optional[float] = None):
+        """memtable_max_bytes: per-bucket default flush threshold
+        (PERSISTENCE_MEMTABLES_MAX_SIZE_MB). flush_idle_seconds: the
+        background cycle also flushes memtables with no writes for this
+        long (PERSISTENCE_FLUSH_IDLE_MEMTABLES_AFTER; bounds WAL-replay
+        time after a crash on a write-quiet shard)."""
         self.root = root
+        self.memtable_max_bytes = memtable_max_bytes
+        self.flush_idle_seconds = flush_idle_seconds
         os.makedirs(root, exist_ok=True)
         self._buckets: dict[str, Bucket] = {}
         self._lock = threading.Lock()
@@ -891,10 +903,18 @@ class Store:
 
         def loop():
             while not self._stop.wait(iv):
+                # independent try blocks: a persistently-failing compaction
+                # (corrupt segment) must not also disable idle flushing
                 try:
                     self.compact_once(max_segs)
                 except Exception:  # noqa: BLE001 — the cycle must survive
-                    pass
+                    logging.getLogger(__name__).warning(
+                        "lsm compaction cycle error", exc_info=True)
+                try:
+                    self.flush_idle_once()
+                except Exception:  # noqa: BLE001
+                    logging.getLogger(__name__).warning(
+                        "lsm idle-flush cycle error", exc_info=True)
 
         self._cycle_thread = threading.Thread(
             target=loop, daemon=True, name="lsm-compaction"
@@ -911,10 +931,27 @@ class Store:
                     merges += 1
         return merges
 
+    def flush_idle_once(self) -> int:
+        """Flush memtables untouched for flush_idle_seconds (lsmkv's
+        FlushAfterIdle cycle): bounds crash-recovery WAL replay on shards
+        that went write-quiet. -> buckets flushed."""
+        if not self.flush_idle_seconds:
+            return 0
+        now = time.monotonic()
+        flushed = 0
+        with self._compaction_gate:
+            for b in list(self._buckets.values()):
+                if len(b._mem) and now - b._last_write >= self.flush_idle_seconds:
+                    b.flush_memtable()
+                    flushed += 1
+        return flushed
+
     def create_or_load_bucket(self, name: str, strategy: str, **kw) -> Bucket:
         with self._lock:
             b = self._buckets.get(name)
             if b is None:
+                if self.memtable_max_bytes and "memtable_max_bytes" not in kw:
+                    kw["memtable_max_bytes"] = self.memtable_max_bytes
                 b = Bucket(os.path.join(self.root, name), strategy, **kw)
                 self._buckets[name] = b
             elif b.strategy != strategy:
