@@ -79,6 +79,10 @@ def test_tpu_exact_l2(tmp_path, fixture):
     idx.add_batch(np.arange(len(vectors)), vectors)
     r = _recall(idx, queries, gt)
     assert r >= 0.99, r
+    # the recall bar must hold on the SERVING kernel: 200-query batches
+    # qualify for the fused gmin path, and a silent gating regression
+    # (gmin disabled -> legacy scan) would otherwise pass unnoticed
+    assert idx._gmin_validated and not idx._gmin_broken
     idx.shutdown()
 
 
