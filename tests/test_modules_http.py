@@ -469,3 +469,41 @@ def test_qna_openai(svc):
         mod.resolve_additional("answer", rows, {})  # question required
     with pytest.raises(Exception):
         QnAOpenAI("")  # api key required
+
+
+def test_autocorrect_transformer(svc, tmp_path):
+    """bm25/nearText with autocorrect: true run the query through the
+    text-spellcheck transformer before searching (texttransformer.go;
+    the fake corrects everything to 'quantum')."""
+    from weaviate_tpu.modules.readers import TextSpellcheck
+
+    p = Provider()
+    p.register(LocalTextVectorizer())
+    p.register(TextSpellcheck(svc.url))
+    assert p.transform_text(["quntum"]) == ["quantum"]
+
+    app = _mk_app(tmp_path, p)
+    try:
+        app.schema.add_class({
+            "class": "AC", "vectorizer": "text2vec-local",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "body", "dataType": ["text"]}]})
+        import uuid as _uuid
+
+        for i, b in enumerate(["quantum qubits physics", "bread flour yeast"]):
+            app.objects.add({"class": "AC", "id": str(_uuid.UUID(int=900 + i)),
+                             "properties": {"body": b}})
+        # bm25 with a typo: without autocorrect no hits, with it the
+        # corrected term matches
+        q_plain = '{ Get { AC(bm25: {query: "quntum"}) { body } } }'
+        q_fix = '{ Get { AC(bm25: {query: "quntum", autocorrect: true}) { body } } }'
+        assert app.graphql.execute(q_plain)["data"]["Get"]["AC"] == []
+        hits = app.graphql.execute(q_fix)["data"]["Get"]["AC"]
+        assert hits and hits[0]["body"].startswith("quantum")
+        # nearText autocorrect: corrected concept ranks the quantum doc first
+        q_nt = ('{ Get { AC(nearText: {concepts: ["quntum"], autocorrect: true}, '
+                'limit: 1) { body } } }')
+        out = app.graphql.execute(q_nt)
+        assert out["data"]["Get"]["AC"][0]["body"].startswith("quantum")
+    finally:
+        app.shutdown()

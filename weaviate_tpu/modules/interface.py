@@ -64,6 +64,15 @@ class GraphQLArguments(abc.ABC):
         return []
 
 
+class TextTransformer(abc.ABC):
+    """Query-text transformation — the autocorrect hook
+    (modulecapabilities/texttransformer.go TextTransform)."""
+
+    @abc.abstractmethod
+    def transform(self, texts: Sequence[str]) -> list[str]:
+        """-> the transformed texts, same length/order."""
+
+
 class AdditionalProperties(abc.ABC):
     """_additional props the module can resolve
     (modulecapabilities/additional.go)."""

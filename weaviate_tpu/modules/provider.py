@@ -217,6 +217,23 @@ class Provider:
                 out.extend(m.additional_properties())
         return sorted(set(out))
 
+    def transform_text(self, texts: Sequence[str]) -> list[str]:
+        """Run query texts through every enabled TextTransformer (the
+        autocorrect hook, modulecapabilities/texttransformer.go); identity
+        when none is enabled."""
+        from weaviate_tpu.modules.interface import TextTransformer
+
+        out = [str(t) for t in texts]
+        for m in self._modules.values():
+            if isinstance(m, TextTransformer):
+                out = m.transform(out)
+        return out
+
+    def has_text_transformer(self) -> bool:
+        from weaviate_tpu.modules.interface import TextTransformer
+
+        return any(isinstance(m, TextTransformer) for m in self._modules.values())
+
     def graphql_arguments(self) -> list[str]:
         """near-args contributed by enabled modules (nearText, nearImage,
         ...) — feeds GraphQL arg validation (modulecapabilities/graphql.go)."""

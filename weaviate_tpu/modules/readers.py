@@ -20,7 +20,11 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from weaviate_tpu.modules.interface import AdditionalProperties, Module
+from weaviate_tpu.modules.interface import (
+    AdditionalProperties,
+    Module,
+    TextTransformer,
+)
 from weaviate_tpu.modules.provider import ModuleError
 from weaviate_tpu.modules.sidecar import http_json
 
@@ -156,8 +160,11 @@ class NerTransformers(Module, AdditionalProperties):
         return out
 
 
-class TextSpellcheck(Module, AdditionalProperties):
-    """text-spellcheck: query-text corrections (spellCheck additional)."""
+class TextSpellcheck(Module, AdditionalProperties, TextTransformer):
+    """text-spellcheck: query-text corrections (spellCheck additional) and
+    the autocorrect transformer (modules/text-spellcheck/transformer/
+    autocorrect — bm25/nearText queries with autocorrect: true run their
+    text through the corrector before searching)."""
 
     def __init__(self, url: str, timeout: float = 10.0):
         if not url:
@@ -186,6 +193,17 @@ class TextSpellcheck(Module, AdditionalProperties):
         text = (params or {}).get("text", "")
         reply = self.check(text)
         return [reply for _ in results]
+
+    def transform(self, texts):
+        """Autocorrect each text: the sidecar's didYouMean replaces the
+        input when it proposes corrections."""
+        out = []
+        for t in texts:
+            reply = self.check(str(t))
+            corrected = reply.get("didYouMean")
+            out.append(corrected if corrected and reply.get(
+                "numberOfCorrections", 0) else str(t))
+        return out
 
 
 class GenerativeOpenAI(Module, AdditionalProperties):

@@ -86,6 +86,26 @@ class Explorer:
 
     # -- vector resolution (near_params_vector.go) ---------------------------
 
+    def _autocorrected_near_text(self, nt: dict) -> dict:
+        """nearText {autocorrect: true}: run the concepts through the
+        enabled TextTransformer (text-spellcheck's autocorrect,
+        texttransformer.go) before embedding."""
+        if not nt.get("autocorrect") or self.modules is None \
+                or not self.modules.has_text_transformer():
+            return nt
+        concepts = nt.get("concepts") or []
+        if isinstance(concepts, str):
+            concepts = [concepts]
+        return {**nt, "concepts": self.modules.transform_text(concepts)}
+
+    def _autocorrected_bm25(self, kw: dict) -> dict:
+        """bm25 {autocorrect: true}: correct the query string before term
+        matching."""
+        if not kw.get("autocorrect") or self.modules is None \
+                or not self.modules.has_text_transformer():
+            return kw
+        return {**kw, "query": self.modules.transform_text([kw.get("query", "")])[0]}
+
     def _resolve_vector(self, params: GetParams, idx) -> Optional[np.ndarray]:
         nv = params.near_vector
         if nv is not None and nv.get("vector") is not None:
@@ -104,6 +124,7 @@ class Explorer:
             if self.modules is None:
                 raise TraverserError("nearText requires a vectorizer module")
             cd = self.schema.get_class(idx.class_name)
+            nt = self._autocorrected_near_text(nt)
             vec = self.modules.vectorize_query(cd, nt)
             if vec is None:
                 raise TraverserError("nearText: vectorizer returned no vector")
@@ -218,7 +239,7 @@ class Explorer:
             res = idx.object_search(
                 limit,
                 flt=params.filters,
-                keyword_ranking=params.keyword_ranking,
+                keyword_ranking=self._autocorrected_bm25(params.keyword_ranking),
                 offset=params.offset,
                 include_vector=inc_vec,
             )
