@@ -507,3 +507,21 @@ def test_autocorrect_transformer(svc, tmp_path):
         assert out["data"]["Get"]["AC"][0]["body"].startswith("quantum")
     finally:
         app.shutdown()
+
+
+def test_autocorrect_without_module_errors(tmp_path):
+    """autocorrect: true with no transformer enabled is a loud error, not a
+    silently-uncorrected search."""
+    p = Provider()
+    p.register(LocalTextVectorizer())
+    app = _mk_app(tmp_path, p)
+    try:
+        app.schema.add_class({
+            "class": "NA", "vectorizer": "text2vec-local",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "body", "dataType": ["text"]}]})
+        out = app.graphql.execute(
+            '{ Get { NA(bm25: {query: "x", autocorrect: true}) { body } } }')
+        assert out.get("errors") and "transformer" in out["errors"][0]["message"]
+    finally:
+        app.shutdown()

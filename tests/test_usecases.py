@@ -274,3 +274,55 @@ def test_references(stack):
     assert got.properties["writtenBy"] == [{"beacon": beacon}]
     om.delete_reference(b.uuid, "Book", "writtenBy", beacon)
     assert om.get(b.uuid, "Book").properties["writtenBy"] == []
+
+
+def test_phone_number_parse_and_validate():
+    """phoneNumber values validate + parse at import
+    (validation/phone_numbers.go; payload shape phone_number.go)."""
+    from weaviate_tpu.entities.phone import PhoneNumberError, parse_phone_number
+
+    # international input needs no default country
+    out = parse_phone_number({"input": "+49 171 1234567"})
+    assert out["valid"] and out["countryCode"] == 49
+    assert out["national"] == 1711234567
+    assert out["internationalFormatted"] == "+49 1711234567"
+
+    # 00-prefix international form
+    assert parse_phone_number({"input": "0049 171 1234567"})["countryCode"] == 49
+
+    # national input + defaultCountry
+    out = parse_phone_number({"input": "0171 1234567", "defaultCountry": "DE"})
+    assert out["valid"] and out["countryCode"] == 49 and out["national"] == 1711234567
+
+    # malformed values are errors, not silent stores
+    with pytest.raises(PhoneNumberError):
+        parse_phone_number("+491711234567")        # not a map
+    with pytest.raises(PhoneNumberError):
+        parse_phone_number({"input": ""})          # empty input
+    with pytest.raises(PhoneNumberError):
+        parse_phone_number({"input": "0171 123"})  # national w/o country
+    with pytest.raises(PhoneNumberError):
+        parse_phone_number({"input": "123", "defaultCountry": "zz"})
+
+    # parseable-but-invalid numbers store valid=false
+    assert not parse_phone_number({"input": "+49 12"})["valid"]
+    assert not parse_phone_number({"input": "+999 1234567"})["valid"]
+
+
+def test_phone_number_through_objects_manager(stack):
+    db, mgr, om, bm, trav = stack
+    mgr.add_class({
+        "class": "Contact",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "phone", "dataType": ["phoneNumber"]}],
+    })
+    obj = om.add({"class": "Contact",
+                  "properties": {"phone": {"input": "+31 20 123 4567"}},
+                  "vector": [0.0, 0.0]})
+    got = om.get(obj.uuid, "Contact")
+    assert got.properties["phone"]["valid"]
+    assert got.properties["phone"]["countryCode"] == 31
+    assert got.properties["phone"]["internationalFormatted"].startswith("+31 ")
+    with pytest.raises(Exception):
+        om.add({"class": "Contact",
+                "properties": {"phone": "not-a-map"}, "vector": [0.0, 0.0]})

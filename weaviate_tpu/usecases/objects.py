@@ -63,7 +63,7 @@ class ObjectsManager:
                 raise ObjectsError(f"class {class_name!r} not found in schema")
             class_name = resolved
         cd = self.schema.get_class(class_name)
-        self._validate_props(cd, props)
+        props = self._validate_props(cd, props)
         obj_uuid = payload.get("id")
         obj_uuid = _valid_uuid(obj_uuid) if obj_uuid else str(uuidlib.uuid4())
         vector = payload.get("vector")
@@ -79,7 +79,14 @@ class ObjectsManager:
                 obj.vector = np.asarray(vec, dtype=np.float32)
         return obj
 
-    def _validate_props(self, cd, props: dict) -> None:
+    def _validate_props(self, cd, props: dict) -> dict:
+        """Validate the payload's properties; -> a normalized COPY (parsed
+        phoneNumbers etc.) so validate-only callers never see their input
+        mutated."""
+        from weaviate_tpu.entities.phone import PhoneNumberError, parse_phone_number
+        from weaviate_tpu.entities.schema import DataType
+
+        props = dict(props)
         for key, value in props.items():
             prop = cd.get_property(key)
             if prop is None:
@@ -93,6 +100,14 @@ class ObjectsManager:
                 # cross-reference: list of beacons
                 if value is not None and not isinstance(value, list):
                     raise ObjectsError(f"reference property {key!r} must be a list of beacons")
+            elif pt.base is DataType.PHONE_NUMBER and value is not None:
+                # validate-and-parse at import (validation/phone_numbers.go):
+                # the stored value gains the read-only parsed fields
+                try:
+                    props[key] = parse_phone_number(value, key, cd.name)
+                except PhoneNumberError as e:
+                    raise ObjectsError(str(e)) from e
+        return props
 
     def _index_or_raise(self, class_name: str):
         resolved = self.schema.resolve_class_name(class_name)
@@ -176,7 +191,7 @@ class ObjectsManager:
         cd = self.schema.get_class(idx.class_name)
         if self.auto is not None:
             self.auto.ensure(idx.class_name, props)
-        self._validate_props(cd, props)
+        props = self._validate_props(cd, props)
         if vector is None:
             vector = self._revectorize(idx, cd, uuid, props)
         out = idx.merge_object(uuid, props, vector, cl=cl)

@@ -90,9 +90,14 @@ class Explorer:
         """nearText {autocorrect: true}: run the concepts through the
         enabled TextTransformer (text-spellcheck's autocorrect,
         texttransformer.go) before embedding."""
-        if not nt.get("autocorrect") or self.modules is None \
-                or not self.modules.has_text_transformer():
+        if not nt.get("autocorrect"):
             return nt
+        if self.modules is None or not self.modules.has_text_transformer():
+            # the reference only exposes the arg when the module exists —
+            # silently skipping correction would misreport zero hits
+            raise TraverserError(
+                "autocorrect requires a text transformer module "
+                "(text-spellcheck)")
         concepts = nt.get("concepts") or []
         if isinstance(concepts, str):
             concepts = [concepts]
@@ -101,9 +106,12 @@ class Explorer:
     def _autocorrected_bm25(self, kw: dict) -> dict:
         """bm25 {autocorrect: true}: correct the query string before term
         matching."""
-        if not kw.get("autocorrect") or self.modules is None \
-                or not self.modules.has_text_transformer():
+        if not kw.get("autocorrect"):
             return kw
+        if self.modules is None or not self.modules.has_text_transformer():
+            raise TraverserError(
+                "autocorrect requires a text transformer module "
+                "(text-spellcheck)")
         return {**kw, "query": self.modules.transform_text([kw.get("query", "")])[0]}
 
     def _resolve_vector(self, params: GetParams, idx) -> Optional[np.ndarray]:
