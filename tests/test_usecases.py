@@ -326,3 +326,62 @@ def test_phone_number_through_objects_manager(stack):
     with pytest.raises(Exception):
         om.add({"class": "Contact",
                 "properties": {"phone": "not-a-map"}, "vector": [0.0, 0.0]})
+
+
+def test_primitive_type_validation(stack):
+    """date/geo/blob/uuid values validate at import
+    (validation/properties_validation.go): bad shapes are errors, good
+    ones store."""
+    db, mgr, om, bm, trav = stack
+    mgr.add_class({
+        "class": "Typed",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [
+            {"name": "when", "dataType": ["date"]},
+            {"name": "where", "dataType": ["geoCoordinates"]},
+            {"name": "img", "dataType": ["blob"]},
+            {"name": "ext", "dataType": ["uuid"]},
+            {"name": "days", "dataType": ["date[]"]},
+        ],
+    })
+
+    ok = om.add({"class": "Typed", "vector": [0.0, 0.0], "properties": {
+        "when": "2023-06-01T12:00:00Z",
+        "where": {"latitude": 52.5, "longitude": 13.4},
+        "img": "aGVsbG8=",
+        "ext": "7b2e1c66-0000-0000-0000-000000000001",
+        "days": ["2023-06-01T12:00:00+02:00"],
+    }})
+    assert om.get(ok.uuid, "Typed").properties["when"].startswith("2023")
+
+    bad = [
+        {"when": "not-a-date"},
+        {"when": 12345},
+        {"where": {"latitude": 52.5}},                     # missing longitude
+        {"where": {"latitude": 95.0, "longitude": 0.0}},   # out of range
+        {"where": "52.5,13.4"},
+        {"img": "not base64!!"},
+        {"ext": "nope"},
+        {"days": ["2023-06-01T12:00:00Z", "bad"]},         # arrays validate per item
+        {"days": "2023-06-01T12:00:00Z"},                  # array type needs a list
+    ]
+    for props in bad:
+        with pytest.raises(Exception):
+            om.add({"class": "Typed", "vector": [0.0, 0.0], "properties": props})
+
+
+def test_phone_trunk_zero_rules():
+    from weaviate_tpu.entities.phone import PhoneNumberError, parse_phone_number
+
+    # "(0)" notation: the marked trunk zero is dropped
+    out = parse_phone_number({"input": "+49 (0)171 1234567"})
+    assert out["internationalFormatted"] == "+49 1711234567"
+    # bare leading zero after +CC is kept (significant in Italy)
+    out = parse_phone_number({"input": "+39 06 1234567"})
+    assert out["national"] == 61234567 and out["nationalFormatted"] == "061234567"
+    # national Italian input keeps its zero too
+    out = parse_phone_number({"input": "06 1234567", "defaultCountry": "IT"})
+    assert out["nationalFormatted"] == "061234567"
+    # unknown defaultCountry errors on BOTH input forms
+    with pytest.raises(PhoneNumberError):
+        parse_phone_number({"input": "+49 171 1234567", "defaultCountry": "zz"})

@@ -27,6 +27,10 @@ COUNTRY_CODES = {
 _CC_BY_LENGTH = sorted({str(c) for c in COUNTRY_CODES.values()},
                        key=len, reverse=True)
 
+# countries where the leading 0 is PART of the national number (no trunk
+# prefix to strip — e.g. Rome numbers start with 06)
+_NO_TRUNK_STRIP = {"it"}
+
 _DIGITS = re.compile(r"\d+")
 
 
@@ -52,6 +56,10 @@ def parse_phone_number(value: dict, prop_name: str = "", class_name: str = "") -
         raise PhoneNumberError(
             f"invalid phoneNumber{where}: 'input' must be a non-empty string")
     default_country = str(value.get("defaultCountry", "") or "").lower()
+    if default_country and default_country not in COUNTRY_CODES:
+        raise PhoneNumberError(
+            f"invalid phoneNumber{where}: unknown defaultCountry "
+            f"{value.get('defaultCountry')!r}")
 
     digits = "".join(_DIGITS.findall(raw))
     out = {
@@ -70,21 +78,23 @@ def parse_phone_number(value: dict, prop_name: str = "", class_name: str = "") -
         cc = next((c for c in _CC_BY_LENGTH if body.startswith(c)), None)
         if cc is None:
             return out  # unknown country prefix: stored, flagged invalid
-        # drop the trunk zero ("+49 (0)171 ..." notation): it is not part
-        # of the dialable international number
-        national = body[len(cc):].lstrip("0")
+        national = body[len(cc):]
+        # the "(0)" notation marks an explicit trunk zero that is NOT part
+        # of the dialable international number; a bare leading zero is kept
+        # (it is significant in e.g. Italy), matching what the caller wrote
+        if "(0)" in raw.replace(" ", "") and national.startswith("0"):
+            national = national[1:]
     else:
         if not default_country:
             raise PhoneNumberError(
                 f"invalid phoneNumber{where}: national number requires "
                 "'defaultCountry' (ISO 3166-1 alpha-2)")
-        code = COUNTRY_CODES.get(default_country)
-        if code is None:
-            raise PhoneNumberError(
-                f"invalid phoneNumber{where}: unknown defaultCountry "
-                f"{value.get('defaultCountry')!r}")
-        cc = str(code)
-        national = digits.lstrip("0")
+        cc = str(COUNTRY_CODES[default_country])
+        national = digits
+        # drop ONE trunk zero for trunk-zero countries (most of the table);
+        # countries whose national numbers keep the zero are exempt
+        if national.startswith("0") and default_country not in _NO_TRUNK_STRIP:
+            national = national[1:]
 
     if not (4 <= len(national) <= 14):
         return out

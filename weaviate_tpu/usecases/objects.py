@@ -107,7 +107,68 @@ class ObjectsManager:
                     props[key] = parse_phone_number(value, key, cd.name)
                 except PhoneNumberError as e:
                     raise ObjectsError(str(e)) from e
+            elif value is not None:
+                # per-type shape validation (validation/
+                # properties_validation.go): bad values are 422s at import,
+                # not corrupt rows discovered at query time
+                if pt.is_array:
+                    if not isinstance(value, list):
+                        raise ObjectsError(
+                            f"invalid {pt.value} property {key!r} on class "
+                            f"{cd.name!r}: must be a list")
+                    vals = value
+                else:
+                    vals = [value]
+                for v in vals:
+                    self._validate_primitive(pt.base, v, key, cd.name)
         return props
+
+    @staticmethod
+    def _validate_primitive(base, v, key: str, cls: str) -> None:
+        from weaviate_tpu.entities.schema import DataType
+
+        where = f"property {key!r} on class {cls!r}"
+        if base is DataType.DATE:
+            from datetime import datetime
+
+            if not isinstance(v, str):
+                raise ObjectsError(
+                    f"invalid date {where}: requires an RFC3339 string, got "
+                    f"{type(v).__name__}")
+            try:
+                datetime.fromisoformat(v.replace("Z", "+00:00"))
+            except ValueError as e:
+                raise ObjectsError(f"invalid date {where}: {v!r}") from e
+        elif base is DataType.GEO_COORDINATES:
+            if not isinstance(v, dict):
+                raise ObjectsError(f"invalid geoCoordinates {where}: must be a map")
+            for fld in ("latitude", "longitude"):
+                if fld not in v:
+                    raise ObjectsError(
+                        f"invalid geoCoordinates {where}: missing required "
+                        f"field {fld!r}")
+                if not isinstance(v[fld], (int, float)) or isinstance(v[fld], bool):
+                    raise ObjectsError(
+                        f"invalid geoCoordinates {where}: {fld} must be a number")
+            if not (-90.0 <= float(v["latitude"]) <= 90.0):
+                raise ObjectsError(f"invalid geoCoordinates {where}: latitude out of range")
+            if not (-180.0 <= float(v["longitude"]) <= 180.0):
+                raise ObjectsError(f"invalid geoCoordinates {where}: longitude out of range")
+        elif base is DataType.BLOB:
+            import base64
+            import binascii
+
+            if not isinstance(v, str):
+                raise ObjectsError(f"invalid blob {where}: must be a base64 string")
+            try:
+                base64.b64decode(v, validate=True)
+            except (binascii.Error, ValueError) as e:
+                raise ObjectsError(f"invalid blob {where}: not valid base64") from e
+        elif base is DataType.UUID:
+            try:
+                uuidlib.UUID(str(v))
+            except ValueError as e:
+                raise ObjectsError(f"invalid uuid {where}: {v!r}") from e
 
     def _index_or_raise(self, class_name: str):
         resolved = self.schema.resolve_class_name(class_name)

@@ -101,7 +101,10 @@ class Explorer:
         concepts = nt.get("concepts") or []
         if isinstance(concepts, str):
             concepts = [concepts]
-        return {**nt, "concepts": self.modules.transform_text(concepts)}
+        # flag cleared in the output: callers that pre-transform (explore's
+        # once-before-the-loop) must not re-correct per class
+        return {**nt, "concepts": self.modules.transform_text(concepts),
+                "autocorrect": False}
 
     def _autocorrected_bm25(self, kw: dict) -> dict:
         """bm25 {autocorrect: true}: correct the query string before term
@@ -433,6 +436,11 @@ class Explorer:
         limit: int = 25,
     ) -> list[dict]:
         out = []
+        if near_text is not None:
+            # transform ONCE before the per-class loop: the loop's
+            # per-class except must not swallow a missing-transformer error
+            # into silent zero hits
+            near_text = self._autocorrected_near_text(near_text)
         for idx in self.db.indexes.values():
             p = GetParams(
                 class_name=idx.class_name,
