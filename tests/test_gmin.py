@@ -88,6 +88,45 @@ def test_gmin_async_path(tmp_path):
     np.testing.assert_array_equal(ids.ravel(), np.arange(32, dtype=np.uint64))
 
 
+def test_gmin_per_shape_fallback(tmp_path, monkeypatch):
+    """A Mosaic rejection on one compiled shape falls back to the legacy
+    kernel for THAT shape only; other shapes keep the fused path. Only
+    repeated distinct-shape failures with zero successes disable the path
+    (a restart may make an oversized batch the first-ever query)."""
+    idx, vecs, rng = _mk_index(tmp_path, vi.DISTANCE_L2)
+    real = idx._search_full_gmin
+
+    def failing(q, kk, allow_words):
+        if q.shape[0] >= 64:  # "over VMEM budget" for big batches
+            raise RuntimeError("Mosaic: scoped vmem limit exceeded")
+        return real(q, kk, allow_words)
+
+    monkeypatch.setattr(idx, "_search_full_gmin", failing)
+    big = rng.standard_normal((64, vecs.shape[1])).astype(np.float32)
+    ids, _ = idx.search_by_vectors(big, 5)  # first-ever query fails
+    assert ids.shape == (64, 5)
+    assert not idx._gmin_broken and len(idx._gmin_shape_broken) == 1
+    # a small shape still compiles and validates the fused path
+    ids, _ = idx.search_by_vectors(big[:16], 5)
+    assert idx._gmin_validated and not idx._gmin_broken
+    # the broken shape stays on the legacy kernel without re-raising
+    ids, _ = idx.search_by_vectors(big, 5)
+    assert ids.shape == (64, 5) and len(idx._gmin_shape_broken) == 1
+
+
+def test_gmin_disables_after_repeated_distinct_failures(tmp_path, monkeypatch):
+    idx, vecs, rng = _mk_index(tmp_path, vi.DISTANCE_L2)
+    monkeypatch.setattr(
+        idx, "_search_full_gmin",
+        lambda q, kk, allow_words: (_ for _ in ()).throw(
+            RuntimeError("platform broken")))
+    q = rng.standard_normal((16, vecs.shape[1])).astype(np.float32)
+    for k in (3, 5, 7):  # three distinct compiled shapes all fail
+        ids, _ = idx.search_by_vectors(q, k)
+        assert ids.shape == (16, k)  # legacy kernel answered
+    assert idx._gmin_broken and not idx._gmin_validated
+
+
 def test_gmin_uneven_rescore_block(tmp_path):
     """b=3072 (a 1024-multiple bucket NOT divisible by the 2048 rescore
     block) exercises the ceil-split + pad path."""

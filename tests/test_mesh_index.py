@@ -364,11 +364,19 @@ def test_pq_mesh_large_k_and_manhattan_guard(tmp_path, rng):
     assert len(real) >= 300 - 1  # pool covered k
 
     man = make_index(tmp_path / "man", metric="manhattan")
-    man.add_batch(np.arange(300), rng.standard_normal((300, DIM)).astype(np.float32))
+    mvecs = rng.standard_normal((300, DIM)).astype(np.float32)
+    man.add_batch(np.arange(300), mvecs)
     with pytest.raises(ConfigValidationError):
         man.update_user_config(parse_and_validate_config(
             "hnsw_tpu_mesh",
             {"distance": "manhattan", "pq": {"enabled": True, "segments": 4}}))
+    # the rejected pq-enable must not stick in config: adds and searches
+    # keep working (a sticky pq.enabled would re-raise from _flush_pending)
+    assert not man.config.pq.enabled
+    man.add_batch(np.arange(300, 320),
+                  rng.standard_normal((20, DIM)).astype(np.float32))
+    ids, _ = man.search_by_vectors(mvecs[:1], 5)
+    assert ids[0][0] == 0
 
 
 def test_pq_mesh_compact_keeps_f32_log(tmp_path, rng):

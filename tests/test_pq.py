@@ -196,6 +196,37 @@ def test_pq_immutable_disable(tmp_path, data):
         idx.update_user_config(off)
 
 
+def test_pq_enable_rejection_does_not_stick(tmp_path, data):
+    """segments that don't divide dims reject the pq-enable update — and the
+    rejected config must not stick, or _flush_pending's declarative trigger
+    would re-raise on every later add/search."""
+    idx = TpuVectorIndex(_cfg(), str(tmp_path / "s"), persist=False)
+    idx.add_batch(np.arange(512), data[:512])
+    bad = _cfg(enabled=True, segments=7, centroids=64)  # 7 ∤ 32
+    with pytest.raises(vi.ConfigValidationError):
+        idx.update_user_config(bad)
+    assert not idx.config.pq.enabled
+    idx.add_batch(np.arange(512, 560), data[512:560])
+    ids, _ = idx.search_by_vector(data[0], 5)
+    assert ids[0] == 0
+
+
+def test_pq_declared_invalid_auto_disables(tmp_path, data):
+    """pq declared at class creation with segments that turn out not to
+    divide dims (unknowable before the first import) auto-disables with a
+    warning at the compression threshold instead of erroring every
+    subsequent add/search."""
+    cfg = _cfg(enabled=True, segments=7, centroids=64)  # 7 ∤ 32
+    idx = TpuVectorIndex(cfg, str(tmp_path / "s"), persist=False)
+    idx.add_batch(np.arange(512), data[:512])  # crosses the 256 threshold
+    ids, _ = idx.search_by_vector(data[0], 5)  # search flushes -> triggers
+    assert ids[0] == 0
+    assert not idx.config.pq.enabled and not idx.compressed
+    idx.add_batch(np.arange(512, 560), data[512:560])
+    ids, _ = idx.search_by_vector(data[1], 5)
+    assert ids[0] == 1
+
+
 def test_compressed_large_k(tmp_path, rng):
     """Regression: k larger than the per-chunk candidate quota must widen
     the pool instead of crashing the final top_k."""

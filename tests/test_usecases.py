@@ -70,6 +70,16 @@ def test_schema_ddl_and_persistence(tmp_path, stack):
     mgr.update_class("Article", {"description": "news articles"})
     assert mgr.get_class("Article").description == "news articles"
 
+    # a fetch-tweak-PUT payload that merely REORDERS properties is not a
+    # property change; an actual change still rejects
+    cur = mgr.get_class("Article").to_dict()
+    mgr.update_class("Article", {"description": "reordered",
+                                 "properties": cur["properties"][::-1]})
+    assert mgr.get_class("Article").description == "reordered"
+    with pytest.raises(SchemaValidationError):
+        mgr.update_class("Article", {"properties": [
+            {"name": "title", "dataType": ["int"]}]})
+
     mgr.delete_class("Article")
     assert mgr.get_class("Article") is None
     assert db.get_index("Article") is None

@@ -460,8 +460,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(200, {"results": wire.results_to_wire(rows)})
             if method == "POST" and op == ":aggregations":
                 # remote half of distributed Aggregate (reference:
-                # clusterapi indices.go :aggregations): ship back the
-                # matching objects' raw data; the coordinator runs the same
+                # clusterapi indices.go :aggregations): ship back only what
+                # the coordinator asked for — one integer (countOnly), the
+                # referenced columns (columns), or the full object set for
+                # peers predating pushdown; the coordinator runs the same
                 # aggregation math over the concatenated columns, so
                 # median/mode/topOccurrences/groupBy stay exact
                 body = self._body_json()
@@ -470,6 +472,9 @@ class _Handler(BaseHTTPRequestHandler):
                     # meta-count aggregations need one integer, not objects
                     return self._json(
                         200, {"count": len(shard.find_doc_ids(flt))})
+                if body.get("columns") is not None:
+                    return self._json(200, shard.aggregate_columns(
+                        flt, [str(p) for p in body["columns"]]))
                 return self._json(200, {"objects": wire.objs_to_wire(
                     shard.find_objects(flt, include_vector=False))})
             if method == "POST" and op == ":deletebyfilter":

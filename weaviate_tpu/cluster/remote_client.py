@@ -174,16 +174,24 @@ class RemoteIndex:
         # count it rather than silently contributing 0 (rolling upgrades)
         return len(data.get("objects", []))
 
-    def aggregate_shard(self, class_name: str, shard: str,
-                        flt: Optional[LocalFilter]) -> list:
-        """Matching objects of a remote shard for Aggregate (the coordinator
-        concatenates columns and aggregates once — clusterapi :aggregations)."""
+    def aggregate_shard_columns(self, class_name: str, shard: str,
+                                flt: Optional[LocalFilter],
+                                props: list[str]) -> dict:
+        """Referenced property columns of a remote shard for Aggregate (the
+        coordinator concatenates columns and aggregates once — clusterapi
+        :aggregations). Only the named columns cross the wire."""
         host = self._host(class_name, shard)
         data = self.http.json(
             host, "POST", f"/indices/{class_name}/shards/{shard}/objects:aggregations",
-            {"filter": wire.filter_to_wire(flt)},
+            {"filter": wire.filter_to_wire(flt), "columns": list(props)},
         )
-        return wire.objs_from_wire(data.get("objects", []))
+        if "cols" in data:
+            return {"count": int(data.get("count", 0)), "cols": data["cols"]}
+        # a peer that predates column pushdown ships the object set —
+        # project it here rather than failing (rolling upgrades)
+        objs = wire.objs_from_wire(data.get("objects", []))
+        return {"count": len(objs),
+                "cols": {p: [o.properties.get(p) for o in objs] for p in props}}
 
     def object_count(self, class_name: str, shard: str) -> int:
         host = self._host(class_name, shard)

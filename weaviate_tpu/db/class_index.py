@@ -309,23 +309,32 @@ class ClassIndex:
         futs = [self._pool.submit(run, n, s) for n, s in targets]
         return sum(f.result() for f in futs)
 
-    def aggregate_objects(self, flt=None) -> list[StorObj]:
-        """All matching objects across every physical shard (local reads +
-        remote :aggregations calls) — the data plane of Aggregate
-        (index.go's aggregation scatter-gather)."""
+    def aggregate_columns(self, flt=None, props: tuple = ()) -> dict:
+        """Referenced property columns across every physical shard (local
+        reads + remote :aggregations column requests) — the data plane of
+        Aggregate (index.go's aggregation scatter-gather). Ships columns,
+        never whole objects, so coordinator memory/network are bounded by
+        the properties the query names."""
         targets = self._all_shard_targets()
+        props = list(props)
 
         def run(name, shard):
             if shard is not None:
-                # aggregations read decoded properties only — skipping the
-                # vector halves hydration and keeps it off the wire
-                return shard.find_objects(flt, include_vector=False)
-            return self.remote.aggregate_shard(self.class_name, name, flt)
+                return shard.aggregate_columns(flt, props)
+            return self.remote.aggregate_shard_columns(
+                self.class_name, name, flt, props)
 
         if len(targets) == 1:
-            return run(*targets[0])
-        futs = [self._pool.submit(run, n, s) for n, s in targets]
-        return [o for f in futs for o in f.result()]
+            parts = [run(*targets[0])]
+        else:
+            futs = [self._pool.submit(run, n, s) for n, s in targets]
+            parts = [f.result() for f in futs]
+        merged: dict = {"count": sum(p["count"] for p in parts),
+                        "cols": {p: [] for p in props}}
+        for part in parts:
+            for p in props:
+                merged["cols"][p].extend(part["cols"].get(p, []))
+        return merged
 
     def object_search(
         self,

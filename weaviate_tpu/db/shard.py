@@ -501,6 +501,20 @@ class Shard:
     def find_uuids(self, flt: Optional[LocalFilter]) -> list[str]:
         return [o.uuid for o in self.find_objects(flt, include_vector=False)]
 
+    def aggregate_columns(self, flt: Optional[LocalFilter],
+                          props: list[str]) -> dict:
+        """Row-aligned property columns for Aggregate pushdown: ships only
+        the referenced columns (count + raw values, None kept for row
+        alignment) instead of whole objects, bounding coordinator memory and
+        the wire to the columns the query names while keeping
+        median/mode/topOccurrences/groupBy exact (the reference pushes
+        per-shard aggregation down and merges)."""
+        objs = self.find_objects(flt, include_vector=False)
+        return {
+            "count": len(objs),
+            "cols": {p: [o.properties.get(p) for o in objs] for p in props},
+        }
+
     def reindex_missing_filterable(self) -> dict[str, int]:
         """Backfill filterable postings for docs indexed before their prop's
         indexFilterable flag was on (INDEX_MISSING_TEXT_FILTERABLE_AT_STARTUP;

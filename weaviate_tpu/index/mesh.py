@@ -309,7 +309,19 @@ class MeshVectorIndex(VectorIndex):
             and not self._restoring
             and self.live >= max(256, self.config.pq.centroids)
         ):
-            self._compress_locked()
+            try:
+                self._compress_locked()
+            except vi.ConfigValidationError as e:
+                # a pq config that only turns out invalid once dims are
+                # known (declared before the first import) must not turn
+                # every later add/search into an error: auto-disable with a
+                # warning and keep serving uncompressed
+                import logging
+
+                self.config.pq.enabled = False
+                logging.getLogger(__name__).warning(
+                    "declared pq config is invalid (%s); auto-disabling "
+                    "compression for this index", e)
 
     def _write_balanced(self, docs: np.ndarray, rows: np.ndarray) -> None:
         """Land [count, D] rows across slabs in whole-mesh insert steps."""
@@ -625,12 +637,34 @@ class MeshVectorIndex(VectorIndex):
         with self._lock:
             vi.validate_config_update(self.config, updated)
             was_enabled = self.config.pq.enabled
+            if updated.pq.enabled and not was_enabled:
+                # reject what is knowable NOW instead of deferring the
+                # failure into the compression trigger
+                if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT,
+                                       vi.DISTANCE_COSINE):
+                    raise vi.ConfigValidationError(
+                        f"pq on hnsw_tpu_mesh supports l2-squared/dot/"
+                        f"cosine, not {self.metric}")
+                if (self.dim is not None and updated.pq.segments > 0
+                        and self.dim % updated.pq.segments != 0):
+                    raise vi.ConfigValidationError(
+                        f"pq.segments ({updated.pq.segments}) must divide "
+                        f"vector dims ({self.dim})")
+            prev = self.config
             self.config = updated
             # pq.enabled flipped on triggers compression (compress.go)
             if updated.pq.enabled and not was_enabled and not self.compressed:
-                self._flush_pending()
-                if self.live > 0:
-                    self._compress_locked()
+                try:
+                    self._flush_pending()
+                    if self.live > 0:
+                        self._compress_locked()
+                except Exception:
+                    # a failed pq-enable must not stick — config or runtime
+                    # (an OOM'd kmeans fit): a committed-but-uncompressed
+                    # config would re-run the full fit from _flush_pending's
+                    # declarative trigger on every later add/search
+                    self.config = prev
+                    raise
 
     def flush(self) -> None:
         with self._lock:

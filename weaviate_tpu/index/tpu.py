@@ -564,7 +564,11 @@ class TpuVectorIndex(VectorIndex):
         # flips true on a Mosaic compile failure of the fused gmin kernel;
         # searches then stay on the lax.scan kernel permanently
         self._gmin_broken = False
-        self._gmin_validated = False  # first gmin search succeeded
+        # compiled-shape keys (b, k, rg, active_g, use_allow) that completed a
+        # materialized search — each key is its own Mosaic compilation, so one
+        # small-shape success must not vouch for a larger VMEM footprint
+        self._gmin_validated: set = set()
+        self._gmin_shape_broken: set = set()  # keys Mosaic rejected
         self._log = VectorLog(os.path.join(shard_path, "vector.log")) if persist else None
         if self._log is not None:
             self._restore()
@@ -746,7 +750,19 @@ class TpuVectorIndex(VectorIndex):
             and not self._restoring
             and self.n >= max(256, self.config.pq.centroids)
         ):
-            self._compress_locked()
+            try:
+                self._compress_locked()
+            except vi.ConfigValidationError as e:
+                # a pq config that only turns out invalid once dims are
+                # known (declared before the first import) must not turn
+                # every later add/search into an error: auto-disable with a
+                # warning and keep serving uncompressed
+                import logging
+
+                self.config.pq.enabled = False
+                logging.getLogger(__name__).warning(
+                    "declared pq config is invalid (%s); auto-disabling "
+                    "compression for this index", e)
 
     # -- product quantization (compress.go analog) ---------------------------
 
@@ -944,31 +960,55 @@ class TpuVectorIndex(VectorIndex):
         )
 
     def _gmin_packed_or_none(self, q: np.ndarray, kk: int, allow_words):
-        """Run the fused scan, or None to use the legacy kernel. Only a
-        failure BEFORE the first success disables the path (a Mosaic
-        compile/shape error on this platform); once validated, errors are
-        real and propagate instead of silently halving throughput."""
+        """Run the fused scan, or None to use the legacy kernel. Validation
+        is per compiled shape: each distinct (b, k, rg, active_g, use_allow)
+        is a separate Mosaic compilation with its own VMEM footprint
+        (active_g grows as the slab fills), so a failure on a NEW shape falls
+        back for that shape only, while a failure on a shape that already
+        completed a materialized search is a real runtime fault and
+        propagates instead of silently halving throughput."""
         if not self._use_gmin(q.shape[0], kk):
+            return None
+        from weaviate_tpu.ops import gmin_scan
+
+        ncols = self.capacity // gmin_scan.G
+        # capacity is part of the key: the compilation is parameterized by
+        # the [capacity, D] store, so growth invalidates prior validation
+        key = (q.shape[0], kk, self._gmin_rg(kk), -(-self.n // ncols),
+               self.capacity, allow_words is not None)
+        if key in self._gmin_shape_broken:
             return None
         try:
             packed = self._search_full_gmin(q, kk, allow_words)
-            if not self._gmin_validated:
+            if key not in self._gmin_validated:
                 # JAX defers device errors to materialization — the first
-                # call blocks here so a runtime fault (not just a compile
-                # error) still lands in this except and falls back; once
-                # validated, results stay unmaterialized for pipelining
+                # call per shape blocks here so a runtime fault (not just a
+                # compile error) still lands in this except and falls back;
+                # once a shape is validated, its results stay unmaterialized
+                # for pipelining
                 packed = np.asarray(packed)
         except Exception as e:  # noqa: BLE001 — see docstring
-            if self._gmin_validated:
+            if key in self._gmin_validated:
                 raise
-            self._gmin_broken = True
             import logging
 
-            logging.getLogger(__name__).warning(
-                "fused gmin kernel unavailable (%s: %s); using lax.scan "
-                "kernel for this index", type(e).__name__, e)
+            # remember this shape as over-budget and keep serving it on the
+            # legacy kernel; a failure must not be blamed on the whole path
+            # (after a restart the FIRST query may be the one oversized
+            # shape) — only repeated distinct-shape failures with zero
+            # successes mark the platform broken, capping compile retries
+            self._gmin_shape_broken.add(key)
+            if not self._gmin_validated and len(self._gmin_shape_broken) >= 3:
+                self._gmin_broken = True
+                logging.getLogger(__name__).warning(
+                    "fused gmin kernel unavailable (%s: %s); using lax.scan "
+                    "kernel for this index", type(e).__name__, e)
+            else:
+                logging.getLogger(__name__).warning(
+                    "fused gmin kernel rejected shape %s (%s: %s); using "
+                    "lax.scan kernel for this shape", key, type(e).__name__, e)
             return None
-        self._gmin_validated = True
+        self._gmin_validated.add(key)
         return packed
 
     def _rescore_r(self, k: int) -> int:
@@ -1273,13 +1313,30 @@ class TpuVectorIndex(VectorIndex):
         with self._lock:
             vi.validate_config_update(self.config, updated)
             was_enabled = self.config.pq.enabled
+            if updated.pq.enabled and not was_enabled and self.dim is not None \
+                    and updated.pq.segments > 0 \
+                    and self.dim % updated.pq.segments != 0:
+                # dims are known: reject synchronously instead of deferring
+                # the failure into the compression trigger
+                raise vi.ConfigValidationError(
+                    f"pq.segments ({updated.pq.segments}) must divide vector "
+                    f"dims ({self.dim})")
+            prev = self.config
             self.config = updated
             # pq.enabled flipped on by a config update triggers compression
             # (compress.go: "triggered by config update pq.enabled")
             if updated.pq.enabled and not was_enabled and not self.compressed:
-                self._flush_pending()
-                if self.n > 0:
-                    self._compress_locked()
+                try:
+                    self._flush_pending()
+                    if self.n > 0:
+                        self._compress_locked()
+                except Exception:
+                    # a failed pq-enable must not stick — config or runtime
+                    # (an OOM'd kmeans fit): a committed-but-uncompressed
+                    # config would re-run the full fit from _flush_pending's
+                    # declarative trigger on every later add/search
+                    self.config = prev
+                    raise
 
     def flush(self) -> None:
         with self._lock:

@@ -279,7 +279,8 @@ class SchemaManager:
                 except (KeyError, TypeError, AttributeError) as e:
                     raise SchemaValidationError(
                         f"malformed properties payload: {e}") from e
-                if new_props != cur_props:
+                by_name = lambda props: sorted(props, key=lambda p: p.get("name", ""))  # noqa: E731
+                if by_name(new_props) != by_name(cur_props):
                     # silent-ignore would ack a change that never happened;
                     # reject like the reference's update validation (new
                     # props go through POST .../properties; index-flag

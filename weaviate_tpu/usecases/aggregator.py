@@ -75,29 +75,34 @@ class Aggregator:
         ):
             return [{"meta": {"count": idx.aggregate_count(params.filters)}}]
 
-        objs = self._doc_set(idx, params)
+        count, cols = self._columns(idx, params)
 
         if params.group_by:
             prop = params.group_by[0]
-            groups: dict[Any, list] = {}
-            for o in objs:
-                v = o.properties.get(prop)
+            # group by ROW INDEX so every aggregated column stays aligned
+            # with its group without re-shipping objects
+            groups: dict[Any, list[int]] = {}
+            for i, v in enumerate(cols.get(prop, [])):
                 for key in v if isinstance(v, list) else [v]:
-                    groups.setdefault(key, []).append(o)
+                    groups.setdefault(key, []).append(i)
             out = []
             items = sorted(groups.items(), key=lambda kv: -len(kv[1]))
             if params.limit is not None:
                 items = items[: params.limit]
-            for key, rows in items:
-                g = self._aggregate_rows(cd, rows, params)
+            for key, idxs in items:
+                sub = {p: [cols[p][i] for i in idxs] for p in params.properties}
+                g = self._aggregate_cols(cd, sub, len(idxs), params)
                 g["groupedBy"] = {"path": [prop], "value": key}
                 out.append(g)
             return out
-        return [self._aggregate_rows(cd, objs, params)]
+        return [self._aggregate_cols(cd, cols, count, params)]
 
-    # -- doc-set selection (filtered / near-restricted / full) ---------------
+    # -- column selection (filtered / near-restricted / full) ----------------
 
-    def _doc_set(self, idx, params: AggregateParams) -> list:
+    def _columns(self, idx, params: AggregateParams) -> tuple[int, dict]:
+        """-> (matching-row count, {prop: row-aligned raw values}) for every
+        property the query references. Shards ship columns, not objects."""
+        need = sorted(set(params.properties) | set(params.group_by or []))
         if (
             params.near_vector is not None
             or params.near_object is not None
@@ -119,25 +124,28 @@ class Aggregator:
                     limit=params.object_limit,
                 )
             )
-            return [r.obj for r in res]
+            return len(res), {
+                p: [r.obj.properties.get(p) for r in res] for p in need
+            }
         # scatter-gather over ALL physical shards (remote included) so a
         # distributed class aggregates its full data set (index.go +
         # clusterapi :aggregations)
-        return idx.aggregate_objects(params.filters)
+        data = idx.aggregate_columns(params.filters, need)
+        return data["count"], data["cols"]
 
     # -- per-group aggregation ----------------------------------------------
 
-    def _aggregate_rows(self, cd, rows: list, params: AggregateParams) -> dict:
+    def _aggregate_cols(self, cd, cols: dict, count: int,
+                        params: AggregateParams) -> dict:
         out: dict[str, Any] = {}
         if params.include_meta_count:
-            out["meta"] = {"count": len(rows)}
+            out["meta"] = {"count": count}
         for prop_name, aggs in params.properties.items():
             prop = cd.get_property(prop_name)
             if prop is None:
                 raise AggregatorError(f"unknown property {prop_name!r}")
             pt = prop.primitive_type()
-            col = [o.properties.get(prop_name) for o in rows]
-            col = [v for v in col if v is not None]
+            col = [v for v in cols.get(prop_name, []) if v is not None]
             # flatten array props
             if col and isinstance(col[0], list):
                 col = [x for v in col for x in v]
