@@ -6,3 +6,5 @@ OUT_DIR="../weaviate_tpu/_native"
 mkdir -p "$OUT_DIR"
 g++ -O3 -march=native -std=c++17 -fopenmp -shared -fPIC -o "$OUT_DIR/libhnsw.so" hnsw.cpp
 echo "built $OUT_DIR/libhnsw.so"
+g++ -O3 -march=native -std=c++17 -shared -fPIC -o "$OUT_DIR/libreply.so" reply.cpp
+echo "built $OUT_DIR/libreply.so"

@@ -1,0 +1,190 @@
+// Native gRPC reply marshaller: storobj storage images -> serialized
+// SearchReply protobuf wire bytes, no Python per-result work.
+//
+// The serving hot path returns k winners for each of hundreds of queries per
+// batch; building upb message objects per result costs ~25us of Python each.
+// This builder parses each stored object image (the codec in
+// entities/storobj.py) and emits the SearchReply wire format directly
+// (reference analog: adapters/handlers/grpc/server.go searchResultsToProto,
+// which marshals in compiled Go for the same reason).
+//
+// Wire schema (grpcapi/weaviate.proto):
+//   SearchResult: id=1 string, properties_json=2 string,
+//                 distance=3 double (optional), certainty=4 double (optional),
+//                 creation_time_unix=7 int64, last_update_time_unix=8 int64
+//   SearchReply:  results=1 repeated message, took_seconds=2 float
+//
+// Storobj image (entities/storobj.py):
+//   u8 version | u64 doc_id | i64 created | i64 updated | 16B uuid |
+//   u16 cls_len + cls | u32 dim + dim*f32 | u32 plen + props_json |
+//   u32 mlen + meta_json
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline int varint_size(uint64_t v) {
+    int n = 1;
+    while (v >= 0x80) { v >>= 7; n++; }
+    return n;
+}
+
+inline uint8_t* put_varint(uint8_t* p, uint64_t v) {
+    while (v >= 0x80) { *p++ = uint8_t(v) | 0x80; v >>= 7; }
+    *p++ = uint8_t(v);
+    return p;
+}
+
+inline uint8_t* put_double_field(uint8_t* p, uint8_t tag, double v) {
+    *p++ = tag;
+    std::memcpy(p, &v, 8);
+    return p + 8;
+}
+
+const char kHex[] = "0123456789abcdef";
+
+// 16 uuid bytes -> 8-4-4-4-12 lowercase hex (36 chars)
+inline void format_uuid(const uint8_t* u, char* out) {
+    static const int dash_after[] = {4, 6, 8, 10};
+    int di = 0, o = 0;
+    for (int i = 0; i < 16; i++) {
+        if (di < 4 && i == dash_after[di]) { out[o++] = '-'; di++; }
+        out[o++] = kHex[u[i] >> 4];
+        out[o++] = kHex[u[i] & 0xf];
+    }
+}
+
+struct ObjView {
+    const uint8_t* uuid;
+    int64_t created, updated;
+    const uint8_t* props;
+    uint64_t plen;
+};
+
+// -> 0 ok, -1 malformed/truncated
+int parse_storobj(const uint8_t* d, int64_t len, ObjView* out) {
+    // fixed prefix: 1 + 8 + 8 + 8 + 16 = 41 bytes
+    if (len < 41 + 2 || d[0] != 1) return -1;
+    std::memcpy(&out->created, d + 9, 8);
+    std::memcpy(&out->updated, d + 17, 8);
+    out->uuid = d + 25;
+    uint64_t off = 41;
+    uint16_t cls_len;
+    std::memcpy(&cls_len, d + off, 2);
+    off += 2 + cls_len;
+    if (off + 4 > uint64_t(len)) return -1;
+    uint32_t dim;
+    std::memcpy(&dim, d + off, 4);
+    off += 4 + uint64_t(dim) * 4;
+    if (off + 4 > uint64_t(len)) return -1;
+    uint32_t plen;
+    std::memcpy(&plen, d + off, 4);
+    off += 4;
+    if (off + plen > uint64_t(len)) return -1;
+    out->props = plen ? d + off : reinterpret_cast<const uint8_t*>("{}");
+    out->plen = plen ? plen : 2;
+    return 0;
+}
+
+uint64_t result_body_size(const ObjView& o, double dist, double cert) {
+    uint64_t n = 2 + 36;                                   // id
+    n += 1 + varint_size(o.plen) + o.plen;                 // properties_json
+    if (!std::isnan(dist)) n += 9;                         // distance
+    if (!std::isnan(cert)) n += 9;                         // certainty
+    if (o.created) n += 1 + varint_size(uint64_t(o.created));
+    if (o.updated) n += 1 + varint_size(uint64_t(o.updated));
+    return n;
+}
+
+uint8_t* write_result_body(uint8_t* p, const ObjView& o, double dist, double cert) {
+    *p++ = 0x0A; *p++ = 36;                                // id = 1, len 36
+    format_uuid(o.uuid, reinterpret_cast<char*>(p));
+    p += 36;
+    *p++ = 0x12;                                           // properties_json = 2
+    p = put_varint(p, o.plen);
+    std::memcpy(p, o.props, o.plen);
+    p += o.plen;
+    if (!std::isnan(dist)) p = put_double_field(p, 0x19, dist);   // distance = 3
+    if (!std::isnan(cert)) p = put_double_field(p, 0x21, cert);   // certainty = 4
+    if (o.created) { *p++ = 0x38; p = put_varint(p, uint64_t(o.created)); }
+    if (o.updated) { *p++ = 0x40; p = put_varint(p, uint64_t(o.updated)); }
+    return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Serialize one SearchReply from n stored-object images.
+// raws[i]/raw_lens[i]: storobj image; dists/certs: NaN => field omitted.
+// Returns bytes written into out, -1 if cap is too small, -2 on a malformed
+// image (caller falls back to the Python marshaller).
+int64_t build_search_reply(const uint8_t* const* raws, const int64_t* raw_lens,
+                           const double* dists, const double* certs,
+                           int64_t n, float took_seconds,
+                           uint8_t* out, int64_t cap) {
+    uint8_t* p = out;
+    uint8_t* end = out + cap;
+    for (int64_t i = 0; i < n; i++) {
+        ObjView o;
+        if (parse_storobj(raws[i], raw_lens[i], &o) != 0) return -2;
+        uint64_t body = result_body_size(o, dists[i], certs[i]);
+        uint64_t need = 1 + varint_size(body) + body;
+        if (p + need > end) return -1;
+        *p++ = 0x0A;                                       // results = 1
+        p = put_varint(p, body);
+        p = write_result_body(p, o, dists[i], certs[i]);
+    }
+    if (took_seconds != 0.0f) {
+        if (p + 5 > end) return -1;
+        *p++ = 0x15;                                       // took_seconds = 2
+        std::memcpy(p, &took_seconds, 4);
+        p += 4;
+    }
+    return p - out;
+}
+
+// Serialize a whole BatchSearchReply (repeated SearchReply = field 1) from
+// flat per-result arrays split into n_replies runs of counts[i] results.
+// One call replaces hundreds of per-slot marshaller invocations.
+int64_t build_batch_reply(const uint8_t* const* raws, const int64_t* raw_lens,
+                          const double* dists, const double* certs,
+                          const int64_t* counts, int64_t n_replies,
+                          float took_seconds, uint8_t* out, int64_t cap) {
+    uint8_t* p = out;
+    uint8_t* end = out + cap;
+    int64_t base = 0;
+    for (int64_t ri = 0; ri < n_replies; ri++) {
+        // pass 1: this reply's body size
+        uint64_t body = (took_seconds != 0.0f) ? 5 : 0;
+        for (int64_t i = base; i < base + counts[ri]; i++) {
+            ObjView o;
+            if (parse_storobj(raws[i], raw_lens[i], &o) != 0) return -2;
+            uint64_t rb = result_body_size(o, dists[i], certs[i]);
+            body += 1 + varint_size(rb) + rb;
+        }
+        if (p + 1 + varint_size(body) + body > end) return -1;
+        *p++ = 0x0A;                                   // replies = 1
+        p = put_varint(p, body);
+        // pass 2: emit
+        for (int64_t i = base; i < base + counts[ri]; i++) {
+            ObjView o;
+            parse_storobj(raws[i], raw_lens[i], &o);
+            uint64_t rb = result_body_size(o, dists[i], certs[i]);
+            *p++ = 0x0A;                               // results = 1
+            p = put_varint(p, rb);
+            p = write_result_body(p, o, dists[i], certs[i]);
+        }
+        if (took_seconds != 0.0f) {
+            *p++ = 0x15;                               // took_seconds = 2
+            std::memcpy(p, &took_seconds, 4);
+            p += 4;
+        }
+        base += counts[ri];
+    }
+    return p - out;
+}
+
+}  // extern "C"

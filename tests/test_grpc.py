@@ -110,6 +110,96 @@ def test_batch_search_one_dispatch(setup):
         assert one.results[0].id == str(uuidlib.UUID(int=i + 1))
 
 
+def test_native_reply_marshaller_equivalence(setup):
+    """The native wire builder (native/reply.cpp) must produce bytes that
+    parse to EXACTLY what the upb marshaller produces, across unicode
+    props, empty props, missing distance, and nested JSON."""
+    from weaviate_tpu.db.shard import SearchResult
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.server import reply_native
+    from weaviate_tpu.server.grpc_server import fast_reply_bytes, result_to_proto
+
+    assert reply_native.available(), "native reply marshaller must build"
+    cases = [
+        {"body": "héllo wörld é中文", "rank": 1, "tags": ["a", "b"]},
+        {},
+        {"nested": {"x": [1.5, None, True], "y": "z"}},
+    ]
+    results = []
+    for i, props in enumerate(cases):
+        raw = StorObj(class_name="Doc", uuid=str(uuidlib.UUID(int=900 + i)),
+                      properties=props, vector=np.arange(4, dtype=np.float32),
+                      doc_id=900 + i).to_binary()
+        obj = StorObj.from_binary(raw, include_vector=False)
+        results.append(SearchResult(
+            obj=obj, distance=0.25 * i if i != 1 else None, shard="s"))
+    req = pb.SearchRequest(class_name="Doc", limit=3)
+    fast = fast_reply_bytes(results, req, took=0.125)
+    assert fast is not None, "fast path must engage for pristine objects"
+    got = pb.SearchReply.FromString(fast)
+    want = pb.SearchReply(took_seconds=0.125)
+    want.results.extend(result_to_proto(r, req) for r in results)
+    assert got == want
+
+    # whole-batch builder: two replies (2 + 1 results) parse identically
+    raws = [r.obj.raw_if_pristine() for r in results]
+    batch = reply_native.build_batch_reply(
+        raws, [r.distance for r in results], [None] * 3, [2, 1], 0.125)
+    got_b = pb.BatchSearchReply.FromString(batch)
+    want_b = pb.BatchSearchReply()
+    for rows in (results[:2], results[2:]):
+        one = pb.SearchReply(took_seconds=0.125)
+        one.results.extend(result_to_proto(r, req) for r in rows)
+        want_b.replies.append(one)
+    assert got_b == want_b
+
+    # property filtering / vectors / mutated objects refuse the fast path
+    assert fast_reply_bytes(
+        results, pb.SearchRequest(properties=["rank"]), 0.0) is None
+    assert fast_reply_bytes(
+        results, pb.SearchRequest(additional_properties=["vector"]), 0.0) is None
+    results[0].obj.properties["body"] = "mutated"
+    assert fast_reply_bytes(results, req, 0.0) is None
+
+
+def test_batch_search_uses_native_path(setup):
+    """BatchSearch over the real wire must serve nearVector batches through
+    the native marshaller (not silently fall back)."""
+    from weaviate_tpu.server import grpc_server as gs
+
+    _, _, client, vecs = setup
+    calls = []
+    orig_one = gs.reply_native.build_search_reply
+    orig_batch = gs.reply_native.build_batch_reply
+
+    def spy_one(*a, **k):
+        out = orig_one(*a, **k)
+        calls.append(out is not None)
+        return out
+
+    def spy_batch(*a, **k):
+        out = orig_batch(*a, **k)
+        calls.append(out is not None)
+        return out
+
+    gs.reply_native.build_search_reply = spy_one
+    gs.reply_native.build_batch_reply = spy_batch
+    try:
+        breq = pb.BatchSearchRequest(requests=[
+            pb.SearchRequest(class_name="Doc", limit=2,
+                             near_vector=pb.NearVectorParams(vector=vecs[i].tolist()))
+            for i in range(4)
+        ])
+        reply = client.batch_search(breq)
+    finally:
+        gs.reply_native.build_search_reply = orig_one
+        gs.reply_native.build_batch_reply = orig_batch
+    assert len(reply.replies) == 4 and calls and all(calls)
+    for i, one in enumerate(reply.replies):
+        assert one.results[0].id == str(uuidlib.UUID(int=i + 1))
+        assert json.loads(one.results[0].properties_json)["rank"] == i
+
+
 def test_batch_search_per_slot_errors(setup):
     _, _, client, vecs = setup
     breq = pb.BatchSearchRequest(requests=[

@@ -25,6 +25,22 @@ from weaviate_tpu.entities.schema import ClassDef
 from weaviate_tpu.entities.storobj import StorObj
 
 
+def _merge_shard_results(
+    all_results: list, b: int, k: int
+) -> list[list[SearchResult]]:
+    """Per-query merge of shard result lists: concatenate, sort by distance
+    (None last), truncate to k — shared by the sync and async search paths
+    so their merge semantics cannot diverge."""
+    merged: list[list[SearchResult]] = []
+    for qi in range(b):
+        rows: list[SearchResult] = []
+        for shard_res in all_results:
+            rows.extend(shard_res[qi])
+        rows.sort(key=lambda r: (r.distance if r.distance is not None else np.inf))
+        merged.append(rows[:k])
+    return merged
+
+
 class ClassIndex:
     def __init__(
         self,
@@ -260,15 +276,41 @@ class ClassIndex:
         else:
             futs = [self._pool.submit(run, n, s) for n, s in targets]
             all_results = [f.result() for f in futs]
+        return _merge_shard_results(all_results, b, k)
 
-        merged: list[list[SearchResult]] = []
-        for qi in range(b):
-            rows: list[SearchResult] = []
-            for shard_res in all_results:
-                rows.extend(shard_res[qi])
-            rows.sort(key=lambda r: (r.distance if r.distance is not None else np.inf))
-            merged.append(rows[:k])
-        return merged
+    def object_vector_search_async(
+        self, vectors: np.ndarray, k: int, include_vector: bool = False
+    ):
+        """Deferred-hydration twin of object_vector_search for the
+        unfiltered batched path: a single local shard enqueues its device
+        dispatch now so concurrent requests overlap device compute with
+        hydration; multi-shard / remote / no-async-index layouts run the
+        shard searches concurrently on the pool (the sync path's
+        parallelism — an inline per-shard fallback would serialize them)."""
+        q = np.asarray(vectors, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        b = q.shape[0]
+        targets = self._all_shard_targets()
+        fins = []
+        for name, shard in targets:
+            if shard is None:
+                fut = self._pool.submit(
+                    self.remote.search_shard, self.class_name, name, q, k,
+                    None, None, include_vector)
+                fins.append(fut.result)
+            elif len(targets) == 1 and hasattr(
+                    shard.vector_index, "search_by_vectors_async"):
+                fins.append(shard.object_vector_search_async(q, k, include_vector))
+            else:
+                fut = self._pool.submit(
+                    shard.object_vector_search, q, k, None, None, include_vector)
+                fins.append(fut.result)
+
+        def done() -> list[list[SearchResult]]:
+            return _merge_shard_results([f() for f in fins], b, k)
+
+        return done
 
     def is_consistent(self, uuid: str, update_time: int) -> bool:
         """_additional.isConsistent: replicated shards digest-compare every

@@ -47,7 +47,7 @@ class ShardReadOnlyError(RuntimeError):
     pass
 
 
-@dataclass
+@dataclass(slots=True)
 class SearchResult:
     """One search hit: the object + additional result props
     (the reference's search.Result / _additional map)."""
@@ -389,9 +389,7 @@ class Shard:
             return out
         ids, dists = self.vector_index.search_by_vectors(q, k, allow)
         t2 = time.perf_counter()
-        hydrated = [
-            self._hydrate(ids[i], dists[i], include_vector) for i in range(ids.shape[0])
-        ]
+        hydrated = self._hydrate_batch(ids, dists, include_vector)
         if m is not None:
             m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
             m.filtered_vector_objects.labels(cls, self.name).observe(
@@ -401,17 +399,83 @@ class Shard:
                 int(q.shape[0] * q.shape[1]))
         return hydrated
 
+    def object_vector_search_async(
+        self, vectors: np.ndarray, k: int, include_vector: bool = False
+    ):
+        """Unfiltered batched kNN with deferred hydration: the device
+        dispatch is enqueued immediately and `finalize() -> hydrated
+        results` materializes later, so concurrent requests overlap device
+        compute with another request's hydration instead of serializing
+        both under the index lock (the depth-2 pipeline the index bench
+        uses, extended to the serving stack)."""
+        q = np.asarray(vectors, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        dispatch = getattr(self.vector_index, "search_by_vectors_async", None)
+        if dispatch is None:
+            res = self.object_vector_search(q, k, None, None, include_vector)
+            return lambda: res
+        m = self.metrics
+        cls = self.class_def.name
+        finalize = dispatch(q, k)
+
+        def done() -> list[list[SearchResult]]:
+            # observe only the time BLOCKED on the device result — wall time
+            # since dispatch includes deliberate deferral (the two-phase
+            # traverser enqueues every group before finalizing any) and
+            # would pollute the same histogram the sync path feeds
+            t0 = time.perf_counter()
+            ids, dists = finalize()
+            t1 = time.perf_counter()
+            hydrated = self._hydrate_batch(ids, dists, include_vector)
+            if m is not None:
+                m.filtered_vector_search.labels(cls, self.name).observe(
+                    (t1 - t0) * 1000.0)
+                m.filtered_vector_objects.labels(cls, self.name).observe(
+                    (time.perf_counter() - t1) * 1000.0)
+                m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
+                m.query_dimensions.labels("nearVector", "search", cls).inc(
+                    int(q.shape[0] * q.shape[1]))
+            return hydrated
+
+        return done
+
     def _hydrate(self, ids, dists, include_vector: bool) -> list[SearchResult]:
-        valid = ~np.isinf(np.asarray(dists, dtype=np.float32))
-        ids = np.asarray(ids)[valid]
-        dists = np.asarray(dists)[valid]
-        objs = self.objects_by_doc_ids([int(i) for i in ids], include_vector)
-        out = []
-        for obj, dist in zip(objs, dists):
-            if obj is None:
-                continue  # deleted between search and hydration
-            out.append(SearchResult(obj=obj, distance=float(dist), shard=self.name))
-        return out
+        return self._hydrate_batch(
+            np.asarray(ids)[None, :], np.asarray(dists)[None, :], include_vector)[0]
+
+    def _hydrate_batch(
+        self, ids, dists, include_vector: bool
+    ) -> list[list[SearchResult]]:
+        """All queries' winners in one pass: one valid-mask over [B, k], one
+        LSM multi-get per store (docid -> uuid key -> image, single lock
+        acquisition each), lazy StorObj wrappers. The per-result Python work
+        is one object alloc + one SearchResult."""
+        dists = np.asarray(dists, dtype=np.float32)
+        ids = np.asarray(ids)
+        valid = ~np.isinf(dists)
+        counts = valid.sum(axis=1)
+        flat_ids = ids[valid]
+        flat_d = dists[valid].tolist()
+        keys = [struct.pack("<Q", int(d)) for d in flat_ids]
+        ukeys = self.docid_lookup.multi_get(keys)
+        raws = self.objects.multi_get(ukeys)
+        name = self.name
+        from_binary = StorObj.from_binary
+        out_all: list[list[SearchResult]] = []
+        pos = 0
+        for c in counts.tolist():
+            row: list[SearchResult] = []
+            for j in range(pos, pos + c):
+                raw = raws[j]
+                if raw is None:
+                    continue  # deleted between search and hydration
+                row.append(SearchResult(
+                    obj=from_binary(raw, include_vector),
+                    distance=flat_d[j], shard=name))
+            pos += c
+            out_all.append(row)
+        return out_all
 
     def object_search(
         self,

@@ -20,9 +20,39 @@ import grpc
 
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+from weaviate_tpu.server import reply_native
 from weaviate_tpu.usecases.traverser import GetParams
 
 _SERVICE = "weaviatetpu.v1.Weaviate"
+
+
+def _collect_fast(results, req: pb.SearchRequest):
+    """(raws, dists, certs) for the native marshaller — ONLY when every
+    result can be emitted verbatim from its storage image (no property
+    filtering, no vectors, no scores, objects pristine); None otherwise.
+    The single source of fast-path eligibility for both the per-reply and
+    whole-batch builders."""
+    if req.properties or "vector" in req.additional_properties:
+        return None
+    raws, dists, certs = [], [], []
+    for r in results:
+        raw = r.obj.raw_if_pristine()
+        if raw is None or r.score is not None or r.explain_score:
+            return None
+        raws.append(raw)
+        dists.append(r.distance)
+        certs.append(r.certainty)
+    return raws, dists, certs
+
+
+def fast_reply_bytes(results, req: pb.SearchRequest,
+                     took: float) -> Optional[bytes]:
+    """Serialized SearchReply via the native marshaller, or None => use the
+    upb path (result_to_proto), which is always correct."""
+    triple = _collect_fast(results, req)
+    if triple is None:
+        return None
+    return reply_native.build_search_reply(*triple, took)
 
 
 def params_from_proto(req: pb.SearchRequest) -> GetParams:
@@ -77,12 +107,21 @@ def params_from_proto(req: pb.SearchRequest) -> GetParams:
 def result_to_proto(r, req: pb.SearchRequest) -> pb.SearchResult:
     """searchResultsToProto twin (server.go:85)."""
     obj = r.obj
-    props = obj.properties or {}
     if req.properties:
-        props = {k: v for k, v in props.items() if k in req.properties}
+        props = obj.properties or {}
+        props_json = json.dumps(
+            {k: v for k, v in props.items() if k in req.properties},
+            default=str)
+    else:
+        # unfiltered replies reuse the stored JSON verbatim — the hot path
+        # never parses or re-serializes properties (props_json_bytes is None
+        # once the dict was materialized/mutated)
+        raw = obj.props_json_bytes()
+        props_json = (raw.decode("utf-8") if raw is not None
+                      else json.dumps(obj.properties or {}, default=str))
     out = pb.SearchResult(
         id=obj.uuid,
-        properties_json=json.dumps(props, default=str),
+        properties_json=props_json,
         creation_time_unix=obj.creation_time_unix,
         last_update_time_unix=obj.last_update_time_unix,
     )
@@ -119,7 +158,11 @@ class SearchServicer:
         except Exception as e:
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
             return
-        reply = pb.SearchReply(took_seconds=time.perf_counter() - start)
+        took = time.perf_counter() - start
+        fast = fast_reply_bytes(results, request, took)
+        if fast is not None:
+            return fast  # pre-serialized; the passthrough serializer ships it
+        reply = pb.SearchReply(took_seconds=took)
         reply.results.extend(result_to_proto(r, request) for r in results)
         return reply
 
@@ -137,21 +180,65 @@ class SearchServicer:
                 parse_errs[i] = str(e)
         valid = [(i, p) for i, p in enumerate(slot_params) if i not in parse_errs]
         results = self.app.traverser.get_class_batched([p for _, p in valid]) if valid else []
-        reply = pb.BatchSearchReply()
         took = time.perf_counter() - start
         slot_out: dict[int, object] = {i: res for (i, _), res in zip(valid, results)}
+        if not parse_errs and len(valid) == len(request.requests):
+            whole = self._whole_batch_fast(request, slot_out, took)
+            if whole is not None:
+                return whole
+        # assemble the outer BatchSearchReply as wire bytes so fast-path
+        # slots (native-marshalled, see fast_reply_bytes) splice in without
+        # ever becoming Python message objects; slow slots serialize via upb
+        # and splice the same way — concatenated length-delimited field 1
+        # entries ARE the repeated `replies` encoding
+        chunks: list[bytes] = []
         for i, req in enumerate(request.requests):
-            one = pb.SearchReply(took_seconds=took)
-            if i in parse_errs:
-                one.error_message = parse_errs[i]
-            else:
+            body: Optional[bytes] = None
+            if i not in parse_errs:
                 slot = slot_out.get(i)
-                if isinstance(slot, Exception):
-                    one.error_message = str(slot)
-                elif slot is not None:
-                    one.results.extend(result_to_proto(r, req) for r in slot)
-            reply.replies.append(one)
-        return reply
+                if slot is not None and not isinstance(slot, Exception):
+                    body = fast_reply_bytes(slot, req, took)
+            if body is None:
+                one = pb.SearchReply(took_seconds=took)
+                if i in parse_errs:
+                    one.error_message = parse_errs[i]
+                else:
+                    slot = slot_out.get(i)
+                    if isinstance(slot, Exception):
+                        one.error_message = str(slot)
+                    elif slot is not None:
+                        one.results.extend(result_to_proto(r, req) for r in slot)
+                body = one.SerializeToString()
+            chunks.append(b"\x0a" + reply_native.varint(len(body)) + body)
+        return b"".join(chunks)
+
+    def _whole_batch_fast(self, request, slot_out, took) -> Optional[bytes]:
+        """One native call serializes the ENTIRE BatchSearchReply when every
+        slot is fast-eligible; None falls back to per-slot assembly."""
+        raws: list[bytes] = []
+        dists: list = []
+        certs: list = []
+        counts: list[int] = []
+        for i, req in enumerate(request.requests):
+            slot = slot_out.get(i)
+            if slot is None or isinstance(slot, Exception):
+                return None
+            triple = _collect_fast(slot, req)
+            if triple is None:
+                return None
+            raws.extend(triple[0])
+            dists.extend(triple[1])
+            certs.extend(triple[2])
+            counts.append(len(triple[0]))
+        return reply_native.build_batch_reply(raws, dists, certs, counts, took)
+
+
+def _serialize_passthrough(msg):
+    """Responses are either upb messages or pre-serialized wire bytes from
+    the native marshaller — both ship as-is."""
+    if isinstance(msg, (bytes, bytearray)):
+        return bytes(msg)
+    return msg.SerializeToString()
 
 
 def _handlers(servicer) -> grpc.GenericRpcHandler:
@@ -159,12 +246,12 @@ def _handlers(servicer) -> grpc.GenericRpcHandler:
         "Search": grpc.unary_unary_rpc_method_handler(
             servicer.Search,
             request_deserializer=pb.SearchRequest.FromString,
-            response_serializer=pb.SearchReply.SerializeToString,
+            response_serializer=_serialize_passthrough,
         ),
         "BatchSearch": grpc.unary_unary_rpc_method_handler(
             servicer.BatchSearch,
             request_deserializer=pb.BatchSearchRequest.FromString,
-            response_serializer=pb.BatchSearchReply.SerializeToString,
+            response_serializer=_serialize_passthrough,
         ),
     })
 

@@ -581,6 +581,29 @@ class Bucket:
                     return None if v == _TOMBSTONE else v
             return None
 
+    def multi_get(self, keys) -> list[Optional[bytes]]:
+        """Batched replace-strategy point gets under ONE lock acquisition —
+        the serving path hydrates thousands of winners per batch and per-get
+        locking would dominate. A None key yields None (missing upstream
+        lookup), keeping caller indexing aligned."""
+        assert self.strategy == STRATEGY_REPLACE
+        out: list[Optional[bytes]] = []
+        with self._lock:
+            mem_get = self._mem.get
+            segs = self._segments
+            for key in keys:
+                if key is None:
+                    out.append(None)
+                    continue
+                v = mem_get(key)
+                if v is None:
+                    for seg in reversed(segs):
+                        v = seg.get_raw(key)
+                        if v is not None:
+                            break
+                out.append(None if v is None or v == _TOMBSTONE else v)
+        return out
+
     def set_get(self, key: bytes) -> set[bytes]:
         assert self.strategy == STRATEGY_SET
         with self._lock:

@@ -210,17 +210,37 @@ class Explorer:
                     out[i] = self._get_one(p)
             except Exception as e:
                 out[i] = e
+        # two-phase: enqueue every group's device dispatch first, THEN
+        # finalize — groups (and concurrent requests) overlap device compute
+        # with hydration instead of serializing
+        pending: list[tuple] = []
         for (class_name, limit, offset, inc_vec), idxs in batchable.items():
             try:
                 idx = self._index(class_name)
                 vecs = np.stack(
                     [np.asarray(params_list[i].near_vector["vector"], np.float32) for i in idxs]
                 )
-                res = idx.object_vector_search(vecs, limit + offset, include_vector=inc_vec)
+                if hasattr(idx, "object_vector_search_async"):
+                    done = idx.object_vector_search_async(
+                        vecs, limit + offset, include_vector=inc_vec)
+                else:
+                    res = idx.object_vector_search(
+                        vecs, limit + offset, include_vector=inc_vec)
+                    done = (lambda res=res: res)
+                pending.append((idxs, offset, done))
+            except Exception:
+                # ragged shapes or a bad class: isolate per query
+                for i in idxs:
+                    try:
+                        out[i] = self._get_one(params_list[i])
+                    except Exception as e2:
+                        out[i] = e2
+        for idxs, offset, done in pending:
+            try:
+                res = done()
                 for j, i in enumerate(idxs):
                     out[i] = self._postprocess(params_list[i], res[j][offset:])
-            except Exception as e:
-                # ragged shapes or a bad class: isolate per query
+            except Exception:
                 for i in idxs:
                     try:
                         out[i] = self._get_one(params_list[i])
