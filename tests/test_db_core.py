@@ -92,6 +92,51 @@ def test_batch_and_vector_search(db):
         assert res[qi][0].distance < 1e-3
 
 
+def test_uuid_bytes_fast_path():
+    from weaviate_tpu.db.shard import _uuid_bytes
+
+    u = str(uuidlib.UUID(int=0xDEADBEEF))
+    assert _uuid_bytes(u) == uuidlib.UUID(u).bytes
+    assert _uuid_bytes(u.upper()) == uuidlib.UUID(u).bytes
+    assert _uuid_bytes("urn:uuid:" + u) == uuidlib.UUID(u).bytes
+    for bad in ["0" * 36, "not-a-uuid-at-all-not-a-uuid-at-all!", "x" * 36]:
+        with pytest.raises(ValueError):
+            _uuid_bytes(bad)
+
+
+def test_batch_duplicate_uuid_within_batch(db):
+    """A batch carrying the same uuid twice keeps only the LAST version —
+    object store, inverted postings, and vector index must all agree
+    (the staged batch path must treat the earlier version as 'previous')."""
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    idx = db.add_class(make_class(), cfg)
+    a = new_obj(1)
+    b = new_obj(1)  # same uuid
+    b.properties = dict(b.properties)
+    b.properties["title"] = "second version only"
+    b.vector = a.vector + 1.0
+    filler = [new_obj(i) for i in range(2, 30)]
+    errs = idx.put_batch([a] + filler[:10] + [b] + filler[10:])
+    assert all(e is None for e in errs)
+    assert idx.object_count() == 29  # 28 fillers + 1 (deduped)
+    got = idx.object_by_uuid(a.uuid)
+    assert got.properties["title"] == "second version only"
+    # inverted postings: only the second version's tokens match
+    from weaviate_tpu.entities.filters import LocalFilter as LF
+
+    hits = idx.object_search(10, flt=LF.from_dict(
+        {"operator": "Equal", "path": ["title"], "valueText": "second"}))
+    assert [h.obj.uuid for h in hits] == [a.uuid]
+    hits = idx.object_search(
+        10, keyword_ranking={"query": "second version"})
+    assert hits and hits[0].obj.uuid == a.uuid
+    # vector index holds the second vector, not the first
+    res = idx.object_vector_search(b.vector, k=1)
+    assert res[0][0].obj.uuid == a.uuid and res[0][0].distance < 1e-3
+    res = idx.object_vector_search(a.vector, k=1)
+    assert res[0][0].distance > 1.0
+
+
 def test_filtered_vector_search(db):
     cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
     idx = db.add_class(make_class(), cfg)

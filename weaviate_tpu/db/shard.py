@@ -62,6 +62,16 @@ class SearchResult:
 
 
 def _uuid_bytes(u: str) -> bytes:
+    # canonical-form fast path (~4x over uuid.UUID); anything else — braces,
+    # urn: prefix — takes the full parser. The 32-hex-after-dash-strip check
+    # keeps malformed ids raising instead of silently hashing to a bogus key
+    if len(u) == 36:
+        h = u.replace("-", "")
+        if len(h) == 32:
+            try:
+                return bytes.fromhex(h)
+            except ValueError:
+                pass
     return uuidlib.UUID(u).bytes
 
 
@@ -212,28 +222,42 @@ class Shard:
             fresh_vecs: list[np.ndarray] = []
             staged_pos: dict[int, int] = {}  # doc_id -> index into fresh_*
             dim: Optional[int] = None
+            # staged LSM/inverted writes: each bucket takes the whole batch
+            # in ONE call (single lock + WAL write; postings grouped per
+            # term) instead of per-object puts
+            obj_puts: dict[bytes, bytes] = {}
+            doc_puts: list[tuple[bytes, bytes]] = []
+            inv_items: list[tuple[int, dict, int]] = []  # doc, props, obj idx
             for i, obj in enumerate(objs):
                 try:
                     key = _uuid_bytes(obj.uuid)
                     self._deleted.pop(obj.uuid, None)
-                    prev_raw = self.objects.get(key)
+                    # a duplicate uuid within this batch must see the staged
+                    # (not yet written) earlier version as its previous state
+                    prev_raw = obj_puts.get(key)
+                    if prev_raw is None:
+                        prev_raw = self.objects.get(key)
                     if prev_raw is not None:
                         prev = StorObj.from_binary(prev_raw)
                         obj.creation_time_unix = prev.creation_time_unix
                         if not preserve_times:
                             obj.last_update_time_unix = int(time.time() * 1000)
                         self._cleanup_previous(prev)
-                        # duplicate uuid within this batch: un-stage the
-                        # earlier version's vector (it was never device-added,
-                        # so vector_index.delete above was a no-op)
+                        inv_items = [
+                            it for it in inv_items if it[0] != prev.doc_id]
+                        doc_puts = [
+                            dp for dp in doc_puts
+                            if dp[0] != struct.pack("<Q", prev.doc_id)]
+                        # the earlier version's vector was never device-added,
+                        # so vector_index.delete above was a no-op
                         pos = staged_pos.pop(prev.doc_id, None)
                         if pos is not None:
                             fresh_ids[pos] = -1
                     doc_id = self.counter.get_and_inc()
                     obj.doc_id = doc_id
-                    self.objects.put(key, obj.to_binary())
-                    self.docid_lookup.put(struct.pack("<Q", doc_id), key)
-                    self.inverted.add_object(doc_id, obj.properties)
+                    obj_puts[key] = obj.to_binary()
+                    doc_puts.append((struct.pack("<Q", doc_id), key))
+                    inv_items.append((doc_id, obj.properties, i))
                     self._geo_add(doc_id, obj.properties)
                     if obj.vector is not None:
                         if dim is None:
@@ -246,6 +270,26 @@ class Shard:
                             self.vector_index.add(doc_id, obj.vector)
                 except Exception as e:  # per-object error isolation (batch semantics)
                     errs[i] = e
+            try:
+                self.objects.put_many(obj_puts.items())
+                self.docid_lookup.put_many(doc_puts)
+                inv_errs = self.inverted.add_objects_batch(
+                    [(d, p) for d, p, _ in inv_items])
+            except Exception as e:  # noqa: BLE001 — store-level IO failure
+                # the batched writes sit outside the per-object try: report
+                # the failure on every object instead of aborting the caller,
+                # and skip the device add (LSM state is incomplete)
+                for _, _, i in inv_items:
+                    if errs[i] is None:
+                        errs[i] = e
+                return errs
+            for d, _, i in inv_items:
+                e = inv_errs.get(d)
+                if e is not None:
+                    errs[i] = e
+                    pos = staged_pos.pop(d, None)
+                    if pos is not None:
+                        fresh_ids[pos] = -1  # match add_object-failure semantics
             if any(d >= 0 for d in fresh_ids):
                 keep = [j for j, d in enumerate(fresh_ids) if d >= 0]
                 fresh_ids = [fresh_ids[j] for j in keep]

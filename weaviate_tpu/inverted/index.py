@@ -101,6 +101,65 @@ class InvertedIndex:
                 lb = self.store.bucket(length_bucket(prop.name))
                 lb.map_put(b"len", did, struct.pack("<I", len(toks)))
 
+    def add_objects_batch(self, items) -> dict[int, Exception]:
+        """Batch twin of add_object (shard_write_batch_objects.go analog):
+        postings are grouped per term across the WHOLE batch, so each unique
+        token costs one WAL record + one memtable update instead of one per
+        containing object. items = [(doc_id, properties)];
+        -> {doc_id: error} for objects whose analysis failed (they get no
+        postings; callers keep per-object batch error isolation)."""
+        analyzed: list[tuple[int, dict]] = []
+        errs: dict[int, Exception] = {}
+        for doc_id, props in items:
+            try:
+                analyzed.append((doc_id, self.analyzer.analyze(props)))
+            except Exception as e:  # noqa: BLE001 — per-object isolation
+                errs[doc_id] = e
+        if not analyzed:
+            return errs
+        self._all.roaring_add_many(ALL_DOCS_KEY, [d for d, _ in analyzed])
+        for prop in self.class_def.properties:
+            pt = prop.primitive_type()
+            if pt is None or pt.base in (DataType.GEO_COORDINATES, DataType.BLOB):
+                continue
+            name = prop.name
+            if prop.index_filterable:
+                nulls_t: list[int] = []
+                nulls_f: list[int] = []
+                by_tok: dict[bytes, list[int]] = {}
+                for doc_id, tokens in analyzed:
+                    toks = tokens.get(name)
+                    (nulls_t if toks is None else nulls_f).append(doc_id)
+                    if toks:
+                        for t in set(toks):
+                            by_tok.setdefault(t, []).append(doc_id)
+                null_recs = []
+                if nulls_t:
+                    null_recs.append((NULL_TRUE, nulls_t))
+                if nulls_f:
+                    null_recs.append((NULL_FALSE, nulls_f))
+                if null_recs:
+                    self.store.bucket(null_bucket(name)).roaring_add_many_keys(null_recs)
+                if by_tok:
+                    self.store.bucket(filterable_bucket(name)).roaring_add_many_keys(
+                        by_tok.items())
+            if prop.index_searchable and pt.base in (DataType.TEXT, DataType.STRING):
+                sput: list[tuple[bytes, bytes, bytes]] = []
+                lput: list[tuple[bytes, bytes, bytes]] = []
+                for doc_id, tokens in analyzed:
+                    toks = tokens.get(name)
+                    if not toks:
+                        continue
+                    did = struct.pack("<Q", doc_id)
+                    for t, tf in PyCounter(toks).items():
+                        sput.append((t, did, struct.pack("<f", float(tf))))
+                    lput.append((b"len", did, struct.pack("<I", len(toks))))
+                if sput:
+                    self.store.bucket(searchable_bucket(name)).map_put_many(sput)
+                if lput:
+                    self.store.bucket(length_bucket(name)).map_put_many(lput)
+        return errs
+
     def _filterable_indexed_docs(self, prop_name: str):
         """Bitmap of docs whose filterable postings exist for the prop: the
         null bucket gets exactly one entry (TRUE or FALSE) per doc when

@@ -80,6 +80,22 @@ def available() -> bool:
     return _load() is not None
 
 
+def _marshal_inputs(raws, dists, certs, extra_cap: int):
+    """Shared ctypes marshalling for both builders: pointer/length arrays,
+    NaN substitution for absent distance/certainty, output buffer sized so
+    the native side can never overrun (props are a subset of each image)."""
+    n = len(raws)
+    raw_arr = (ctypes.c_char_p * n)(*raws)
+    len_arr = (ctypes.c_int64 * n)(*[len(r) for r in raws])
+    d_arr = (ctypes.c_double * n)(*[
+        _NAN if d is None else float(d) for d in dists])
+    c_arr = (ctypes.c_double * n)(*[
+        _NAN if c is None else float(c) for c in certs])
+    cap = sum(len(r) for r in raws) + n * 128 + extra_cap + 16
+    out = (ctypes.c_ubyte * cap)()
+    return n, raw_arr, len_arr, d_arr, c_arr, out, cap
+
+
 def build_search_reply(
     raws: Sequence[bytes],
     dists: Sequence[Optional[float]],
@@ -90,15 +106,8 @@ def build_search_reply(
     lib = _load()
     if lib is None:
         return None
-    n = len(raws)
-    raw_arr = (ctypes.c_char_p * n)(*raws)
-    len_arr = (ctypes.c_int64 * n)(*[len(r) for r in raws])
-    d_arr = (ctypes.c_double * n)(*[
-        _NAN if d is None else float(d) for d in dists])
-    c_arr = (ctypes.c_double * n)(*[
-        _NAN if c is None else float(c) for c in certs])
-    cap = sum(len(r) for r in raws) + n * 128 + 16
-    out = (ctypes.c_ubyte * cap)()
+    n, raw_arr, len_arr, d_arr, c_arr, out, cap = _marshal_inputs(
+        raws, dists, certs, 0)
     wrote = lib.build_search_reply(raw_arr, len_arr, d_arr, c_arr, n,
                                    float(took_seconds), out, cap)
     if wrote < 0:
@@ -118,16 +127,9 @@ def build_batch_reply(
     lib = _load()
     if lib is None:
         return None
-    n = len(raws)
-    raw_arr = (ctypes.c_char_p * n)(*raws)
-    len_arr = (ctypes.c_int64 * n)(*[len(r) for r in raws])
-    d_arr = (ctypes.c_double * n)(*[
-        _NAN if d is None else float(d) for d in dists])
-    c_arr = (ctypes.c_double * n)(*[
-        _NAN if c is None else float(c) for c in certs])
+    n, raw_arr, len_arr, d_arr, c_arr, out, cap = _marshal_inputs(
+        raws, dists, certs, len(counts) * 16)
     cnt_arr = (ctypes.c_int64 * len(counts))(*counts)
-    cap = sum(len(r) for r in raws) + n * 128 + len(counts) * 16 + 16
-    out = (ctypes.c_ubyte * cap)()
     wrote = lib.build_batch_reply(raw_arr, len_arr, d_arr, c_arr, cnt_arr,
                                   len(counts), float(took_seconds), out, cap)
     if wrote < 0:

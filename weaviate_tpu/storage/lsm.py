@@ -459,6 +459,23 @@ class Bucket:
             self._wal.flush()
             os.fsync(self._wal.fileno())
 
+    def _wal_append_many(self, records) -> None:
+        """Many (op, *parts) records in ONE file write (and one fsync when
+        sync_writes) — batch imports append thousands of postings per call
+        and per-record writes would dominate."""
+        buf = io.BytesIO()
+        w = buf.write
+        for rec in records:
+            w(bytes([rec[0]]))
+            w(bytes([len(rec) - 1]))
+            for p in rec[1:]:
+                _write_frame(buf, p)
+        self._wal.write(buf.getvalue())
+        self._last_write = time.monotonic()
+        if self.sync_writes:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
     def _replay_wal(self) -> None:
         if not os.path.exists(self._wal_path):
             return
@@ -515,6 +532,19 @@ class Bucket:
             self._mem.put(key, value)
             self._maybe_flush()
 
+    def put_many(self, pairs) -> None:
+        """Batched replace puts: one lock, one WAL write (batch import)."""
+        assert self.strategy == STRATEGY_REPLACE
+        pairs = list(pairs)
+        if not pairs:
+            return
+        with self._lock:
+            self._wal_append_many([(_W_PUT, k, v) for k, v in pairs])
+            mput = self._mem.put
+            for k, v in pairs:
+                mput(k, v)
+            self._maybe_flush()
+
     def delete(self, key: bytes) -> None:
         assert self.strategy == STRATEGY_REPLACE
         with self._lock:
@@ -543,6 +573,20 @@ class Bucket:
             self._mem.put(key, subkey, value)
             self._maybe_flush()
 
+    def map_put_many(self, items) -> None:
+        """Batched map puts [(key, subkey, value)]: one lock, one WAL write
+        — a batch import's per-term postings land together."""
+        assert self.strategy == STRATEGY_MAP
+        items = list(items)
+        if not items:
+            return
+        with self._lock:
+            self._wal_append_many([(_W_PUT, k, s, v) for k, s, v in items])
+            mput = self._mem.put
+            for k, s, v in items:
+                mput(k, s, v)
+            self._maybe_flush()
+
     def map_delete(self, key: bytes, subkey: bytes) -> None:
         assert self.strategy == STRATEGY_MAP
         with self._lock:
@@ -556,6 +600,25 @@ class Bucket:
         with self._lock:
             self._wal_append(_W_RS_ADD_MANY, key, ids.tobytes())
             self._mem.add_many(key, ids)
+            self._maybe_flush()
+
+    def roaring_add_many_keys(self, items) -> None:
+        """Batched roaring adds [(key, doc_ids)]: one lock, one WAL write —
+        a batch import's per-token bitmaps land together."""
+        assert self.strategy == STRATEGY_ROARINGSET
+        staged = []
+        for k, ids in items:
+            a = (ids.astype("<u8", copy=False) if isinstance(ids, np.ndarray)
+                 else np.fromiter(ids, dtype="<u8"))
+            staged.append((k, a))
+        if not staged:
+            return
+        with self._lock:
+            self._wal_append_many(
+                [(_W_RS_ADD_MANY, k, a.tobytes()) for k, a in staged])
+            add = self._mem.add_many
+            for k, a in staged:
+                add(k, a)
             self._maybe_flush()
 
     def roaring_remove_many(self, key: bytes, doc_ids: Iterable[int]) -> None:
