@@ -96,10 +96,10 @@ def test_gmin_per_shape_fallback(tmp_path, monkeypatch):
     idx, vecs, rng = _mk_index(tmp_path, vi.DISTANCE_L2)
     real = idx._search_full_gmin
 
-    def failing(q, kk, allow_words):
+    def failing(q, kk, allow_words, *a, **k):
         if q.shape[0] >= 64:  # "over VMEM budget" for big batches
             raise RuntimeError("Mosaic: scoped vmem limit exceeded")
-        return real(q, kk, allow_words)
+        return real(q, kk, allow_words, *a, **k)
 
     monkeypatch.setattr(idx, "_search_full_gmin", failing)
     big = rng.standard_normal((64, vecs.shape[1])).astype(np.float32)
@@ -118,7 +118,7 @@ def test_gmin_disables_after_repeated_distinct_failures(tmp_path, monkeypatch):
     idx, vecs, rng = _mk_index(tmp_path, vi.DISTANCE_L2)
     monkeypatch.setattr(
         idx, "_search_full_gmin",
-        lambda q, kk, allow_words: (_ for _ in ()).throw(
+        lambda *a, **k: (_ for _ in ()).throw(
             RuntimeError("platform broken")))
     q = rng.standard_normal((16, vecs.shape[1])).astype(np.float32)
     for k in (3, 5, 7):  # three distinct compiled shapes all fail

@@ -211,6 +211,89 @@ def test_pq_enable_rejection_does_not_stick(tmp_path, data):
     assert ids[0] == 0
 
 
+def test_pq_rescore_serves_from_store_scan(tmp_path, data):
+    """With rescore enabled the bf16 row copy is already in HBM, so the
+    fast scan runs straight over it (codes are write/restart-side only) —
+    results must match exact numpy within bf16 tolerance."""
+    cfg = _cfg(enabled=True, segments=8, centroids=64)
+    idx = TpuVectorIndex(cfg, str(tmp_path / "s"), persist=False)
+    idx.add_batch(np.arange(1000), data[:1000])
+    idx.flush()
+    assert idx.compressed and idx._rescore_dev is not None
+    q = data[:32] + 0.001 * np.random.default_rng(1).standard_normal((32, 32)).astype(np.float32)
+    ids, dists = idx.search_by_vectors(q, 5)
+    d = ((q[:, None, :] - data[None, :1000, :]) ** 2).sum(-1)
+    want = np.argsort(d, axis=1)[:, :5]
+    hit = np.mean([len(set(ids[i].tolist()) & set(want[i].tolist())) / 5
+                   for i in range(32)])
+    assert hit >= 0.96
+    np.testing.assert_array_equal(ids[:, 0], np.arange(32, dtype=np.uint64))
+    # distances come from the bf16 row copy, not the PQ approximation
+    np.testing.assert_allclose(dists[:, 0], d[np.arange(32), ids[:, 0].astype(int)],
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_pq_manhattan_rides_store_scan(tmp_path):
+    """manhattan compressed search rides the bf16 rescore-store scan (the
+    old 131-QPS LUT gather path is gone for it)."""
+    rng = np.random.default_rng(5)
+    base = rng.standard_normal((600, 32)).astype(np.float32)
+    cfg = vi.HnswUserConfig.from_dict(
+        {"distance": "manhattan",
+         "pq": {"enabled": True, "segments": 8, "centroids": 32}}, "hnsw_tpu")
+    idx = TpuVectorIndex(cfg, str(tmp_path / "man"), persist=False)
+    idx.add_batch(np.arange(600), base)
+    idx.flush()
+    assert idx.compressed
+    ids, dists = idx.search_by_vectors(base[:8], 3)
+    np.testing.assert_array_equal(ids[:, 0], np.arange(8, dtype=np.uint64))
+    d = np.abs(base[:8, None, :] - base[None, :, :]).sum(-1)
+    want = np.argsort(d, axis=1)[:, :3]
+    for i in range(8):
+        assert len(set(ids[i].tolist()) & set(want[i].tolist())) >= 2
+
+
+def test_pq_hamming_rejected(tmp_path):
+    """hamming + kmeans-PQ has no meaningful ADC (mean centroids fail every
+    exact-equality test) — compress must refuse, not mis-rank."""
+    cfg = vi.HnswUserConfig.from_dict(
+        {"distance": "hamming",
+         "pq": {"enabled": True, "segments": 8, "centroids": 32}}, "hnsw_tpu")
+    idx = TpuVectorIndex(cfg, str(tmp_path / "ham"), persist=False)
+    rng = np.random.default_rng(5)
+    idx.add_batch(np.arange(600), rng.integers(0, 4, (600, 32)).astype(np.float32))
+    ids, _ = idx.search_by_vectors(
+        rng.integers(0, 4, (8, 32)).astype(np.float32), 3)
+    # declarative trigger auto-disables (invalid-config path) and the
+    # uncompressed hamming scan keeps serving
+    assert not idx.compressed and not idx.config.pq.enabled
+    assert ids.shape == (8, 3)
+
+
+def test_persisted_rejected_pq_serves_uncompressed(tmp_path, data):
+    """A pq.npz this build refuses (e.g. a hamming codebook persisted by an
+    older build) must not make the shard unloadable — restore logs a warning
+    and serves uncompressed."""
+    path = str(tmp_path / "shard")
+    cfg = vi.HnswUserConfig.from_dict({"distance": "hamming"}, "hnsw_tpu")
+    rng = np.random.default_rng(2)
+    base = rng.integers(0, 4, (300, 32)).astype(np.float32)
+    idx = TpuVectorIndex(cfg, path)
+    idx.add_batch(np.arange(300), base)
+    idx.flush()
+    idx.shutdown()
+    import os
+
+    np.savez(os.path.join(path, "pq"), codebook=np.zeros((8, 32, 4), np.float32),
+             dim=32, segments=8, centroids=32, metric="hamming",
+             encoder="kmeans", distribution="log-normal")
+    idx2 = TpuVectorIndex(cfg, path)
+    assert not idx2.compressed and idx2.n == 300
+    ids, _ = idx2.search_by_vector(base[5], 3)
+    assert ids[0] == 5
+    idx2.shutdown()
+
+
 def test_pq_declared_invalid_auto_disables(tmp_path, data):
     """pq declared at class creation with segments that turn out not to
     divide dims (unknowable before the first import) auto-disables with a

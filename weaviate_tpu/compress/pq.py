@@ -38,7 +38,9 @@ Array = jax.Array
 
 _FIT_SAMPLE_MAX = 16384   # rows used to fit codebooks (kmeans.go samples too)
 _KMEANS_ITERS = 10
-_ENCODE_CHUNK = 8192
+# encode streams the store through the device in fixed chunks; big chunks
+# matter off-chip (each dispatch pays the full host<->device round trip)
+_ENCODE_CHUNK = 65536
 
 
 # -- kmeans (per-segment, on device) ----------------------------------------
@@ -167,6 +169,11 @@ class ProductQuantizer:
                 f"pq.segments ({segments}) must divide vector dims ({dim})")
         if centroids > 65536:
             raise vi.ConfigValidationError("pq.centroids must be <= 65536")
+        if metric == vi.DISTANCE_HAMMING:
+            # kmeans centroids are MEANS: exact-equality distance to a mean
+            # counts ~every dim a mismatch, so every ADC distance collapses
+            # to ~D — silently-useless ranking is worse than an error
+            raise vi.ConfigValidationError("pq does not support hamming")
         if encoder == vi.PQ_ENCODER_TILE and dim != segments:
             raise vi.ConfigValidationError("tile encoder requires segments == dims")
         self.dim = dim
@@ -246,8 +253,11 @@ class ProductQuantizer:
         m, ds = self.segments, self.ds
         out = np.empty((n, m), dtype=self.code_dtype)
         cb = self._dev_codebook()
-        for off in range(0, n, _ENCODE_CHUNK):
-            end = min(off + _ENCODE_CHUNK, n)
+        # the per-segment [chunk, C] assignment matrix is the peak buffer:
+        # cap it at ~1 GB so max centroids (65536) still fits device memory
+        step = min(_ENCODE_CHUNK, max(4096, (1 << 28) // max(self.centroids, 1)))
+        for off in range(0, n, step):
+            end = min(off + step, n)
             blk = vectors[off:end].reshape(end - off, m, ds).transpose(1, 0, 2)
             codes = np.asarray(_encode_chunk(jnp.asarray(blk), cb))
             out[off:end] = codes.astype(self.code_dtype)

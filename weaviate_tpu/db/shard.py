@@ -69,7 +69,11 @@ def _uuid_bytes(u: str) -> bytes:
         h = u.replace("-", "")
         if len(h) == 32:
             try:
-                return bytes.fromhex(h)
+                b = bytes.fromhex(h)
+                # fromhex skips ASCII whitespace — 16 decoded bytes proves
+                # all 32 chars were hex digits
+                if len(b) == 16:
+                    return b
             except ValueError:
                 pass
     return uuidlib.UUID(u).bytes
@@ -226,8 +230,8 @@ class Shard:
             # in ONE call (single lock + WAL write; postings grouped per
             # term) instead of per-object puts
             obj_puts: dict[bytes, bytes] = {}
-            doc_puts: list[tuple[bytes, bytes]] = []
-            inv_items: list[tuple[int, dict, int]] = []  # doc, props, obj idx
+            doc_puts: dict[int, tuple[bytes, bytes]] = {}  # doc -> (key8, key)
+            inv_items: dict[int, tuple[dict, int]] = {}  # doc -> (props, idx)
             for i, obj in enumerate(objs):
                 try:
                     key = _uuid_bytes(obj.uuid)
@@ -243,11 +247,8 @@ class Shard:
                         if not preserve_times:
                             obj.last_update_time_unix = int(time.time() * 1000)
                         self._cleanup_previous(prev)
-                        inv_items = [
-                            it for it in inv_items if it[0] != prev.doc_id]
-                        doc_puts = [
-                            dp for dp in doc_puts
-                            if dp[0] != struct.pack("<Q", prev.doc_id)]
+                        inv_items.pop(prev.doc_id, None)
+                        doc_puts.pop(prev.doc_id, None)
                         # the earlier version's vector was never device-added,
                         # so vector_index.delete above was a no-op
                         pos = staged_pos.pop(prev.doc_id, None)
@@ -256,8 +257,8 @@ class Shard:
                     doc_id = self.counter.get_and_inc()
                     obj.doc_id = doc_id
                     obj_puts[key] = obj.to_binary()
-                    doc_puts.append((struct.pack("<Q", doc_id), key))
-                    inv_items.append((doc_id, obj.properties, i))
+                    doc_puts[doc_id] = (struct.pack("<Q", doc_id), key)
+                    inv_items[doc_id] = (obj.properties, i)
                     self._geo_add(doc_id, obj.properties)
                     if obj.vector is not None:
                         if dim is None:
@@ -272,18 +273,18 @@ class Shard:
                     errs[i] = e
             try:
                 self.objects.put_many(obj_puts.items())
-                self.docid_lookup.put_many(doc_puts)
+                self.docid_lookup.put_many(doc_puts.values())
                 inv_errs = self.inverted.add_objects_batch(
-                    [(d, p) for d, p, _ in inv_items])
+                    [(d, p) for d, (p, _) in inv_items.items()])
             except Exception as e:  # noqa: BLE001 — store-level IO failure
                 # the batched writes sit outside the per-object try: report
                 # the failure on every object instead of aborting the caller,
                 # and skip the device add (LSM state is incomplete)
-                for _, _, i in inv_items:
+                for _, i in inv_items.values():
                     if errs[i] is None:
                         errs[i] = e
                 return errs
-            for d, _, i in inv_items:
+            for d, (_, i) in inv_items.items():
                 e = inv_errs.get(d)
                 if e is not None:
                     errs[i] = e

@@ -374,35 +374,6 @@ def _search_pq(codes, tombs, n, lut, allow_words, r, use_allow, exact=False,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _rescore_on_device(rescore_store, q, slots, k, metric):
-    """PQ rescoring without the host round trip: gather the top-R candidate
-    rows from the on-device bf16 rescore copy and score them at f32. The
-    gather + [B, R] elementwise pass is microseconds of device time; the
-    old path shipped [B, R, D] float rows (gigabytes at serving batch
-    sizes) through the host per batch."""
-    cap = rescore_store.shape[0]
-    safe = jnp.clip(slots, 0, cap - 1)
-    cand = jnp.take(rescore_store, safe, axis=0).astype(jnp.float32)  # [B,R,D]
-    qf = q.astype(jnp.float32)[:, None, :]
-    if metric == vi.DISTANCE_L2:
-        d = jnp.sum((cand - qf) ** 2, axis=-1)
-    elif metric == vi.DISTANCE_DOT:
-        d = -jnp.sum(cand * qf, axis=-1)
-    elif metric == vi.DISTANCE_COSINE:
-        d = 1.0 - jnp.sum(cand * qf, axis=-1)
-    elif metric == vi.DISTANCE_MANHATTAN:
-        d = jnp.sum(jnp.abs(cand - qf), axis=-1)
-    else:
-        d = jnp.sum((cand != qf).astype(jnp.float32), axis=-1)
-    d = jnp.where(slots >= 0, d, jnp.inf)
-    neg, pos = jax.lax.top_k(-d, k)
-    top = -neg
-    final = jnp.take_along_axis(slots, pos, axis=1)
-    final = jnp.where(jnp.isinf(top), -1, final).astype(jnp.int32)
-    return _pack(top, final)
-
-
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
 def _score_rows(sub, q, row_valid, k, metric):
     """Score an uploaded [R, D] row block against [B, D] queries (the gather
     path when the float store lives host-side under PQ)."""
@@ -557,6 +528,7 @@ class TpuVectorIndex(VectorIndex):
         self._pq = None                     # ProductQuantizer
         self._codes = None                  # device [capacity, M]
         self._rescore_dev = None            # device bf16 [capacity, D]
+        self._rescore_sq_norms = None       # device f32 [capacity] (l2 bias)
         self._recon_norms = None            # device f32 [capacity] ||recon||^2
         self._host_vecs: Optional[np.ndarray] = None  # np [capacity, D] f32
         self._pq_path = os.path.join(shard_path, "pq.npz")
@@ -592,8 +564,21 @@ class TpuVectorIndex(VectorIndex):
 
                 self._flush_pending()
                 if self.n > 0:
-                    vecs = np.asarray(self._store[: self.n], dtype=np.float32)
-                    self._enable_pq(ProductQuantizer.load(self._pq_path), vecs, save=False)
+                    try:
+                        pq = ProductQuantizer.load(self._pq_path)
+                        vecs = np.asarray(self._store[: self.n], dtype=np.float32)
+                        self._enable_pq(pq, vecs, save=False)
+                    except Exception as e:  # noqa: BLE001 — see below
+                        # a pq.npz this build cannot use — rejected config
+                        # (hamming), corrupt zip, missing key, dim mismatch —
+                        # must not make the shard unloadable: serve
+                        # uncompressed with a warning
+                        import logging
+
+                        self.config.pq.enabled = False
+                        logging.getLogger(__name__).warning(
+                            "persisted pq codebook rejected (%s: %s); "
+                            "serving uncompressed", type(e).__name__, e)
         finally:
             self._restoring = False
 
@@ -625,6 +610,9 @@ class TpuVectorIndex(VectorIndex):
                 self._host_vecs = hv
                 if self._rescore_dev is not None:
                     self._rescore_dev = _grow_store(self._rescore_dev, cap)
+                    if self._rescore_sq_norms is not None:
+                        self._rescore_sq_norms = _grow_1d(
+                            self._rescore_sq_norms, cap, jnp.float32(0))
                 self._recon_norms = _grow_1d(self._recon_norms, cap, jnp.float32(0))
             else:
                 self._store = _grow_store(self._store, cap)
@@ -659,6 +647,14 @@ class TpuVectorIndex(VectorIndex):
                     self._rescore_dev = _write_rows(
                         self._rescore_dev, jnp.asarray(chunk, jnp.bfloat16), start + off
                     )
+                    if self._rescore_sq_norms is not None:
+                        self._rescore_sq_norms = _write_norms(
+                            self._rescore_sq_norms,
+                            jnp.asarray(np.einsum("ij,ij->i", chunk, chunk,
+                                                  dtype=np.float64)
+                                        .astype(np.float32)),
+                            start + off,
+                        )
             else:
                 self._store = _write_rows(self._store, jnp.asarray(chunk, self.dtype), start + off)
                 if self.metric == vi.DISTANCE_L2:
@@ -818,13 +814,20 @@ class TpuVectorIndex(VectorIndex):
         # never crosses the host boundary (half the f32 footprint the codes
         # just replaced; disable via pq.rescore=false for memory-tightest)
         if self.config.pq.rescore:
-            full_rs = np.zeros((self.capacity, self.dim), np.float32)
-            full_rs[: self.n] = vecs_n
+            # hv already holds the zero-padded [capacity, D] rows
             self._rescore_dev = jax.device_put(
-                jnp.asarray(full_rs, jnp.bfloat16), self.device
+                jnp.asarray(hv, jnp.bfloat16), self.device
             )
+            # the fast scan runs straight over this copy; only l2 reads the
+            # norms (einsum: f64 accumulation without a full f64 temp)
+            self._rescore_sq_norms = (
+                jax.device_put(jnp.asarray(np.einsum(
+                    "ij,ij->i", hv, hv, dtype=np.float64).astype(np.float32)),
+                    self.device)
+                if self.metric == vi.DISTANCE_L2 else None)
         else:
             self._rescore_dev = None
+            self._rescore_sq_norms = None
         self._store = None
         self._sq_norms = None
         self._pq = pq
@@ -938,14 +941,15 @@ class TpuVectorIndex(VectorIndex):
             return False
         return self._gmin_rg(k) > 0
 
-    def _search_full_gmin(self, q: np.ndarray, kk: int, allow_words):
+    def _search_full_gmin(self, q: np.ndarray, kk: int, allow_words,
+                          store=None, sq_norms=None):
         from weaviate_tpu.ops import gmin_scan
 
         interpret = jax.default_backend() not in ("tpu", "axon")
         ncols = self.capacity // gmin_scan.G
         return gmin_scan.search_gmin(
-            self._store,
-            self._sq_norms,
+            self._store if store is None else store,
+            self._sq_norms if sq_norms is None else sq_norms,
             self._tombs,
             self.n,
             jnp.asarray(q),
@@ -959,7 +963,8 @@ class TpuVectorIndex(VectorIndex):
             interpret,
         )
 
-    def _gmin_packed_or_none(self, q: np.ndarray, kk: int, allow_words):
+    def _gmin_packed_or_none(self, q: np.ndarray, kk: int, allow_words,
+                             store=None, sq_norms=None):
         """Run the fused scan, or None to use the legacy kernel. Validation
         is per compiled shape: each distinct (b, k, rg, active_g, use_allow)
         is a separate Mosaic compilation with its own VMEM footprint
@@ -975,11 +980,11 @@ class TpuVectorIndex(VectorIndex):
         # capacity is part of the key: the compilation is parameterized by
         # the [capacity, D] store, so growth invalidates prior validation
         key = (q.shape[0], kk, self._gmin_rg(kk), -(-self.n // ncols),
-               self.capacity, allow_words is not None)
+               self.capacity, allow_words is not None, store is not None)
         if key in self._gmin_shape_broken:
             return None
         try:
-            packed = self._search_full_gmin(q, kk, allow_words)
+            packed = self._search_full_gmin(q, kk, allow_words, store, sq_norms)
             if key not in self._gmin_validated:
                 # JAX defers device errors to materialization — the first
                 # call per shape blocks here so a runtime fault (not just a
@@ -1066,48 +1071,71 @@ class TpuVectorIndex(VectorIndex):
                 ids, dists = self._search_full_pq(q, b, k_eff, allow_list)
             else:
                 allow_words = self._allow_words(allow_list) if allow_list is not None else None
-                kk = min(max(k_eff, 1), self.n)
-                packed = self._gmin_packed_or_none(q, kk, allow_words)
-                if packed is not None:
-                    packed = np.asarray(packed)
-                else:
-                    packed = np.asarray(
-                        _search_full(
-                            self._store,
-                            self._sq_norms if self.metric == vi.DISTANCE_L2 else None,
-                            self._tombs,
-                            self.n,
-                            jnp.asarray(q),
-                            allow_words if allow_words is not None else jnp.zeros((self.capacity // 32,), jnp.uint32),
-                            kk,
-                            self.metric,
-                            allow_words is not None,
-                            getattr(self.config, "exact_topk", False),
-                            -(-self.n // _SCAN_CHUNK),
-                            self._rescore_r(kk),
-                        )
-                    )
-                top, idx = _unpack(packed)
-                top = top[:b]
-                idx = idx[:b]
-                ids = np.where(idx >= 0, self._slot_to_doc[np.clip(idx, 0, None)], -1)
-                dists = top
+                ids, dists = self._scan_store(q, b, k_eff, allow_words)
             return ids.astype(np.uint64), dists.astype(np.float32)
 
+    def _scan_store(self, q: np.ndarray, b: int, k_eff: int, allow_words,
+                    store=None, sq_norms=None):
+        """Full-store scan (fused gmin when eligible, legacy lax.scan kernel
+        otherwise) over `store` — the f32 store uncompressed, or the bf16
+        rescore copy under PQ-with-rescore (scanning codes first would read
+        MORE HBM than the copy the rescore pass consults anyway)."""
+        kk = min(max(k_eff, 1), self.n)
+        packed = self._gmin_packed_or_none(q, kk, allow_words, store, sq_norms)
+        if packed is not None:
+            packed = np.asarray(packed)
+        else:
+            sq = self._sq_norms if sq_norms is None else sq_norms
+            packed = np.asarray(
+                _search_full(
+                    self._store if store is None else store,
+                    sq if self.metric == vi.DISTANCE_L2 else None,
+                    self._tombs,
+                    self.n,
+                    jnp.asarray(q),
+                    allow_words if allow_words is not None else jnp.zeros((self.capacity // 32,), jnp.uint32),
+                    kk,
+                    self.metric,
+                    allow_words is not None,
+                    getattr(self.config, "exact_topk", False),
+                    -(-self.n // _SCAN_CHUNK),
+                    self._rescore_r(kk),
+                )
+            )
+        top, idx = _unpack(packed)
+        top = top[:b]
+        idx = idx[:b]
+        ids = np.where(idx >= 0, self._slot_to_doc[np.clip(idx, 0, None)], -1)
+        return ids, top
+
     def _search_full_pq(self, q: np.ndarray, b: int, k: int, allow_list):
-        """Compressed full-store search: LUT scan over the code matrix for the
-        top-R candidate slots, then (by default) exact float rescoring from
-        the host-side row store."""
+        """Compressed full-store search.
+
+        With rescore enabled a full bf16 copy of the rows already lives in
+        HBM for the rescoring pass — so the fast scan reads THAT copy
+        directly (fused gmin kernel / legacy scan), which is strictly less
+        HBM traffic and strictly more accurate than scanning the codes
+        first; the codes then only serve writes and restarts. The reference
+        has no such copy, hence its LUT scan (product_quantization.go:56-75).
+
+        With rescore disabled (memory-tightest tier) the scan really runs
+        over the codes: reconstruction-matmul ADC for matmul metrics, LUT
+        gathers for manhattan. (hamming never compresses — ProductQuantizer
+        rejects it at fit/load.)"""
         from weaviate_tpu.compress.pq import build_lut
 
         pqc = self.config.pq
         rescore = pqc.rescore and self._rescore_dev is not None
-        if self.metric == vi.DISTANCE_HAMMING:
-            # exact-equality tests against a bf16 copy count every dim as a
-            # mismatch; the LUT distance is already the hamming ADC estimate
-            rescore = False
+        if rescore:
+            allow_words = (self._allow_words(allow_list)
+                           if allow_list is not None else None)
+            ids, dists = self._scan_store(
+                q, b, k, allow_words,
+                store=self._rescore_dev, sq_norms=self._rescore_sq_norms)
+            return ids, dists
+        # codes-only tier from here: raw ADC distances, no rescoring pass
         # per-chunk candidate depth: selection cost on TPU grows sharply
-        # with k, so each chunk contributes a SMALL top-r and the rescored
+        # with k, so each chunk contributes a SMALL top-r and the candidate
         # pool is nchunks * r_chunk deep. Sized so the pool stays >= 512
         # regardless of chunk count (64/chunk over a 1M store; deeper per
         # chunk when the store fits fewer chunks).
@@ -1118,7 +1146,6 @@ class TpuVectorIndex(VectorIndex):
         )
         # the concatenated pool must cover k (final top_k rejects k > pool)
         r_chunk = max(r_chunk, min(-(-k // nchunks_eff), self.n))
-        r = min(_bucket_b(max(8 * k, 200)) if rescore else k, self.n, _PQ_SCAN_CHUNK)
         allow_words = self._allow_words(allow_list) if allow_list is not None else None
         words = (allow_words if allow_words is not None
                  else jnp.zeros((self.capacity // 32,), jnp.uint32))
@@ -1130,8 +1157,7 @@ class TpuVectorIndex(VectorIndex):
                     self._tombs,
                     self.n,
                     self._pq._dev_codebook(),
-                    (self._rescore_dev if rescore
-                     else jnp.zeros((1, self.dim), jnp.bfloat16)),
+                    jnp.zeros((1, self.dim), jnp.bfloat16),
                     jnp.asarray(q),
                     words,
                     min(k, self.live),
@@ -1140,52 +1166,32 @@ class TpuVectorIndex(VectorIndex):
                     allow_words is not None,
                     getattr(self.config, "exact_topk", False),
                     -(-self.n // _SCAN_CHUNK),
-                    rescore,
+                    False,
                 )
             )
             top, slots = _unpack(packed)
             top, slots = top[:b], slots[:b]
-            if not rescore and self.metric == vi.DISTANCE_COSINE:
-                pass  # recon path already emits 1 - dot directly
+            # (cosine: the recon path already emits 1 - dot directly)
             ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
             return ids[:, :k], top[:, :k]
-        else:
-            lut = build_lut(jnp.asarray(q), self._pq._dev_codebook(), self.metric)
-            packed = np.asarray(
-                _search_pq(
-                    self._codes,
-                    self._tombs,
-                    self.n,
-                    lut,
-                    words,
-                    r,
-                    allow_words is not None,
-                    getattr(self.config, "exact_topk", False),
-                    -(-self.n // _PQ_SCAN_CHUNK),
-                )
-            )
-        if not rescore or self._rescore_dev is None:
-            top, slots = _unpack(packed)
-            top, slots = top[:b], slots[:b]
-            if self.metric == vi.DISTANCE_COSINE:
-                top = np.where(np.isinf(top), top, top + 1.0)
-            ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
-            return ids[:, :k], top[:, :k]
-        # exact rescoring entirely on device against the bf16 rescore copy
-        _, slots_np = _unpack(packed)
-        packed2 = np.asarray(
-            _rescore_on_device(
-                self._rescore_dev,
-                jnp.asarray(q),
-                jnp.asarray(slots_np),
-                min(k, r),
-                self.metric,
+        lut = build_lut(jnp.asarray(q), self._pq._dev_codebook(), self.metric)
+        packed = np.asarray(
+            _search_pq(
+                self._codes,
+                self._tombs,
+                self.n,
+                lut,
+                words,
+                min(k, self.n, _PQ_SCAN_CHUNK),
+                allow_words is not None,
+                getattr(self.config, "exact_topk", False),
+                -(-self.n // _PQ_SCAN_CHUNK),
             )
         )
-        dists, final_slots = _unpack(packed2)
-        dists, final_slots = dists[:b], final_slots[:b]
-        ids = np.where(final_slots >= 0, self._slot_to_doc[np.clip(final_slots, 0, None)], -1)
-        return ids, dists
+        top, slots = _unpack(packed)
+        top, slots = top[:b], slots[:b]
+        ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
+        return ids[:, :k], top[:, :k]
 
     def _sorted_doc_slots(self) -> tuple[np.ndarray, np.ndarray]:
         if self._map_cache is None:
@@ -1368,6 +1374,7 @@ class TpuVectorIndex(VectorIndex):
             self._pq = None
             self._codes = None
             self._rescore_dev = None
+            self._rescore_sq_norms = None
             self._recon_norms = None
             self._host_vecs = None
             self.dim = None
@@ -1407,6 +1414,7 @@ class TpuVectorIndex(VectorIndex):
             self._pq = None
             self._codes = None
             self._rescore_dev = None
+            self._rescore_sq_norms = None
             self._recon_norms = None
             self._host_vecs = None
             try:

@@ -74,32 +74,10 @@ class InvertedIndex:
     # -- write path ----------------------------------------------------------
 
     def add_object(self, doc_id: int, properties: dict) -> None:
-        tokens_by_prop = self.analyzer.analyze(properties)
-        self._all.roaring_add_many(ALL_DOCS_KEY, [doc_id])
-        did = struct.pack("<Q", doc_id)
-        for prop in self.class_def.properties:
-            pt = prop.primitive_type()
-            if pt is None or pt.base in (DataType.GEO_COORDINATES, DataType.BLOB):
-                continue
-            toks = tokens_by_prop.get(prop.name)
-            if prop.index_filterable:
-                nb = self.store.bucket(null_bucket(prop.name))
-                nb.roaring_add_many(NULL_TRUE if toks is None else NULL_FALSE, [doc_id])
-                if toks:
-                    fb = self.store.bucket(filterable_bucket(prop.name))
-                    for t in set(toks):
-                        fb.roaring_add_many(t, [doc_id])
-            if (
-                prop.index_searchable
-                and pt.base in (DataType.TEXT, DataType.STRING)
-                and toks
-            ):
-                sb = self.store.bucket(searchable_bucket(prop.name))
-                counts = PyCounter(toks)
-                for t, tf in counts.items():
-                    sb.map_put(t, did, struct.pack("<f", float(tf)))
-                lb = self.store.bucket(length_bucket(prop.name))
-                lb.map_put(b"len", did, struct.pack("<I", len(toks)))
+        # single-object form of the batch writer — ONE posting code path
+        errs = self.add_objects_batch([(doc_id, properties)])
+        if doc_id in errs:
+            raise errs[doc_id]
 
     def add_objects_batch(self, items) -> dict[int, Exception]:
         """Batch twin of add_object (shard_write_batch_objects.go analog):
