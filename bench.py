@@ -193,11 +193,15 @@ def _measure_sync(idx, queries, k, n_batches):
     return queries.shape[0] / med, med, ids
 
 
-def run_matrix(rng, vecs, queries, idx_l2, gt):
-    """BASELINE.md configs 2-5."""
+def run_matrix(rng, vecs, queries, idx_l2, gt, headline=None):
+    """BASELINE.md configs 2-5 (config 1 lands as the headline row, keyed by
+    the dataset that was actually measured)."""
     from weaviate_tpu.storage.bitmap import Bitmap
 
     results = {}
+    if headline:
+        label = headline.pop("label")
+        results[label] = headline
 
     def flush():
         with open(MATRIX_FILE, "w") as f:
@@ -205,7 +209,7 @@ def run_matrix(rng, vecs, queries, idx_l2, gt):
 
     # config 3: filtered ANN (10% allowList -> masked device bitmap path)
     log("matrix: filtered ANN (10% allowList)...")
-    mask = rng.random(N) < 0.10
+    mask = rng.random(len(vecs)) < 0.10
     allow = Bitmap(np.nonzero(mask)[0].astype(np.uint64))
     idx_l2.search_by_vectors(queries, K, allow_list=allow)
     t0 = time.perf_counter()
@@ -241,18 +245,35 @@ def run_matrix(rng, vecs, queries, idx_l2, gt):
     idx_pq.drop()
     del idx_pq
 
-    # config 2: cosine (glove-100-angular shape)
-    log("matrix: cosine d=100...")
-    vecs_cos = make_data(N, 100, rng)
-    vecs_cos /= np.linalg.norm(vecs_cos, axis=1, keepdims=True)
-    q_cos = vecs_cos[rng.integers(0, N, B)] + 0.05 * rng.standard_normal((B, 100), dtype=np.float32)
+    # config 2: cosine — real glove-100-angular when available
+    log("matrix: cosine (glove-100-angular)...")
+    from bench_datasets import load_or_synthetic, tile_queries
+
+    def synth_glove():
+        vecs_cos = make_data(N, 100, rng)
+        vecs_cos /= np.linalg.norm(vecs_cos, axis=1, keepdims=True)
+        return {"train": vecs_cos, "queries": None, "metric": "cosine"}
+
+    gdata, glabel = load_or_synthetic(
+        "glove-100-angular", synth_glove,
+        max_rows=None if N >= 1_000_000 else N)
+    vecs_cos = gdata["train"]
+    if gdata["queries"] is not None:
+        q_cos = tile_queries(gdata["queries"], B)
+    else:
+        q_cos = vecs_cos[rng.integers(0, len(vecs_cos), B)] + \
+            0.05 * rng.standard_normal((B, vecs_cos.shape[1]), dtype=np.float32)
     idx_cos, _ = _build_index(vecs_cos, metric="cosine")
     qps_cos, med_cos, ids_cos = _measure_sync(idx_cos, q_cos, K, 4)
-    qn = q_cos[:128] / np.linalg.norm(q_cos[:128], axis=1, keepdims=True)
-    gt_cos = exact_gt(vecs_cos, qn, K, metric="cosine")
-    results["cosine_d100"] = {
+    if gdata.get("gt") is not None:
+        gt_cos = [row[:K] for row in gdata["gt"][: min(128, B)]]
+    else:
+        qn = q_cos[:128] / np.linalg.norm(q_cos[:128], axis=1, keepdims=True)
+        gt_cos = exact_gt(vecs_cos, qn, K, metric="cosine")
+    results[glabel] = {
         "qps": round(qps_cos, 1),
         "recall@10": round(recall_at_k(ids_cos, gt_cos, K), 4),
+        "n": len(vecs_cos), "dim": int(vecs_cos.shape[1]),
     }
     flush()
     idx_cos.drop()
@@ -370,14 +391,29 @@ def main():
     _probe_device()
     import jax
 
-    log(f"generating {N}x{DIM} clustered vectors...")
-    vecs = make_data(N, DIM, rng)
-    queries = rng.standard_normal((B, DIM), dtype=np.float32) * 0.1 + vecs[
-        rng.integers(0, N, B)
-    ]
+    from bench_datasets import load_or_synthetic, tile_queries
+
+    # real SIFT1M when available (BASELINE.json config 1; reference harness
+    # test/benchmark/benchmark_sift.go); shape-matched synthetic otherwise —
+    # the metric line names whichever was measured
+    def synth():
+        log(f"generating {N}x{DIM} clustered vectors...")
+        return {"train": make_data(N, DIM, rng), "queries": None,
+                "metric": "l2-squared"}
+
+    data, data_label = load_or_synthetic(
+        "sift1m", synth, max_rows=None if N >= 1_000_000 else N)
+    vecs = data["train"]
+    n_eff, dim_eff = vecs.shape
+    if data["queries"] is not None:
+        queries = tile_queries(data["queries"], B)
+    else:
+        queries = rng.standard_normal((B, dim_eff), dtype=np.float32) * 0.1 + vecs[
+            rng.integers(0, n_eff, B)
+        ]
 
     idx, import_s = _build_index(vecs)
-    log(f"import: {import_s:.1f}s ({N/import_s:.0f} vec/s) on {jax.devices()[0]}")
+    log(f"import: {import_s:.1f}s ({n_eff/import_s:.0f} vec/s) on {jax.devices()[0]}")
 
     qps_sync, med, ids = _measure_sync(idx, queries, K, N_QUERY_BATCHES)
     log(f"TPU batched kNN (sync): {qps_sync:.0f} QPS (median {med*1000:.1f} ms / {B}-query batch)")
@@ -386,10 +422,15 @@ def main():
     qps_pipe, per_batch = _measure_pipelined(idx, queries, K, N_QUERY_BATCHES)
     log(f"TPU batched kNN (pipelined, serving path): {qps_pipe:.0f} QPS ({per_batch*1000:.1f} ms/batch)")
 
-    log(f"computing exact ground truth on {N_GT} queries...")
-    gt = exact_gt(vecs, queries[:N_GT], K)
+    if data.get("gt") is not None:
+        # clamp to the measured batch: ids has B rows
+        gt = [row[:K] for row in data["gt"][: min(N_GT, B)]]
+        log(f"using shipped ground truth ({len(gt)} queries)")
+    else:
+        log(f"computing exact ground truth on {N_GT} queries...")
+        gt = exact_gt(vecs, queries[:N_GT], K)
     recall = recall_at_k(ids, gt, K)
-    log(f"recall@10 = {recall:.4f} ({N_GT} queries)")
+    log(f"recall@10 = {recall:.4f} ({len(gt)} queries)")
 
     if recall < 0.95 and getattr(idx, "_gmin_validated", False):
         # the fused kernel missed the recall bar on this platform — a
@@ -425,8 +466,9 @@ def main():
 
     out = {
         "metric": (
-            f"pipelined batched kNN QPS (N={N}, d={DIM}, k={K}, batch={B}, L2, "
-            f"recall@10={recall:.3f} on {N_GT} queries vs exact GT, "
+            f"pipelined batched kNN QPS ({data_label}, N={n_eff}, d={dim_eff}, "
+            f"k={K}, batch={B}, L2, "
+            f"recall@10={recall:.3f} on {len(gt)} queries vs exact GT, "
             f"baseline={base_note})"
         ),
         "value": round(qps_pipe, 1),
@@ -437,7 +479,12 @@ def main():
     }
 
     if os.environ.get("BENCH_MATRIX"):
-        run_matrix(rng, vecs, queries, idx, gt)
+        run_matrix(rng, vecs, queries, idx, gt, headline={
+            "label": data_label,
+            "qps": round(qps_pipe, 1), "sync_qps": round(qps_sync, 1),
+            "recall@10": round(recall, 4),
+            "n": int(n_eff), "dim": int(dim_eff),
+        })
 
     print(json.dumps(out))
 

@@ -1,0 +1,165 @@
+"""Benchmark dataset loaders: SIFT1M and glove-100-angular.
+
+Download-or-cache with a clearly labeled synthetic fallback — BASELINE.json
+names real datasets (SIFT1M, glove-100-angular; reference harness:
+test/benchmark/benchmark_sift.go), but the bench must also run in
+zero-egress environments, so every loader degrades to the shape-matched
+synthetic generator and the result rows SAY which data they measured.
+
+Cache layout (override with BENCH_DATA_DIR):
+    datasets/sift/sift_base.fvecs|sift_query.fvecs|sift_groundtruth.ivecs
+    datasets/glove-100-angular.hdf5        (ann-benchmarks export, needs h5py)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+CACHE = os.environ.get(
+    "BENCH_DATA_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "datasets"))
+
+SIFT_URL = "ftp://ftp.irisa.fr/local/texmex/corpus/sift.tar.gz"
+GLOVE_URL = "https://ann-benchmarks.com/glove-100-angular.hdf5"
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def read_fvecs(path: str, max_rows: Optional[int] = None) -> np.ndarray:
+    """TexMex .fvecs: per row an int32 dim then dim float32s."""
+    raw = np.fromfile(path, dtype=np.int32)
+    d = int(raw[0])
+    rows = raw.reshape(-1, d + 1)
+    if max_rows is not None:
+        rows = rows[:max_rows]
+    return rows[:, 1:].view(np.float32).copy()
+
+
+def read_ivecs(path: str, max_rows: Optional[int] = None) -> np.ndarray:
+    raw = np.fromfile(path, dtype=np.int32)
+    d = int(raw[0])
+    rows = raw.reshape(-1, d + 1)
+    if max_rows is not None:
+        rows = rows[:max_rows]
+    return rows[:, 1:].copy()
+
+
+def _download(url: str, dest: str, timeout: int = 120) -> bool:
+    import urllib.request
+
+    try:
+        _log(f"downloading {url} ...")
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        tmp = dest + ".part"
+        with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 22)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(tmp, dest)
+        return True
+    except Exception as e:  # noqa: BLE001 — zero-egress is the common case
+        _log(f"download failed ({type(e).__name__}: {e})")
+        return False
+
+
+def load_sift1m(max_rows: Optional[int] = None) -> Optional[dict]:
+    """-> {train [N,128] f32, queries [10k,128], gt [10k,100] int32} or None.
+    gt is exact L2 neighbor ids over the FULL 1M base — only valid when
+    max_rows is None."""
+    base_dir = os.path.join(CACHE, "sift")
+    base = os.path.join(base_dir, "sift_base.fvecs")
+    if not os.path.exists(base):
+        tgz = os.path.join(CACHE, "sift.tar.gz")
+        if not os.path.exists(tgz) and not _download(SIFT_URL, tgz):
+            return None
+        try:
+            with tarfile.open(tgz) as t:
+                t.extractall(CACHE, filter="data")
+        except Exception as e:  # noqa: BLE001
+            _log(f"sift extract failed: {e}")
+            return None
+    try:
+        out = {
+            "train": read_fvecs(base, max_rows),
+            "queries": read_fvecs(os.path.join(base_dir, "sift_query.fvecs")),
+            "metric": "l2-squared",
+        }
+    except Exception as e:  # noqa: BLE001
+        _log(f"sift parse failed: {e}")
+        return None
+    if max_rows is None:
+        # best-effort: a missing/truncated groundtruth file must not discard
+        # the real base vectors — callers compute exact GT when absent
+        try:
+            out["gt"] = read_ivecs(os.path.join(base_dir, "sift_groundtruth.ivecs"))
+        except Exception as e:  # noqa: BLE001
+            _log(f"sift groundtruth unavailable ({e}); exact GT will be computed")
+    return out
+
+
+def load_glove100(max_rows: Optional[int] = None) -> Optional[dict]:
+    """-> {train [~1.18M,100] f32 normalized, queries, gt [q,100]} or None.
+    Requires h5py for the ann-benchmarks HDF5 export."""
+    path = os.path.join(CACHE, "glove-100-angular.hdf5")
+    if not os.path.exists(path) and not _download(GLOVE_URL, path):
+        return None
+    try:
+        import h5py  # not in the base image; the cache may still exist
+    except ImportError:
+        _log("glove-100 cached file needs h5py, which is unavailable")
+        return None
+    try:
+        with h5py.File(path, "r") as f:
+            train = np.asarray(f["train"], dtype=np.float32)
+            if max_rows is not None:
+                train = train[:max_rows]
+            out = {
+                "train": train,
+                "queries": np.asarray(f["test"], dtype=np.float32),
+                "metric": "cosine",
+            }
+            if max_rows is None:
+                out["gt"] = np.asarray(f["neighbors"], dtype=np.int32)
+        # angular: rows are compared by cosine; normalize once here
+        for k in ("train", "queries"):
+            nrm = np.linalg.norm(out[k], axis=1, keepdims=True)
+            nrm[nrm == 0] = 1.0
+            out[k] = out[k] / nrm
+        return out
+    except Exception as e:  # noqa: BLE001
+        _log(f"glove parse failed: {e}")
+        return None
+
+
+def tile_queries(queries: np.ndarray, b: int) -> np.ndarray:
+    """First b query rows, tiling the real query set when it is smaller than
+    the bench batch (row order preserved so shipped GT stays aligned)."""
+    reps = -(-b // len(queries))
+    return np.tile(queries, (reps, 1))[:b].astype(np.float32)
+
+
+def load_or_synthetic(name: str, synth_fn, max_rows: Optional[int] = None):
+    """-> (data dict, label). label names the REAL dataset only when the
+    real files loaded; the synthetic fallback is explicit in every
+    downstream result row."""
+    loader = {"sift1m": load_sift1m, "glove-100-angular": load_glove100}[name]
+    if os.environ.get("BENCH_FORCE_SYNTHETIC"):
+        data = None
+    else:
+        data = loader(max_rows)
+    if data is not None:
+        label = name if max_rows is None else f"{name}[:{max_rows}]"
+        _log(f"dataset: {label} (real)")
+        return data, label
+    _log(f"dataset: {name} unavailable; measuring the SYNTHETIC "
+         f"shape-matched generator instead")
+    return synth_fn(), f"synthetic-{name}-shaped"

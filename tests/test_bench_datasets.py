@@ -1,0 +1,71 @@
+"""Dataset loader plumbing: TexMex fvecs/ivecs codecs + labeled fallback.
+
+Reference harness analog: test/benchmark/benchmark_sift.go (SIFT fvecs
+parsing); ann-benchmarks hdf5 for glove-100-angular.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench_datasets as bd  # noqa: E402
+
+
+def _write_fvecs(path, arr):
+    with open(path, "wb") as f:
+        for row in arr:
+            np.int32(arr.shape[1]).tofile(f)
+            row.astype("<f4").tofile(f)
+
+
+def _write_ivecs(path, arr):
+    with open(path, "wb") as f:
+        for row in arr:
+            np.int32(arr.shape[1]).tofile(f)
+            row.astype("<i4").tofile(f)
+
+
+def test_fvecs_ivecs_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    vec = rng.standard_normal((50, 16)).astype(np.float32)
+    ids = rng.integers(0, 1000, (50, 10)).astype(np.int32)
+    fp, ip = str(tmp_path / "a.fvecs"), str(tmp_path / "a.ivecs")
+    _write_fvecs(fp, vec)
+    _write_ivecs(ip, ids)
+    np.testing.assert_array_equal(bd.read_fvecs(fp), vec)
+    np.testing.assert_array_equal(bd.read_ivecs(ip), ids)
+    np.testing.assert_array_equal(bd.read_fvecs(fp, max_rows=7), vec[:7])
+    np.testing.assert_array_equal(bd.read_ivecs(ip, max_rows=7), ids[:7])
+
+
+def test_cached_sift_layout_loads(tmp_path, monkeypatch):
+    """A pre-populated cache loads without any network attempt."""
+    sift = tmp_path / "sift"
+    sift.mkdir()
+    rng = np.random.default_rng(1)
+    base = rng.standard_normal((100, 8)).astype(np.float32)
+    qs = rng.standard_normal((5, 8)).astype(np.float32)
+    gt = rng.integers(0, 100, (5, 10)).astype(np.int32)
+    _write_fvecs(str(sift / "sift_base.fvecs"), base)
+    _write_fvecs(str(sift / "sift_query.fvecs"), qs)
+    _write_ivecs(str(sift / "sift_groundtruth.ivecs"), gt)
+    monkeypatch.setattr(bd, "CACHE", str(tmp_path))
+    monkeypatch.setattr(bd, "_download", lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("no network attempt expected")))
+    data = bd.load_sift1m()
+    np.testing.assert_array_equal(data["train"], base)
+    np.testing.assert_array_equal(data["gt"], gt)
+    assert data["metric"] == "l2-squared"
+    data, label = bd.load_or_synthetic("sift1m", lambda: {"train": None})
+    assert label == "sift1m" and data["train"] is not None
+
+
+def test_fallback_is_labeled_synthetic(tmp_path, monkeypatch):
+    monkeypatch.setattr(bd, "CACHE", str(tmp_path / "empty"))
+    monkeypatch.setattr(bd, "_download", lambda *a, **k: False)
+    sentinel = {"train": "SYNTH", "queries": None, "metric": "l2-squared"}
+    data, label = bd.load_or_synthetic("sift1m", lambda: sentinel)
+    assert data is sentinel and label == "synthetic-sift1m-shaped"
