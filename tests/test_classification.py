@@ -148,6 +148,97 @@ def test_zeroshot_classification(served):
         assert beacon.endswith(want)
 
 
+def test_contextual_classification_journey(tmp_path):
+    """text2vec-contextionary-contextual: no training data — sources gain a
+    ref to the target whose vector is closest to the boosted centroid of the
+    source's most discriminative basedOn words
+    (classifier_run_contextual.go journey)."""
+    cfg = Config()
+    cfg.enable_modules = ["text2vec-local"]
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    srv = RestServer(app, port=0)
+    srv.start()
+    try:
+        _req(srv.port, "POST", "/v1/schema", {
+            "class": "Topic", "vectorizer": "text2vec-local",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "name", "dataType": ["text"]}],
+        })
+        topic_ids = {}
+        for name, words in (("science", "science physics research experiment"),
+                            ("sports", "sports football match goal stadium")):
+            uid = str(uuidlib.uuid4())
+            topic_ids[name] = uid
+            st, _ = _req(srv.port, "POST", "/v1/objects", {
+                "class": "Topic", "id": uid, "properties": {"name": words}})
+            assert st == 200
+        _req(srv.port, "POST", "/v1/schema", {
+            "class": "Post", "vectorizer": "none",
+            "vectorIndexConfig": {"distance": "cosine"},
+            "properties": [{"name": "body", "dataType": ["text"]},
+                           {"name": "ofTopic", "dataType": ["Topic"]}],
+        })
+        posts = []
+        bodies = {
+            "science": "the physics experiment confirmed the research result",
+            "sports": "the football match ended with a late goal at the stadium",
+        }
+        for label, body in bodies.items():
+            for i in range(3):
+                uid = str(uuidlib.uuid4())
+                posts.append((uid, label))
+                st, _ = _req(srv.port, "POST", "/v1/objects", {
+                    "class": "Post", "id": uid,
+                    "properties": {"body": f"{body} number {i}"},
+                    "vector": [0.0] * 256})
+                assert st == 200
+
+        st, job = _req(srv.port, "POST", "/v1/classifications", {
+            "class": "Post", "classifyProperties": ["ofTopic"],
+            "basedOnProperties": ["body"],
+            "type": "text2vec-contextionary-contextual",
+        })
+        assert st == 201, job
+        final = _wait_job(srv.port, job["id"])
+        assert final["status"] == "completed", final
+        assert final["meta"]["countSucceeded"] == 6
+        assert final["settings"]["minimumUsableWords"] == 3  # defaults applied
+
+        for uid, label in posts:
+            st, got = _req(srv.port, "GET", f"/v1/objects/Post/{uid}")
+            beacon = got["properties"]["ofTopic"][0]["beacon"]
+            assert beacon.endswith(topic_ids[label]), (label, got["properties"])
+            addl = got.get("additional") or got.get("_additional") or {}
+        # classification metadata stamped (scope + classifiedFields)
+        st, got = _req(
+            srv.port, "GET",
+            f"/v1/objects/Post/{posts[0][0]}?include=classification")
+        meta = (got.get("additional") or {}).get("classification") or \
+               (got.get("_additional") or {}).get("classification")
+        if meta:
+            assert meta["scope"] == ["ofTopic"]
+
+        # validation: basedOnProperties required for the contextual type,
+        # must exist in the schema, and must be a text property
+        st, out = _req(srv.port, "POST", "/v1/classifications", {
+            "class": "Post", "classifyProperties": ["ofTopic"],
+            "type": "text2vec-contextionary-contextual"})
+        assert st == 422
+        st, out = _req(srv.port, "POST", "/v1/classifications", {
+            "class": "Post", "classifyProperties": ["ofTopic"],
+            "basedOnProperties": ["bdy"],  # typo
+            "type": "text2vec-contextionary-contextual"})
+        assert st == 422
+        st, out = _req(srv.port, "POST", "/v1/classifications", {
+            "class": "Post", "classifyProperties": ["ofTopic"],
+            "basedOnProperties": ["ofTopic"],  # not text
+            "type": "text2vec-contextionary-contextual"})
+        assert st == 422
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
 def test_classification_validation(served):
     app, srv = served
     st, out = _req(srv.port, "POST", "/v1/classifications", {"class": "Nope",

@@ -148,7 +148,7 @@ class App:
             self.backup_scheduler = BackupScheduler(self.db, self.schema, self.modules)
         from weaviate_tpu.usecases.classification import Classifier
 
-        self.classifier = Classifier(self.db, self.schema)
+        self.classifier = Classifier(self.db, self.schema, self.modules)
         self.cluster = self.cluster_node  # /v1/nodes aggregation source
         # disk-pressure failure detection (storagestate READONLY automation)
         from weaviate_tpu.monitoring.disk import DiskMonitor

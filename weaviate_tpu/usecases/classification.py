@@ -1,20 +1,26 @@
-"""Classification: kNN + zero-shot, as async jobs.
+"""Classification: kNN + zero-shot + contextual, as async jobs.
 
 Reference: usecases/classification/ — classifier_run_knn.go (kNN vote over
 a training set: objects that already carry the target property), zero-shot
-(assign the nearest object of the reference property's target class), run as
-background jobs polled via GET /v1/classifications/{id}
-(classifier.go Schedule + status persistence).
+(assign the nearest object of the reference property's target class),
+text2vec-contextionary-contextual (modules/text2vec-contextionary/
+classification/classifier_run_contextual.go: per-word scoring against the
+target set, TF-IDF + information-gain corpus selection, boosted-centroid
+vectorization, closest target wins), run as background jobs polled via
+GET /v1/classifications/{id} (classifier.go Schedule + status persistence).
 
 TPU-first restructuring: the reference classifies source-by-source, each
-doing its own vector search. Here the whole run is batched — all source
-vectors against the training matrix in chunked numpy/BLAS matmuls (and the
-per-source assignment is a vectorized argpartition + vote), the same
-batch-first shape as the query path.
+doing its own vector search (and, for contextual, one vectorizer round trip
+per word per item). Here the whole run is batched — all source vectors
+against the training matrix in chunked numpy/BLAS matmuls, and contextual
+word scoring is computed ONCE per vocabulary word per target set ([V, T]
+distance matrix) instead of per item.
 """
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 import uuid as uuidlib
@@ -30,9 +36,48 @@ STATUS_FAILED = "failed"
 
 TYPE_KNN = "knn"
 TYPE_ZEROSHOT = "zeroshot"
+TYPE_CONTEXTUAL = "text2vec-contextionary-contextual"
 
 _MAX_TRAINING = 100_000
 _CHUNK = 4096
+
+_WORD_RE = re.compile(r"[a-zA-Z]+")
+
+
+def _split_words(text: str) -> list[str]:
+    """Lowercased word split (splitter.go: letters only)."""
+    return [w.lower() for w in _WORD_RE.findall(text or "")]
+
+
+class TfIdf:
+    """Per-run TF-IDF over the source corpus (tf_idf.go): relative term
+    frequency per doc x log10(N / docs-containing-term)."""
+
+    def __init__(self, docs: list[str]):
+        self.n = len(docs)
+        self.doc_terms: list[dict[str, int]] = []
+        contained: dict[str, int] = {}
+        for d in docs:
+            counts: dict[str, int] = {}
+            for w in _split_words(d):
+                counts[w] = counts.get(w, 0) + 1
+            self.doc_terms.append(counts)
+            for w in counts:
+                contained[w] = contained.get(w, 0) + 1
+        self.idf = {
+            w: math.log10(self.n / c) if c else 0.0 for w, c in contained.items()
+        }
+
+    def top_terms(self, doc_index: int, percentile: int) -> set[str]:
+        """Terms in the top `percentile`% of this doc by tf-idf
+        (GetAllTerms + isInTfPercentile semantics)."""
+        counts = self.doc_terms[doc_index]
+        total = sum(counts.values()) or 1
+        scored = sorted(
+            ((c / total) * self.idf.get(w, 0.0), w) for w, c in counts.items()
+        )[::-1]
+        cutoff = max(1, int(len(scored) * percentile / 100))
+        return {w for _, w in scored[:cutoff]}
 
 
 class ClassificationError(ValueError):
@@ -40,9 +85,10 @@ class ClassificationError(ValueError):
 
 
 class Classifier:
-    def __init__(self, db, schema):
+    def __init__(self, db, schema, modules=None):
         self.db = db
         self.schema = schema
+        self.modules = modules  # vectorizer provider (contextual type)
         self._jobs: dict[str, dict] = {}
         self._lock = threading.Lock()
 
@@ -63,11 +109,41 @@ class Classifier:
             if cd.get_property(p) is None:
                 raise ClassificationError(f"classifyProperty {p!r} not in schema")
         ctype = body.get("type") or TYPE_KNN
-        if ctype not in (TYPE_KNN, TYPE_ZEROSHOT):
+        if ctype not in (TYPE_KNN, TYPE_ZEROSHOT, TYPE_CONTEXTUAL):
             raise ClassificationError(f"unknown classification type {ctype!r}")
         settings = body.get("settings") or {}
         k = int(settings.get("k", 3))
         filters = body.get("filters") or {}
+        if ctype == TYPE_CONTEXTUAL:
+            based_on = body.get("basedOnProperties") or []
+            if len(based_on) != 1:
+                # validation.go: contextual supports exactly one basedOn prop
+                raise ClassificationError(
+                    "contextual classification requires exactly one "
+                    "basedOnProperties entry")
+            bprop = cd.get_property(based_on[0])
+            if bprop is None:
+                raise ClassificationError(
+                    f"basedOnProperty {based_on[0]!r} not in schema")
+            from weaviate_tpu.entities.schema import DataType
+
+            pt = bprop.primitive_type()
+            if pt is None or pt.base not in (DataType.TEXT, DataType.STRING):
+                raise ClassificationError(
+                    f"basedOnProperty {based_on[0]!r} must be a text property")
+            if self.modules is None:
+                raise ClassificationError(
+                    "contextual classification requires a vectorizer module")
+            # ParamsContextual.SetDefaults (classifier_params.go:21)
+            settings = {
+                "minimumUsableWords": int(settings.get("minimumUsableWords", 3)),
+                "informationGainCutoffPercentile": int(
+                    settings.get("informationGainCutoffPercentile", 50)),
+                "informationGainMaximumBoost": int(
+                    settings.get("informationGainMaximumBoost", 3)),
+                "tfidfCutoffPercentile": int(
+                    settings.get("tfidfCutoffPercentile", 80)),
+            }
 
         job_id = str(uuidlib.uuid4())
         job = {
@@ -76,7 +152,7 @@ class Classifier:
             "classifyProperties": classify_props,
             "basedOnProperties": body.get("basedOnProperties") or [],
             "type": ctype,
-            "settings": {"k": k},
+            "settings": settings if ctype == TYPE_CONTEXTUAL else {"k": k},
             "status": STATUS_RUNNING,
             "meta": {"started": int(time.time() * 1000), "completed": 0,
                      "count": 0, "countSucceeded": 0, "countFailed": 0},
@@ -102,6 +178,8 @@ class Classifier:
         try:
             if ctype == TYPE_KNN:
                 counts = self._run_knn(class_name, classify_props, k, filters, job)
+            elif ctype == TYPE_CONTEXTUAL:
+                counts = self._run_contextual(class_name, classify_props, filters, job)
             else:
                 counts = self._run_zeroshot(class_name, classify_props, filters, job)
             with self._lock:
@@ -247,6 +325,133 @@ class Classifier:
                     succeeded += 1
                 except Exception:  # noqa: BLE001
                     pass
+        return total, succeeded
+
+    def _run_contextual(self, class_name, classify_props, filters, job) -> tuple[int, int]:
+        """text2vec-contextionary-contextual (classifier_run_contextual.go):
+        no training data — each source's basedOn text is reduced to its most
+        discriminative words (TF-IDF within the corpus x information gain
+        against the target set), the surviving words form a boosted centroid,
+        and the cosine-closest target object wins.
+
+        Batched: one vectorizer call for the whole run's vocabulary and one
+        [V, T] distance matrix per classify property (the reference pays a
+        vectorizer round trip per word per item)."""
+        idx = self.db.get_index(class_name)
+        cd = self.schema.get_class(class_name)
+        s = job["settings"]
+        based_on = job["basedOnProperties"][0]
+        source_flt = LocalFilter.from_dict(filters.get("sourceWhere"))
+        target_flt = LocalFilter.from_dict(filters.get("targetWhere"))
+
+        # targets per classify prop: every object of the ref's target class
+        targets_per_prop: dict[str, tuple[np.ndarray, list[str]]] = {}
+        for p in classify_props:
+            prop = cd.get_property(p)
+            if prop is None or prop.primitive_type() is not None:
+                raise ClassificationError(
+                    f"contextual classifyProperty {p!r} must be a reference property")
+            target_class = prop.data_type[0]
+            tidx = self.db.get_index(target_class)
+            if tidx is None:
+                raise ClassificationError(f"target class {target_class!r} not found")
+            vecs, beacons = [], []
+            for r in self._fetch(tidx, target_flt, _MAX_TRAINING):
+                if r.obj.vector is not None:
+                    v = np.asarray(r.obj.vector, np.float32)
+                    n = np.linalg.norm(v)
+                    vecs.append(v / n if n > 0 else v)
+                    beacons.append(f"weaviate://localhost/{target_class}/{r.obj.uuid}")
+            if not vecs:
+                raise ClassificationError(
+                    f"contextual: target class {target_class!r} has no vectors")
+            targets_per_prop[p] = (np.stack(vecs), beacons)
+
+        sources = [
+            r.obj for r in self._fetch(idx, source_flt, _MAX_TRAINING)
+            if self._prop_value_key(r.obj.properties.get(classify_props[0])) is None
+        ]
+        docs = [str(o.properties.get(based_on) or "") for o in sources]
+        tfidf = TfIdf(docs)
+
+        # run-wide vocabulary -> one vectorizer pass per TARGET class (word
+        # vectors must live in the target vectors' space; the source class
+        # may have no vectorizer at all) + one unit-row matrix each
+        vocab = sorted({w for d in docs for w in _split_words(d)})
+        if not vocab:
+            return len(sources), 0
+        vocab_pos = {w: i for i, w in enumerate(vocab)}
+        wv_by_class: dict[str, np.ndarray] = {}
+        wv_per_prop: dict[str, np.ndarray] = {}
+        for p in classify_props:
+            target_class = cd.get_property(p).data_type[0]
+            if target_class not in wv_by_class:
+                tcd = self.schema.get_class(target_class)
+                blocks = [
+                    np.asarray(self.modules.vectorize_texts(
+                        tcd, vocab[off : off + _CHUNK]), np.float32)
+                    for off in range(0, len(vocab), _CHUNK)
+                ]
+                wv = np.concatenate(blocks) if len(blocks) > 1 else blocks[0]
+                norms = np.linalg.norm(wv, axis=1, keepdims=True)
+                norms[norms == 0] = 1.0
+                wv_by_class[target_class] = wv / norms
+            wv_per_prop[p] = wv_by_class[target_class]
+
+        # per prop: [V, T] cosine distances -> per-word information gain
+        # (avg - min, scoreWord in classifier_run_contextual.go)
+        word_ig: dict[str, np.ndarray] = {}
+        for p, (tv, _) in targets_per_prop.items():
+            d = 1.0 - wv_per_prop[p] @ tv.T  # [V, T]
+            word_ig[p] = d.mean(axis=1) - d.min(axis=1)
+
+        ig_pctile = s["informationGainCutoffPercentile"]
+        tf_pctile = s["tfidfCutoffPercentile"]
+        max_boost = float(s["informationGainMaximumBoost"])
+        min_words = s["minimumUsableWords"]
+        total = succeeded = 0
+        for si, obj in enumerate(sources):
+            total += 1
+            words = _split_words(docs[si])
+            uniq = list(dict.fromkeys(words))
+            if not uniq:
+                continue
+            try:
+                props = {}
+                for p, (tv, beacons) in targets_per_prop.items():
+                    ig = word_ig[p]
+                    # rank the item's words by information gain (desc)
+                    ranked = sorted(
+                        uniq, key=lambda w: -float(ig[vocab_pos[w]]))
+                    ig_cut = max(1, int(len(ranked) * ig_pctile / 100))
+                    ig_top = set(ranked[:ig_cut])
+                    tf_top = tfidf.top_terms(si, tf_pctile)
+                    corpus = [w for w in words if w in ig_top and w in tf_top]
+                    if len(set(corpus)) < min_words:
+                        # getTopNWords parity: caps at the words that exist,
+                        # so a 1-word source still classifies from that word
+                        corpus = ranked[:min_words]
+                    # boost by IG rank (buildBoostedCorpus: 1 - log(i/cutoff),
+                    # capped), then weighted centroid of the corpus words
+                    boosts = {}
+                    for i, w in enumerate(ranked[:ig_cut]):
+                        b = 1.0 - math.log(i / ig_cut) if i > 0 else max_boost
+                        boosts[w] = min(b, max_boost)
+                    weights = np.asarray(
+                        [boosts.get(w, 1.0) for w in corpus], np.float32)
+                    pwv = wv_per_prop[p]
+                    cv = (weights[:, None] * pwv[[vocab_pos[w] for w in corpus]]
+                          ).sum(0) / weights.sum()
+                    n = np.linalg.norm(cv)
+                    cv = cv / n if n > 0 else cv
+                    dists = 1.0 - tv @ cv
+                    win = int(np.argmin(dists))
+                    props[p] = [{"beacon": beacons[win]}]
+                idx.merge_object(obj.uuid, props,
+                                 meta=self._class_meta(job, sorted(props)))
+                succeeded += 1
+            except Exception:  # noqa: BLE001 — per-object failure counted
+                pass
         return total, succeeded
 
     @staticmethod
