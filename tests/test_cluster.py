@@ -446,6 +446,50 @@ def test_late_joiner_syncs_schema(tmp_path):
         teardown_cluster(early)
 
 
+def test_gossip_cluster_auto_discovery(tmp_path):
+    """Gossip-backed ClusterNodes: each node joins with ONE seed address and
+    the full membership (names + dialable cluster-API addresses) propagates;
+    the late joiner can then sync schema from discovered peers."""
+    import time
+
+    names = ["node-0", "node-1", "node-2"]
+    nodes = [
+        ClusterNode(str(tmp_path / n), n, node_names=names,
+                    enable_gossip=True, gossip_interval=0.1)
+        for n in names
+    ]
+    try:
+        for n in nodes:
+            n.start()
+        seed = nodes[0].gossip.gossip_addr
+        for n in nodes[1:]:
+            n.join_gossip([seed])
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(sorted(n.cluster.all_names()) == names for n in nodes):
+                break
+            time.sleep(0.05)
+        assert all(sorted(n.cluster.all_names()) == names for n in nodes)
+        # discovered addresses are the real cluster-API endpoints
+        assert nodes[2].cluster.node_address("node-0") == nodes[0].advertise
+        nodes[0].schema.add_class(make_class(shards=3))
+        # new classes shard over the DISCOVERED membership (not just the
+        # static construction-time list), and every node derives the SAME
+        # ring (the coordinator persists its node assignment in the 2PC
+        # payload / shardingConfig)
+        st0 = nodes[0].schema.sharding_state("Dist")
+        owners = {st0.belongs_to_nodes(s)[0] for s in st0.all_physical_shards()}
+        assert owners == set(names)
+        st2 = nodes[2].schema.sharding_state("Dist")
+        assert all(st2.belongs_to_nodes(s) == st0.belongs_to_nodes(s)
+                   for s in st0.all_physical_shards())
+        # nodes_status aggregates over gossip-discovered members
+        statuses = nodes[1].nodes_status()
+        assert {s["name"] for s in statuses} == set(names)
+    finally:
+        teardown_cluster(nodes)
+
+
 def test_distributed_aggregation(cluster3):
     """Aggregate over a sharded class reaches REMOTE shards through the
     cluster API :aggregations endpoint (clusterapi indices.go analog) —

@@ -37,6 +37,95 @@ def make_db_with_data(tmp_path, metrics=None):
     return db, idx
 
 
+def _wait_until(pred, timeout=10.0, step=0.05):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_gossip_seed_join_propagates_cluster_wide():
+    """memberlist-style auto-discovery (state.go:38): a node that joins with
+    ONE seed address becomes visible to every member, and learns every
+    member itself, via epidemic table exchange."""
+    from weaviate_tpu.cluster.gossip import GossipTransport
+    from weaviate_tpu.cluster.membership import ClusterState
+
+    nodes = []
+    try:
+        for i in range(3):
+            st = ClusterState(local_name=f"g{i}")
+            g = GossipTransport(st, f"g{i}", f"127.0.0.1:9{i}00",
+                                interval=0.1, suspect_after=1.0, dead_after=3.0)
+            g.start()
+            nodes.append((st, g))
+        seed = nodes[0][1].gossip_addr
+        # every newcomer knows ONLY the seed
+        for _, g in nodes[1:]:
+            g.join([seed])
+        assert _wait_until(lambda: all(
+            sorted(st.all_names()) == ["g0", "g1", "g2"] for st, _ in nodes)), \
+            [st.all_names() for st, _ in nodes]
+        # piggybacked metadata: every node resolves every data address
+        for st, _ in nodes:
+            assert st.node_address("g2") == "127.0.0.1:9200"
+        assert all(st.cluster_health_score() == 0 for st, _ in nodes)
+    finally:
+        for st, g in nodes:
+            g.shutdown()
+            st.shutdown()
+
+
+def test_gossip_partition_detection_and_recovery():
+    """A partitioned node goes suspect -> not alive on the survivors (reads
+    fail over), and its advancing heartbeat revives it when it returns."""
+    from weaviate_tpu.cluster.gossip import GossipTransport
+    from weaviate_tpu.cluster.membership import ClusterState
+
+    nodes = []
+    try:
+        for i in range(3):
+            st = ClusterState(local_name=f"p{i}")
+            g = GossipTransport(st, f"p{i}", f"127.0.0.1:91{i}0",
+                                interval=0.1, suspect_after=0.6, dead_after=30.0)
+            g.start()
+            nodes.append((st, g))
+        for _, g in nodes[1:]:
+            g.join([nodes[0][1].gossip_addr])
+        assert _wait_until(lambda: all(
+            len(st.all_names()) == 3 for st, _ in nodes))
+        # partition p2: stop its gossip entirely (no heartbeats leave it)
+        nodes[2][1].shutdown()
+        assert _wait_until(
+            lambda: not nodes[0][0].is_alive("p2")
+            and not nodes[1][0].is_alive("p2")), "p2 never went suspect"
+        assert nodes[0][0].cluster_health_score() == 1
+        assert nodes[0][1].status("p2") in ("suspect", "dead")
+        # p0/p1 keep trusting each other across the partition
+        assert nodes[0][0].is_alive("p1") and nodes[1][0].is_alive("p0")
+
+        # p2 returns with a fresh transport on the SAME identity: its table
+        # restarts at hb=0, but its first merge learns the cluster's higher
+        # hb for itself... the new instance gossips its own entry, and the
+        # survivors revive it once its heartbeat advances past what they saw
+        st2 = nodes[2][0]
+        g2 = GossipTransport(st2, "p2", "127.0.0.1:9120",
+                             interval=0.1, suspect_after=0.6, dead_after=30.0)
+        g2.start()
+        g2.join([nodes[0][1].gossip_addr])
+        nodes[2] = (st2, g2)
+        assert _wait_until(lambda: nodes[0][0].is_alive("p2")
+                           and nodes[1][0].is_alive("p2")), "p2 never revived"
+    finally:
+        for st, g in nodes:
+            g.shutdown()
+            st.shutdown()
+
+
 def test_disk_pressure_flips_readonly(tmp_path, monkeypatch):
     db, idx = make_db_with_data(tmp_path)
     try:

@@ -94,8 +94,9 @@ class ClusterState:
                 if n.name != self.local_name and not n.alive
             )
 
-    def probe_once(self, timeout: float = 1.0) -> None:
-        """Ping every remote node's cluster API health endpoint."""
+    def probe_once(self, timeout: float = 1.0, exclude=None) -> None:
+        """Ping every remote node's cluster API health endpoint. `exclude`
+        (name -> bool) skips nodes another failure detector owns (gossip)."""
         import http.client
 
         from weaviate_tpu.cluster.httputil import Http
@@ -103,6 +104,8 @@ class ClusterState:
         http_client = Http(timeout)
         for name in self.all_names():
             if name == self.local_name:
+                continue
+            if exclude is not None and exclude(name):
                 continue
             host = self.node_address(name)
             if host is None:
@@ -114,14 +117,14 @@ class ClusterState:
                 ok = False
             self.mark(name, ok)
 
-    def start_probing(self) -> None:
+    def start_probing(self, exclude=None) -> None:
         if self._probe_thread is not None:
             return
 
         def loop():
             while not self._stop.wait(self.probe_interval):
                 try:
-                    self.probe_once()
+                    self.probe_once(exclude=exclude)
                 except Exception:  # noqa: BLE001 — the probe thread must survive
                     pass
 

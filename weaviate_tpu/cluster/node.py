@@ -40,9 +40,16 @@ class ClusterNode:
         default_vectorizer: str = "none",
         tolerate_node_failures: bool = False,
         store_opts=None,
+        enable_gossip: bool = False,
+        gossip_bind_host: str = "127.0.0.1",
+        gossip_bind_port: int = 0,
+        gossip_interval: float = 1.0,
     ):
         os.makedirs(data_path, exist_ok=True)
         self.node_name = node_name
+        self._gossip_opts = (enable_gossip, gossip_bind_host,
+                             gossip_bind_port, gossip_interval)
+        self.gossip = None
         self.node_names = node_names or [node_name]
         self.cluster = ClusterState(local_name=node_name)
         self.remote_index = RemoteIndex(self._resolve_shard)
@@ -63,6 +70,9 @@ class ClusterNode:
             node_names=self.node_names,
             tx=self.tx_manager,
             default_vectorizer=default_vectorizer,
+            # gossip clusters shard new classes over LIVE membership (the
+            # static node_names list only knows construction-time peers)
+            node_source=(self.cluster.all_names) if enable_gossip else None,
         )
         self.tx_participant = TxParticipant(self.schema)
         self.api = ClusterApi(
@@ -124,14 +134,34 @@ class ClusterNode:
     def start(self) -> None:
         self.server.start()
         self.cluster.register(self.node_name, self.advertise)
-        # liveness probing keeps is_alive()/_resolve_shard honest so reads
-        # fail over instead of timing out against a dead replica
-        self.cluster.start_probing()
+        enable, ghost, gport, ginterval = self._gossip_opts
+        if enable:
+            # gossip owns failure detection for its members: membership,
+            # metadata, and liveness ride the UDP heartbeat table
+            from weaviate_tpu.cluster.gossip import GossipTransport
+
+            self.gossip = GossipTransport(
+                self.cluster, self.node_name, self.advertise,
+                bind_host=ghost, bind_port=gport, interval=ginterval,
+                suspect_after=4 * ginterval, dead_after=12 * ginterval)
+            self.gossip.start()
+        # the probe loop still covers STATICALLY registered peers (mixed
+        # "name@host" + seed deployments) — gossip-managed names are skipped
+        # so the two detectors never fight over the same node
+        self.cluster.start_probing(
+            exclude=lambda name: self.gossip is not None
+            and self.gossip.status(name) is not None)
 
     def join(self, peers: dict[str, str]) -> None:
         """Register peer nodes (CLUSTER_JOIN analog): {name: host:port}."""
         for name, host in peers.items():
             self.cluster.register(name, host)
+
+    def join_gossip(self, seeds: list[str]) -> None:
+        """Seed-address join (memberlist Join analog): 'host:port' gossip
+        addresses; one reachable seed makes this node visible cluster-wide."""
+        if self.gossip is not None:
+            self.gossip.join(seeds)
 
     def sync_schema(self) -> int:
         """Startup cluster schema sync (startup_cluster_sync.go /
@@ -185,6 +215,8 @@ class ClusterNode:
 
     def shutdown(self) -> None:
         self.server.shutdown()
+        if self.gossip is not None:
+            self.gossip.shutdown()
         self.cluster.shutdown()
         self.replica_coord.shutdown()
         self.db.shutdown()

@@ -100,6 +100,7 @@ class AuthzConfig:
 @dataclass
 class ClusterConfig:
     hostname: str = ""
+    gossip: bool = False  # UDP gossip membership (seed nodes set this too)
     gossip_bind_port: int = 7946
     data_bind_port: int = 7947
     join: list[str] = field(default_factory=list)
@@ -222,6 +223,7 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.authz.readonly_users = _list(e, "AUTHORIZATION_ADMINLIST_READONLY_USERS")
 
     cfg.cluster.hostname = e.get("CLUSTER_HOSTNAME", "")
+    cfg.cluster.gossip = _bool(e, "CLUSTER_GOSSIP")
     cfg.cluster.gossip_bind_port = _int(e, "CLUSTER_GOSSIP_BIND_PORT", 7946)
     cfg.cluster.data_bind_port = _int(e, "CLUSTER_DATA_BIND_PORT", 7947)
     cfg.cluster.join = _list(e, "CLUSTER_JOIN")

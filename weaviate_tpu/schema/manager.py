@@ -50,12 +50,18 @@ class SchemaManager:
         node_names: Optional[list[str]] = None,
         tx=None,
         default_vectorizer: str = "none",
+        node_source=None,
     ):
         """`migrator` is the DB (db.DB implements the migrate surface:
-        add_class/drop_class/update_class/update_vector_config)."""
+        add_class/drop_class/update_class/update_vector_config).
+        `node_source` (callable -> list[str]) supplies LIVE membership for
+        new classes (gossip auto-discovery); the chosen assignment is
+        persisted into shardingConfig so restarts and late joiners keep the
+        exact ring regardless of current membership."""
         self.persist_path = persist_path
         self.migrator = migrator
         self.node_names = node_names or ["node-0"]
+        self.node_source = node_source
         self.tx = tx  # cluster.TxManager or None (single node)
         self.scaler = None  # usecases/scaler hook, set by cluster wiring
         self.default_vectorizer = default_vectorizer
@@ -108,14 +114,25 @@ class SchemaManager:
         except vi.ConfigValidationError as e:
             raise SchemaValidationError(str(e)) from e
 
+    def _current_nodes(self) -> list[str]:
+        if self.node_source is not None:
+            live = sorted(self.node_source())
+            if live:
+                return live
+        return self.node_names
+
     def _mk_sharding_state(self, cd: ClassDef) -> ShardingState:
-        cfg = ShardingConfig.from_dict(cd.sharding_config, len(self.node_names))
+        # a previously chosen node assignment (persisted, or shipped in the
+        # 2PC payload by the coordinator) is authoritative — every node must
+        # build the SAME ring even if its current membership view differs
+        names = (cd.sharding_config or {}).get("nodes") or self._current_nodes()
+        cfg = ShardingConfig.from_dict(cd.sharding_config, len(names))
         repl = (cd.replication_config or {}).get("factor")
         if repl:
             cfg.replicas = int(repl)
-        st = ShardingState(cd.name, cfg, self.node_names)
+        st = ShardingState(cd.name, cfg, names)
         self.sharding_states[cd.name] = st
-        cd.sharding_config = cfg.to_dict()
+        cd.sharding_config = {**cfg.to_dict(), "nodes": list(names)}
         return st
 
     def get_schema(self) -> Schema:
@@ -167,6 +184,14 @@ class SchemaManager:
                     raise SchemaValidationError(f"duplicate property {p.name!r}")
                 seen.add(low)
             vi_cfg = self._parse_vi_config(class_def)  # validates
+            # the COORDINATOR fixes the node assignment and ships it in the
+            # 2PC payload (and persists it) — remote views must not re-derive
+            # the ring from possibly-divergent membership
+            if not (class_def.sharding_config or {}).get("nodes"):
+                class_def.sharding_config = {
+                    **(class_def.sharding_config or {}),
+                    "nodes": self._current_nodes(),
+                }
             if self.tx is not None:
                 self.tx.broadcast_commit(TX_ADD_CLASS, {"class": class_def.to_dict()})
             self.apply_add_class(class_def, vi_cfg)

@@ -56,11 +56,17 @@ class App:
             from weaviate_tpu.cluster.node import ClusterNode
 
             node_name = cl_cfg.hostname or "node-0"
+            # "name@host:port" entries are a static registry; bare
+            # "host:port" entries are gossip SEEDS (memberlist-style
+            # auto-discovery: the rest of the cluster is learned over UDP)
             peers = {}
+            seeds = []
             for item in cl_cfg.join:
                 if "@" in item:
                     pname, phost = item.split("@", 1)
                     peers[pname] = phost
+                elif item.strip():
+                    seeds.append(item.strip())
             node_names = sorted(set(peers) | {node_name})
             self.cluster_node = ClusterNode(
                 path,
@@ -71,9 +77,13 @@ class App:
                 metrics=self.metrics,
                 default_vectorizer=self.config.default_vectorizer_module,
                 store_opts=self._store_opts(),
+                enable_gossip=bool(seeds) or cl_cfg.gossip,
+                gossip_bind_host="0.0.0.0",
+                gossip_bind_port=max(cl_cfg.gossip_bind_port, 0),
             )
             self.cluster_node.start()
             self.cluster_node.join(peers)
+            self.cluster_node.join_gossip(seeds)
             if not cl_cfg.ignore_schema_sync:
                 self.cluster_node.sync_schema()
             self.db = self.cluster_node.db

@@ -265,36 +265,45 @@ class Classifier:
                     pass
         return total, succeeded
 
+    def _collect_targets(self, cd, classify_props, flt, normalize: bool,
+                         kind: str) -> dict[str, tuple[np.ndarray, list[str]]]:
+        """Per classify (reference) property: every target-class object with
+        a vector -> ([T, D] matrix, beacons). Shared by zeroshot and
+        contextual (findTargetsForProps, classifier_prepare_contextual.go)."""
+        out: dict[str, tuple[np.ndarray, list[str]]] = {}
+        for p in classify_props:
+            prop = cd.get_property(p)
+            if prop is None or prop.primitive_type() is not None:
+                raise ClassificationError(
+                    f"{kind} classifyProperty {p!r} must be a reference property")
+            target_class = prop.data_type[0]
+            tidx = self.db.get_index(target_class)
+            if tidx is None:
+                raise ClassificationError(f"target class {target_class!r} not found")
+            vecs, beacons = [], []
+            for r in self._fetch(tidx, flt, _MAX_TRAINING):
+                if r.obj.vector is not None:
+                    v = np.asarray(r.obj.vector, np.float32)
+                    if normalize:
+                        n = np.linalg.norm(v)
+                        v = v / n if n > 0 else v
+                    vecs.append(v)
+                    beacons.append(
+                        f"weaviate://localhost/{target_class}/{r.obj.uuid}")
+            if not vecs:
+                raise ClassificationError(
+                    f"{kind}: target class {target_class!r} has no vectors")
+            out[p] = (np.stack(vecs), beacons)
+        return out
+
     def _run_zeroshot(self, class_name, classify_props, filters, job) -> tuple[int, int]:
         """Zero-shot: each classify property must be a reference; assign the
         vector-nearest object of the property's target class."""
         idx = self.db.get_index(class_name)
         cd = self.schema.get_class(class_name)
         source_flt = LocalFilter.from_dict(filters.get("sourceWhere"))
-
-        targets_per_prop: dict[str, tuple[np.ndarray, list[str]]] = {}
-        for p in classify_props:
-            prop = cd.get_property(p)
-            if prop is None or prop.primitive_type() is not None:
-                raise ClassificationError(
-                    f"zeroshot classifyProperty {p!r} must be a reference property"
-                )
-            target_class = prop.data_type[0]
-            tidx = self.db.get_index(target_class)
-            if tidx is None:
-                raise ClassificationError(f"target class {target_class!r} not found")
-            vecs, beacons = [], []
-            for r in self._fetch(tidx, None, _MAX_TRAINING):
-                if r.obj.vector is not None:
-                    vecs.append(np.asarray(r.obj.vector, np.float32))
-                    beacons.append(
-                        f"weaviate://localhost/{target_class}/{r.obj.uuid}"
-                    )
-            if not vecs:
-                raise ClassificationError(
-                    f"zeroshot: target class {target_class!r} has no vectors"
-                )
-            targets_per_prop[p] = (np.stack(vecs), beacons)
+        targets_per_prop = self._collect_targets(
+            cd, classify_props, None, normalize=False, kind="zeroshot")
 
         sources = [
             r.obj for r in self._fetch(idx, source_flt, _MAX_TRAINING)
@@ -343,29 +352,8 @@ class Classifier:
         based_on = job["basedOnProperties"][0]
         source_flt = LocalFilter.from_dict(filters.get("sourceWhere"))
         target_flt = LocalFilter.from_dict(filters.get("targetWhere"))
-
-        # targets per classify prop: every object of the ref's target class
-        targets_per_prop: dict[str, tuple[np.ndarray, list[str]]] = {}
-        for p in classify_props:
-            prop = cd.get_property(p)
-            if prop is None or prop.primitive_type() is not None:
-                raise ClassificationError(
-                    f"contextual classifyProperty {p!r} must be a reference property")
-            target_class = prop.data_type[0]
-            tidx = self.db.get_index(target_class)
-            if tidx is None:
-                raise ClassificationError(f"target class {target_class!r} not found")
-            vecs, beacons = [], []
-            for r in self._fetch(tidx, target_flt, _MAX_TRAINING):
-                if r.obj.vector is not None:
-                    v = np.asarray(r.obj.vector, np.float32)
-                    n = np.linalg.norm(v)
-                    vecs.append(v / n if n > 0 else v)
-                    beacons.append(f"weaviate://localhost/{target_class}/{r.obj.uuid}")
-            if not vecs:
-                raise ClassificationError(
-                    f"contextual: target class {target_class!r} has no vectors")
-            targets_per_prop[p] = (np.stack(vecs), beacons)
+        targets_per_prop = self._collect_targets(
+            cd, classify_props, target_flt, normalize=True, kind="contextual")
 
         sources = [
             r.obj for r in self._fetch(idx, source_flt, _MAX_TRAINING)
