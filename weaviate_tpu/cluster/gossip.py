@@ -80,6 +80,7 @@ class GossipTransport:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._seeds: list[str] = []
         state.register(local_name, data_host)
 
     # -- lifecycle -----------------------------------------------------------
@@ -100,7 +101,10 @@ class GossipTransport:
 
     def join(self, seeds: list[str]) -> None:
         """Contact seed gossip addresses ('host:port'); one reachable seed
-        is enough for cluster-wide visibility."""
+        is enough for cluster-wide visibility. Seeds are remembered and
+        re-contacted every tick while the table has no remote member — a
+        dropped JOIN datagram (UDP) must not isolate the node forever."""
+        self._seeds = list(seeds)
         for seed in seeds:
             self._send(seed, kind="join")
 
@@ -131,12 +135,14 @@ class GossipTransport:
             try:
                 msg = json.loads(data)
                 nodes = msg.get("nodes") or {}
-            except (ValueError, AttributeError):
-                continue
-            self._merge(nodes)
-            if msg.get("t") == "join" and msg.get("from"):
-                # push-pull: a joiner learns the whole table immediately
-                self._send(str(msg["from"]), kind="sync")
+                if not isinstance(nodes, dict):
+                    continue
+                self._merge(nodes)
+                if msg.get("t") == "join" and msg.get("from"):
+                    # push-pull: a joiner learns the whole table immediately
+                    self._send(str(msg["from"]), kind="sync")
+            except Exception:  # noqa: BLE001 — one bad datagram must not
+                continue      # kill the recv thread (one-packet DoS)
 
     def _merge(self, nodes: dict) -> None:
         now = time.monotonic()
@@ -154,16 +160,21 @@ class GossipTransport:
                 hb = int(entry.get("hb", 0))
                 cur = self._table.get(name)
                 if cur is None or hb > cur["hb"]:
-                    self._table[name] = {
+                    new = {
                         "host": str(entry.get("host", "")),
                         "gossip": str(entry.get("gossip", "")),
                         "hb": hb,
                     }
+                    self._table[name] = new
                     self._seen[name] = now
                     if cur is None:
-                        self.state.register(name, self._table[name]["host"])
+                        self.state.register(name, new["host"])
                         self._statuses[name] = "alive"
                         self.state.mark(name, True)
+                    elif cur.get("host") != new["host"]:
+                        # a member rescheduled onto a new data address:
+                        # ClusterState must resolve the CURRENT endpoint
+                        self.state.register(name, new["host"])
 
     # -- failure detection + dissemination ------------------------------------
 
@@ -214,6 +225,11 @@ class GossipTransport:
                 if n != self.local_name and e.get("gossip")
                 and self._statuses.get(n) == "dead"
             ]
+        if not peers and not dead and self._seeds:
+            # still alone: the initial JOIN datagram may have been lost —
+            # keep knocking on the seeds until someone answers
+            for seed in self._seeds:
+                self._send(seed, kind="join")
         for addr in random.sample(peers, min(self.fanout, len(peers))):
             self._send(addr)
         if dead and self._ticks % 5 == 0:

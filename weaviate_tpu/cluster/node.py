@@ -71,8 +71,13 @@ class ClusterNode:
             tx=self.tx_manager,
             default_vectorizer=default_vectorizer,
             # gossip clusters shard new classes over LIVE membership (the
-            # static node_names list only knows construction-time peers)
-            node_source=(self.cluster.all_names) if enable_gossip else None,
+            # static node_names list only knows construction-time peers);
+            # suspect/dead members are excluded — a class must not be rung
+            # onto a node the coordinator already knows is down
+            node_source=(lambda: [
+                n for n in self.cluster.all_names()
+                if self.cluster.is_alive(n)
+            ]) if enable_gossip else None,
         )
         self.tx_participant = TxParticipant(self.schema)
         self.api = ClusterApi(

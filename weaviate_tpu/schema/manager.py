@@ -124,8 +124,11 @@ class SchemaManager:
     def _mk_sharding_state(self, cd: ClassDef) -> ShardingState:
         # a previously chosen node assignment (persisted, or shipped in the
         # 2PC payload by the coordinator) is authoritative — every node must
-        # build the SAME ring even if its current membership view differs
-        names = (cd.sharding_config or {}).get("nodes") or self._current_nodes()
+        # build the SAME ring even if its current membership view differs.
+        # Legacy classes without one fall back to the STATIC node list, never
+        # live membership: _load() runs before gossip has converged, and a
+        # half-empty view would silently re-ring existing data
+        names = (cd.sharding_config or {}).get("nodes") or self.node_names
         cfg = ShardingConfig.from_dict(cd.sharding_config, len(names))
         repl = (cd.replication_config or {}).get("factor")
         if repl:
