@@ -162,9 +162,115 @@ def test_neartext_end_to_end(neartext_app):
     st, res2 = _req(srv.port, "POST", "/v1/graphql", {"query": q2})
     assert res2["data"]["Get"]["Doc"][0]["title"] == "sourdough bread baking"
 
+
+def test_module_extension_endpoints(neartext_app):
+    """/v1/modules/text2vec-local/* user-facing extensions (the reference's
+    text2vec-contextionary extensions/rest_user_facing.go + concepts/rest.go
+    surface): store a custom concept, then USE it — nearText with the new
+    concept must retrieve by the concept's definition."""
+    app, srv = neartext_app
+    # the fixture's Doc class may already hold the bread/quantum docs from
+    # the previous test — add one doc the custom concept should find
+    _req(srv.port, "POST", "/v1/schema", {
+        "class": "ExtDoc", "vectorizer": "text2vec-local",
+        "vectorIndexConfig": {"distance": "cosine"},
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "body", "dataType": ["text"]}],
+    })
+    payloads = [
+        {"class": "ExtDoc", "id": str(uuidlib.UUID(int=101)),
+         "properties": {"title": "element post",
+                        "body": "a naturally occurring element seen by programmers"}},
+        {"class": "ExtDoc", "id": str(uuidlib.UUID(int=102)),
+         "properties": {"title": "cooking post",
+                        "body": "flour water salt yeast oven"}},
+    ]
+    st, out = _req(srv.port, "POST", "/v1/batch/objects", {"objects": payloads})
+    assert st == 200 and all(o["result"]["status"] == "SUCCESS" for o in out)
+
+    # validation first: bad concept casing / missing definition / bad weight
+    st, _ = _req(srv.port, "POST", "/v1/modules/text2vec-local/extensions",
+                 {"concept": "FooBarium", "definition": "x", "weight": 1})
+    assert st == 422
+    st, _ = _req(srv.port, "POST", "/v1/modules/text2vec-local/extensions",
+                 {"concept": "foobarium", "weight": 1})
+    assert st == 422
+    st, _ = _req(srv.port, "POST", "/v1/modules/text2vec-local/extensions",
+                 {"concept": "foobarium", "definition": "x", "weight": 2})
+    assert st == 422
+    # a brand-new concept must be defined at weight 1
+    st, _ = _req(srv.port, "POST", "/v1/modules/text2vec-local/extensions",
+                 {"concept": "zzzconcept", "definition": "x", "weight": 0.5})
+    assert st == 400
+
+    st, ext = _req(srv.port, "POST", "/v1/modules/text2vec-local/extensions", {
+        "concept": "foobarium",
+        "definition": "a naturally occurring element seen by programmers",
+        "weight": 1,
+    })
+    assert st == 200 and ext["concept"] == "foobarium"
+    st, all_ext = _req(srv.port, "GET", "/v1/modules/text2vec-local/extensions")
+    assert st == 200 and any(
+        e["concept"] == "foobarium" for e in all_ext["extensions"])
+
+    # USE the concept: nearText ["foobarium"] ranks the definition-matching
+    # doc first even though no document contains the word itself
+    q = '{ Get { ExtDoc(nearText: {concepts: ["foobarium"]}, limit: 1) { title } } }'
+    st, res = _req(srv.port, "POST", "/v1/graphql", {"query": q})
+    assert st == 200, res
+    assert res["data"]["Get"]["ExtDoc"][0]["title"] == "element post", res
+
+    # concepts introspection
+    st, info = _req(srv.port, "GET", "/v1/modules/text2vec-local/concepts/foobarium")
+    assert st == 200
+    assert info["individualWords"][0]["word"] == "foobarium"
+    assert info["individualWords"][0]["info"]["custom"] is True
+
+    # unknown module / module without a REST surface
+    st, _ = _req(srv.port, "GET", "/v1/modules/nope/extensions")
+    assert st == 404
+    st, _ = _req(srv.port, "GET", "/v1/modules/text2vec-local/unknown")
+    assert st == 404
+
     # meta reports the module
     st, meta = _req(srv.port, "GET", "/v1/meta")
     assert "text2vec-local" in meta["modules"]
+
+
+def test_module_extensions_survive_restart(tmp_path):
+    """Extensions persist (the reference's extensions-storage role): a
+    restarted node keeps embedding the custom concept the way the already-
+    imported vectors saw it."""
+    from weaviate_tpu.config import Config
+
+    c = Config()
+    c.enable_modules = ["text2vec-local"]
+    c.persistence.data_path = str(tmp_path / "data")
+    app = App(config=c, data_path=str(tmp_path / "data"))
+    srv = RestServer(app, port=0)
+    srv.start()
+    st, _ = _req(srv.port, "POST", "/v1/modules/text2vec-local/extensions", {
+        "concept": "glorp", "definition": "distributed vector database",
+        "weight": 1})
+    assert st == 200
+    vec_before = app.modules.get("text2vec-local").vectorize_text(["glorp"])[0]
+    srv.stop()
+    app.shutdown()
+
+    c2 = Config()
+    c2.enable_modules = ["text2vec-local"]
+    c2.persistence.data_path = str(tmp_path / "data")
+    app2 = App(config=c2, data_path=str(tmp_path / "data"))
+    srv2 = RestServer(app2, port=0)
+    srv2.start()
+    try:
+        st, all_ext = _req(srv2.port, "GET", "/v1/modules/text2vec-local/extensions")
+        assert st == 200 and [e["concept"] for e in all_ext["extensions"]] == ["glorp"]
+        vec_after = app2.modules.get("text2vec-local").vectorize_text(["glorp"])[0]
+        np.testing.assert_array_equal(vec_before, vec_after)
+    finally:
+        srv2.stop()
+        app2.shutdown()
 
 
 def test_patch_revectorizes(neartext_app):

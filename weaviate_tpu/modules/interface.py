@@ -64,6 +64,18 @@ class GraphQLArguments(abc.ABC):
         return []
 
 
+class ModuleRest(abc.ABC):
+    """User-facing module REST extension surface served under
+    /v1/modules/<module-name>/... (the reference mounts each module's
+    RootHandler there, middlewares.go:66; e.g. text2vec-contextionary's
+    /extensions and /concepts/{concept} handlers)."""
+
+    @abc.abstractmethod
+    def handle_rest(self, method: str, path: str, body):
+        """method + subpath (no module prefix) + decoded JSON body (or
+        None) -> (status_code, payload dict)."""
+
+
 class TextTransformer(abc.ABC):
     """Query-text transformation — the autocorrect hook
     (modulecapabilities/texttransformer.go TextTransform)."""

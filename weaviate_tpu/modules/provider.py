@@ -267,6 +267,21 @@ class Provider:
 
     # -- backup backends -----------------------------------------------------
 
+    def handle_module_rest(self, module_name: str, method: str, path: str,
+                           body) -> tuple[int, dict]:
+        """Dispatch /v1/modules/<module-name>/<path> to the module's REST
+        surface (middlewares.go:66 mounts each module's RootHandler)."""
+        from weaviate_tpu.modules.interface import ModuleRest
+
+        mod = self.get(module_name)
+        if mod is None:
+            return 404, {"error": [{"message":
+                f"module {module_name!r} is not enabled"}]}
+        if not isinstance(mod, ModuleRest):
+            return 405, {"error": [{"message":
+                f"module {module_name!r} exposes no REST surface"}]}
+        return mod.handle_rest(method, path, body)
+
     def backup_backend(self, name: str) -> Optional[BackupBackend]:
         mod = self._modules.get(name) or self._modules.get(f"backup-{name}")
         if mod is not None and isinstance(mod, BackupBackend):
@@ -291,9 +306,15 @@ def build_provider(config) -> Optional[Provider]:
         if not name:
             continue
         if name in ("text2vec-local", "text2vec-hash"):
+            import os as _os
+
             from weaviate_tpu.modules.text2vec_local import LocalTextVectorizer
 
-            p.register(LocalTextVectorizer(name=name))
+            data_path = getattr(
+                getattr(config, "persistence", None), "data_path", "") or ""
+            p.register(LocalTextVectorizer(name=name, persist_path=(
+                _os.path.join(data_path, "modules", name, "extensions.json")
+                if data_path else None)))
         elif name == "text2vec-contextionary":
             from weaviate_tpu.modules.text2vec_contextionary import (
                 ContextionaryVectorizer,

@@ -18,23 +18,48 @@ fully reproducible across processes and platforms.
 from __future__ import annotations
 
 import hashlib
+import json
+import os
 import re
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
 
 from weaviate_tpu.modules.explain import SemanticExplainer
-from weaviate_tpu.modules.interface import GraphQLArguments, Module, Vectorizer
+from weaviate_tpu.modules.interface import (
+    GraphQLArguments,
+    Module,
+    ModuleRest,
+    Vectorizer,
+)
 from weaviate_tpu.modules.provider import corpus_from_object
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
+_CONCEPT_RE = re.compile(r"^[a-z0-9]+( [a-z0-9]+)*$")
 
 
-class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplainer):
-    def __init__(self, name: str = "text2vec-local", dim: int = 256):
+class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplainer,
+                          ModuleRest):
+    def __init__(self, name: str = "text2vec-local", dim: int = 256,
+                 persist_path: Optional[str] = None):
         self._name = name
         self.dim = dim
         self._cache: dict[str, np.ndarray] = {}
+        # custom concepts (C11yExtension): concept -> (blended vector, ext);
+        # definitions persist (extensions-storage role) so restarts keep
+        # embedding the concept the way already-imported vectors saw it
+        self._extensions: dict[str, tuple[np.ndarray, dict]] = {}
+        self._ext_lock = threading.Lock()
+        self._persist_path = persist_path
+        if persist_path and os.path.exists(persist_path):
+            try:
+                with open(persist_path) as f:
+                    for rec in json.load(f):
+                        vec = np.asarray(rec.pop("vector"), np.float32)
+                        self._extensions[rec["concept"]] = (vec, rec)
+            except (OSError, ValueError, KeyError):
+                pass  # corrupt extension file: serve without extensions
 
     @property
     def name(self) -> str:
@@ -53,6 +78,9 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplaine
     # -- embedding -----------------------------------------------------------
 
     def _token_vec(self, token: str) -> np.ndarray:
+        ext = self._extensions.get(token)
+        if ext is not None:
+            return ext[0]  # custom concept overrides the hash direction
         v = self._cache.get(token)
         if v is None:
             seed = int.from_bytes(
@@ -64,6 +92,9 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplaine
         return v
 
     def _embed(self, text: str) -> np.ndarray:
+        ext = self._extensions.get(text.strip().lower())
+        if ext is not None:
+            return ext[0]  # compound custom concepts match whole queries
         tokens = _TOKEN_RE.findall(text.lower())
         if not tokens:
             return np.zeros(self.dim, dtype=np.float32)
@@ -89,3 +120,94 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplaine
 
     def vectorize_input(self, class_def, obj, module_cfg: dict):
         return corpus_from_object(class_def, obj, module_cfg, self._name)
+
+    def _blend(self, concept: str, def_vec: np.ndarray,
+               weight: float) -> np.ndarray:
+        """weight=1 overrides entirely; otherwise blend with the concept's
+        PREVIOUS vector (only reachable for already-extended concepts — new
+        ones require weight=1)."""
+        if weight >= 1.0 or concept not in self._extensions:
+            return def_vec.astype(np.float32)
+        prev = self._extensions[concept][0]
+        vec = weight * def_vec + (1.0 - weight) * prev
+        n = np.linalg.norm(vec)
+        return (vec / n if n > 0 else vec).astype(np.float32)
+
+    def _save_extensions(self) -> None:
+        if not self._persist_path:
+            return
+        try:
+            os.makedirs(os.path.dirname(self._persist_path), exist_ok=True)
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "w") as f:
+                # the FINAL vector persists too: a weight<1 blend chain is
+                # not reconstructible from the latest definition alone
+                json.dump([{**e, "vector": v.tolist()}
+                           for v, e in self._extensions.values()], f)
+            os.replace(tmp, self._persist_path)
+        except OSError:
+            pass  # persistence is best-effort; the live table still serves
+
+    # -- /v1/modules/<name>/... (ModuleRest) ----------------------------------
+
+    def handle_rest(self, method: str, path: str, body):
+        """User-facing extension surface (the reference's
+        modules/text2vec-contextionary/extensions/rest_user_facing.go and
+        concepts/rest.go, served locally):
+
+        POST /extensions          {concept, definition, weight} -> stored;
+                                  the concept now embeds as `weight * def +
+                                  (1-weight) * hash-direction` and nearText /
+                                  vectorize-at-import pick it up immediately
+        GET  /extensions          all stored extensions
+        GET  /concepts/<concept>  word-presence info (C11yWordsResponse shape)
+        """
+        path = path.rstrip("/")
+        if path == "/extensions" and method == "POST":
+            if not isinstance(body, dict):
+                return 422, {"error": [{"message": "body must be a JSON object"}]}
+            concept = str(body.get("concept", "")).strip()
+            definition = str(body.get("definition", "")).strip()
+            try:
+                weight = float(body.get("weight", 1.0))
+            except (TypeError, ValueError):
+                return 422, {"error": [{"message": "weight must be a number"}]}
+            # validated as GIVEN: uppercase is rejected, not normalized
+            # (rest_user_facing.go: "must be an all-lowercase single word")
+            if not _CONCEPT_RE.match(concept):
+                return 422, {"error": [{"message":
+                    "concept must be an all-lowercase single word or "
+                    "space-delimited compound word"}]}
+            if not definition:
+                return 422, {"error": [{"message": "definition is required"}]}
+            if not 0.0 <= weight <= 1.0:
+                return 422, {"error": [{"message": "weight must be in [0, 1]"}]}
+            with self._ext_lock:
+                if concept not in self._extensions and weight < 1.0:
+                    # rest_user_facing.go semantics: a concept the module
+                    # does not know yet cannot blend with an existing one
+                    return 400, {"error": [{"message":
+                        "custom concepts require weight=1 on first definition"}]}
+                def_vec = self._embed(definition)
+                vec = self._blend(concept, def_vec, weight)
+                ext = {"concept": concept, "definition": definition,
+                       "weight": weight}
+                self._extensions[concept] = (vec, ext)
+                self._save_extensions()
+            return 200, ext
+        if path == "/extensions" and method == "GET":
+            with self._ext_lock:
+                return 200, {"extensions":
+                             [e for _, e in self._extensions.values()]}
+        if path.startswith("/concepts/") and method == "GET":
+            concept = path[len("/concepts/"):].strip().lower()
+            words = _TOKEN_RE.findall(concept) or [concept]
+            return 200, {"individualWords": [{
+                "word": w,
+                "present": True,  # hash embedding: every token has a vector
+                "info": {
+                    "custom": w in self._extensions,
+                    "nearestNeighbors": [],
+                },
+            } for w in words]}
+        return 404, {"error": [{"message": f"no module route {method} {path}"}]}

@@ -110,6 +110,13 @@ for _m, _p, _n in [
     ("GET", r"/v1/backups/(?P<backend>[^/]+)/(?P<id>[^/]+)/restore", "backup_restore_status"),
     ("POST", r"/v1/classifications", "classification_create"),
     ("GET", r"/v1/classifications/(?P<id>[^/]+)", "classification_get"),
+    # module REST extensions: /v1/modules/<module>/<module-defined subpath>
+    # (the reference mounts each module's RootHandler at this prefix,
+    # middlewares.go:66)
+    ("GET", r"/v1/modules/(?P<module>[^/]+)(?P<rest>/.*)", "module_rest"),
+    ("POST", r"/v1/modules/(?P<module>[^/]+)(?P<rest>/.*)", "module_rest"),
+    ("PUT", r"/v1/modules/(?P<module>[^/]+)(?P<rest>/.*)", "module_rest"),
+    ("DELETE", r"/v1/modules/(?P<module>[^/]+)(?P<rest>/.*)", "module_rest"),
 ]:
     ROUTES.add(_m, _p, _n)
 
@@ -496,6 +503,15 @@ class Handler(BaseHTTPRequestHandler):
         if st is None:
             raise NotFoundError(f"classification {id} not found")
         self._reply(200, st)
+
+    def h_module_rest(self, module, rest):
+        if self.app.modules is None:
+            self._reply(404, _err_body("no modules enabled"))
+            return
+        body = self._json_body() if self.command in ("POST", "PUT") else None
+        status, payload = self.app.modules.handle_module_rest(
+            module, self.command, rest, body)
+        self._reply(status, payload)
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
