@@ -220,11 +220,19 @@ def test_module_extension_endpoints(neartext_app):
     assert st == 200, res
     assert res["data"]["Get"]["ExtDoc"][0]["title"] == "element post", res
 
-    # concepts introspection
+    # concepts introspection, incl. a percent-encoded compound concept
     st, info = _req(srv.port, "GET", "/v1/modules/text2vec-local/concepts/foobarium")
     assert st == 200
     assert info["individualWords"][0]["word"] == "foobarium"
     assert info["individualWords"][0]["info"]["custom"] is True
+    st, _ = _req(srv.port, "POST", "/v1/modules/text2vec-local/extensions", {
+        "concept": "machine learning",
+        "definition": "statistical models trained from data", "weight": 1})
+    assert st == 200
+    st, info = _req(srv.port, "GET",
+                    "/v1/modules/text2vec-local/concepts/machine%20learning")
+    assert st == 200 and info["custom"] is True
+    assert [w["word"] for w in info["individualWords"]] == ["machine", "learning"]
 
     # unknown module / module without a REST surface
     st, _ = _req(srv.port, "GET", "/v1/modules/nope/extensions")

@@ -55,11 +55,14 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplaine
         if persist_path and os.path.exists(persist_path):
             try:
                 with open(persist_path) as f:
-                    for rec in json.load(f):
-                        vec = np.asarray(rec.pop("vector"), np.float32)
-                        self._extensions[rec["concept"]] = (vec, rec)
-            except (OSError, ValueError, KeyError):
-                pass  # corrupt extension file: serve without extensions
+                    records = json.load(f)
+                loaded = {}
+                for rec in records:  # any malformed shape lands in except
+                    vec = np.asarray(rec.pop("vector"), np.float32)
+                    loaded[rec["concept"]] = (vec, rec)
+                self._extensions = loaded  # all-or-nothing, never partial
+            except Exception:  # noqa: BLE001 — corrupt file must not stop
+                self._extensions = {}      # the server; serve without ext.
 
     @property
     def name(self) -> str:
@@ -156,8 +159,10 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplaine
         concepts/rest.go, served locally):
 
         POST /extensions          {concept, definition, weight} -> stored;
-                                  the concept now embeds as `weight * def +
-                                  (1-weight) * hash-direction` and nearText /
+                                  the concept now embeds as the definition
+                                  (weight=1) or as `weight * new_def +
+                                  (1-weight) * previous_extension_vector`
+                                  on re-definition; nearText and
                                   vectorize-at-import pick it up immediately
         GET  /extensions          all stored extensions
         GET  /concepts/<concept>  word-presence info (C11yWordsResponse shape)
@@ -200,14 +205,22 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplaine
                 return 200, {"extensions":
                              [e for _, e in self._extensions.values()]}
         if path.startswith("/concepts/") and method == "GET":
-            concept = path[len("/concepts/"):].strip().lower()
-            words = _TOKEN_RE.findall(concept) or [concept]
-            return 200, {"individualWords": [{
-                "word": w,
-                "present": True,  # hash embedding: every token has a vector
-                "info": {
-                    "custom": w in self._extensions,
-                    "nearestNeighbors": [],
-                },
-            } for w in words]}
+            from urllib.parse import unquote
+
+            concept = unquote(path[len("/concepts/"):]).strip().lower()
+            with self._ext_lock:
+                whole = concept in self._extensions  # compound custom concept
+                words = _TOKEN_RE.findall(concept) or [concept]
+                return 200, {
+                    "concept": concept,
+                    "custom": whole,
+                    "individualWords": [{
+                        "word": w,
+                        "present": True,  # hash embedding: every token embeds
+                        "info": {
+                            "custom": whole or w in self._extensions,
+                            "nearestNeighbors": [],
+                        },
+                    } for w in words],
+                }
         return 404, {"error": [{"message": f"no module route {method} {path}"}]}
