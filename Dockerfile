@@ -10,20 +10,17 @@
 # TPU VM build with:  --build-arg JAX_EXTRA="jax[tpu]" (pulls libtpu).
 
 ###############################################################################
-FROM python:3.12-slim AS build_base
+FROM python:3.12-slim AS server_builder
 RUN apt-get update && apt-get install -y --no-install-recommends \
         g++ make && rm -rf /var/lib/apt/lists/*
 WORKDIR /app
-ARG JAX_EXTRA="jax[cpu]"
-RUN pip install --no-cache-dir "${JAX_EXTRA}" numpy grpcio protobuf
-
-###############################################################################
-FROM build_base AS server_builder
 COPY native/ native/
 COPY weaviate_tpu/ weaviate_tpu/
 # compile the native engines (CPU HNSW graph, gRPC reply marshaller) into
-# weaviate_tpu/_native — the runtime never needs a compiler
-RUN sh native/build.sh
+# weaviate_tpu/_native — the runtime never needs a compiler. Portable
+# baseline ISA: the image must run on any x86-64-v2 host, not just the
+# build machine (-march=native would SIGILL elsewhere).
+RUN ARCH_FLAGS="-march=x86-64-v2" sh native/build.sh
 
 ###############################################################################
 FROM python:3.12-slim AS weaviate-tpu
@@ -40,7 +37,7 @@ ENV PERSISTENCE_DATA_PATH=/var/lib/weaviate \
     QUERY_DEFAULTS_LIMIT=25 \
     DEFAULT_VECTORIZER_MODULE=none \
     PYTHONUNBUFFERED=1
-EXPOSE 8080 50051 7946 7947
+EXPOSE 8080 50051 7946 7947 2112
 VOLUME /var/lib/weaviate
 HEALTHCHECK --interval=10s --timeout=3s --start-period=30s \
     CMD curl -sf http://localhost:8080/v1/.well-known/ready || exit 1

@@ -127,6 +127,45 @@ def test_gmin_disables_after_repeated_distinct_failures(tmp_path, monkeypatch):
     assert idx._gmin_broken and not idx._gmin_validated
 
 
+def test_vmem_tile_plan():
+    """plan_tiles keeps every shape under the 12 MB budget by shrinking the
+    store tile (then the query tile); fits_vmem refuses only when even the
+    smallest tiling is over (the round-2 relay wedge was a VMEM-oversized
+    kernel reaching Mosaic — this is the gate that prevents a repeat)."""
+    from weaviate_tpu.ops import gmin_scan as gs
+
+    # SIFT-shaped: full 512x512 tiles fit
+    qb, scg, fp = gs.plan_tiles(16384, 128, 65536, 16, 4)
+    assert (qb, scg) == (512, 512) and fp <= gs._VMEM_BUDGET
+    # d=768 with a full slab: the f32 store block alone (16*128*768*4 =
+    # 6.3 MB, double-buffered) is over budget even at the smallest tiling —
+    # the index must fall back to the legacy scan rather than compile it...
+    assert not gs.fits_vmem(4096, 768, 4096, 16, 4)
+    # ...but the bf16 rescore store (PQ serving) fits at a shrunk tile
+    qb2, scg2, fp2 = gs.plan_tiles(4096, 768, 4096, 16, 2)
+    assert scg2 < 512 and fp2 <= gs._VMEM_BUDGET
+    assert gs.fits_vmem(4096, 768, 4096, 16, 2)
+    # and a part-full slab (active_g=4) fits even at f32
+    assert gs.fits_vmem(4096, 768, 4096, 4, 4)
+    # absurdly wide vectors: refuse instead of compiling a wedge
+    assert not gs.fits_vmem(512, 65536, 1024, 16, 4)
+    # every plan is a power-of-two divisor of the padded dims
+    for d in (32, 128, 256, 512, 1024, 2048):
+        qb, scg, fp = gs.plan_tiles(1024, d, 1024, 16, 4)
+        assert 1024 % qb == 0 and 1024 % scg == 0
+        assert scg >= 128 and qb >= 64  # lane-width / sublane floors hold
+
+
+def test_gmin_wide_vectors_adaptive_tiles(tmp_path):
+    """d=768 forces a reduced store tile; the kernel must stay correct
+    (interpret mode) at the adapted tiling."""
+    idx, vecs, rng = _mk_index(tmp_path, vi.DISTANCE_L2, n=700, d=768)
+    q = vecs[:16] + 0.001 * rng.standard_normal((16, 768)).astype(np.float32)
+    ids, dists = idx.search_by_vectors(q, 5)
+    assert idx._gmin_validated and not idx._gmin_broken
+    np.testing.assert_array_equal(ids[:, 0], np.arange(16, dtype=np.uint64))
+
+
 def test_gmin_uneven_rescore_block(tmp_path):
     """b=3072 (a 1024-multiple bucket NOT divisible by the 2048 rescore
     block) exercises the ceil-split + pad path."""

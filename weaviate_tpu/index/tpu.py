@@ -977,9 +977,15 @@ class TpuVectorIndex(VectorIndex):
         from weaviate_tpu.ops import gmin_scan
 
         ncols = self.capacity // gmin_scan.G
+        active_g = -(-self.n // ncols)
+        sb = (store if store is not None else self._store).dtype.itemsize
+        if not gmin_scan.fits_vmem(q.shape[0], self.dim, ncols, active_g, sb):
+            # even the smallest tiling exceeds the VMEM budget (very wide
+            # vectors): never hand Mosaic a kernel that can wedge the chip
+            return None
         # capacity is part of the key: the compilation is parameterized by
         # the [capacity, D] store, so growth invalidates prior validation
-        key = (q.shape[0], kk, self._gmin_rg(kk), -(-self.n // ncols),
+        key = (q.shape[0], kk, self._gmin_rg(kk), active_g,
                self.capacity, allow_words is not None, store is not None)
         if key in self._gmin_shape_broken:
             return None

@@ -218,7 +218,9 @@ class LocalTextVectorizer(Module, Vectorizer, GraphQLArguments, SemanticExplaine
                         "word": w,
                         "present": True,  # hash embedding: every token embeds
                         "info": {
-                            "custom": whole or w in self._extensions,
+                            # per-WORD customness only; the top-level
+                            # "custom" field reports the compound concept
+                            "custom": w in self._extensions,
                             "nearestNeighbors": [],
                         },
                     } for w in words],

@@ -40,9 +40,43 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 G = 16          # group size (min columns per selected group)
-_SCG = 512      # group-columns per grid step (VMEM-sized)
-_QB = 512       # query rows per grid step
+_SCG = 512      # group-columns per grid step (VMEM upper bound; see plan_tiles)
+_QB = 512       # query rows per grid step (upper bound)
 _RESCORE_BLOCK = 2048  # query rows per rescore map step (bounds the gather)
+
+# per-core VMEM is 16 MB; budget conservatively (inputs are double-buffered
+# and Mosaic needs scratch) — exceeding this on a live chip has wedged the
+# TPU relay before, so the plan below is a hard gate, not a hint
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _tile_footprint(qb: int, scg: int, d: int, ag: int, store_bytes: int) -> int:
+    """Estimated VMEM bytes for one grid step: double-buffered input blocks
+    (query tile, [ag, scg, d] store slices, bias), double-buffered output,
+    plus bf16 compute copies and the f32 accumulator."""
+    inputs = qb * d * 4 + ag * scg * d * store_bytes + ag * scg * 4
+    outputs = qb * scg * 4
+    compute = qb * d * 2 + scg * d * 2 + qb * scg * 4
+    return 2 * inputs + 2 * outputs + compute
+
+
+def plan_tiles(b: int, d: int, ncols: int, ag: int,
+               store_bytes: int = 4) -> tuple[int, int, int]:
+    """-> (qb, scg, footprint_bytes): the largest power-of-two tile sizes
+    whose VMEM footprint fits the budget. Wide vectors (d >= ~512 at f32)
+    shrink the store tile first, then the query tile; callers must refuse
+    the kernel when even the smallest tiling is over budget."""
+    qb = min(_QB, b)
+    scg = min(_SCG, ncols)
+    while scg > 128 and _tile_footprint(qb, scg, d, ag, store_bytes) > _VMEM_BUDGET:
+        scg //= 2
+    while qb > 64 and _tile_footprint(qb, scg, d, ag, store_bytes) > _VMEM_BUDGET:
+        qb //= 2
+    return qb, scg, _tile_footprint(qb, scg, d, ag, store_bytes)
+
+
+def fits_vmem(b: int, d: int, ncols: int, ag: int, store_bytes: int = 4) -> bool:
+    return plan_tiles(b, d, ncols, ag, store_bytes)[2] <= _VMEM_BUDGET
 
 
 def _gmin_kernel(q_ref, s_ref, b_ref, o_ref, *, alpha: float, g: int):
@@ -74,8 +108,7 @@ def group_min_scores(q, store3, bias2, alpha: float, *, active_g: int = G,
     b, d = q.shape
     g, ncols, _ = store3.shape
     ag = max(1, min(int(active_g), g))
-    qb = min(_QB, b)
-    scg = min(_SCG, ncols)
+    qb, scg, _ = plan_tiles(b, d, ncols, ag, store3.dtype.itemsize)
     grid = (ncols // scg, b // qb)  # queries innermost: store tile loads once
     return pl.pallas_call(
         functools.partial(_gmin_kernel, alpha=alpha, g=ag),
