@@ -379,6 +379,37 @@ def test_pq_mesh_large_k_and_manhattan_guard(tmp_path, rng):
     assert ids[0][0] == 0
 
 
+def test_mesh_gmin_fused_kernel_matches_exact(tmp_path, rng):
+    """Slabs big enough for the fused group-min path (n_loc >= 16384):
+    results must match exact numpy, the kernel must actually engage, and
+    deletes + filters must hold (interpret mode on the CPU mesh)."""
+    from weaviate_tpu.storage.bitmap import Bitmap
+
+    config = parse_and_validate_config("hnsw_tpu_mesh", {"distance": "l2-squared"})
+    idx = MeshVectorIndex(config, str(tmp_path / "g"),
+                          initial_capacity_per_shard=16384)
+    n = 3000
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    for doc in range(0, 30, 2):
+        idx.delete(doc)
+    q = vecs[:16] + 0.001 * rng.standard_normal((16, DIM)).astype(np.float32)
+    ids, dists = idx.search_by_vectors(q, 5)
+    # the fused path was eligible AND actually served (validated shape)
+    assert not idx._gmin_broken and idx._gmin_validated
+    assert idx._gmin_plan(16, 5) is not None
+    live = np.array([d for d in range(n) if not (d < 30 and d % 2 == 0)])
+    dd = ((q[:, None, :] - vecs[live][None, :, :]) ** 2).sum(-1)
+    want = live[np.argsort(dd, axis=1)[:, :5]]
+    for i in range(16):
+        assert set(int(x) for x in ids[i]) == set(int(x) for x in want[i]), i
+    # filtered: allowList restricted to docs < 500
+    allow = Bitmap(np.arange(500).astype(np.uint64))
+    ids_f, _ = idx.search_by_vectors(q, 5, allow)
+    flat = ids_f[ids_f != np.uint64(0xFFFFFFFFFFFFFFFF)]
+    assert all(int(x) < 500 for x in flat)
+
+
 def test_pq_mesh_compact_keeps_f32_log(tmp_path, rng):
     """compact() under PQ rewrites the log from the f32 host copy, not the
     bf16-downcast device store."""

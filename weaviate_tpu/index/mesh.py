@@ -126,6 +126,9 @@ class MeshVectorIndex(VectorIndex):
         self._host_vecs = None      # np [cap, D] f32 (compressed mode only)
         self._pq_path = os.path.join(shard_path, "pq.npz") if shard_path else ""
         self._restoring = False
+        self._gmin_broken = False  # fused mesh kernel failed: use the scan
+        self._gmin_validated: set = set()     # shapes that served correctly
+        self._gmin_shape_broken: set = set()  # shapes Mosaic rejected
         self._log = (
             VectorLog(os.path.join(shard_path, "vector.log")) if persist else None
         )
@@ -584,26 +587,105 @@ class MeshVectorIndex(VectorIndex):
                 ids = np.where(rows >= 0, self._slot_to_doc[np.clip(rows, 0, None)], -1)
                 return ids.astype(np.uint64), top.astype(np.float32)
 
-            packed = np.asarray(
-                mesh_search_step(
-                    self._store,
-                    self._sq_norms,
-                    self._tombs,
-                    jnp.asarray(self._counts.astype(np.int32)),
-                    words,
-                    jnp.asarray(q),
-                    kk,
-                    self.metric,
-                    use_allow,
-                    self.metric == vi.DISTANCE_L2,
-                    getattr(self.config, "exact_topk", False),
-                    self.mesh,
+            packed = self._gmin_step_or_none(q, kk, words, use_allow)
+            if packed is None:
+                packed = np.asarray(
+                    mesh_search_step(
+                        self._store,
+                        self._sq_norms,
+                        self._tombs,
+                        jnp.asarray(self._counts.astype(np.int32)),
+                        words,
+                        jnp.asarray(q),
+                        kk,
+                        self.metric,
+                        use_allow,
+                        self.metric == vi.DISTANCE_L2,
+                        getattr(self.config, "exact_topk", False),
+                        self.mesh,
+                    )
                 )
-            )
             top, rows = unpack_topk(packed)
             top, rows = top[:b], rows[:b]
             ids = np.where(rows >= 0, self._slot_to_doc[np.clip(rows, 0, None)], -1)
             return ids.astype(np.uint64), top.astype(np.float32)
+
+    def _gmin_plan(self, b: int, kk: int):
+        """-> (rg, active_g) when the fused mesh kernel is eligible for this
+        shape (metric, slab size, VMEM budget), else None. Pure gate — no
+        kernel execution — so tests can assert eligibility directly."""
+        from weaviate_tpu.ops import gmin_scan
+
+        if self._gmin_broken or getattr(self.config, "exact_topk", False):
+            return None
+        if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+            return None
+        if self.n_loc < 16384 or b < 8:
+            return None
+        ncols_l = self.n_loc // gmin_scan.G
+        rg = min(max(32, 2 * kk), 128, ncols_l)
+        if rg < kk:
+            return None
+        active_g = max(1, -(-int(self._counts.max()) // ncols_l))
+        if not gmin_scan.fits_vmem(b, self.dim, ncols_l, active_g,
+                                   self._store.dtype.itemsize):
+            return None
+        return rg, active_g
+
+    def _gmin_step_or_none(self, q: np.ndarray, kk: int, words, use_allow):
+        """Run the fused group-min mesh kernel, or None for the legacy scan.
+        Validation mirrors tpu.py's _gmin_packed_or_none: per compiled
+        shape — a Mosaic rejection on a NEW shape falls back for that shape
+        only, a failure on a shape that already served propagates, and only
+        repeated distinct-shape failures with zero successes disable the
+        path."""
+        from weaviate_tpu.parallel.mesh_search import mesh_search_gmin_step
+
+        plan = self._gmin_plan(q.shape[0], kk)
+        if plan is None:
+            return None
+        rg, active_g = plan
+        key = (q.shape[0], kk, rg, active_g, self.n_loc, use_allow)
+        if key in self._gmin_shape_broken:
+            return None
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        try:
+            packed = mesh_search_gmin_step(
+                self._store,
+                self._sq_norms,
+                self._tombs,
+                jnp.asarray(self._counts.astype(np.int32)),
+                words,
+                jnp.asarray(q),
+                kk,
+                self.metric,
+                use_allow,
+                self.metric == vi.DISTANCE_L2,
+                rg,
+                active_g,
+                interpret,
+                self.mesh,
+            )
+            if key not in self._gmin_validated:
+                packed = np.asarray(packed)  # force device errors here
+        except Exception as e:  # noqa: BLE001 — see docstring
+            if key in self._gmin_validated:
+                raise
+            import logging
+
+            self._gmin_shape_broken.add(key)
+            if not self._gmin_validated and len(self._gmin_shape_broken) >= 3:
+                self._gmin_broken = True
+                logging.getLogger(__name__).warning(
+                    "mesh gmin kernel unavailable (%s: %s); using the scan "
+                    "kernel for this index", type(e).__name__, e)
+            else:
+                logging.getLogger(__name__).warning(
+                    "mesh gmin kernel rejected shape %s (%s: %s); using the "
+                    "scan kernel for this shape", key, type(e).__name__, e)
+            return None
+        self._gmin_validated.add(key)
+        return np.asarray(packed)
 
     def search_by_vector(
         self, vector: np.ndarray, k: int, allow_list: Optional[AllowList] = None

@@ -137,7 +137,19 @@ def search_gmin(store, sq_norms, tombs, n, q, allow_words, use_allow,
     allow_words: packed uint32 allowList bitmap over slots (ignored unless
     use_allow).
     """
-    from weaviate_tpu.ops.topk import bitmap_to_mask, pack_topk
+    from weaviate_tpu.ops.topk import pack_topk
+
+    top, idx = gmin_topk(store, sq_norms, tombs, n, q, allow_words, use_allow,
+                         k, metric, rg, active_g, interpret)
+    return pack_topk(top, idx)
+
+
+def gmin_topk(store, sq_norms, tombs, n, q, allow_words, use_allow,
+              k, metric, rg, active_g=G, interpret=False):
+    """search_gmin's traceable body -> ([B, k] dists, [B, k] slot idx, -1
+    for missing). Unjitted so it can run per-shard inside shard_map (the
+    mesh kernel) as well as under the single-chip jit wrapper."""
+    from weaviate_tpu.ops.topk import bitmap_to_mask
 
     cap, dim = store.shape
     ncols = cap // G
@@ -196,4 +208,4 @@ def search_gmin(store, sq_norms, tombs, n, q, allow_words, use_allow,
         top, idx = rescore_block((q, gidx))
 
     idx = jnp.where(jnp.isinf(top), -1, idx).astype(jnp.int32)
-    return pack_topk(top, idx)
+    return top, idx

@@ -43,6 +43,19 @@ SHARD_AXIS = "shard"
 _MESH_SCAN_CHUNK = 131072
 
 
+def _merge_across_shards(d_top, i_glob, k):
+    """Cross-chip merge inside a shard_fn: all_gather the per-chip (dist,
+    global-row) candidate sets over ICI, reselect k, pack. Shared by every
+    search kernel so the merge semantics cannot diverge."""
+    d_all = jax.lax.all_gather(d_top, SHARD_AXIS, axis=1, tiled=True)
+    i_all = jax.lax.all_gather(i_glob, SHARD_AXIS, axis=1, tiled=True)
+    neg, pos = jax.lax.top_k(-d_all, k)
+    d_fin = -neg
+    i_fin = jnp.take_along_axis(i_all, pos, axis=1)
+    i_fin = jnp.where(jnp.isinf(d_fin), -1, i_fin).astype(jnp.int32)
+    return pack_topk(d_fin, i_fin)
+
+
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()[: n_devices or len(jax.devices())]
@@ -128,14 +141,50 @@ def mesh_search_step(
             xs.append(allow_c)
         (d_top, i_top), _ = jax.lax.scan(step, init, tuple(xs))
         i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
-        # merge across chips over ICI: gather all candidate sets, reselect
-        d_all = jax.lax.all_gather(d_top, SHARD_AXIS, axis=1, tiled=True)  # [B, ndev*k]
-        i_all = jax.lax.all_gather(i_glob, SHARD_AXIS, axis=1, tiled=True)
-        neg, pos = jax.lax.top_k(-d_all, k)
-        d_fin = -neg
-        i_fin = jnp.take_along_axis(i_all, pos, axis=1)
-        i_fin = jnp.where(jnp.isinf(d_fin), -1, i_fin).astype(jnp.int32)
-        return pack_topk(d_fin, i_fin)
+        return _merge_across_shards(d_top, i_glob, k)
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
+            P(SHARD_AXIS), P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(store, sq_norms, tombs, n_per_shard, allow_words, queries)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "use_norms", "rg",
+                     "active_g", "interpret", "mesh"),
+)
+def mesh_search_gmin_step(
+    store, sq_norms, tombs, n_per_shard, allow_words, queries,
+    k, metric, use_allow, use_norms, rg, active_g, interpret, mesh,
+):
+    """Fused group-min kNN, mesh-sharded: each chip runs the SAME Pallas
+    fast-scan + exact-rescore the single-chip index uses
+    (ops/gmin_scan.gmin_topk) over its own HBM slab — distances never
+    round-trip through HBM — and the cross-chip merge all_gathers k
+    (dist, global-row) pairs over ICI and reselects, exactly like
+    mesh_search_step. Same argument layout as mesh_search_step plus the
+    gmin parameters (rg kept groups, active_g live slices per slab)."""
+    from weaviate_tpu.ops import gmin_scan
+
+    n_dev = mesh.devices.size
+    n_loc = store.shape[0] // n_dev
+
+    def shard_fn(store_l, norms_l, tombs_l, n_all, allow_l, q):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        n_mine = n_all[my]
+        norms = norms_l if use_norms else jnp.zeros_like(norms_l)
+        d_top, i_top = gmin_scan.gmin_topk(
+            store_l, norms, tombs_l, n_mine, q, allow_l, use_allow,
+            k, metric, rg, active_g, interpret)
+        i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
+        return _merge_across_shards(d_top, i_glob, k)
 
     return jax.shard_map(
         shard_fn,
@@ -240,13 +289,7 @@ def mesh_search_pq_step(
         d_top = -neg
         i_top = jnp.take_along_axis(cand_i, pos, axis=1)
         i_glob = jnp.where(jnp.isinf(d_top), -1, i_top + my * n_loc)
-        d_all = jax.lax.all_gather(d_top, SHARD_AXIS, axis=1, tiled=True)
-        i_all = jax.lax.all_gather(i_glob, SHARD_AXIS, axis=1, tiled=True)
-        neg, pos = jax.lax.top_k(-d_all, k)
-        d_fin = -neg
-        i_fin = jnp.take_along_axis(i_all, pos, axis=1)
-        i_fin = jnp.where(jnp.isinf(d_fin), -1, i_fin).astype(jnp.int32)
-        return pack_topk(d_fin, i_fin)
+        return _merge_across_shards(d_top, i_glob, k)
 
     return jax.shard_map(
         shard_fn,
