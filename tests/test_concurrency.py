@@ -173,3 +173,64 @@ def test_tpu_index_concurrent_add_search_compact(tmp_path):
     ids, _ = idx.search_by_vectors(
         np.zeros((1, DIM), np.float32), min(10, len(idx)))
     assert len(idx) >= 500
+
+
+def test_shard_async_search_races_writes(tmp_path):
+    """The async serving path (deferred hydration) racing batch writes and
+    deletes: finalize() must always hydrate a consistent snapshot — sorted
+    distances, no duplicate uuids within a row, no exceptions — while the
+    LSM and the device store churn underneath it."""
+    from weaviate_tpu.db.shard import Shard
+
+    cd = ClassDef(name="Race", properties=[
+        Property(name="t", data_type=["text"]),
+    ], vector_index_type="hnsw_tpu")
+    shard = Shard("shard-0", str(tmp_path / "race" / "shard-0"), cd,
+                  parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"}))
+    rng = np.random.default_rng(3)
+    base = [StorObj(class_name="Race", uuid=str(uuidlib.UUID(int=i + 1)),
+                    properties={"t": f"doc {i}"},
+                    vector=rng.standard_normal(DIM).astype(np.float32))
+            for i in range(400)]
+    shard.put_batch(base)
+    seq = iter(range(500_000, 10_000_000))
+    lock = threading.Lock()
+
+    def batch_writer():
+        with lock:
+            start = next(seq)
+        objs = [StorObj(class_name="Race", uuid=str(uuidlib.UUID(int=start * 100 + j)),
+                        properties={"t": f"doc {start} {j}"},
+                        vector=np.random.default_rng(start + j)
+                        .standard_normal(DIM).astype(np.float32))
+                for j in range(8)]
+        errs = shard.put_batch(objs)
+        assert all(e is None for e in errs)
+
+    def deleter():
+        with lock:
+            i = next(seq)
+        u = str(uuidlib.UUID(int=i + 1))
+        shard.put_object(StorObj(
+            class_name="Race", uuid=u, properties={"t": "x"},
+            vector=np.random.default_rng(i).standard_normal(DIM).astype(np.float32)))
+        shard.delete_object(u)
+
+    def async_searcher():
+        q = np.random.default_rng(7).standard_normal((8, DIM)).astype(np.float32)
+        done = shard.object_vector_search_async(q, 5)
+        rows = done()
+        assert len(rows) == 8
+        for res in rows:
+            ds = [r.distance for r in res]
+            assert ds == sorted(ds)
+            uuids = [r.obj.uuid for r in res]
+            assert len(set(uuids)) == len(uuids)
+
+    _run_all([batch_writer, deleter, async_searcher, async_searcher])
+    # post-race sanity: a fresh async search hydrates every winner
+    done = shard.object_vector_search_async(
+        np.stack([o.vector for o in base[:4]]), 3)
+    rows = done()
+    assert all(rows[i][0].obj.uuid == base[i].uuid for i in range(4))
+    shard.shutdown()

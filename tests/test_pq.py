@@ -270,6 +270,31 @@ def test_pq_hamming_rejected(tmp_path):
     assert ids.shape == (8, 3)
 
 
+def test_pq_async_dispatch_matches_sync(tmp_path, data):
+    """The async serving dispatch pipelines PQ-with-rescore (bf16 store
+    scan) instead of degrading to a blocking search; results match sync."""
+    cfg = _cfg(enabled=True, segments=8, centroids=64)
+    idx = TpuVectorIndex(cfg, str(tmp_path / "s"), persist=False)
+    idx.add_batch(np.arange(1000), data[:1000])
+    idx.flush()
+    assert idx.compressed
+    q = data[:32]
+    fin = idx.search_by_vectors_async(q, 5)
+    ids_a, d_a = fin()
+    ids_s, d_s = idx.search_by_vectors(q, 5)
+    np.testing.assert_array_equal(ids_a, ids_s)
+    np.testing.assert_allclose(d_a, d_s, rtol=1e-5)
+    # codes-only tier still answers (synchronously) through the same API
+    cfg2 = _cfg(enabled=True, segments=8, centroids=64, rescore=False)
+    idx2 = TpuVectorIndex(cfg2, str(tmp_path / "s2"), persist=False)
+    idx2.add_batch(np.arange(1000), data[:1000])
+    idx2.flush()
+    assert idx2.compressed and idx2._rescore_dev is None
+    fin2 = idx2.search_by_vectors_async(q, 5)
+    ids2, _ = fin2()
+    assert ids2.shape == (32, 5)
+
+
 def test_persisted_rejected_pq_serves_uncompressed(tmp_path, data):
     """A pq.npz this build refuses (e.g. a hamming codebook persisted by an
     older build) must not make the shard unloadable — restore logs a warning

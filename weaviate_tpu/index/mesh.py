@@ -641,16 +641,17 @@ class MeshVectorIndex(VectorIndex):
         path."""
         from weaviate_tpu.parallel.mesh_search import mesh_search_gmin_step
 
+        from weaviate_tpu.ops import gmin_scan
+
         plan = self._gmin_plan(q.shape[0], kk)
         if plan is None:
             return None
         rg, active_g = plan
         key = (q.shape[0], kk, rg, active_g, self.n_loc, use_allow)
-        if key in self._gmin_shape_broken:
-            return None
         interpret = jax.default_backend() not in ("tpu", "axon")
-        try:
-            packed = mesh_search_gmin_step(
+        packed = gmin_scan.guarded_kernel_call(
+            self, key,
+            lambda: mesh_search_gmin_step(
                 self._store,
                 self._sq_norms,
                 self._tombs,
@@ -665,27 +666,9 @@ class MeshVectorIndex(VectorIndex):
                 active_g,
                 interpret,
                 self.mesh,
-            )
-            if key not in self._gmin_validated:
-                packed = np.asarray(packed)  # force device errors here
-        except Exception as e:  # noqa: BLE001 — see docstring
-            if key in self._gmin_validated:
-                raise
-            import logging
-
-            self._gmin_shape_broken.add(key)
-            if not self._gmin_validated and len(self._gmin_shape_broken) >= 3:
-                self._gmin_broken = True
-                logging.getLogger(__name__).warning(
-                    "mesh gmin kernel unavailable (%s: %s); using the scan "
-                    "kernel for this index", type(e).__name__, e)
-            else:
-                logging.getLogger(__name__).warning(
-                    "mesh gmin kernel rejected shape %s (%s: %s); using the "
-                    "scan kernel for this shape", key, type(e).__name__, e)
-            return None
-        self._gmin_validated.add(key)
-        return np.asarray(packed)
+            ),
+            "mesh gmin kernel")
+        return None if packed is None else np.asarray(packed)
 
     def search_by_vector(
         self, vector: np.ndarray, k: int, allow_list: Optional[AllowList] = None

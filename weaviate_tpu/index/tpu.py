@@ -987,40 +987,10 @@ class TpuVectorIndex(VectorIndex):
         # the [capacity, D] store, so growth invalidates prior validation
         key = (q.shape[0], kk, self._gmin_rg(kk), active_g,
                self.capacity, allow_words is not None, store is not None)
-        if key in self._gmin_shape_broken:
-            return None
-        try:
-            packed = self._search_full_gmin(q, kk, allow_words, store, sq_norms)
-            if key not in self._gmin_validated:
-                # JAX defers device errors to materialization — the first
-                # call per shape blocks here so a runtime fault (not just a
-                # compile error) still lands in this except and falls back;
-                # once a shape is validated, its results stay unmaterialized
-                # for pipelining
-                packed = np.asarray(packed)
-        except Exception as e:  # noqa: BLE001 — see docstring
-            if key in self._gmin_validated:
-                raise
-            import logging
-
-            # remember this shape as over-budget and keep serving it on the
-            # legacy kernel; a failure must not be blamed on the whole path
-            # (after a restart the FIRST query may be the one oversized
-            # shape) — only repeated distinct-shape failures with zero
-            # successes mark the platform broken, capping compile retries
-            self._gmin_shape_broken.add(key)
-            if not self._gmin_validated and len(self._gmin_shape_broken) >= 3:
-                self._gmin_broken = True
-                logging.getLogger(__name__).warning(
-                    "fused gmin kernel unavailable (%s: %s); using lax.scan "
-                    "kernel for this index", type(e).__name__, e)
-            else:
-                logging.getLogger(__name__).warning(
-                    "fused gmin kernel rejected shape %s (%s: %s); using "
-                    "lax.scan kernel for this shape", key, type(e).__name__, e)
-            return None
-        self._gmin_validated.add(key)
-        return packed
+        return gmin_scan.guarded_kernel_call(
+            self, key,
+            lambda: self._search_full_gmin(q, kk, allow_words, store, sq_norms),
+            "fused gmin kernel")
 
     def _rescore_r(self, k: int) -> int:
         """Fast-scan candidate depth: 0 disables (exactTopK config or
@@ -1267,16 +1237,24 @@ class TpuVectorIndex(VectorIndex):
             if self.n == 0 or self.live == 0:
                 b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
                 return lambda: (np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32))
+            # PQ-with-rescore serves from the bf16 rescore store through the
+            # same scan kernels, so it pipelines like the uncompressed path;
+            # only the codes-only tier stays synchronous
+            store = sq = None
             if self.compressed:
-                ids, dists = self.search_by_vectors(vectors, k)
-                return lambda: (ids, dists)
+                if (self._rescore_dev is None
+                        or self.metric == vi.DISTANCE_HAMMING):
+                    ids, dists = self.search_by_vectors(vectors, k)
+                    return lambda: (ids, dists)
+                store, sq = self._rescore_dev, self._rescore_sq_norms
             q, b = self._prep_queries(vectors)
             kk = min(max(min(k, self.live), 1), self.n)
-            packed_dev = self._gmin_packed_or_none(q, kk, None)
+            packed_dev = self._gmin_packed_or_none(q, kk, None, store, sq)
             if packed_dev is None:
                 packed_dev = _search_full(
-                    self._store,
-                    self._sq_norms if self.metric == vi.DISTANCE_L2 else None,
+                    self._store if store is None else store,
+                    (self._sq_norms if sq is None else sq)
+                    if self.metric == vi.DISTANCE_L2 else None,
                     self._tombs,
                     self.n,
                     jnp.asarray(q),

@@ -79,6 +79,47 @@ def fits_vmem(b: int, d: int, ncols: int, ag: int, store_bytes: int = 4) -> bool
     return plan_tiles(b, d, ncols, ag, store_bytes)[2] <= _VMEM_BUDGET
 
 
+def guarded_kernel_call(index, key, thunk, kernel_desc: str):
+    """Per-compiled-shape validation state machine, shared by the
+    single-chip and mesh indexes so their fallback behavior cannot diverge.
+
+    `index` carries `_gmin_validated` / `_gmin_shape_broken` (shape-key
+    sets) and `_gmin_broken` (global flag). Policy: a failure on a NEW
+    shape falls back for that shape only (first call per shape
+    materializes, so runtime faults land here too); a failure on a shape
+    that already served propagates (a real device fault must not silently
+    halve throughput); three distinct pre-validation failures mark the
+    whole path broken. -> the thunk's value (device-resident once the
+    shape is validated, for pipelining), or None to use the fallback
+    kernel."""
+    import numpy as np
+
+    if key in index._gmin_shape_broken:
+        return None
+    try:
+        out = thunk()
+        if key not in index._gmin_validated:
+            out = np.asarray(out)
+    except Exception as e:  # noqa: BLE001 — see docstring
+        if key in index._gmin_validated:
+            raise
+        import logging
+
+        index._gmin_shape_broken.add(key)
+        if not index._gmin_validated and len(index._gmin_shape_broken) >= 3:
+            index._gmin_broken = True
+            logging.getLogger(__name__).warning(
+                "%s unavailable (%s: %s); using the fallback kernel for "
+                "this index", kernel_desc, type(e).__name__, e)
+        else:
+            logging.getLogger(__name__).warning(
+                "%s rejected shape %s (%s: %s); using the fallback kernel "
+                "for this shape", kernel_desc, key, type(e).__name__, e)
+        return None
+    index._gmin_validated.add(key)
+    return out
+
+
 def _gmin_kernel(q_ref, s_ref, b_ref, o_ref, *, alpha: float, g: int):
     """One (store-tile, query-tile) step: min over g strided sub-tiles of
     bias + alpha * (q @ store_g.T), accumulated in VMEM."""
