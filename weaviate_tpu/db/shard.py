@@ -374,16 +374,15 @@ class Shard:
     def objects_by_doc_ids(
         self, doc_ids: Sequence[int], include_vector: bool = False
     ) -> list[Optional[StorObj]]:
-        """Hydrate winners (storobj.ObjectsByDocID, storage_object.go:211)."""
-        out: list[Optional[StorObj]] = []
-        for d in doc_ids:
-            key = self.docid_lookup.get(struct.pack("<Q", int(d)))
-            if key is None:
-                out.append(None)
-                continue
-            raw = self.objects.get(key)
-            out.append(StorObj.from_binary(raw, include_vector) if raw is not None else None)
-        return out
+        """Hydrate winners (storobj.ObjectsByDocID, storage_object.go:211):
+        one multi-get per store (single lock acquisition each), lazy
+        decode — the same batched plane the vector path's _hydrate_batch
+        uses, shared by BM25 / listing / aggregation hydration."""
+        keys = self.docid_lookup.multi_get(
+            [struct.pack("<Q", int(d)) for d in doc_ids])
+        raws = self.objects.multi_get(keys)
+        return [StorObj.from_binary(r, include_vector) if r is not None else None
+                for r in raws]
 
     def build_allow_list(self, flt: Optional[LocalFilter]) -> Optional[Bitmap]:
         """filters -> allowList (shard_read.go:377 buildAllowList)."""
