@@ -22,7 +22,7 @@ import struct
 import threading
 import time
 import uuid as uuidlib
-from dataclasses import dataclass, field
+
 from typing import Optional, Sequence
 
 import numpy as np
@@ -47,18 +47,60 @@ class ShardReadOnlyError(RuntimeError):
     pass
 
 
-@dataclass(slots=True)
 class SearchResult:
     """One search hit: the object + additional result props
-    (the reference's search.Result / _additional map)."""
+    (the reference's search.Result / _additional map).
 
-    obj: StorObj
-    distance: Optional[float] = None
-    certainty: Optional[float] = None
-    score: Optional[float] = None
-    explain_score: Optional[str] = None
-    shard: str = ""
-    additional: dict = field(default_factory=dict)
+    `obj` materializes LAZILY from the raw storage image when the hit was
+    hydrated from disk: the gRPC fast path serializes thousands of winners
+    per batch straight from `raw_pristine()` and never needs a StorObj (or
+    even its field slots) built per result."""
+
+    __slots__ = ("_obj", "_raw", "_include_vector", "distance", "certainty",
+                 "score", "explain_score", "shard", "additional")
+
+    def __init__(self, obj: Optional[StorObj] = None,
+                 distance: Optional[float] = None,
+                 certainty: Optional[float] = None,
+                 score: Optional[float] = None,
+                 explain_score: Optional[str] = None,
+                 shard: str = "", additional: Optional[dict] = None,
+                 raw: Optional[bytes] = None, include_vector: bool = False):
+        if obj is None and raw is None:
+            # the old dataclass made obj required — keep construction-time
+            # failure at the buggy call site, not a NoneType blowup later
+            raise TypeError("SearchResult requires obj or raw")
+        self._obj = obj
+        self._raw = raw
+        self._include_vector = include_vector
+        self.distance = distance
+        self.certainty = certainty
+        self.score = score
+        self.explain_score = explain_score
+        self.shard = shard
+        self.additional = additional if additional is not None else {}
+
+    @property
+    def obj(self) -> StorObj:
+        if self._obj is None and self._raw is not None:
+            self._obj = StorObj.from_binary(self._raw, self._include_vector)
+        return self._obj
+
+    @obj.setter
+    def obj(self, value: StorObj) -> None:
+        self._obj = value
+        self._raw = None
+
+    def raw_pristine(self) -> Optional[bytes]:
+        """The hit's storage image when it is still byte-faithful: either
+        the object was never materialized, or it was and is unmutated."""
+        if self._obj is None:
+            return self._raw
+        return self._obj.raw_if_pristine()
+
+    def __repr__(self) -> str:
+        return (f"SearchResult(obj={self._obj!r}, distance={self.distance}, "
+                f"shard={self.shard!r})")
 
 
 def _uuid_bytes(u: str) -> bytes:
@@ -505,20 +547,18 @@ class Shard:
         ukeys = self.docid_lookup.multi_get(keys)
         raws = self.objects.multi_get(ukeys)
         name = self.name
-        from_binary = StorObj.from_binary
         out_all: list[list[SearchResult]] = []
         pos = 0
         for c in counts.tolist():
-            row: list[SearchResult] = []
-            for j in range(pos, pos + c):
-                raw = raws[j]
-                if raw is None:
-                    continue  # deleted between search and hydration
-                row.append(SearchResult(
-                    obj=from_binary(raw, include_vector),
-                    distance=flat_d[j], shard=name))
+            # raw images ride the SearchResult; StorObj materializes only if
+            # a consumer touches .obj (the gRPC fast path never does)
+            out_all.append([
+                SearchResult(raw=raws[j], include_vector=include_vector,
+                             distance=flat_d[j], shard=name)
+                for j in range(pos, pos + c)
+                if raws[j] is not None  # deleted between search + hydration
+            ])
             pos += c
-            out_all.append(row)
         return out_all
 
     def object_search(

@@ -36,7 +36,7 @@ def _collect_fast(results, req: pb.SearchRequest):
         return None
     raws, dists, certs = [], [], []
     for r in results:
-        raw = r.obj.raw_if_pristine()
+        raw = r.raw_pristine()
         if raw is None or r.score is not None or r.explain_score:
             return None
         raws.append(raw)
