@@ -379,6 +379,35 @@ def test_pq_mesh_large_k_and_manhattan_guard(tmp_path, rng):
     assert ids[0][0] == 0
 
 
+def test_mesh_bulk_replay_matches_prerestart(tmp_path, rng):
+    """A large (>256-record runs) mixed log — adds, deletes, re-adds,
+    in-run duplicates — restores onto the mesh with the exact pre-restart
+    state via the bulk replay path."""
+    config = parse_and_validate_config("hnsw_tpu_mesh", {"distance": "l2-squared"})
+    idx = MeshVectorIndex(config, str(tmp_path / "br"),
+                          initial_capacity_per_shard=1024)
+    n = 1500
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    idx.delete(*range(0, 50, 2))
+    idx.add_batch(np.arange(10), vecs[500:510])  # re-adds incl. deleted
+    dup_vecs = rng.standard_normal((3, DIM)).astype(np.float32)
+    idx.add_batch(np.array([7, 7, 7]), dup_vecs)
+    idx.flush()
+    live_ref = idx.live
+    ids_ref, d_ref = idx.search_by_vectors(vecs[100:116], 3)
+    idx.flush()
+    del idx
+
+    idx2 = MeshVectorIndex(config, str(tmp_path / "br"),
+                           initial_capacity_per_shard=1024)
+    assert idx2.live == live_ref
+    ids2, d2 = idx2.search_by_vectors(vecs[100:116], 3)
+    np.testing.assert_allclose(d2, d_ref, atol=1e-4)
+    ids7, d7 = idx2.search_by_vector(dup_vecs[2], 1)
+    assert ids7[0] == 7 and d7[0] < 1e-5
+
+
 def test_mesh_gmin_fused_kernel_matches_exact(tmp_path, rng):
     """Slabs big enough for the fused group-min path (n_loc >= 16384):
     results must match exact numpy, the kernel must actually engage, and

@@ -147,6 +147,11 @@ def test_bulk_replay_mixed_log_matches_prerestart(tmp_path, rng):
     # (a shared vector would make the keep-last check a top_k tie-break)
     dup_vecs = rng.standard_normal((3, 8)).astype(np.float32)
     idx.add_batch(np.array([7, 7, 7]), dup_vecs)
+    # a >=256-record run MIXING already-known docs (150..299: old slots must
+    # tombstone via the per-record path) with fresh ones (300..429: bulk) —
+    # exercises the known-filter and keep-mask slicing
+    readd_vecs = rng.standard_normal((280, 8)).astype(np.float32)
+    idx.add_batch(np.arange(150, 430), readd_vecs)
     idx.flush()
     live_ref = idx.live
     ids_ref, d_ref = idx.search_by_vectors(vecs[:16], 3)

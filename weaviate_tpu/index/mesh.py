@@ -144,11 +144,11 @@ class MeshVectorIndex(VectorIndex):
         recomputed at replay time, so the same log restores onto any mesh."""
         self._restoring = True
         try:
-            for op, doc_id, vec in VectorLog.replay(self._log.path):
+            for op, ids, vecs in VectorLog.replay_batches(self._log.path):
                 if op == "add":
-                    self._stage_add(doc_id, vec, log=False)
+                    self._bulk_stage_add(ids, vecs)
                 else:
-                    self._stage_delete(doc_id, log=False)
+                    self._stage_delete(int(ids), log=False)
             if self._pq_path and os.path.exists(self._pq_path):
                 from weaviate_tpu.compress.pq import ProductQuantizer
 
@@ -248,6 +248,39 @@ class MeshVectorIndex(VectorIndex):
         self.live += 1
         if log and self._log is not None:
             self._log.append_add(doc_id, vector)
+        if len(self._pending) >= _FLUSH_CHUNK:
+            self._flush_pending()
+
+    def _bulk_stage_add(self, ids: np.ndarray, vecs: np.ndarray) -> None:
+        """Restore-path bulk staging (single-chip twin in tpu.py): a run of
+        add records feeds the staging buffer in one dict update with
+        _stage_add's exact semantics; small/fragmented runs and docs the
+        index already knows take the per-record path."""
+        if len(ids) < 256:
+            for d, v in zip(ids.tolist(), vecs):
+                self._stage_add(int(d), v, log=False)
+            return
+        if self.dim is None:
+            self._init_device(int(np.asarray(vecs).shape[1]))
+        elif np.asarray(vecs).shape[1] != self.dim:
+            raise ValueError(
+                f"dim mismatch: index has {self.dim}, got {np.asarray(vecs).shape[1]}")
+        from weaviate_tpu.index.tpu import _prep_bulk_run
+
+        d2r = self._doc_to_row
+        ids64, vecs, known = _prep_bulk_run(
+            ids, vecs, self.metric,
+            lambda d: d in d2r or d in self._pending)
+        if known:
+            for i in known:
+                self._stage_add(int(ids64[i]), vecs[i], log=False)
+            keep = np.ones(len(ids64), bool)
+            keep[known] = False
+            ids64, vecs = ids64[keep], vecs[keep]
+            if len(ids64) == 0:
+                return
+        self._pending.update(zip(ids64.tolist(), vecs))
+        self.live += len(ids64)
         if len(self._pending) >= _FLUSH_CHUNK:
             self._flush_pending()
 

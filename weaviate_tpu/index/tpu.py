@@ -397,6 +397,27 @@ def _search_gathered(store, q, rows, row_valid, k, metric):
     return _pack(top, jnp.where(jnp.isinf(top), -1, idx).astype(jnp.int32))
 
 
+def _prep_bulk_run(ids: np.ndarray, vecs: np.ndarray, metric: str, known_fn):
+    """Shared restore-run preparation for the single-chip and mesh indexes:
+    f32 cast, cosine normalization, keep-last dedup of in-run duplicate
+    docs, and the indices of docs the index already knows (those must take
+    the per-record path so their old slots tombstone).
+    -> (ids int64 [n], vecs f32 [n, d], known_indices list)."""
+    vecs = np.asarray(vecs, np.float32)
+    if metric == vi.DISTANCE_COSINE:
+        nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
+        nrm[nrm == 0] = 1.0
+        vecs = vecs / nrm
+    ids64 = ids.astype(np.int64)
+    if len(np.unique(ids64)) != len(ids64):
+        # keep-last within the run (later records overwrite earlier)
+        _, last_rev = np.unique(ids64[::-1], return_index=True)
+        order = np.sort(len(ids64) - 1 - last_rev)
+        ids64, vecs = ids64[order], vecs[order]
+    known = [i for i, d in enumerate(ids64.tolist()) if known_fn(d)]
+    return ids64, vecs, known
+
+
 class VectorLog:
     """Append-only durability log for the device store (commit-log analog)."""
 
@@ -736,36 +757,26 @@ class TpuVectorIndex(VectorIndex):
             self._flush_pending()
 
     def _bulk_stage_add(self, ids: np.ndarray, vecs: np.ndarray) -> None:
-        """Restore-path bulk staging: a run of add records lands as ONE
-        chunked device write instead of per-record python staging, with
-        _stage_add's exact semantics (keep-last for duplicate docs in the
-        run, slow path for docs the index already knows so their old slots
-        tombstone correctly). Small runs (fragmented, delete-heavy logs)
-        stay on the staging buffer — a direct device write per tiny run
-        would cost a padded _CHUNK write each."""
+        """Restore-path bulk staging with _stage_add's exact semantics
+        (keep-last for duplicate docs in the run, per-record path for docs
+        the index already knows so their old slots tombstone correctly).
+        Tiny runs stay per-record; mid-size runs feed the staging buffer in
+        one dict update; only runs of at least a full device chunk
+        direct-write — a padded _CHUNK write per fragmented run would make
+        churned logs restore SLOWER than the per-record path."""
         if len(ids) < 256:
             for d, v in zip(ids.tolist(), vecs):
                 self._stage_add(int(d), v, log=False)
             return
-        vecs = np.asarray(vecs, np.float32)
-        if self.metric == vi.DISTANCE_COSINE:
-            nrm = np.linalg.norm(vecs, axis=1, keepdims=True)
-            nrm[nrm == 0] = 1.0
-            vecs = vecs / nrm
         if self.dim is None:
-            self._init_device(int(vecs.shape[1]))
-        elif vecs.shape[1] != self.dim:
+            self._init_device(int(np.asarray(vecs).shape[1]))
+        elif np.asarray(vecs).shape[1] != self.dim:
             raise ValueError(
-                f"dim mismatch: index has {self.dim}, got {vecs.shape[1]}")
-        ids64 = ids.astype(np.int64)
-        if len(np.unique(ids64)) != len(ids64):
-            # keep-last within the run (later records overwrite earlier)
-            _, last_rev = np.unique(ids64[::-1], return_index=True)
-            order = np.sort(len(ids64) - 1 - last_rev)
-            ids64, vecs = ids64[order], vecs[order]
+                f"dim mismatch: index has {self.dim}, got {np.asarray(vecs).shape[1]}")
         d2s = self._doc_to_slot
-        known = [i for i, d in enumerate(ids64.tolist())
-                 if d in d2s or d in self._pending]
+        ids64, vecs, known = _prep_bulk_run(
+            ids, vecs, self.metric,
+            lambda d: d in d2s or d in self._pending)
         if known:
             for i in known:
                 self._stage_add(int(ids64[i]), vecs[i], log=False)
@@ -774,6 +785,12 @@ class TpuVectorIndex(VectorIndex):
             ids64, vecs = ids64[keep], vecs[keep]
             if len(ids64) == 0:
                 return
+        if len(ids64) < _CHUNK:
+            self._pending.update(zip(ids64.tolist(), vecs))
+            self.live += len(ids64)
+            if len(self._pending) >= _CHUNK:
+                self._flush_pending()
+            return
         self._flush_pending()  # earlier staged singles keep their slots
         count = len(ids64)
         self._ensure_capacity(self.n + count)
