@@ -156,3 +156,36 @@ def test_nodes_and_errors(client):
     with pytest.raises(ClientError) as ei:
         client.schema.create_class({"class": "Book"})  # duplicate
     assert ei.value.status == 422
+
+
+def test_module_extensions_via_client(client):
+    """client.modules: store a custom concept, list it, introspect it, and
+    USE it through nearText — the full extensions journey client-side."""
+    ext = client.modules.create_extension(
+        "text2vec-local", "zanthor",
+        "a mythical creature that reviews pull requests")
+    assert ext["concept"] == "zanthor" and ext["weight"] == 1.0
+    assert any(e["concept"] == "zanthor"
+               for e in client.modules.get_extensions("text2vec-local"))
+    info = client.modules.get_concept("text2vec-local", "zanthor")
+    assert info["individualWords"][0]["info"]["custom"] is True
+
+    client.schema.create_class({
+        "class": "ExtClientDoc", "vectorizer": "text2vec-local",
+        "vectorIndexConfig": {"distance": "cosine"},
+        "properties": [{"name": "body", "dataType": ["text"]}]})
+    client.batch.create_objects([
+        {"class": "ExtClientDoc",
+         "properties": {"body": "a mythical creature reviewing pull requests"}},
+        {"class": "ExtClientDoc",
+         "properties": {"body": "sourdough starter hydration schedule"}},
+    ])
+    hits = (client.query.get("ExtClientDoc", ["body"])
+            .with_near_text({"concepts": ["zanthor"]}).with_limit(1).do())
+    assert "mythical" in hits[0]["body"]
+
+    # validation surfaces as ClientError
+    with pytest.raises(ClientError):
+        client.modules.create_extension("text2vec-local", "BadCase", "x")
+    with pytest.raises(ClientError):
+        client.modules.get_extensions("no-such-module")

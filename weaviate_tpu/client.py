@@ -360,6 +360,29 @@ class _ClusterApi:
         return self._t.expect("GET", "/v1/nodes")["nodes"]
 
 
+class _ModulesApi:
+    """User-facing module endpoints under /v1/modules/<module>/ (the
+    contextionary extensions surface)."""
+
+    def __init__(self, t: _Transport):
+        self._t = t
+
+    def create_extension(self, module: str, concept: str, definition: str,
+                         weight: float = 1.0) -> dict:
+        return self._t.expect(
+            "POST", f"/v1/modules/{module}/extensions",
+            {"concept": concept, "definition": definition, "weight": weight})
+
+    def get_extensions(self, module: str) -> list[dict]:
+        return self._t.expect(
+            "GET", f"/v1/modules/{module}/extensions")["extensions"]
+
+    def get_concept(self, module: str, concept: str) -> dict:
+        return self._t.expect(
+            "GET",
+            f"/v1/modules/{module}/concepts/{urllib.parse.quote(concept)}")
+
+
 class Client:
     def __init__(self, url: str = "http://localhost:8080",
                  api_key: Optional[str] = None,
@@ -372,6 +395,7 @@ class Client:
         self.backup = _BackupApi(self._t)
         self.classification = _ClassificationApi(self._t)
         self.cluster = _ClusterApi(self._t)
+        self.modules = _ModulesApi(self._t)
 
     def is_ready(self) -> bool:
         try:
