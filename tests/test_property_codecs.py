@@ -106,24 +106,26 @@ def test_vector_log_batch_parser_equals_scalar(n, dim, n_deletes,
     import shutil
     import tempfile
 
+    # the permutation draw can raise hypothesis control-flow exceptions, so
+    # EVERYTHING after mkdtemp sits under the cleanup finally
     rng = np.random.default_rng(n * 1000 + dim)
     tmpdir = tempfile.mkdtemp()
-    path = str(__import__("pathlib").Path(tmpdir) / "vector.log")
-    log = VectorLog(path)
-    ops = ["add"] * n + ["delete"] * n_deletes
-    order = data.draw(st.permutations(ops))
-    for i, op in enumerate(order):
-        if op == "add":
-            log.append_add(i, rng.standard_normal(dim).astype(np.float32))
-        else:
-            log.append_delete(i)
-    log.flush()
-    log.close()
-    if torn:
-        with open(path, "ab") as f:
-            f.write(bytes(range(torn))[:torn])
-
     try:
+        path = str(__import__("pathlib").Path(tmpdir) / "vector.log")
+        log = VectorLog(path)
+        ops = ["add"] * n + ["delete"] * n_deletes
+        order = data.draw(st.permutations(ops))
+        for i, op in enumerate(order):
+            if op == "add":
+                log.append_add(i, rng.standard_normal(dim).astype(np.float32))
+            else:
+                log.append_delete(i)
+        log.flush()
+        log.close()
+        if torn:
+            with open(path, "ab") as f:
+                f.write(bytes(range(torn))[:torn])
+
         scalar = list(VectorLog.replay(path))
         flat = [
             (op, int(i), None if vv is None else v.copy())
