@@ -1,0 +1,82 @@
+"""One-server sweep of every /v1 route group SURVEY.md names — a broad
+regression net proving the whole API surface answers (status codes only;
+the per-surface suites assert content)."""
+
+import json
+import tempfile
+import urllib.error
+import urllib.request
+import uuid as uuidlib
+
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.server import App, RestServer
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    c = Config()
+    c.enable_modules = ["text2vec-local", "backup-filesystem"]
+    c.backup_filesystem_path = str(tmp_path_factory.mktemp("backups"))
+    app = App(config=c, data_path=str(tmp_path_factory.mktemp("data")))
+    server = RestServer(app, port=0)
+    server.start()
+    yield server
+    server.stop()
+    app.shutdown()
+
+
+def _st(srv, method, path, body=None):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(r, timeout=15) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_every_route_group_answers(srv):
+    uid = str(uuidlib.UUID(int=1))
+    checks = [
+        # group, method, path, body, expected
+        ("GET", "/v1/meta", None, 200),
+        ("GET", "/v1/.well-known/ready", None, 200),
+        ("GET", "/v1/.well-known/live", None, 200),
+        ("GET", "/v1/.well-known/openid-configuration", None, 404),  # oidc off
+        ("POST", "/v1/schema", {"class": "Sweep", "vectorizer": "none",
+                                "vectorIndexConfig": {"distance": "l2-squared"},
+                                "properties": [{"name": "t", "dataType": ["text"]}]}, 200),
+        ("GET", "/v1/schema", None, 200),
+        ("GET", "/v1/schema/Sweep", None, 200),
+        ("GET", "/v1/schema/Sweep/shards", None, 200),
+        ("POST", "/v1/objects", {"class": "Sweep", "id": uid,
+                                 "properties": {"t": "x"}, "vector": [0.0] * 4}, 200),
+        ("GET", "/v1/objects", None, 200),
+        ("GET", f"/v1/objects/Sweep/{uid}", None, 200),
+        ("HEAD", f"/v1/objects/Sweep/{uid}", None, 204),
+        ("POST", "/v1/batch/objects", {"objects": []}, 200),
+        ("POST", "/v1/graphql",
+         {"query": "{ __schema { queryType { name } } }"}, 200),
+        ("POST", "/v1/graphql",
+         {"query": "{ Get { Sweep (limit: 1) { t } } }"}, 200),
+        ("GET", "/v1/nodes", None, 200),
+        ("POST", "/v1/classifications", {}, 422),
+        ("GET", f"/v1/classifications/{uuidlib.uuid4()}", None, 404),
+        ("POST", "/v1/backups/filesystem", {"id": "sweep1"}, 200),
+        ("GET", "/v1/backups/filesystem/sweep1", None, 200),
+        ("GET", "/v1/modules/text2vec-local/extensions", None, 200),
+        ("GET", "/v1/modules/nope/extensions", None, 404),
+        ("GET", "/metrics", None, 200),
+        ("GET", "/debug/pprof/goroutine", None, 200),
+        ("DELETE", f"/v1/objects/Sweep/{uid}", None, 204),
+        ("DELETE", "/v1/schema/Sweep", None, 200),
+    ]
+    failures = [
+        (m, p, got, want) for m, p, b, want in checks
+        if (got := _st(srv, m, p, b)) != want
+    ]
+    assert not failures, failures
