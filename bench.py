@@ -25,6 +25,16 @@ measured-hardware and scaled-CPU comparisons are visible.
 
 BENCH_MATRIX=1 additionally measures BASELINE.md configs 2-5 (cosine,
 filtered, PQ, gRPC 256-query batch latency) and writes bench_matrix.json.
+
+BENCH_BACKEND=cpu runs the CPU-backend artifact matrix instead: it forces
+JAX onto the host CPU (no relay probe) and reproduces the round-3
+serving/import/PQ claims as bench rows — full-stack import objs/s, gRPC
+256-query p50, PQ tier QPS (uncompressed / rescored / codes-only), and
+vector-log restart replay. Rows are labeled "backend": "cpu" and merged
+into bench_matrix.json WITHOUT touching the TPU-measured rows, which get a
+one-time {"backend": "tpu-v5e", "round": 2, "stale": ...} annotation. These
+are NOT TPU numbers; they exist so the host-path work is a reproducible
+artifact even when the TPU relay is unreachable.
 """
 
 import json
@@ -193,19 +203,53 @@ def _measure_sync(idx, queries, k, n_batches):
     return queries.shape[0] / med, med, ids
 
 
+def _pq_tier_rows(vecs, queries, gt, tiers=("rescored",), reps=4):
+    """Build a segments=32 PQ index, compress, and measure the requested
+    serving tiers -> {"fit_seconds", tier: {"qps", "recall@10"}, ...}.
+    Shared by the TPU matrix (config 4) and the CPU artifact matrix so both
+    measure the same thing."""
+    out = {}
+    idx_pq, _ = _build_index(
+        vecs, pq={"enabled": False, "segments": 32, "centroids": 256})
+    t0 = time.perf_counter()
+    idx_pq.compress()
+    out["fit_seconds"] = round(time.perf_counter() - t0, 1)
+    try:
+        for tier in tiers:
+            idx_pq.config.pq.rescore = tier == "rescored"
+            qps, _, ids = _measure_sync(idx_pq, queries, K, reps)
+            out[tier] = {
+                "qps": round(qps, 1),
+                "recall@10": round(recall_at_k(ids, gt, K), 4),
+            }
+    finally:
+        idx_pq.config.pq.rescore = True
+        idx_pq.drop()
+    return out
+
+
 def run_matrix(rng, vecs, queries, idx_l2, gt, headline=None):
     """BASELINE.md configs 2-5 (config 1 lands as the headline row, keyed by
     the dataset that was actually measured)."""
+    import jax
+
     from weaviate_tpu.storage.bitmap import Bitmap
 
+    plat = jax.devices()[0].platform
+    common = {
+        # axon is the relay platform name for the same v5e hardware the
+        # legacy rows were measured on — keep ONE backend vocabulary
+        "backend": "tpu-v5e" if plat in ("tpu", "axon") else plat,
+        "round": 4,
+        "date": time.strftime("%Y-%m-%d"),
+    }
     results = {}
     if headline:
         label = headline.pop("label")
-        results[label] = headline
+        results[label] = {**headline, **common}
 
     def flush():
-        with open(MATRIX_FILE, "w") as f:
-            json.dump(results, f, indent=1)
+        _merge_matrix({k: dict(v, **common) for k, v in results.items()})
 
     # config 3: filtered ANN (10% allowList -> masked device bitmap path)
     log("matrix: filtered ANN (10% allowList)...")
@@ -229,21 +273,13 @@ def run_matrix(rng, vecs, queries, idx_l2, gt, headline=None):
     }
     flush()
 
-    # config 4: PQ-compressed (segments=32, device LUT scan + f32 rescoring)
+    # config 4: PQ-compressed (segments=32, bf16 rescore-store scan)
     log("matrix: PQ (segments=32, rescored)...")
-    idx_pq, _ = _build_index(vecs, pq={"enabled": False, "segments": 32, "centroids": 256})
-    t0 = time.perf_counter()
-    idx_pq.compress()
-    fit_s = time.perf_counter() - t0
-    qps_pq, med_pq, ids_pq = _measure_sync(idx_pq, queries, K, 4)
+    pq_out = _pq_tier_rows(vecs, queries, gt)
     results["pq_seg32_rescored"] = {
-        "qps": round(qps_pq, 1),
-        "recall@10": round(recall_at_k(ids_pq, gt, K), 4),
-        "fit_seconds": round(fit_s, 1),
+        **pq_out["rescored"], "fit_seconds": pq_out["fit_seconds"],
     }
     flush()
-    idx_pq.drop()
-    del idx_pq
 
     # config 2: cosine — real glove-100-angular when available
     log("matrix: cosine (glove-100-angular)...")
@@ -353,7 +389,146 @@ def _grpc_e2e(rng, n=50_000):
         "qps_e2e": round(256 / p50, 1),
         "qps_concurrent8": round(conc_qps, 1), "complete_replies": ok,
         "import_seconds": round(import_s, 1),
+        "objs_per_s": round(n / import_s, 1),
     }
+
+
+def _merge_matrix(new_rows: dict) -> dict:
+    """Merge rows into bench_matrix.json, preserving TPU-measured history.
+
+    Legacy rows (written before per-row provenance existed) are annotated
+    once as round-2 TPU numbers that predate the round-3 rewrites; new rows
+    carry their own backend/round fields."""
+    data = {}
+    if os.path.exists(MATRIX_FILE):
+        with open(MATRIX_FILE) as f:
+            data = json.load(f)
+    for key, row in data.items():
+        if key == "_meta" or not isinstance(row, dict):
+            continue
+        if "backend" not in row:
+            row["backend"] = "tpu-v5e"
+            row["round"] = 2
+            row["stale"] = (
+                "predates the round-3 serving/import/PQ rewrites; regenerate "
+                "with BENCH_MATRIX=1 on hardware"
+            )
+    data.update(new_rows)
+    data["_meta"] = {
+        "provenance": "per-row: see each row's backend/round fields",
+        "rounds": sorted({r.get("round", 0) for k, r in data.items()
+                          if k != "_meta" and isinstance(r, dict)}),
+    }
+    with open(MATRIX_FILE, "w") as f:
+        json.dump(data, f, indent=1)
+    return data
+
+
+def run_cpu_matrix(rng):
+    """CPU-backend artifact run (VERDICT r3 item 2): reproduce the round-3
+    serving/import/PQ commit-message claims as bench rows that need no TPU.
+
+    Single-core host: the absolute QPS here is the XLA-CPU scan, which is
+    NOT the serving target — the value of these rows is (a) the host-path
+    costs (import, gRPC p50, replay) that are backend-independent, and
+    (b) the RELATIVE PQ tier ordering (rescored vs codes-only)."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    stamp = time.strftime("%Y-%m-%d")
+    common = {"backend": "cpu", "round": 4, "date": stamp,
+              "cores": os.cpu_count() or 1}
+    rows = {}
+
+    # -- row 1+2: full-stack import rate + gRPC 256-query batch p50 -------
+    log("cpu matrix: gRPC 256-batch e2e + full-stack import (n=50k)...")
+    g = _grpc_e2e(rng)
+    g.update(common)
+    g["provenance"] = (
+        "full-stack put_batch import (batched LSM + grouped postings, "
+        "commit 4f30882) and native-marshaller serving (commit bdac438), "
+        "measured over real gRPC on the CPU backend"
+    )
+    rows["grpc_batch256_cpu"] = g
+    _merge_matrix(rows)
+
+    # -- row 3: PQ tiers at n=200k ----------------------------------------
+    n_pq = int(os.environ.get("BENCH_CPU_PQ_N", 200_000))
+    b_pq = 256
+    log(f"cpu matrix: PQ tiers (n={n_pq}, batch={b_pq})...")
+    vecs = make_data(n_pq, DIM, rng)
+    queries = vecs[rng.integers(0, n_pq, b_pq)] + 0.05 * rng.standard_normal(
+        (b_pq, DIM), dtype=np.float32)
+    gt = exact_gt(vecs, queries[:128], K)
+
+    tiers = dict(common)
+    tiers["n"] = n_pq
+    tiers["batch"] = b_pq
+    idx, _ = _build_index(vecs)
+    qps_u, _, ids_u = _measure_sync(idx, queries, K, 3)
+    tiers["uncompressed"] = {
+        "qps": round(qps_u, 1),
+        "recall@10": round(recall_at_k(ids_u, gt, K), 4),
+    }
+    idx.drop()
+    del idx
+
+    tiers.update(_pq_tier_rows(
+        vecs, queries, gt, tiers=("rescored", "codes_only"), reps=3))
+    tiers["provenance"] = (
+        "PQ serving tiers (commit 00ac1d6: rescored tier scans the bf16 "
+        "rescore store via gmin; codes-only runs reconstruction-matmul ADC)"
+    )
+    rows["pq_tiers_cpu"] = tiers
+    _merge_matrix(rows)
+
+    # -- row 4: restart replay (vector-log bulk replay, commit 6d39c68) ---
+    n_r = 50_000
+    log(f"cpu matrix: restart replay (n={n_r})...")
+    from weaviate_tpu.entities import vectorindex as vi
+    from weaviate_tpu.index.tpu import TpuVectorIndex
+
+    rdir = tempfile.mkdtemp(prefix="benchreplay")
+    try:
+        cfg = vi.HnswUserConfig.from_dict({"distance": "l2-squared"}, "hnsw_tpu")
+        idx = TpuVectorIndex(cfg, rdir, persist=True)
+        rvecs = make_data(n_r, DIM, rng)
+        idx.add_batch(np.arange(n_r), rvecs)
+        idx.flush()
+        del idx
+        t0 = time.perf_counter()
+        idx2 = TpuVectorIndex(cfg, rdir, persist=True)
+        idx2.post_startup()
+        replay_s = time.perf_counter() - t0
+        assert idx2.live == n_r, f"replay lost rows: {idx2.live} != {n_r}"
+        del idx2
+    finally:
+        import shutil
+
+        shutil.rmtree(rdir, ignore_errors=True)
+    row = dict(common)
+    row.update({
+        "n": n_r,
+        "replay_seconds": round(replay_s, 2),
+        "vecs_per_s": round(n_r / replay_s, 1),
+        "provenance": (
+            "vector-log bulk replay (commits b7e608e, 6d39c68: vectorized "
+            "decode + bulk staged adds)"
+        ),
+    })
+    rows["restart_replay_cpu"] = row
+    data = _merge_matrix(rows)
+    log(f"wrote {MATRIX_FILE} ({len(data) - 1} rows)")
+    print(json.dumps({
+        "metric": "cpu-backend artifact matrix (backend: cpu — host-path "
+                  "claims, not TPU serving numbers)",
+        "value": rows["grpc_batch256_cpu"]["p50_ms"],
+        "unit": "ms p50 per 256-query gRPC batch",
+        "vs_baseline": 0,
+        "rows": sorted(rows.keys()),
+    }))
 
 
 def _probe_device(timeout_s: int = 180) -> None:
@@ -386,6 +561,9 @@ def main():
     rng = np.random.default_rng(7)
     if os.environ.get("BENCH_MEASURE_CPU"):
         measure_cpu_baseline(rng)
+        return
+    if os.environ.get("BENCH_BACKEND") == "cpu":
+        run_cpu_matrix(rng)
         return
 
     _probe_device()
