@@ -1,0 +1,237 @@
+"""Compound failure journeys: chained disaster scenarios in one test each.
+
+Two journeys the reference exercises across clusterintegrationtest and the
+commit-log corruption fixer (adapters/repos/db/clusterintegrationtest/,
+adapters/repos/db/vector/hnsw/corrupt_commit_logs_fixer.go), here chained
+end-to-end instead of per-subsystem:
+
+1. import -> backup -> node dies losing its disk (gossip marks it dead) ->
+   node returns empty -> restore from backup -> replicated QUORUM read with
+   read repair.
+2. crash with BOTH a torn LSM WAL tail and a torn vector-log tail ->
+   recovery serves the surviving prefix consistently -> post-recovery
+   writes survive another restart.
+"""
+
+import shutil
+import time
+import uuid as uuidlib
+
+import numpy as np
+
+from weaviate_tpu.cluster.node import ClusterNode
+from weaviate_tpu.db import DB
+from weaviate_tpu.entities.schema import ClassDef, Property
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+from weaviate_tpu.modules import Provider
+from weaviate_tpu.modules.backup_fs import FilesystemBackupBackend
+from weaviate_tpu.usecases.backup import BackupScheduler
+
+from tests.test_cluster import make_class, new_obj, teardown_cluster
+
+DIM = 8
+
+
+def _wait_until(pred, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _attach_backup(node, shared_root):
+    p = Provider()
+    p.register(FilesystemBackupBackend(shared_root))
+    sched = BackupScheduler(
+        node.db, node.schema, p, node_name=node.node_name,
+        cluster=node.cluster, node_client=node.transfer_client,
+    )
+    node.api.backup = sched
+    return sched
+
+
+def test_backup_node_loss_restore_quorum_journey(tmp_path):
+    """import -> backup -> kill node-2 AND wipe its disk (gossip marks it
+    dead) -> node-2 returns empty and is revived -> cluster-wide restore
+    from the backup -> diverge one replica -> QUORUM read repairs it."""
+    names = ["node-0", "node-1", "node-2"]
+    shared_root = str(tmp_path / "shared-backups")
+    nodes = [
+        ClusterNode(str(tmp_path / n), n, node_names=names,
+                    enable_gossip=True, gossip_interval=0.1)
+        for n in names
+    ]
+    try:
+        for n in nodes:
+            n.start()
+        seed = nodes[0].gossip.gossip_addr
+        for n in nodes[1:]:
+            n.join_gossip([seed])
+        assert _wait_until(lambda: all(
+            sorted(n.cluster.all_names()) == names for n in nodes))
+        for n in nodes:
+            _attach_backup(n, shared_root)
+
+        # 1. import: rf=3 so every shard lives on all three nodes and
+        # QUORUM (2/3) survives one node loss
+        nodes[0].schema.add_class(make_class(shards=2, replicas=3))
+        idx0 = nodes[0].db.get_index("Dist")
+        objs = [new_obj(i) for i in range(40)]
+        assert all(e is None for e in idx0.put_batch(objs))
+
+        # 2. backup while everyone is alive
+        sched0 = nodes[0].api.backup
+        sched0.backup("filesystem", {"id": "journey1"})
+        assert sched0.wait("journey1")["status"] == "SUCCESS"
+
+        # 3. disaster: node-2 dies and its data directory is lost
+        nodes[2].shutdown()
+        shutil.rmtree(str(tmp_path / "node-2"))
+        assert _wait_until(
+            lambda: not nodes[0].cluster.is_alive("node-2")
+            and not nodes[1].cluster.is_alive("node-2")), \
+            "gossip never marked the dead node"
+
+        # survivors still answer QUORUM reads (2 of 3 replicas)
+        got = nodes[0].db.get_index("Dist").object_by_uuid(
+            objs[7].uuid, cl="QUORUM")
+        assert got is not None and got.properties["wordCount"] == 7
+
+        # 4. node-2 returns on the same identity with an EMPTY disk,
+        # rejoins via gossip, and syncs the schema from the cluster
+        n2 = ClusterNode(str(tmp_path / "node-2"), "node-2", node_names=names,
+                         enable_gossip=True, gossip_interval=0.1)
+        n2.start()
+        n2.join_gossip([seed])
+        nodes[2] = n2
+        assert _wait_until(lambda: nodes[0].cluster.is_alive("node-2")
+                           and nodes[1].cluster.is_alive("node-2")), \
+            "returned node never revived"
+        _attach_backup(n2, shared_root)
+        # the returned node's disk is empty: adopt the cluster schema
+        # (startup_cluster_sync.go semantics)
+        if n2.schema.get_class("Dist") is None:
+            n2.sync_schema()
+        assert n2.schema.get_class("Dist") is not None, \
+            "returned node never adopted the cluster schema"
+
+        # 5. cluster-wide restore from the backup: drop the class, then
+        # restore brings every node's shards back (incl. the wiped node)
+        nodes[0].schema.delete_class("Dist")
+        for n in nodes:
+            assert n.db.get_index("Dist") is None
+        sched0.restore("filesystem", "journey1", {})
+        assert sched0.wait("journey1", restore=True)["status"] == "SUCCESS"
+        for n in nodes:
+            idx = n.db.get_index("Dist")
+            assert idx is not None
+            local = sum(s.object_count() for s in idx.shards.values())
+            assert local == 40  # rf=3: every node holds every object
+
+        # 6. replicated read at QUORUM with repair: one replica silently
+        # loses an object (data loss, not deletion), a QUORUM read detects
+        # the divergence and backfills it
+        obj = objs[11]
+        shard_name = nodes[0].db.get_index("Dist").shard_for(obj.uuid)
+        stale = nodes[1].db.get_index("Dist")._local_shard(shard_name)
+        assert stale is not None
+        stale.delete_object(obj.uuid)
+        stale._deleted.clear()
+        assert stale.object_by_uuid(obj.uuid) is None
+        got = nodes[1].db.get_index("Dist").object_by_uuid(obj.uuid, cl="QUORUM")
+        assert got is not None and got.properties["wordCount"] == 11
+        assert stale.object_by_uuid(obj.uuid) is not None  # repaired
+
+        # and the restored data actually serves vector search, cluster-wide
+        res = nodes[2].db.get_index("Dist").object_vector_search(
+            objs[5].vector, k=3)
+        assert res[0][0].obj.uuid == objs[5].uuid
+    finally:
+        teardown_cluster(nodes)
+
+
+def test_torn_wal_and_vector_log_crash_recovery(tmp_path):
+    """One crash tears BOTH durability logs: the LSM objects-bucket WAL gets
+    a half-written record appended AND the vector log loses bytes mid-record
+    plus gains a garbage tail. Recovery must serve the fully-written prefix
+    consistently (object store and vector index agree on it), and
+    post-recovery writes must survive a further clean restart."""
+    rng = np.random.default_rng(3)
+
+    def make_db(path):
+        db = DB(str(path))
+        if db.get_index("J") is None:
+            cd = ClassDef(
+                name="J",
+                properties=[Property(name="t", data_type=["text"]),
+                            Property(name="n", data_type=["int"])],
+                sharding_config={"desiredCount": 1},
+            )
+            db.add_class(cd, parse_and_validate_config(
+                "hnsw_tpu", {"distance": "l2-squared"}))
+        return db
+
+    vecs = rng.standard_normal((43, DIM)).astype(np.float32)
+
+    def obj(i):
+        return StorObj(class_name="J", uuid=str(uuidlib.UUID(int=i + 1)),
+                       properties={"t": f"x{i}", "n": i}, vector=vecs[i])
+
+    root = tmp_path / "data"
+    db = make_db(root)
+    idx = db.get_index("J")
+    # wave A: 40 objects, flushed -> must survive any tail corruption
+    assert all(e is None for e in idx.put_batch([obj(i) for i in range(40)]))
+    for s in idx.shards.values():
+        s.flush()
+    # wave B: 3 more objects land in the WAL/vector-log tails
+    assert all(e is None for e in idx.put_batch([obj(i) for i in range(40, 43)]))
+    shard_path = next(iter(idx.shards.values())).path
+    db.shutdown()
+
+    # the crash: tear both logs. The WAL gains a half-written record; the
+    # vector log loses the end of its last record AND gains a torn header.
+    wal = f"{shard_path}/lsm/objects/bucket.wal"
+    vlog = f"{shard_path}/vector.log"
+    with open(wal, "ab") as f:
+        f.write(b"\x07\x01\xff\xfe")
+    with open(vlog, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 5)
+    with open(vlog, "ab") as f:
+        f.write(b"\x01" + b"\x00" * 9)
+
+    # recovery: the shard must open and serve the surviving prefix
+    db2 = make_db(root)
+    idx2 = db2.get_index("J")
+    shard2 = next(iter(idx2.shards.values()))
+    for i in range(40):
+        got = shard2.object_by_uuid(obj(i).uuid)
+        assert got is not None and got.properties["n"] == i
+        ids, d = shard2.vector_index.search_by_vector(vecs[i], 1)
+        assert int(ids[0]) == got.doc_id and d[0] < 1e-5, i
+    # torn-tail writes may be partially lost, but reads must not crash and
+    # anything the object store kept must be intact
+    for i in range(40, 43):
+        got = shard2.object_by_uuid(obj(i).uuid)
+        if got is not None:
+            assert got.properties["n"] == i
+    assert shard2.object_count() >= 40
+
+    # post-recovery writes work and survive a clean restart
+    extra = StorObj(class_name="J", uuid=str(uuidlib.UUID(int=1000)),
+                    properties={"t": "post-crash", "n": 1000},
+                    vector=rng.standard_normal(DIM).astype(np.float32))
+    idx2.put_object(extra)
+    db2.shutdown()
+
+    db3 = make_db(root)
+    shard3 = next(iter(db3.get_index("J").shards.values()))
+    got = shard3.object_by_uuid(extra.uuid)
+    assert got is not None and got.properties["t"] == "post-crash"
+    ids, d = shard3.vector_index.search_by_vector(np.asarray(extra.vector), 1)
+    assert int(ids[0]) == got.doc_id and d[0] < 1e-5
+    db3.shutdown()

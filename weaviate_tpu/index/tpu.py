@@ -424,11 +424,59 @@ class VectorLog:
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        new = not os.path.exists(path)
+        fresh = True
+        if os.path.exists(path):
+            # a crash can leave a torn/corrupt tail. Replay stops at the
+            # first bad record, so anything appended AFTER that point would
+            # be durably written yet unreachable — silent data loss on the
+            # next restart. Truncate to the valid prefix before reusing the
+            # file (corrupt_commit_logs_fixer.go: corrupt tails are cut,
+            # never appended past).
+            size = os.path.getsize(path)
+            valid = self._valid_prefix_len(path)
+            if valid < size:
+                with open(path, "r+b") as f:
+                    f.truncate(valid)
+            fresh = valid == 0
         self._f = open(path, "ab")
-        if new:
+        if fresh:
             self._f.write(_LOG_MAGIC + struct.pack("<H", _LOG_VERSION))
             self._f.flush()
+
+    @staticmethod
+    def _valid_prefix_len(path: str) -> int:
+        """Byte length of the longest parseable record prefix — the exact
+        point replay()/replay_batches() would stop at. 0 means the header
+        itself is unusable (the file must be re-initialized). Scans record
+        HEADERS only (seek past payloads), so a multi-GB log costs one
+        sequential header walk, not a whole-file read."""
+        with open(path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            head = f.read(6)
+            if len(head) < 6 or head[:4] != _LOG_MAGIC:
+                return 0
+            off = 6
+            while off < size:
+                f.seek(off)
+                hdr = f.read(13)
+                if not hdr:
+                    return off
+                op = hdr[0]
+                if op == _LOG_ADD:
+                    if len(hdr) < 13:
+                        return off
+                    (dim,) = struct.unpack_from("<I", hdr, 9)
+                    end = off + 13 + 4 * dim
+                    if end > size:
+                        return off
+                    off = end
+                elif op == _LOG_DELETE:
+                    if len(hdr) < 9:
+                        return off
+                    off += 9
+                else:
+                    return off
+            return off
 
     def append_add(self, doc_id: int, vector: np.ndarray) -> None:
         v = np.ascontiguousarray(vector, dtype=np.float32)
