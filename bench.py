@@ -352,6 +352,11 @@ def _grpc_e2e(rng, n=50_000):
     for s in range(0, n, 10_000):
         idx.put_batch(objs[s : s + 10_000])
     import_s = time.perf_counter() - t0
+    # serving steady state: memtables flushed to segments (idle flush would
+    # do this) — the zero-object raw lane requires it for exactness
+    for sh in idx.shards.values():
+        sh.objects.flush_memtable()
+        sh.docid_lookup.flush_memtable()
     srv = GrpcServer(app, port=0)
     srv.start()
     client = SearchClient(f"127.0.0.1:{srv.port}")
@@ -362,6 +367,9 @@ def _grpc_e2e(rng, n=50_000):
         for q in qs
     ])
     client.batch_search(req)  # warm
+    from weaviate_tpu.server.grpc_server import SearchServicer
+
+    raw_lane = SearchServicer(app)._raw_batch_lane(req, 0.0) is not None
     lats = []
     for _ in range(7):
         t0 = time.perf_counter()
@@ -390,6 +398,7 @@ def _grpc_e2e(rng, n=50_000):
         "qps_concurrent8": round(conc_qps, 1), "complete_replies": ok,
         "import_seconds": round(import_s, 1),
         "objs_per_s": round(n / import_s, 1),
+        "raw_lane": raw_lane,
     }
 
 
@@ -447,8 +456,9 @@ def run_cpu_matrix(rng):
     g = _grpc_e2e(rng)
     g.update(common)
     g["provenance"] = (
-        "full-stack put_batch import (batched LSM + grouped postings, "
-        "commit 4f30882) and native-marshaller serving (commit bdac438), "
+        "full-stack put_batch import (batched LSM + grouped postings) and "
+        "the round-4 zero-object raw serving lane (native point-get plane "
+        "-> packed native reply marshaller; raw_lane flags engagement), "
         "measured over real gRPC on the CPU backend"
     )
     rows["grpc_batch256_cpu"] = g

@@ -12,3 +12,5 @@ g++ -O3 $ARCH_FLAGS -std=c++17 -fopenmp -shared -fPIC -o "$OUT_DIR/libhnsw.so" h
 echo "built $OUT_DIR/libhnsw.so"
 g++ -O3 $ARCH_FLAGS -std=c++17 -shared -fPIC -o "$OUT_DIR/libreply.so" reply.cpp
 echo "built $OUT_DIR/libreply.so"
+g++ -O3 $ARCH_FLAGS -std=c++17 -shared -fPIC -o "$OUT_DIR/liblsmget.so" lsm_get.cpp
+echo "built $OUT_DIR/liblsmget.so"

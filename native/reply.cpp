@@ -187,4 +187,52 @@ int64_t build_batch_reply(const uint8_t* const* raws, const int64_t* raw_lens,
     return p - out;
 }
 
+// Packed twin of build_batch_reply for the raw serving lane: the object
+// images live in ONE arena (buf) at offs[i]..offs[i+1] — the layout the
+// native LSM point-get plane (lsm_get.cpp) produces — so no per-result
+// pointer arrays or Python bytes objects exist at all. flags[i] == 0 marks
+// a missing hit (deleted between search and hydration): it is DROPPED from
+// its reply. dists are float32 per flat slot; counts[ri] counts SLOTS
+// (missing included). No certainty: the raw lane never computes one.
+int64_t build_batch_reply_packed(const uint8_t* buf, const int64_t* offs,
+                                 const int8_t* flags, const float* dists,
+                                 const int64_t* counts, int64_t n_replies,
+                                 float took_seconds, uint8_t* out,
+                                 int64_t cap) {
+    uint8_t* p = out;
+    uint8_t* end = out + cap;
+    int64_t base = 0;
+    const double nan_cert = std::nan("");
+    for (int64_t ri = 0; ri < n_replies; ri++) {
+        uint64_t body = (took_seconds != 0.0f) ? 5 : 0;
+        for (int64_t i = base; i < base + counts[ri]; i++) {
+            if (!flags[i]) continue;
+            ObjView o;
+            if (parse_storobj(buf + offs[i], offs[i + 1] - offs[i], &o) != 0)
+                return -2;
+            uint64_t rb = result_body_size(o, double(dists[i]), nan_cert);
+            body += 1 + varint_size(rb) + rb;
+        }
+        if (p + 1 + varint_size(body) + body > end) return -1;
+        *p++ = 0x0A;                                   // replies = 1
+        p = put_varint(p, body);
+        for (int64_t i = base; i < base + counts[ri]; i++) {
+            if (!flags[i]) continue;
+            ObjView o;
+            parse_storobj(buf + offs[i], offs[i + 1] - offs[i], &o);
+            uint64_t rb = result_body_size(o, double(dists[i]), nan_cert);
+            *p++ = 0x0A;                               // results = 1
+            p = put_varint(p, rb);
+            p = write_result_body(p, o, double(dists[i]), nan_cert);
+        }
+        if (took_seconds != 0.0f) {
+            *p++ = 0x15;                               // took_seconds = 2
+            std::memcpy(p, &took_seconds, 4);
+            p += 4;
+        }
+        base += counts[ri];
+    }
+    return p - out;
+}
+
 }  // extern "C"

@@ -200,6 +200,68 @@ def test_batch_search_uses_native_path(setup):
         assert json.loads(one.results[0].properties_json)["rank"] == i
 
 
+def test_raw_batch_lane_equivalence_and_engagement(tmp_path):
+    """The zero-object raw lane (device search -> packed native point-gets
+    -> packed native reply) must ENGAGE once memtables are flushed, and its
+    replies must be message-equal to the general path's — including when a
+    winner was deleted between import and serving (dropped by both)."""
+    from weaviate_tpu.server.grpc_server import SearchServicer
+
+    app = App(data_path=str(tmp_path / "raw"))
+    app.schema.add_class({
+        "class": "Raw",
+        "properties": [{"name": "rank", "dataType": ["int"]}],
+        "vectorIndexConfig": {"distance": "l2-squared"},
+    })
+    rng = np.random.default_rng(5)
+    vecs = rng.standard_normal((300, 16)).astype(np.float32)
+    app.batch.add_objects([{
+        "class": "Raw", "id": str(uuidlib.UUID(int=i + 1)),
+        "properties": {"rank": i}, "vector": vecs[i].tolist(),
+    } for i in range(300)])
+    idx = app.db.get_index("Raw")
+    shard = next(iter(idx.shards.values()))
+    sv = SearchServicer(app)
+    breq = pb.BatchSearchRequest(requests=[
+        pb.SearchRequest(class_name="Raw", limit=3,
+                         near_vector=pb.NearVectorParams(vector=vecs[i].tolist()))
+        for i in range(16)
+    ])
+
+    class Ctx:
+        def abort(self, *a):
+            raise AssertionError(a)
+
+    # memtable-resident: raw lane must decline (exactness), general path serves
+    assert sv._raw_batch_lane(breq, 0.0) is None
+    got = sv.BatchSearch(breq, Ctx())
+    general_before = pb.BatchSearchReply.FromString(
+        got if isinstance(got, (bytes, bytearray)) else got.SerializeToString())
+
+    # flush memtables -> segments: the raw lane must now engage
+    for b in (shard.objects, shard.docid_lookup):
+        b.flush_memtable()
+    raw_bytes = sv._raw_batch_lane(breq, 0.0)
+    assert raw_bytes is not None, "raw lane did not engage on flushed segments"
+    raw = pb.BatchSearchReply.FromString(raw_bytes)
+    assert len(raw.replies) == 16
+    for i, one in enumerate(raw.replies):
+        want = general_before.replies[i]
+        assert len(one.results) == len(want.results) == 3
+        for a, b_ in zip(one.results, want.results):
+            assert a.id == b_.id
+            assert abs(a.distance - b_.distance) < 1e-5
+            assert json.loads(a.properties_json) == json.loads(b_.properties_json)
+            assert a.creation_time_unix == b_.creation_time_unix
+
+    # ineligible requests (properties filter) must decline
+    breq2 = pb.BatchSearchRequest(requests=[
+        pb.SearchRequest(class_name="Raw", limit=3, properties=["rank"],
+                         near_vector=pb.NearVectorParams(vector=vecs[0].tolist()))])
+    assert sv._raw_batch_lane(breq2, 0.0) is None
+    app.shutdown()
+
+
 def test_batch_search_per_slot_errors(setup):
     _, _, client, vecs = setup
     breq = pb.BatchSearchRequest(requests=[

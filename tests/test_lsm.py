@@ -119,6 +119,110 @@ def test_roaringset_strategy(tmp_path):
     assert sorted(b.roaring_get(b"color:red")) == [1, 3, 100, 200]
 
 
+def test_bloom_survives_cross_process_restart(tmp_path):
+    """Persisted blooms must use a DETERMINISTIC hash: Python's builtin
+    hash() is siphash-randomized per process, so a bloom written by one
+    process read by another turns ~99% of present keys into false
+    negatives — silent loss of all flushed data on real restarts (in-process
+    reopens share the seed and never catch this)."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path / "b")
+    write = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from weaviate_tpu.storage.lsm import Bucket, STRATEGY_REPLACE\n"
+        "b = Bucket(%r, STRATEGY_REPLACE)\n"
+        "[b.put(f'key{i}'.encode(), f'val{i}'.encode()) for i in range(200)]\n"
+        "b.flush_memtable()\n"
+    )
+    read = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from weaviate_tpu.storage.lsm import Bucket, STRATEGY_REPLACE\n"
+        "b = Bucket(%r, STRATEGY_REPLACE)\n"
+        "missing = sum(1 for i in range(200)"
+        " if b.get(f'key{i}'.encode()) is None)\n"
+        "assert missing == 0, f'{missing}/200 keys lost across processes'\n"
+    )
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONHASHSEED"}
+    for code in (write % (repo, d), read % (repo, d)):
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_legacy_bloom_file_rebuilt(tmp_path):
+    """A pre-versioning bloom file (or a corrupt one) must be discarded and
+    rebuilt from the segment's key footer, not trusted."""
+    p = str(tmp_path / "b")
+    b = Bucket(p, STRATEGY_REPLACE)
+    for i in range(50):
+        b.put(f"k{i}".encode(), f"v{i}".encode())
+    b.flush_memtable()
+    seg_path = b._segments[-1].path
+    # overwrite with a legacy-format file: raw m/k header, garbage bits
+    import struct
+
+    with open(seg_path + ".bloom", "wb") as f:
+        f.write(struct.pack("<QI", 4096, 7) + b"\xaa" * 512)
+    b2 = Bucket(p, STRATEGY_REPLACE)
+    for i in range(50):
+        assert b2.get(f"k{i}".encode()) == f"v{i}".encode()
+    # and the rebuilt file is now versioned
+    from weaviate_tpu.storage.lsm import BloomFilter
+
+    with open(seg_path + ".bloom", "rb") as f:
+        assert BloomFilter.from_bytes(f.read()) is not None
+
+
+def test_native_multi_get_races_compaction(tmp_path):
+    """The native point-get plane reads mmap'd segments OUTSIDE the bucket
+    lock; compaction rewrites and retires segments concurrently. Hammer
+    both: every read must return either the correct value — never garbage,
+    never a crash — and retired segments must eventually close."""
+    import threading
+
+    from weaviate_tpu.storage import lsm_native
+
+    if not lsm_native.available():
+        pytest.skip("native lsm plane unavailable")
+    b = Bucket(str(tmp_path / "b"), STRATEGY_REPLACE, memtable_max_bytes=1)
+    n = 2000
+    keys = [f"key-{i:05d}".encode() for i in range(n)]
+    for i, k in enumerate(keys):
+        b.put(k, b"v%d" % i)
+    b.flush_memtable()
+    errors: list = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            got = b.multi_get(keys)
+            for i, v in enumerate(got):
+                if v != b"v%d" % i:
+                    errors.append((i, v))
+                    return
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    # repeated pair compactions while readers are in flight
+    for _ in range(30):
+        if not b.compact_pair():
+            break
+    b.compact()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    with b._lock:
+        assert b._native_inflight == 0
+        assert not b._retired_segments  # all retired segments were closed
+
+
 def test_wal_torn_tail(tmp_path):
     p = str(tmp_path / "b")
     b = Bucket(p, STRATEGY_REPLACE)

@@ -526,6 +526,64 @@ class Shard:
 
         return done
 
+    def raw_plane_ready(self) -> bool:
+        """Cheap pre-check for the raw serving lane, BEFORE any device work:
+        the packed native plane serves exactly only when both point-get
+        buckets are segment-resident (empty memtables) and the native
+        library loads — checked first so an ineligible batch never runs the
+        device kNN twice (once here, once on the general path)."""
+        from weaviate_tpu.storage import lsm_native
+
+        if not lsm_native.available():
+            return False
+        for b in (self.docid_lookup, self.objects):
+            with b._lock:
+                if len(b._mem) or not b._segments:
+                    return False
+        return True
+
+    def search_raw_packed(self, q: np.ndarray, k: int):
+        """Raw serving lane: device kNN + packed native hydration, with NO
+        per-result Python objects — the value arena feeds the native reply
+        marshaller directly (reply_native.build_batch_reply_packed).
+        -> (val_buf, val_offs, flags, flat_dists, counts) or None when the
+        packed plane can't serve exactly (memtables busy, native
+        unavailable); the caller uses the general path. Callers should
+        gate on raw_plane_ready() first to avoid duplicate device work."""
+        m = self.metrics
+        cls = self.class_def.name
+        t1 = time.perf_counter()
+        ids, dists = self.vector_index.search_by_vectors(q, k)
+        t2 = time.perf_counter()
+        out = self.hydrate_raw_packed(ids, dists)
+        if m is not None:
+            m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
+            m.filtered_vector_objects.labels(cls, self.name).observe(
+                (time.perf_counter() - t2) * 1000.0)
+            m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
+            m.query_dimensions.labels("nearVector", "search", cls).inc(
+                int(q.shape[0] * q.shape[1]))
+        return out
+
+    def hydrate_raw_packed(self, ids, dists):
+        """Packed twin of _hydrate_batch: docid -> uuid -> image entirely in
+        buffer space; one call's value arena IS the next call's key buffer."""
+        dists = np.asarray(dists, dtype=np.float32)
+        ids = np.asarray(ids)
+        valid = ~np.isinf(dists)
+        counts = valid.sum(axis=1).astype(np.int64)
+        flat_ids = ids[valid].astype("<u8")
+        key_offs = np.arange(flat_ids.size + 1, dtype=np.int64) * 8
+        r1 = self.docid_lookup.multi_get_packed(flat_ids.tobytes(), key_offs)
+        if r1 is None:
+            return None
+        ubuf, uoffs, _ = r1
+        r2 = self.objects.multi_get_packed(ubuf, uoffs)
+        if r2 is None:
+            return None
+        vbuf, voffs, vflags = r2
+        return vbuf, voffs, vflags, dists[valid], counts
+
     def _hydrate(self, ids, dists, include_vector: bool) -> list[SearchResult]:
         return self._hydrate_batch(
             np.asarray(ids)[None, :], np.asarray(dists)[None, :], include_vector)[0]

@@ -17,6 +17,7 @@ from concurrent import futures
 from typing import Optional
 
 import grpc
+import numpy as np
 
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.grpcapi import weaviate_pb2 as pb
@@ -166,11 +167,68 @@ class SearchServicer:
         reply.results.extend(result_to_proto(r, request) for r in results)
         return reply
 
+    def _raw_batch_lane(self, request: pb.BatchSearchRequest,
+                        start: float) -> Optional[bytes]:
+        """Zero-object serving lane: when every slot is a plain same-class
+        nearVector query with verbatim replies, the whole batch runs as
+        device search -> packed native point-gets -> packed native reply
+        marshalling, with no per-result Python objects anywhere. None =>
+        the general path (which is always correct) serves the batch."""
+        reqs = request.requests
+        if not reqs:
+            return None
+        f0 = reqs[0]
+        cls, limit = f0.class_name, int(f0.limit)
+        explorer = self.app.traverser.explorer
+        k = limit or explorer.query_limit
+        if k > explorer.max_results:
+            return None
+        dim = len(f0.near_vector.vector) if f0.HasField("near_vector") else 0
+        if dim == 0:
+            return None
+        for r in reqs:
+            if (r.class_name != cls or int(r.limit) != limit or r.offset
+                    or r.properties or r.additional_properties or r.where_json
+                    or r.consistency_level
+                    or not r.HasField("near_vector")
+                    or len(r.near_vector.vector) != dim
+                    or r.near_vector.HasField("certainty")
+                    or r.near_vector.HasField("distance")
+                    or r.HasField("near_object") or r.HasField("bm25")
+                    or r.HasField("hybrid")):
+                return None
+        resolved = self.app.schema.resolve_class_name(cls)
+        idx = self.app.db.get_index(resolved) if resolved else None
+        if idx is None:
+            return None
+        targets = idx._all_shard_targets()
+        if len(targets) != 1 or targets[0][1] is None:
+            return None
+        shard = targets[0][1]
+        if not shard.raw_plane_ready():
+            return None  # before ANY device work: the general path searches once
+        q = np.empty((len(reqs), dim), dtype=np.float32)
+        for i, r in enumerate(reqs):
+            q[i] = np.fromiter(r.near_vector.vector, np.float32, dim)
+        try:
+            out = shard.search_raw_packed(q, k)
+        except Exception:  # noqa: BLE001 — the general path re-runs + reports
+            return None
+        if out is None:
+            return None
+        vbuf, voffs, vflags, flat_dists, counts = out
+        return reply_native.build_batch_reply_packed(
+            vbuf, voffs, vflags, flat_dists, counts,
+            time.perf_counter() - start)
+
     def BatchSearch(self, request: pb.BatchSearchRequest, context) -> pb.BatchSearchReply:
         """Per-slot error isolation end to end: a malformed request or failed
         query yields a reply with error_message; the other slots still ride
         the shared device dispatch."""
         start = time.perf_counter()
+        raw = self._raw_batch_lane(request, start)
+        if raw is not None:
+            return raw
         slot_params: list = [None] * len(request.requests)
         parse_errs: dict[int, str] = {}
         for i, r in enumerate(request.requests):

@@ -70,6 +70,18 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_ubyte),
                 ctypes.c_int64,
             ]
+            lib.build_batch_reply_packed.restype = ctypes.c_int64
+            lib.build_batch_reply_packed.argtypes = [
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int8),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_float,
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.c_int64,
+            ]
             _lib = lib
         except Exception:  # noqa: BLE001 — native tier is best-effort
             _lib_failed = True
@@ -132,6 +144,36 @@ def build_batch_reply(
     cnt_arr = (ctypes.c_int64 * len(counts))(*counts)
     wrote = lib.build_batch_reply(raw_arr, len_arr, d_arr, c_arr, cnt_arr,
                                   len(counts), float(took_seconds), out, cap)
+    if wrote < 0:
+        return None
+    return ctypes.string_at(out, wrote)
+
+
+def build_batch_reply_packed(val_buf, val_offs, flags, flat_dists, counts,
+                             took_seconds: float) -> Optional[bytes]:
+    """Raw-lane twin of build_batch_reply: object images live in ONE arena
+    (numpy uint8) at val_offs[i]..val_offs[i+1] — the layout the native LSM
+    point-get plane emits — so no per-result Python objects exist anywhere
+    on the path. flags[i]==0 drops that (deleted) hit from its reply."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(flags)
+    offs = np.ascontiguousarray(val_offs, dtype=np.int64)
+    fl = np.ascontiguousarray(flags, dtype=np.int8)
+    ds = np.ascontiguousarray(flat_dists, dtype=np.float32)
+    cnts = np.ascontiguousarray(counts, dtype=np.int64)
+    cap = int(offs[n]) + n * 128 + len(cnts) * 16 + 16
+    out = (ctypes.c_ubyte * cap)()
+    wrote = lib.build_batch_reply_packed(
+        val_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        fl.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ds.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        cnts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(cnts), float(took_seconds), out, cap)
     if wrote < 0:
         return None
     return ctypes.string_at(out, wrote)

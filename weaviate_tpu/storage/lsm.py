@@ -69,8 +69,20 @@ def _read_frame(buf: memoryview, off: int) -> tuple[bytes, int]:
     return bytes(buf[off : off + n]), off + n
 
 
+_BLOOM_MAGIC = b"WBLM"
+_BLOOM_VERSION = 1
+
+
 class BloomFilter:
-    """Simple double-hashed bloom (segment_bloom_filters.go role)."""
+    """Double-hashed bloom (segment_bloom_filters.go role).
+
+    Hashes are blake2b (stdlib, C speed) — NEVER Python's builtin hash():
+    that one is siphash-randomized PER PROCESS, so a bloom persisted by one
+    process reads as noise in the next and ~99% of present keys report
+    absent — silent loss of all flushed data across restarts. The bloom
+    file is versioned; unversioned legacy files (written with the
+    randomized hash) are discarded and rebuilt from the segment's key
+    footer at open."""
 
     def __init__(self, n_items: int, bits_per_item: int = 10):
         self.m = max(64, n_items * bits_per_item)
@@ -78,8 +90,11 @@ class BloomFilter:
         self.bits = np.zeros((self.m + 7) // 8, dtype=np.uint8)
 
     def _hashes(self, key: bytes):
-        h1 = hash(key) & 0xFFFFFFFFFFFF
-        h2 = hash(b"\x01" + key) | 1
+        import hashlib
+
+        d = hashlib.blake2b(key, digest_size=16).digest()
+        h1 = int.from_bytes(d[:8], "little")
+        h2 = int.from_bytes(d[8:], "little") | 1
         for i in range(self.k):
             yield (h1 + i * h2) % self.m
 
@@ -91,14 +106,21 @@ class BloomFilter:
         return all(self.bits[h >> 3] & (1 << (h & 7)) for h in self._hashes(key))
 
     def to_bytes(self) -> bytes:
-        return struct.pack("<QI", self.m, self.k) + self.bits.tobytes()
+        return (_BLOOM_MAGIC + struct.pack("<H", _BLOOM_VERSION)
+                + struct.pack("<QI", self.m, self.k) + self.bits.tobytes())
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "BloomFilter":
-        m, k = struct.unpack_from("<QI", data, 0)
+    def from_bytes(cls, data: bytes) -> Optional["BloomFilter"]:
+        """None for legacy/corrupt files — the caller rebuilds and rewrites."""
+        if len(data) < 18 or data[:4] != _BLOOM_MAGIC:
+            return None
+        (ver,) = struct.unpack_from("<H", data, 4)
+        if ver != _BLOOM_VERSION:
+            return None
+        m, k = struct.unpack_from("<QI", data, 6)
         b = cls.__new__(cls)
         b.m, b.k = m, k
-        b.bits = np.frombuffer(data, dtype=np.uint8, offset=12).copy()
+        b.bits = np.frombuffer(data, dtype=np.uint8, offset=18).copy()
         return b
 
 
@@ -287,6 +309,16 @@ class Segment:
         if os.path.exists(bloom_path):
             with open(bloom_path, "rb") as bf:
                 self.bloom = BloomFilter.from_bytes(bf.read())
+        if self.bloom is None:
+            # missing, legacy (process-randomized hashes), or corrupt bloom:
+            # rebuild from the key footer so lookups stay correct AND fast
+            self.bloom = BloomFilter(len(self.keys))
+            for k in self.keys:
+                self.bloom.add(k)
+            tmp = bloom_path + ".tmp"
+            with open(tmp, "wb") as bf:
+                bf.write(self.bloom.to_bytes())
+            os.replace(tmp, bloom_path)
 
     def get_raw(self, key: bytes) -> Optional[bytes]:
         if self.bloom is not None and key not in self.bloom:
@@ -302,6 +334,9 @@ class Segment:
             yield k, bytes(self._mm[o : o + ln])
 
     def close(self):
+        from weaviate_tpu.storage import lsm_native
+
+        lsm_native.seg_close(self)
         self._mm.close()
         self._f.close()
 
@@ -436,6 +471,27 @@ class Bucket:
         if self._wal.tell() == 0:
             self._wal.write(_WAL_MAGIC)
             self._wal.flush()
+        # native multi_get lifetime protection: calls run OUTSIDE the bucket
+        # lock on a segment snapshot, so compaction must retire (not close)
+        # segments while any call is in flight
+        self._native_inflight = 0
+        self._retired_segments: list[Segment] = []
+
+    def _retire_segment(self, seg: "Segment") -> None:
+        """Close a replaced segment, or park it until in-flight native
+        reads drain (caller holds the bucket lock)."""
+        if self._native_inflight > 0:
+            self._retired_segments.append(seg)
+        else:
+            seg.close()
+
+    def _native_exit(self) -> None:
+        """Leave the native-read critical section (caller holds the lock)."""
+        self._native_inflight -= 1
+        if self._native_inflight == 0 and self._retired_segments:
+            for s in self._retired_segments:
+                s.close()
+            self._retired_segments.clear()
 
     def _new_memtable(self):
         return {
@@ -631,41 +687,107 @@ class Bucket:
 
     # -- reads ---------------------------------------------------------------
 
+    @staticmethod
+    def _seg_get(segs, key: bytes) -> Optional[bytes]:
+        """Newest-first raw lookup across a segment list (tombstones NOT yet
+        resolved — the caller maps _TOMBSTONE to None). One copy of the scan
+        so read semantics cannot diverge between get/multi_get/fallbacks."""
+        for seg in reversed(segs):
+            v = seg.get_raw(key)
+            if v is not None:
+                return v
+        return None
+
     def get(self, key: bytes) -> Optional[bytes]:
         """replace: newest value or None (tombstone-aware)."""
         assert self.strategy == STRATEGY_REPLACE
         with self._lock:
             v = self._mem.get(key)
-            if v is not None:
-                return None if v == _TOMBSTONE else v
-            for seg in reversed(self._segments):
-                v = seg.get_raw(key)
-                if v is not None:
-                    return None if v == _TOMBSTONE else v
-            return None
+            if v is None:
+                v = self._seg_get(self._segments, key)
+            return None if v is None or v == _TOMBSTONE else v
 
     def multi_get(self, keys) -> list[Optional[bytes]]:
-        """Batched replace-strategy point gets under ONE lock acquisition —
-        the serving path hydrates thousands of winners per batch and per-get
-        locking would dominate. A None key yields None (missing upstream
-        lookup), keeping caller indexing aligned."""
+        """Batched replace-strategy point gets — the serving path hydrates
+        thousands of winners per batch. A None key yields None (missing
+        upstream lookup), keeping caller indexing aligned.
+
+        Memtable hits resolve in Python under one lock acquisition; segment
+        misses then ride ONE native C call (GIL released, see
+        storage/lsm_native.py) over a snapshot protected by the
+        retire-until-idle contract, with the Python bisect reader as the
+        fallback."""
         assert self.strategy == STRATEGY_REPLACE
+        from weaviate_tpu.storage import lsm_native
+
+        n = len(keys) if hasattr(keys, "__len__") else None
         out: list[Optional[bytes]] = []
         with self._lock:
             mem_get = self._mem.get
             segs = self._segments
-            for key in keys:
+            use_native = (n is None or n >= 16) and segs and lsm_native.available()
+            if not use_native:
+                for key in keys:
+                    if key is None:
+                        out.append(None)
+                        continue
+                    v = mem_get(key)
+                    if v is None:
+                        v = self._seg_get(segs, key)
+                    out.append(None if v is None or v == _TOMBSTONE else v)
+                return out
+            miss_idx: list[int] = []
+            miss_keys: list[bytes] = []
+            for i, key in enumerate(keys):
                 if key is None:
                     out.append(None)
                     continue
                 v = mem_get(key)
                 if v is None:
-                    for seg in reversed(segs):
-                        v = seg.get_raw(key)
-                        if v is not None:
-                            break
-                out.append(None if v is None or v == _TOMBSTONE else v)
+                    miss_idx.append(i)
+                    miss_keys.append(key)
+                    out.append(None)
+                else:
+                    out.append(None if v == _TOMBSTONE else v)
+            if not miss_idx:
+                return out
+            snapshot = list(reversed(segs))  # newest first
+            self._native_inflight += 1
+        try:
+            vals = lsm_native.multi_get(snapshot, miss_keys)
+        finally:
+            with self._lock:
+                self._native_exit()
+        if vals is None:  # native unavailable for a segment: Python reader
+            with self._lock:
+                for i, key in zip(miss_idx, miss_keys):
+                    v = self._seg_get(self._segments, key)
+                    out[i] = None if v is None or v == _TOMBSTONE else v
+            return out
+        for i, v in zip(miss_idx, vals):
+            out[i] = v
         return out
+
+    def multi_get_packed(self, key_buf, key_offs):
+        """Packed-buffer batched point gets for the raw serving lane:
+        keys live at key_offs[i]..key_offs[i+1] in key_buf (bytes or uint8
+        array; zero-length = missing upstream) -> (value arena, offsets,
+        flags) straight from the native plane. None whenever the packed
+        path cannot serve EXACTLY (memtable non-empty, no segments, native
+        unavailable) — the caller falls back to the general path."""
+        assert self.strategy == STRATEGY_REPLACE
+        from weaviate_tpu.storage import lsm_native
+
+        with self._lock:
+            if len(self._mem) or not self._segments or not lsm_native.available():
+                return None
+            snapshot = list(reversed(self._segments))
+            self._native_inflight += 1
+        try:
+            return lsm_native.multi_get_packed(snapshot, key_buf, key_offs)
+        finally:
+            with self._lock:
+                self._native_exit()
 
     def set_get(self, key: bytes) -> set[bytes]:
         assert self.strategy == STRATEGY_SET
@@ -824,7 +946,7 @@ class Bucket:
                 return False
             keep_path = pair[0].path
             for seg in pair:
-                seg.close()
+                self._retire_segment(seg)
             # bloom BEFORE segment: a crash in between pairs the old segment
             # with a new bloom (false positives only — harmless); the other
             # order pairs the merged segment with a stale bloom, turning
@@ -891,7 +1013,7 @@ class Bucket:
             old = self._segments
             self._segments = [Segment(seg_path)]
             for seg in old:
-                seg.close()
+                self._retire_segment(seg)
                 os.remove(seg.path)
                 try:
                     os.remove(seg.path + ".bloom")
@@ -911,7 +1033,7 @@ class Bucket:
             self.flush_memtable()
             self._wal.close()
             for seg in self._segments:
-                seg.close()
+                self._retire_segment(seg)  # never munmap under an in-flight read
             self._segments = []
 
     def drop(self) -> None:
@@ -921,7 +1043,7 @@ class Bucket:
             except Exception:
                 pass
             for seg in self._segments:
-                seg.close()
+                self._retire_segment(seg)
             self._segments = []
             import shutil
 
