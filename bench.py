@@ -494,7 +494,67 @@ def run_cpu_matrix(rng):
     rows["pq_tiers_cpu"] = tiers
     _merge_matrix(rows)
 
-    # -- row 4: restart replay (vector-log bulk replay, commit 6d39c68) ---
+    # -- row 4: filtered-search scaling at n=1M (VERDICT r3 item 6) -------
+    n_f = int(os.environ.get("BENCH_CPU_FILTER_N", 1_000_000))
+    b_f = 256
+    log(f"cpu matrix: filtered scaling (n={n_f}, 1%/10%/50% allowLists)...")
+    from weaviate_tpu.storage.bitmap import Bitmap
+
+    fvecs = make_data(n_f, DIM, rng)
+    fq = fvecs[rng.integers(0, n_f, b_f)] + 0.05 * rng.standard_normal(
+        (b_f, DIM), dtype=np.float32)
+    idx_f, _ = _build_index(fvecs)
+    frow = dict(common)
+    frow.update({"n": n_f, "batch": b_f, "selectivities": {}})
+    for sel in (0.01, 0.10, 0.50):
+        ids_sel = np.nonzero(rng.random(n_f) < sel)[0].astype(np.uint64)
+        allow = Bitmap(ids_sel, _sorted=True)
+        gather_path = len(allow) < idx_f.config.flat_search_cutoff
+        entry = {"allow_size": int(len(allow)),
+                 "path": "gather" if gather_path else "masked-scan"}
+        if not gather_path:
+            # host pack cost: cold (scatter table + packbits + upload) vs
+            # cached (repeated queries with the same filter)
+            t0 = time.perf_counter()
+            idx_f._allow_words(allow)
+            entry["pack_cold_ms"] = round((time.perf_counter() - t0) * 1000, 2)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                idx_f._allow_words(allow)
+            entry["pack_cached_ms"] = round(
+                (time.perf_counter() - t0) / 5 * 1000, 3)
+        idx_f.search_by_vectors(fq, K, allow_list=allow)  # warm/compile
+        t0 = time.perf_counter()
+        reps = 2
+        for _ in range(reps):
+            ids_out, _d = idx_f.search_by_vectors(fq, K, allow_list=allow)
+        q_ms = (time.perf_counter() - t0) / reps * 1000
+        entry["query_ms"] = round(q_ms, 1)
+        entry["qps"] = round(b_f / (q_ms / 1000), 1)
+        if "pack_cold_ms" in entry:
+            entry["pack_pct_of_query"] = round(
+                100 * entry["pack_cached_ms"] / q_ms, 2)
+        # recall vs exact GT over the allowed subset (64 queries)
+        gt_f = exact_gt(fvecs[ids_sel.astype(np.int64)], fq[:64], K)
+        sentinel = np.iinfo(np.uint64).max
+        hits = sum(
+            len(set(int(x) for x in ids_out[i][:K] if x != sentinel)
+                & set(ids_sel[gt_f[i]].tolist()))
+            for i in range(64))
+        entry["recall@10"] = round(hits / (64 * K), 4)
+        frow["selectivities"][f"{int(sel*100)}pct"] = entry
+        log(f"  {sel:.0%}: {entry}")
+    idx_f.drop()
+    del idx_f, fvecs
+    frow["provenance"] = (
+        "filtered masked-scan with scatter-table allowList pack + per-filter "
+        "device-words cache (round 4); gather path serves small allowLists "
+        "below flatSearchCutoff"
+    )
+    rows["filtered_scaling_cpu"] = frow
+    _merge_matrix(rows)
+
+    # -- row 5: restart replay (vector-log bulk replay, commit 6d39c68) ---
     n_r = 50_000
     log(f"cpu matrix: restart replay (n={n_r})...")
     from weaviate_tpu.entities import vectorindex as vi

@@ -37,6 +37,36 @@ def test_exact_recall(tmp_path, rng, metric):
     np.testing.assert_allclose(np.sort(dists), np.sort(want_d), rtol=1e-3, atol=1e-3)
 
 
+def test_allow_words_cache_invalidated_by_compact(tmp_path, rng):
+    """The per-allowList packed-words cache is keyed on (token, n,
+    capacity); compact() rebuilds the slot->doc mapping and can restore the
+    SAME n and capacity after re-adds — a stale mask would then route other
+    docs' allow bits to live slots. compact must refresh the token."""
+    idx = make_index(tmp_path, flatSearchCutoff=0)
+    vecs = rng.standard_normal((100, 8)).astype(np.float32)
+    idx.add_batch(np.arange(100), vecs)
+    idx.flush()
+    allow = Bitmap(np.arange(0, 100, 2).astype(np.uint64))  # even docs
+    q = vecs[10:18]  # docs that survive the upcoming delete of 0..9
+    ids, _ = idx.search_by_vectors(q, 3, allow_list=allow)
+    assert getattr(allow, "_words_cache", None) is not None  # cache primed
+    # shift the mapping while restoring n and capacity exactly
+    idx.delete(*range(10))
+    idx.flush()
+    idx.compact()
+    idx.add_batch(np.arange(100, 110), rng.standard_normal((10, 8)).astype(np.float32))
+    idx.flush()
+    assert idx.n == 100  # the aliasing precondition this test exists for
+    ids2, _ = idx.search_by_vectors(q, 3, allow_list=allow)
+    sentinel = np.uint64(0xFFFFFFFFFFFFFFFF)
+    flat = ids2.ravel()
+    flat = flat[flat != sentinel]
+    assert all(int(x) % 2 == 0 and int(x) < 100 for x in flat), flat
+    # self-queries for surviving allowed docs still win
+    for j in range(0, 8, 2):  # queries j are docs 10+j (even, alive)
+        assert int(ids2[j][0]) == 10 + j
+
+
 def test_batched_search(tmp_path, rng):
     idx = make_index(tmp_path)
     vecs = rng.standard_normal((300, 16)).astype(np.float32)

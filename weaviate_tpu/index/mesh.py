@@ -127,6 +127,8 @@ class MeshVectorIndex(VectorIndex):
         self._pq_path = os.path.join(shard_path, "pq.npz") if shard_path else ""
         self._restoring = False
         self._gmin_broken = False  # fused mesh kernel failed: use the scan
+        # identity token for the per-allowList packed-words cache
+        self._allow_token = object()
         self._gmin_validated: set = set()     # shapes that served correctly
         self._gmin_shape_broken: set = set()  # shapes Mosaic rejected
         self._log = (
@@ -558,18 +560,32 @@ class MeshVectorIndex(VectorIndex):
         return q, b
 
     def _allow_words(self, allow_list: AllowList) -> jax.Array:
+        """Sharded packed filter words, cached ON the (immutable) allowList
+        per index state — same contract as the single-chip twin
+        (index/tpu.py _allow_words)."""
+        from weaviate_tpu.storage.bitmap import (
+            Bitmap, allowed_mask, pack_allow_words)
+
         cap = self.n_dev * self.n_loc
+        key = (self._allow_token, int(self._counts.sum()), cap)
+        cached = getattr(allow_list, "_words_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         mask = np.zeros(cap, dtype=bool)
         occupied = self._slot_to_doc >= 0
         if occupied.any():
-            docs = self._slot_to_doc[occupied].astype(np.uint64)
-            mask[occupied] = allow_list.contains_array(docs)
-        words = (
-            np.packbits(mask.reshape(-1, 32), axis=1, bitorder="little")
-            .view(np.uint32)
-            .ravel()
-        )
-        return jax.device_put(jnp.asarray(words), shard_spec(self.mesh))
+            docs = self._slot_to_doc[occupied]
+            if isinstance(allow_list, Bitmap):
+                mask[occupied] = allowed_mask(allow_list, docs)
+            else:
+                mask[occupied] = allow_list.contains_array(docs.astype(np.uint64))
+        out = jax.device_put(
+            jnp.asarray(pack_allow_words(mask, cap)), shard_spec(self.mesh))
+        try:
+            allow_list._words_cache = (key, out)
+        except AttributeError:
+            pass
+        return out
 
     def search_by_vectors(
         self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
@@ -789,6 +805,8 @@ class MeshVectorIndex(VectorIndex):
             store_host = np.asarray(src, dtype=np.float32)[rows]
             if self._log is not None:
                 self._log.rewrite(zip(docs.tolist(), store_host))
+            # mapping rebuild invalidates any packed-words cache keyed on it
+            self._allow_token = object()
             dim = self.dim
             self.dim = None
             self.n_loc = 0

@@ -652,6 +652,15 @@ class TpuVectorIndex(VectorIndex):
         # flips true on a Mosaic compile failure of the fused gmin kernel;
         # searches then stay on the lax.scan kernel permanently
         self._gmin_broken = False
+        # identity token for the per-allowList packed-words cache: the cache
+        # tuple holds a strong ref, so the identity can never be recycled
+        self._allow_token = object()
+        # separate failure domain + codebook-constant cache for the PQ
+        # codes-only fused kernel (ops/pq_gmin.py)
+        from weaviate_tpu.ops.gmin_scan import KernelState
+
+        self._pqg_state = KernelState()
+        self._pqg_cb = None  # (pq identity, cb_chunks dev, flat_cb dev)
         # compiled-shape keys (b, k, rg, active_g, use_allow) that completed a
         # materialized search — each key is its own Mosaic compilation, so one
         # small-shape success must not vouch for a larger VMEM footprint
@@ -1153,6 +1162,71 @@ class TpuVectorIndex(VectorIndex):
             lambda: self._search_full_gmin(q, kk, allow_words, store, sq_norms),
             "fused gmin kernel")
 
+    def _pq_gmin_cb(self):
+        """Device codebook constants for the fused codes kernel, cached per
+        ProductQuantizer instance (rebuilt on compress/restore)."""
+        from weaviate_tpu.ops import pq_gmin
+
+        if self._pqg_cb is None or self._pqg_cb[0] is not self._pq:
+            cb = self._pq.codebook  # [M, C, ds] f32
+            m = cb.shape[0]
+            # bf16 on device: the kernel computes in bf16 anyway, and the
+            # VMEM planner counts this block at 2 bytes/element
+            chunks = jnp.asarray(
+                pq_gmin.build_cb_chunks(cb, min(pq_gmin._MSEG, m)),
+                dtype=jnp.bfloat16)
+            flat = jnp.asarray(cb.reshape(-1, cb.shape[2]))
+            self._pqg_cb = (self._pq, chunks, flat)
+        return self._pqg_cb[1], self._pqg_cb[2]
+
+    def _pq_gmin_packed_or_none(self, q: np.ndarray, b: int, k: int,
+                                allow_list):
+        """Run the fused PQ codes kernel, or None for the legacy recon
+        scan. Same per-shape validation contract as the dense kernel, on a
+        SEPARATE failure domain (self._pqg_state)."""
+        from weaviate_tpu.ops import gmin_scan, pq_gmin
+
+        if self._pqg_state._gmin_broken or getattr(self.config, "exact_topk", False):
+            return None
+        if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+            return None
+        if self._pq is None or self._pq.centroids > 256 or q.shape[0] < 8:
+            return None
+        ncols = self.capacity // gmin_scan.G
+        kk = min(k, self.live)
+        rg = min(max(32, 2 * kk), 128, ncols)
+        if rg < kk:
+            return None
+        active_g = max(1, -(-self.n // ncols))
+        m, c = self._pq.segments, self._pq.centroids
+        if not pq_gmin.fits_vmem_pq(q.shape[0], self.dim, ncols, active_g, m, c):
+            return None
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        use_allow = allow_list is not None
+        words = (self._allow_words(allow_list) if use_allow
+                 else jnp.zeros((self.capacity // 32,), jnp.uint32))
+        cb_chunks, flat_cb = self._pq_gmin_cb()
+        key = (q.shape[0], kk, rg, active_g, self.capacity, m, c, use_allow)
+        return gmin_scan.guarded_kernel_call(
+            self._pqg_state, key,
+            lambda: pq_gmin.search_pq_gmin(
+                self._codes,
+                self._recon_norms,
+                self._tombs,
+                self.n,
+                jnp.asarray(q),
+                cb_chunks,
+                flat_cb,
+                words,
+                use_allow,
+                kk,
+                self.metric,
+                rg,
+                active_g,
+                interpret,
+            ),
+            "fused pq codes kernel")
+
     def _rescore_r(self, k: int) -> int:
         """Fast-scan candidate depth: 0 disables (exactTopK config or
         non-matmul metrics); otherwise 4k clamped to [32, 128] — selection
@@ -1181,12 +1255,28 @@ class TpuVectorIndex(VectorIndex):
         return q, b
 
     def _allow_words(self, allow_list: AllowList) -> jax.Array:
+        """Packed device filter words for this index state, cached ON the
+        (immutable) allowList: repeated queries with the same filter skip
+        the host-side pack entirely. The cache key holds a strong ref to
+        this index's token object, so identity can never be recycled."""
+        from weaviate_tpu.storage.bitmap import (
+            Bitmap, allowed_mask, pack_allow_words)
+
+        key = (self._allow_token, self.n, self.capacity)
+        cached = getattr(allow_list, "_words_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         live_docs = self._slot_to_doc[: self.n]
-        allowed = allow_list.contains_array(live_docs.astype(np.uint64))
-        mask = np.zeros(self.capacity, dtype=bool)
-        mask[: self.n] = allowed
-        words = np.packbits(mask.reshape(-1, 32), axis=1, bitorder="little").view(np.uint32).ravel()
-        return jnp.asarray(words)
+        if isinstance(allow_list, Bitmap):
+            allowed = allowed_mask(allow_list, live_docs)
+        else:
+            allowed = allow_list.contains_array(live_docs.astype(np.uint64))
+        words = jnp.asarray(pack_allow_words(allowed, self.capacity))
+        try:
+            allow_list._words_cache = (key, words)
+        except AttributeError:
+            pass  # foreign AllowList impls without the cache slot
+        return words
 
     def search_by_vectors(
         self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
@@ -1270,7 +1360,16 @@ class TpuVectorIndex(VectorIndex):
                 q, b, k, allow_words,
                 store=self._rescore_dev, sq_norms=self._rescore_sq_norms)
             return ids, dists
-        # codes-only tier from here: raw ADC distances, no rescoring pass
+        # codes-only tier from here: raw ADC distances, no rescoring pass.
+        # Fast path: the fused PQ-ADC group-min kernel (ops/pq_gmin.py) —
+        # reconstruction-as-matmul in VMEM, codes never expand in HBM
+        packed = self._pq_gmin_packed_or_none(q, b, k, allow_list)
+        if packed is not None:
+            top, slots = _unpack(np.asarray(packed))
+            top, slots = top[:b], slots[:b]
+            ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
+            return ids[:, :k], top[:, :k]
+        # legacy reconstruction-scan path:
         # per-chunk candidate depth: selection cost on TPU grows sharply
         # with k, so each chunk contributes a SMALL top-r and the candidate
         # pool is nchunks * r_chunk deep. Sized so the pool stays >= 512
@@ -1513,6 +1612,10 @@ class TpuVectorIndex(VectorIndex):
             vecs = store_host[live_slots]
             if self._log is not None:
                 self._log.rewrite(zip(docs.tolist(), vecs))
+            # the slot->doc mapping is about to be rebuilt wholesale: any
+            # packed-words cache keyed on the old mapping (same n/capacity
+            # possible after re-adds) must never be served again
+            self._allow_token = object()
             # rebuild device state (uncompressed rebuild, then re-encode)
             pq, was_compressed = self._pq, self.compressed
             self.compressed = False

@@ -79,6 +79,20 @@ def fits_vmem(b: int, d: int, ncols: int, ag: int, store_bytes: int = 4) -> bool
     return plan_tiles(b, d, ncols, ag, store_bytes)[2] <= _VMEM_BUDGET
 
 
+class KernelState:
+    """Standalone holder of the per-shape validation state
+    guarded_kernel_call drives — lets an index carry SEPARATE failure
+    domains for different kernels (a Mosaic rejection of the PQ codes
+    kernel must not disable the dense gmin path, and vice versa)."""
+
+    __slots__ = ("_gmin_validated", "_gmin_shape_broken", "_gmin_broken")
+
+    def __init__(self):
+        self._gmin_validated: set = set()
+        self._gmin_shape_broken: set = set()
+        self._gmin_broken = False
+
+
 def guarded_kernel_call(index, key, thunk, kernel_desc: str):
     """Per-compiled-shape validation state machine, shared by the
     single-chip and mesh indexes so their fallback behavior cannot diverge.

@@ -1,0 +1,244 @@
+"""Fused PQ-ADC + group-min Pallas kernel: the codes-only serving fast path.
+
+Why it exists: the memory-tightest PQ tier (rescore disabled, or restarts
+before the rescore store rebuilds) must scan uint8 codes. The previous path
+(index/tpu.py _search_pq_recon) reconstructs every chunk into a [chunk, D]
+float block in HBM via an XLA gather each batch — the gather is
+VPU-hostile on TPU and the reconstruction round-trips HBM. The reference's
+answer is a per-element LUT scan (ssdhelpers/product_quantization.go:56-75),
+which is exactly the gather-bound pattern the MXU cannot help with.
+
+The TPU-native formulation: reconstruction IS a matmul. With one-hot row
+encodings, recon = onehot([scg, M*C]) @ cb_diag([M*C, D]) where cb_diag is
+the block-diagonal expanded codebook (row m*C + c carries codebook[m, c]
+in columns m*ds..(m+1)*ds). The kernel builds the one-hot in VMEM (a
+broadcasted-iota compare — VPU-cheap), reconstructs each store tile ONCE
+per grid row into VMEM scratch, and fuses the distance matmul + group-min
+exactly like the dense kernel (ops/gmin_scan.py). Codes never expand in
+HBM: HBM traffic is the uint8 codes (M bytes/row vs 2D bytes for the bf16
+dense scan — 8x less at M=32, D=128), at the cost of extra MXU work that
+amortizes over the query tiles of a serving batch.
+
+Scoring unifies as  score = bias[slot] + alpha * (q . recon[slot]) with
+bias carrying ||recon||^2 (+inf dead) for l2 — identical rank semantics to
+the dense gmin scan, with ADC error bounded by the quantizer, not the
+kernel. Selection + exact-ADC rescore of the kept groups mirrors
+gmin_topk; distances returned are ADC-exact (the same values
+_search_pq_recon's do_rescore=False tier reports).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from weaviate_tpu.ops.gmin_scan import G, _VMEM_BUDGET
+
+_MSEG = 8     # segments reconstructed per one-hot matmul chunk
+_QB = 256     # query rows per grid step (upper bound)
+_SCG = 256    # group-columns per grid step (upper bound)
+
+
+def plan_tiles_pq(b: int, d: int, ncols: int, ag: int, m: int, c: int,
+                  ) -> tuple[int, int, int, int]:
+    """-> (qb, scg, mseg, footprint_bytes). Same hard-gate contract as
+    gmin_scan.plan_tiles: callers must refuse the kernel when even the
+    smallest tiling exceeds the VMEM budget (an oversized kernel reaching
+    Mosaic has wedged the TPU relay before)."""
+    mseg = min(_MSEG, m)
+    qb = min(_QB, b)
+    scg = min(_SCG, ncols)
+
+    def footprint(qb_, scg_):
+        inputs = (qb_ * d * 4                 # query tile
+                  + ag * scg_ * m             # codes tile (uint8)
+                  + ag * scg_ * 4)            # bias tile
+        cb = (m // mseg + (1 if m % mseg else 0)) * mseg * c * d * 2
+        scratch = ag * scg_ * d * 4           # recon accumulator (f32)
+        onehot = scg_ * mseg * c * 2          # bf16 one-hot chunk
+        outputs = qb_ * scg_ * 4
+        compute = qb_ * d * 2 + qb_ * scg_ * 4
+        return 2 * inputs + cb + scratch + onehot + 2 * outputs + compute
+
+    while scg > 64 and footprint(qb, scg) > _VMEM_BUDGET:
+        scg //= 2
+    while qb > 64 and footprint(qb, scg) > _VMEM_BUDGET:
+        qb //= 2
+    return qb, scg, mseg, footprint(qb, scg)
+
+
+def fits_vmem_pq(b: int, d: int, ncols: int, ag: int, m: int, c: int) -> bool:
+    return plan_tiles_pq(b, d, ncols, ag, m, c)[3] <= _VMEM_BUDGET
+
+
+def build_cb_chunks(codebook: np.ndarray, mseg: int) -> np.ndarray:
+    """[M, C, ds] codebook -> [n_chunks, mseg*C, D] bf16 block-diagonal
+    chunks: chunk t row (s*C + c) carries codebook[t*mseg + s, c] in columns
+    (t*mseg + s)*ds .. +ds, zeros elsewhere — so
+    recon = sum_t onehot_t @ cb_chunks[t]."""
+    m, c, ds = codebook.shape
+    d = m * ds
+    nchunks = -(-m // mseg)
+    out = np.zeros((nchunks, mseg * c, d), dtype=np.float32)
+    for seg in range(m):
+        t, s = divmod(seg, mseg)
+        rows = slice(s * c, (s + 1) * c)
+        cols = slice(seg * ds, (seg + 1) * ds)
+        out[t, rows, cols] = codebook[seg]
+    return out
+
+
+def _pq_gmin_kernel(q_ref, codes_ref, bias_ref, cb_ref, o_ref, recon_ref, *,
+                    alpha: float, g: int, m: int, c: int, mseg: int):
+    """One (store-tile i, query-tile j) step; recon_ref is VMEM scratch
+    [g, scg, D] f32 persisting across the inner (query) grid dimension —
+    reconstruction runs once per store tile and amortizes over every query
+    tile."""
+    scg = codes_ref.shape[1]
+    nchunks = -(-m // mseg)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _reconstruct():
+        def body(gi, _):
+            codes_blk = codes_ref[gi].astype(jnp.int32)   # [scg, M]
+            if m % mseg:
+                # pad ragged tail segments with code 0: the padded rows of
+                # cb_chunks are zeros, so they contribute nothing
+                codes_blk = jnp.pad(
+                    codes_blk, ((0, 0), (0, nchunks * mseg - m)))
+            acc = jnp.zeros((scg, recon_ref.shape[2]), jnp.float32)
+            for t in range(nchunks):
+                lo = t * mseg
+                blk = jax.lax.slice_in_dim(codes_blk, lo, lo + mseg, axis=1)
+                lanes = jax.lax.broadcasted_iota(
+                    jnp.int32, (scg, mseg, c), 2)
+                oh = (lanes == blk[:, :, None]).astype(jnp.bfloat16)
+                acc = acc + jnp.dot(
+                    oh.reshape(scg, mseg * c), cb_ref[t].astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+            recon_ref[gi] = acc
+            return 0
+
+        jax.lax.fori_loop(0, g, body, 0)
+
+    qd = q_ref[...].astype(jnp.bfloat16)
+
+    def score(gi, acc):
+        qx = jnp.dot(qd, recon_ref[gi].astype(jnp.bfloat16).T,
+                     preferred_element_type=jnp.float32)
+        return jnp.minimum(acc, bias_ref[gi] + alpha * qx)
+
+    acc0 = jnp.full(o_ref.shape, jnp.inf, jnp.float32)
+    o_ref[...] = jax.lax.fori_loop(0, g, score, acc0)
+
+
+def pq_group_min_scores(q, codes3, bias2, cb_chunks, alpha: float, *,
+                        active_g: int = G, interpret: bool = False):
+    """[B, D] queries x [G, ncols, M] codes view -> [B, ncols] group-min ADC
+    scores. B % QB == 0 and ncols % SCG == 0 (callers pad; capacities are
+    powers of two)."""
+    b, d = q.shape
+    g, ncols, m = codes3.shape
+    nchunks, mc, _ = cb_chunks.shape
+    c = mc // min(_MSEG, m)
+    ag = max(1, min(int(active_g), g))
+    qb, scg, mseg, _ = plan_tiles_pq(b, d, ncols, ag, m, c)
+    grid = (ncols // scg, b // qb)  # queries innermost: recon runs once/tile
+    return pl.pallas_call(
+        functools.partial(_pq_gmin_kernel, alpha=alpha, g=ag, m=m, c=c,
+                          mseg=mseg),
+        out_shape=jax.ShapeDtypeStruct((b, ncols), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((qb, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((ag, scg, m), lambda i, j: (0, i, 0)),
+            pl.BlockSpec((ag, scg), lambda i, j: (0, i)),
+            pl.BlockSpec((nchunks, mc, d), lambda i, j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((qb, scg), lambda i, j: (j, i)),
+        scratch_shapes=[_vmem((ag, scg, d), jnp.float32)],
+        interpret=interpret,
+    )(q, codes3, bias2, cb_chunks)
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+def pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
+                 allow_words, use_allow, k, metric, rg, active_g=G,
+                 interpret=False):
+    """Full codes-only fused search -> ([B, k] ADC dists, [B, k] slots, -1
+    missing). Mirrors gmin_scan.gmin_topk: fast scan -> top-RG groups ->
+    exact-ADC rescore of RG*G members -> top-k. flat_cb is [M*C, ds] f32
+    (row-major codebook) for the candidate reconstruction gather — tiny
+    (rg*G rows per query), XLA-side."""
+    from weaviate_tpu.ops.topk import bitmap_to_mask, rescore_distances
+
+    cap, m = codes.shape
+    ncols = cap // G
+    b, d = q.shape
+    c = flat_cb.shape[0] // m
+
+    slot = jnp.arange(cap)
+    dead = jnp.logical_or(tombs, slot >= n)
+    if use_allow:
+        dead = jnp.logical_or(dead, jnp.logical_not(bitmap_to_mask(allow_words, cap)))
+    if metric == "l2-squared":
+        base = recon_norms
+        alpha = -2.0
+    else:  # dot / cosine (rows pre-normalized at insert for cosine)
+        base = jnp.zeros((cap,), jnp.float32)
+        alpha = -1.0
+    bias = jnp.where(dead, jnp.inf, base)
+
+    codes3 = codes.reshape(G, ncols, m)
+    bias2 = bias.reshape(G, ncols)
+    gmin = pq_group_min_scores(q, codes3, bias2, cb_chunks, alpha,
+                               active_g=active_g, interpret=interpret)
+    _, gidx = jax.lax.approx_min_k(gmin, rg, recall_target=0.99)
+
+    # exact-ADC rescore of the kept groups' members: reconstruct candidates
+    # from the codebook (a small gather — rg*G rows/query) and score in f32
+    offs = (jnp.arange(G) * ncols)[None, None, :]
+    slots = (gidx[:, :, None] + offs).reshape(b, rg * G)
+    cand_codes = jnp.take(codes, slots, axis=0).astype(jnp.int32)  # [B,RG,M]
+    seg_off = (jnp.arange(m, dtype=jnp.int32) * c)[None, None, :]
+    cand = jnp.take(flat_cb, cand_codes + seg_off, axis=0).reshape(
+        b, rg * G, d)
+    if metric == "l2-squared":
+        q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        qx = jnp.einsum("bd,brd->br", q.astype(jnp.float32), cand)
+        nrm = jnp.take(recon_norms, slots)
+        ed = jnp.maximum(q_sq - 2.0 * qx + nrm, 0.0)
+    else:
+        ed = rescore_distances(cand, q, metric)
+    ed = jnp.where(jnp.isinf(jnp.take(bias, slots)), jnp.inf, ed)
+    neg, pos = jax.lax.top_k(-ed, k)
+    top = -neg
+    idx = jnp.take_along_axis(slots, pos, axis=1)
+    idx = jnp.where(jnp.isinf(top), -1, idx).astype(jnp.int32)
+    return top, idx
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("use_allow", "k", "metric", "rg", "active_g", "interpret"),
+)
+def search_pq_gmin(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
+                   allow_words, use_allow, k, metric, rg, active_g=G,
+                   interpret=False):
+    """Jitted packed wrapper (pack_topk layout), the codes-only twin of
+    gmin_scan.search_gmin."""
+    from weaviate_tpu.ops.topk import pack_topk
+
+    top, idx = pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks,
+                            flat_cb, allow_words, use_allow, k, metric, rg,
+                            active_g, interpret)
+    return pack_topk(top, idx)

@@ -152,39 +152,18 @@ def multi_get(segments_newest_first: Sequence,
               keys: Sequence[Optional[bytes]]) -> Optional[list[Optional[bytes]]]:
     """Batched point gets over a snapshot of segments (NEWEST first).
     None keys stay None. -> values list, or None => caller uses the Python
-    reader. The caller is responsible for segment lifetime (in-flight
-    protection in Bucket)."""
-    lib = _load()
-    if lib is None:
-        return None
-    handles = []
-    for s in segments_newest_first:
-        h = seg_handle(s)
-        if not h:
-            return None  # one unreadable segment would give wrong results
-        handles.append(h)
+    reader. Thin wrapper over multi_get_packed: builds the packed key
+    buffer, slices the value arena into per-key bytes."""
     n = len(keys)
     key_buf = b"".join(k or b"" for k in keys)
     lens = np.fromiter((0 if k is None else len(k) for k in keys),
                        dtype=np.int64, count=n)
     key_offs = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(lens, out=key_offs[1:])
-    out_offs = np.empty(n + 1, dtype=np.int64)
-    flags = np.empty(n, dtype=np.int8)
-    seg_arr = (ctypes.c_void_p * len(handles))(*handles)
-    cap = max(1 << 16, n * 1024)
-    key_ptr = _as_u8_ptr(key_buf)
-    for _ in range(2):
-        out = np.empty(cap, dtype=np.uint8)
-        need = lib.lsm_multi_get(
-            seg_arr, len(handles), key_ptr,
-            key_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), cap,
-            out_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            flags.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
-        if need <= cap:
-            break
-        cap = int(need)
+    packed = multi_get_packed(segments_newest_first, key_buf, key_offs)
+    if packed is None:
+        return None
+    out, out_offs, flags = packed
     res: list[Optional[bytes]] = [None] * n
     offs = out_offs.tolist()
     data = bytes(out[: offs[n]])
