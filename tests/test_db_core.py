@@ -137,6 +137,34 @@ def test_batch_duplicate_uuid_within_batch(db):
     assert res[0][0].distance > 1.0
 
 
+def test_allow_list_cached_across_fresh_filter_objects(db):
+    """The serving path builds a fresh LocalFilter per request: the shard's
+    allowList cache must key on filter CONTENT (same Bitmap object back, so
+    the device-words cache downstream also engages) and invalidate on ANY
+    write."""
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    idx = db.add_class(make_class(), cfg)
+    idx.put_batch([new_obj(i) for i in range(30)])
+    shard = next(iter(idx.shards.values()))
+    d = {"operator": "LessThan", "path": ["wordCount"], "valueInt": 10}
+    a1 = shard.build_allow_list(LocalFilter.from_dict(d))
+    a2 = shard.build_allow_list(LocalFilter.from_dict(dict(d)))  # fresh objs
+    assert a2 is a1, "content-equal filters must reuse the cached Bitmap"
+    assert sorted(int(x) for x in a1.to_array()) == sorted(
+        shard.object_by_uuid(new_obj(i).uuid).doc_id for i in range(10))
+    # ANY write invalidates: the new matching object must appear
+    extra = new_obj(100)
+    extra.properties["wordCount"] = 5
+    idx.put_object(extra)
+    a3 = shard.build_allow_list(LocalFilter.from_dict(d))
+    assert a3 is not a1
+    assert shard.object_by_uuid(extra.uuid).doc_id in [int(x) for x in a3.to_array()]
+    # deletes invalidate too
+    idx.delete_object(new_obj(3).uuid)
+    a4 = shard.build_allow_list(LocalFilter.from_dict(d))
+    assert a4 is not a3
+
+
 def test_filtered_vector_search(db):
     cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
     idx = db.add_class(make_class(), cfg)

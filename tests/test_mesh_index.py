@@ -439,6 +439,43 @@ def test_mesh_gmin_fused_kernel_matches_exact(tmp_path, rng):
     assert all(int(x) < 500 for x in flat)
 
 
+def test_mesh_pq_codes_fused_kernel_matches_legacy(tmp_path, rng):
+    """Codes-only tier on the mesh: slabs big enough for the fused
+    per-shard ADC kernel (n_loc/G >= 64) must serve through it (separate
+    validation domain), with the same winners as the legacy reconstruction
+    scan."""
+    config = parse_and_validate_config(
+        "hnsw_tpu_mesh", {"distance": "l2-squared"})
+    idx = MeshVectorIndex(config, str(tmp_path / "pqm"),
+                          initial_capacity_per_shard=1024)
+    n = 2000
+    vecs = rng.standard_normal((n, DIM)).astype(np.float32)
+    idx.add_batch(np.arange(n), vecs)
+    idx.update_user_config(parse_and_validate_config(
+        "hnsw_tpu_mesh",
+        {"distance": "l2-squared",
+         "pq": {"enabled": True, "segments": 8, "centroids": 32,
+                "rescore": False}}))
+    assert idx.compressed
+    q = vecs[:16] + 0.001 * rng.standard_normal((16, DIM)).astype(np.float32)
+    ids_f, d_f = idx.search_by_vectors(q, 5)
+    assert idx._pqg_state._gmin_validated and not idx._pqg_state._gmin_broken
+    idx._pqg_state._gmin_broken = True  # force the legacy recon scan
+    ids_l, d_l = idx.search_by_vectors(q, 5)
+    idx._pqg_state._gmin_broken = False
+    for i in range(16):
+        assert set(int(x) for x in ids_f[i]) == set(int(x) for x in ids_l[i]), i
+        # the legacy scan computes ADC in bf16 matmuls; the fused path
+        # rescores its candidates in f32 — same quantizer, small skew
+        np.testing.assert_allclose(np.sort(d_f[i]), np.sort(d_l[i]),
+                                   rtol=0.08, atol=0.05)
+    # deletes hold through the fused path
+    idx.delete(0, 2)
+    ids_d, _ = idx.search_by_vectors(q[:4], 3)
+    flat = ids_d.ravel()
+    assert 0 not in [int(x) for x in flat] and 2 not in [int(x) for x in flat]
+
+
 def test_pq_mesh_compact_keeps_f32_log(tmp_path, rng):
     """compact() under PQ rewrites the log from the f32 host copy, not the
     bf16-downcast device store."""

@@ -17,6 +17,7 @@ TPU-first deltas from the reference:
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import threading
@@ -158,6 +159,9 @@ class Shard:
         self.store.start_compaction_cycle()
         self.status = STATUS_READY
         self._deleted: dict[str, int] = {}  # uuid -> deletion ms (digests)
+        # allowList cache: filter-content key -> (write generation, Bitmap)
+        self._write_gen = 0
+        self._allow_cache: dict[str, tuple[int, Bitmap]] = {}
         self._lock = threading.RLock()
 
     # -- geo props (propertyspecific/ + vector/geo) --------------------------
@@ -184,6 +188,7 @@ class Shard:
 
     def update_schema(self, class_def: ClassDef) -> None:
         with self._lock:
+            self._write_gen += 1  # filterable backfill mutates the inverted index
             self.class_def = class_def
             self.inverted.update_schema(class_def)
             self._init_geo_indexes()
@@ -216,6 +221,7 @@ class Shard:
         ping-pong forever)."""
         with self._lock:
             self._check_writable()
+            self._write_gen += 1
             key = _uuid_bytes(obj.uuid)
             self._deleted.pop(obj.uuid, None)
             prev_raw = self.objects.get(key)
@@ -263,6 +269,7 @@ class Shard:
         preserve_times: see put_object (replica apply path)."""
         with self._lock:
             self._check_writable()
+            self._write_gen += 1
             errs: list[Optional[Exception]] = [None] * len(objs)
             fresh_ids: list[int] = []
             fresh_vecs: list[np.ndarray] = []
@@ -357,6 +364,7 @@ class Shard:
         parity: deletes are not durable conflict-resolution state)."""
         with self._lock:
             self._check_writable()
+            self._write_gen += 1
             key = _uuid_bytes(uuid)
             raw = self.objects.get(key)
             if raw is None:
@@ -427,10 +435,29 @@ class Shard:
                 for r in raws]
 
     def build_allow_list(self, flt: Optional[LocalFilter]) -> Optional[Bitmap]:
-        """filters -> allowList (shard_read.go:377 buildAllowList)."""
+        """filters -> allowList (shard_read.go:377 buildAllowList).
+
+        Cached per filter CONTENT for the current write generation: the
+        serving path constructs a fresh LocalFilter/Bitmap per request, so
+        without this the inverted-index evaluation AND the device-words
+        pack (which caches on the Bitmap object — index/tpu.py
+        _allow_words) re-run on every query of a repeated filter. Any
+        write bumps the generation and invalidates."""
         if flt is None:
             return None
-        return self.searcher.doc_ids(flt)
+        try:
+            key = json.dumps(flt.to_dict(), sort_keys=True, default=str)
+        except Exception:  # noqa: BLE001 — unhashable filter: just evaluate
+            return self.searcher.doc_ids(flt)
+        gen = self._write_gen
+        hit = self._allow_cache.get(key)
+        if hit is not None and hit[0] == gen:
+            return hit[1]
+        allow = self.searcher.doc_ids(flt)
+        if len(self._allow_cache) >= 16:  # small FIFO: hot filters are few
+            self._allow_cache.pop(next(iter(self._allow_cache)))
+        self._allow_cache[key] = (gen, allow)
+        return allow
 
     def object_vector_search(
         self,

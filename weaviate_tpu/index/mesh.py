@@ -129,6 +129,11 @@ class MeshVectorIndex(VectorIndex):
         self._gmin_broken = False  # fused mesh kernel failed: use the scan
         # identity token for the per-allowList packed-words cache
         self._allow_token = object()
+        # separate failure domain + codebook cache for the PQ codes kernel
+        from weaviate_tpu.ops.gmin_scan import KernelState
+
+        self._pqg_state = KernelState()
+        self._pqg_cb = None
         self._gmin_validated: set = set()     # shapes that served correctly
         self._gmin_shape_broken: set = set()  # shapes Mosaic rejected
         self._log = (
@@ -606,6 +611,17 @@ class MeshVectorIndex(VectorIndex):
             from weaviate_tpu.ops.topk import unpack_topk
 
             if self.compressed:
+                if not self.config.pq.rescore:
+                    # codes-only tier: try the fused per-shard ADC kernel
+                    # (mesh twin of the single-chip pq_gmin dispatch)
+                    packed = self._pq_gmin_step_or_none(q, kk, words, use_allow)
+                    if packed is not None:
+                        top, rows = unpack_topk(np.asarray(packed))
+                        top, rows = top[:b], rows[:b]
+                        ids = np.where(
+                            rows >= 0,
+                            self._slot_to_doc[np.clip(rows, 0, None)], -1)
+                        return ids.astype(np.uint64), top.astype(np.float32)
                 nchunks_eff = max(1, self.n_loc // chunk)
                 pool_target = self.config.pq.rescore_limit or 1024
                 r_chunk = min(
@@ -680,6 +696,48 @@ class MeshVectorIndex(VectorIndex):
                                    self._store.dtype.itemsize):
             return None
         return rg, active_g
+
+    def _pq_gmin_step_or_none(self, q: np.ndarray, kk: int, words, use_allow):
+        """Run the fused per-shard PQ codes kernel, or None for the legacy
+        reconstruction scan — separate failure domain (self._pqg_state);
+        gating and codebook constants are the shared helpers in
+        ops/pq_gmin.py (one copy with the single-chip dispatch)."""
+        from weaviate_tpu.parallel.mesh_search import mesh_search_pq_gmin_step
+
+        from weaviate_tpu.ops import gmin_scan, pq_gmin
+
+        ncols_l = self.n_loc // gmin_scan.G
+        active_g = max(1, -(-int(self._counts.max()) // ncols_l)) if ncols_l else 1
+        rg = pq_gmin.eligible_rg(
+            self._pqg_state, getattr(self.config, "exact_topk", False),
+            self.metric, self._pq, q.shape[0], ncols_l, kk, self.dim, active_g)
+        if rg is None:
+            return None
+        m, c = self._pq.segments, self._pq.centroids
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        cb_chunks, flat_cb = pq_gmin.cached_cb_constants(self)
+        key = ("pq", q.shape[0], kk, rg, active_g, self.n_loc, m, c, use_allow)
+        packed = gmin_scan.guarded_kernel_call(
+            self._pqg_state, key,
+            lambda: mesh_search_pq_gmin_step(
+                self._codes,
+                self._recon_norms,
+                self._tombs,
+                jnp.asarray(self._counts.astype(np.int32)),
+                words,
+                cb_chunks,
+                flat_cb,
+                jnp.asarray(q),
+                kk,
+                self.metric,
+                use_allow,
+                rg,
+                active_g,
+                interpret,
+                self.mesh,
+            ),
+            "mesh pq codes kernel")
+        return None if packed is None else np.asarray(packed)
 
     def _gmin_step_or_none(self, q: np.ndarray, kk: int, words, use_allow):
         """Run the fused group-min mesh kernel, or None for the legacy scan.

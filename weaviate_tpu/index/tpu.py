@@ -1162,50 +1162,28 @@ class TpuVectorIndex(VectorIndex):
             lambda: self._search_full_gmin(q, kk, allow_words, store, sq_norms),
             "fused gmin kernel")
 
-    def _pq_gmin_cb(self):
-        """Device codebook constants for the fused codes kernel, cached per
-        ProductQuantizer instance (rebuilt on compress/restore)."""
-        from weaviate_tpu.ops import pq_gmin
-
-        if self._pqg_cb is None or self._pqg_cb[0] is not self._pq:
-            cb = self._pq.codebook  # [M, C, ds] f32
-            m = cb.shape[0]
-            # bf16 on device: the kernel computes in bf16 anyway, and the
-            # VMEM planner counts this block at 2 bytes/element
-            chunks = jnp.asarray(
-                pq_gmin.build_cb_chunks(cb, min(pq_gmin._MSEG, m)),
-                dtype=jnp.bfloat16)
-            flat = jnp.asarray(cb.reshape(-1, cb.shape[2]))
-            self._pqg_cb = (self._pq, chunks, flat)
-        return self._pqg_cb[1], self._pqg_cb[2]
-
     def _pq_gmin_packed_or_none(self, q: np.ndarray, b: int, k: int,
                                 allow_list):
         """Run the fused PQ codes kernel, or None for the legacy recon
         scan. Same per-shape validation contract as the dense kernel, on a
-        SEPARATE failure domain (self._pqg_state)."""
+        SEPARATE failure domain (self._pqg_state); gating and codebook
+        constants are the shared helpers in ops/pq_gmin.py."""
         from weaviate_tpu.ops import gmin_scan, pq_gmin
 
-        if self._pqg_state._gmin_broken or getattr(self.config, "exact_topk", False):
-            return None
-        if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
-            return None
-        if self._pq is None or self._pq.centroids > 256 or q.shape[0] < 8:
-            return None
         ncols = self.capacity // gmin_scan.G
         kk = min(k, self.live)
-        rg = min(max(32, 2 * kk), 128, ncols)
-        if rg < kk:
-            return None
         active_g = max(1, -(-self.n // ncols))
-        m, c = self._pq.segments, self._pq.centroids
-        if not pq_gmin.fits_vmem_pq(q.shape[0], self.dim, ncols, active_g, m, c):
+        rg = pq_gmin.eligible_rg(
+            self._pqg_state, getattr(self.config, "exact_topk", False),
+            self.metric, self._pq, q.shape[0], ncols, kk, self.dim, active_g)
+        if rg is None:
             return None
+        m, c = self._pq.segments, self._pq.centroids
         interpret = jax.default_backend() not in ("tpu", "axon")
         use_allow = allow_list is not None
         words = (self._allow_words(allow_list) if use_allow
                  else jnp.zeros((self.capacity // 32,), jnp.uint32))
-        cb_chunks, flat_cb = self._pq_gmin_cb()
+        cb_chunks, flat_cb = pq_gmin.cached_cb_constants(self)
         key = (q.shape[0], kk, rg, active_g, self.capacity, m, c, use_allow)
         return gmin_scan.guarded_kernel_call(
             self._pqg_state, key,

@@ -75,6 +75,47 @@ def fits_vmem_pq(b: int, d: int, ncols: int, ag: int, m: int, c: int) -> bool:
     return plan_tiles_pq(b, d, ncols, ag, m, c)[3] <= _VMEM_BUDGET
 
 
+_MATMUL_METRICS = ("l2-squared", "dot", "cosine")
+
+
+def eligible_rg(state, exact_topk: bool, metric: str, pq, b: int, ncols: int,
+                kk: int, dim: int, active_g: int):
+    """Shared eligibility gate for the fused codes kernel -> rg (kept
+    groups) when this shape may serve, else None. ONE copy for the
+    single-chip and mesh dispatches so their gating cannot diverge (the
+    same contract KernelState enforces for fallback state)."""
+    if state._gmin_broken or exact_topk:
+        return None
+    if metric not in _MATMUL_METRICS:
+        return None
+    if pq is None or pq.centroids > 256 or b < 8:
+        return None
+    if ncols < 64:
+        return None
+    rg = min(max(32, 2 * kk), 128, ncols)
+    if rg < kk:
+        return None
+    if not fits_vmem_pq(b, dim, ncols, active_g, pq.segments, pq.centroids):
+        return None
+    return rg
+
+
+def cached_cb_constants(index):
+    """Device codebook constants for the fused codes kernel, cached on the
+    index per ProductQuantizer instance (index carries `_pqg_cb` and
+    `_pq`): (bf16 block-diagonal chunks — what the kernel holds in VMEM,
+    counted at 2 bytes by the planner — and the f32 flat codebook for the
+    exact-ADC candidate rescore)."""
+    if index._pqg_cb is None or index._pqg_cb[0] is not index._pq:
+        cb = index._pq.codebook  # [M, C, ds] f32
+        m = cb.shape[0]
+        chunks = jnp.asarray(build_cb_chunks(cb, min(_MSEG, m)),
+                             dtype=jnp.bfloat16)
+        flat = jnp.asarray(cb.reshape(-1, cb.shape[2]))
+        index._pqg_cb = (index._pq, chunks, flat)
+    return index._pqg_cb[1], index._pqg_cb[2]
+
+
 def build_cb_chunks(codebook: np.ndarray, mseg: int) -> np.ndarray:
     """[M, C, ds] codebook -> [n_chunks, mseg*C, D] bf16 block-diagonal
     chunks: chunk t row (s*C + c) carries codebook[t*mseg + s, c] in columns

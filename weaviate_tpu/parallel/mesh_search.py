@@ -200,6 +200,49 @@ def mesh_search_gmin_step(
 
 @functools.partial(
     jax.jit,
+    static_argnames=("k", "metric", "use_allow", "rg", "active_g",
+                     "interpret", "mesh"),
+)
+def mesh_search_pq_gmin_step(
+    codes, recon_norms, tombs, n_per_shard, allow_words, cb_chunks, flat_cb,
+    queries, k, metric, use_allow, rg, active_g, interpret, mesh,
+):
+    """Codes-only fused ADC kNN, mesh-sharded: each chip runs the SAME
+    reconstruction-as-matmul Pallas scan the single-chip index uses
+    (ops/pq_gmin.pq_gmin_topk) over its own uint8 code slab — codes never
+    expand in HBM — and the cross-chip merge all_gathers k (ADC dist,
+    global-row) pairs over ICI and reselects, exactly like the dense
+    mesh_search_gmin_step. ADC distances are deterministic per slab, so the
+    merge is exact w.r.t. the quantizer."""
+    from weaviate_tpu.ops import pq_gmin
+
+    n_dev = mesh.devices.size
+    n_loc = codes.shape[0] // n_dev
+
+    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb_c, fcb, q):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        n_mine = n_all[my]
+        d_top, i_top = pq_gmin.pq_gmin_topk(
+            codes_l, norms_l, tombs_l, n_mine, q, cb_c, fcb, allow_l,
+            use_allow, k, metric, rg, active_g, interpret)
+        i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
+        return _merge_across_shards(d_top, i_glob, k)
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
+            P(SHARD_AXIS), P(), P(), P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(codes, recon_norms, tombs, n_per_shard, allow_words, cb_chunks,
+      flat_cb, queries)
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("k", "r_chunk", "metric", "use_allow", "exact",
                      "do_rescore", "mesh"),
 )
