@@ -488,8 +488,11 @@ def run_cpu_matrix(rng):
     tiers.update(_pq_tier_rows(
         vecs, queries, gt, tiers=("rescored", "codes_only"), reps=3))
     tiers["provenance"] = (
-        "PQ serving tiers (commit 00ac1d6: rescored tier scans the bf16 "
-        "rescore store via gmin; codes-only runs reconstruction-matmul ADC)"
+        "PQ serving tiers: rescored scans the bf16 rescore store via gmin; "
+        "codes-only rides the fused PQ-ADC group-min kernel "
+        "(ops/pq_gmin.py, round 4 — was 13.6 QPS on the reconstruction "
+        "gather). Raw-ADC recall is the quantizer's accuracy; rescore=true "
+        "is the quality tier."
     )
     rows["pq_tiers_cpu"] = tiers
     _merge_matrix(rows)
