@@ -262,6 +262,108 @@ def test_raw_batch_lane_equivalence_and_engagement(tmp_path):
     app.shutdown()
 
 
+def test_raw_lane_concurrent_searches_and_writes(tmp_path):
+    """Production concurrency shape: batch searches hammer the raw lane
+    from multiple threads while a writer keeps mutating the class. Every
+    reply must be well-formed with correct distances for its own query —
+    the lane may bounce between engaged (flushed) and declined (memtable
+    busy), but never corrupt a result."""
+    import threading
+
+    from weaviate_tpu.server.grpc_server import SearchServicer
+
+    app = App(data_path=str(tmp_path / "conc"))
+    app.schema.add_class({
+        "class": "C",
+        "properties": [{"name": "rank", "dataType": ["int"]}],
+        "vectorIndexConfig": {"distance": "l2-squared"},
+    })
+    rng = np.random.default_rng(9)
+    vecs = rng.standard_normal((400, 16)).astype(np.float32)
+    app.batch.add_objects([{
+        "class": "C", "id": str(uuidlib.UUID(int=i + 1)),
+        "properties": {"rank": i}, "vector": vecs[i].tolist(),
+    } for i in range(400)])
+    idx = app.db.get_index("C")
+    shard = next(iter(idx.shards.values()))
+    for b in (shard.objects, shard.docid_lookup):
+        b.flush_memtable()
+    sv = SearchServicer(app)
+
+    class Ctx:
+        def abort(self, *a):
+            raise AssertionError(a)
+
+    breq = pb.BatchSearchRequest(requests=[
+        pb.SearchRequest(class_name="C", limit=3,
+                         near_vector=pb.NearVectorParams(vector=vecs[i].tolist()))
+        for i in range(16)
+    ])
+    errors: list = []
+    stop = threading.Event()
+
+    def searcher():
+        try:
+            _searcher()
+        except Exception as e:  # noqa: BLE001 — a dead thread must fail the test
+            errors.append(("searcher-raised", repr(e)))
+
+    def _searcher():
+        while not stop.is_set():
+            out = sv.BatchSearch(breq, Ctx())
+            rep = pb.BatchSearchReply.FromString(
+                out if isinstance(out, (bytes, bytearray))
+                else out.SerializeToString())
+            if len(rep.replies) != 16:
+                errors.append(("replies", len(rep.replies)))
+                return
+            for i, one in enumerate(rep.replies):
+                if one.error_message or not one.results:
+                    errors.append((i, one.error_message))
+                    return
+                # query i is doc i's own vector: its top hit is itself with
+                # ~zero distance (docs 0..15 are never touched by the writer)
+                if one.results[0].id != str(uuidlib.UUID(int=i + 1)) or \
+                        one.results[0].distance > 1e-3:
+                    errors.append((i, one.results[0].id,
+                                   one.results[0].distance))
+                    return
+
+    def writer():
+        try:
+            _writer()
+        except Exception as e:  # noqa: BLE001
+            errors.append(("writer-raised", repr(e)))
+
+    def _writer():
+        j = 1000
+        while not stop.is_set():
+            app.batch.add_objects([{
+                "class": "C", "id": str(uuidlib.UUID(int=j + 1)),
+                "properties": {"rank": j},
+                "vector": (rng.standard_normal(16) * 10 + 50).astype(
+                    np.float32).tolist(),  # far away: never a top hit
+            }])
+            j += 1
+            if j % 7 == 0:  # re-flush so the raw lane re-engages
+                for b in (shard.objects, shard.docid_lookup):
+                    b.flush_memtable()
+
+    threads = [threading.Thread(target=searcher) for _ in range(3)]
+    wt = threading.Thread(target=writer)
+    for t in threads:
+        t.start()
+    wt.start()
+    import time as _t
+
+    _t.sleep(3.0)
+    stop.set()
+    for t in threads + [wt]:
+        t.join()
+    assert not errors, errors[:3]
+    app.shutdown()
+
+
 def test_batch_search_per_slot_errors(setup):
     _, _, client, vecs = setup
     breq = pb.BatchSearchRequest(requests=[

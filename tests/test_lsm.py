@@ -223,6 +223,21 @@ def test_native_multi_get_races_compaction(tmp_path):
         assert not b._retired_segments  # all retired segments were closed
 
 
+def test_reserved_tombstone_value_refused(tmp_path):
+    """Storing the in-band delete marker as a value would silently read
+    back as deleted — the bucket must refuse it loudly (found by the
+    native-plane property fuzzer before the guard existed). Pure-Python
+    behavior: runs regardless of native availability."""
+    from weaviate_tpu.storage.lsm import _TOMBSTONE
+
+    b = Bucket(str(tmp_path / "b"), STRATEGY_REPLACE)
+    with pytest.raises(LsmError):
+        b.put(b"k", _TOMBSTONE)
+    with pytest.raises(LsmError):
+        b.put_many([(b"a", b"ok"), (b"k", _TOMBSTONE)])
+    assert b.get(b"a") is None  # the batch was refused atomically
+
+
 def test_wal_torn_tail(tmp_path):
     p = str(tmp_path / "b")
     b = Bucket(p, STRATEGY_REPLACE)

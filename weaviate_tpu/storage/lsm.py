@@ -583,6 +583,12 @@ class Bucket:
 
     def put(self, key: bytes, value: bytes) -> None:
         assert self.strategy == STRATEGY_REPLACE
+        if value == _TOMBSTONE:
+            # the delete marker is in-band: storing its exact bytes as a
+            # value would read back as "deleted" — silent data loss. No
+            # production codec can produce it (storobj images start 0x01,
+            # uuid values are 16 bytes); refuse loudly instead of losing it.
+            raise LsmError("value collides with the reserved tombstone marker")
         with self._lock:
             self._wal_append(_W_PUT, key, value)
             self._mem.put(key, value)
@@ -594,6 +600,8 @@ class Bucket:
         pairs = list(pairs)
         if not pairs:
             return
+        if any(v == _TOMBSTONE for _, v in pairs):
+            raise LsmError("value collides with the reserved tombstone marker")
         with self._lock:
             self._wal_append_many([(_W_PUT, k, v) for k, v in pairs])
             mput = self._mem.put
