@@ -286,3 +286,20 @@ def test_pprof_surface(port):
 
     st, cl = _req(port, "GET", "/debug/pprof/cmdline", raw=True)
     assert st == 200 and cl
+
+
+def test_pprof_device_trace(port):
+    """/debug/pprof/trace captures a JAX device trace (the TPU twin of
+    pprof's execution trace) and reports where it was written."""
+    st, body = _req(port, "GET", "/debug/pprof/trace?seconds=0.2", raw=True)
+    assert st == 200, body[:300]
+    assert b"device trace written to" in body
+    # the reported directory exists and holds the capture
+    import os
+
+    trace_dir = body.decode().splitlines()[0].split(" to ", 1)[1].strip()
+    assert os.path.isdir(trace_dir)
+    names = []
+    for root, _dirs, files in os.walk(trace_dir):
+        names.extend(files)
+    assert names, "trace capture produced no files"

@@ -21,6 +21,7 @@ Endpoints (all GET, mounted on the main REST port like the reference):
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -104,10 +105,56 @@ def cmdline() -> str:
     return "\x00".join(sys.argv) + "\n"
 
 
+_trace_lock = threading.Lock()
+
+
+class TraceBusyError(RuntimeError):
+    """A device trace is already being captured (maps to HTTP 409)."""
+
+
+def device_trace(data_path: str, seconds: float = 3.0) -> str:
+    """Capture a JAX device trace for ?seconds — the TPU twin of pprof's
+    execution trace (the reference's /debug/pprof/trace). Records XLA op
+    timelines and device (TPU/HBM) activity for whatever the serving path
+    runs during the window; writes a perfetto/tensorboard trace under
+    <data>/traces/<stamp>/ and returns its path + file listing (view with
+    `tensorboard --logdir` or ui.perfetto.dev). One capture at a time —
+    concurrent requests get an explicit error, not a corrupt trace."""
+    import glob
+    import tempfile
+
+    import jax
+
+    if not _trace_lock.acquire(blocking=False):
+        raise TraceBusyError("a device trace is already being captured")
+    try:
+        root = os.path.join(data_path, "traces")
+        os.makedirs(root, exist_ok=True)
+        # mkdtemp: consecutive captures in the same wall-clock second must
+        # not merge into one tensorboard/perfetto session
+        out_dir = tempfile.mkdtemp(
+            prefix=time.strftime("%Y%m%d-%H%M%S-"), dir=root)
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(max(0.0, min(float(seconds), 60.0)))
+        finally:
+            jax.profiler.stop_trace()
+        files = sorted(
+            os.path.relpath(p, out_dir)
+            for p in glob.glob(os.path.join(out_dir, "**"), recursive=True)
+            if os.path.isfile(p))
+        return (f"device trace written to {out_dir}\n"
+                + "".join(f"  {f}\n" for f in files)
+                + "view: tensorboard --logdir <dir>  (or ui.perfetto.dev)\n")
+    finally:
+        _trace_lock.release()
+
+
 def index() -> str:
     return (
         "/debug/pprof/\n"
         "  profile?seconds=5&hz=100  sampled CPU profile (collapsed stacks)\n"
+        "  trace?seconds=3           JAX device trace (XLA ops, TPU activity)\n"
         "  goroutine                 all thread stacks\n"
         "  heap?limit=30             tracemalloc top allocation sites\n"
         "  cmdline                   process argv\n"

@@ -101,6 +101,7 @@ for _m, _p, _n in [
     # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
     ("GET", r"/debug/pprof/?", "pprof_index"),
     ("GET", r"/debug/pprof/profile", "pprof_profile"),
+    ("GET", r"/debug/pprof/trace", "pprof_trace"),
     ("GET", r"/debug/pprof/goroutine", "pprof_goroutine"),
     ("GET", r"/debug/pprof/heap", "pprof_heap"),
     ("GET", r"/debug/pprof/cmdline", "pprof_cmdline"),
@@ -248,6 +249,19 @@ class Handler(BaseHTTPRequestHandler):
             seconds=float(self.query.get("seconds", 5)),
             hz=int(self.query.get("hz", 100)),
         )
+        self._reply(200, raw=text.encode(), content_type="text/plain")
+
+    def h_pprof_trace(self):
+        from weaviate_tpu.monitoring import profiling
+
+        try:
+            text = profiling.device_trace(
+                self.app.db.root_path,
+                seconds=float(self.query.get("seconds", 3)),
+            )
+        except profiling.TraceBusyError as e:
+            self._reply(409, {"error": [{"message": str(e)}]})
+            return
         self._reply(200, raw=text.encode(), content_type="text/plain")
 
     def h_pprof_goroutine(self):
