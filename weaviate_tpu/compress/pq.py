@@ -38,6 +38,8 @@ Array = jax.Array
 
 _FIT_SAMPLE_MAX = 16384   # rows used to fit codebooks (kmeans.go samples too)
 _KMEANS_ITERS = 10
+_OPQ_ITERS = 6            # outer Procrustes alternations (OPQ-NP)
+_OPQ_INNER_ITERS = 4      # kmeans depth per alternation (full depth at the end)
 # encode streams the store through the device in fixed chunks; big chunks
 # matter off-chip (each dispatch pays the full host<->device round trip)
 _ENCODE_CHUNK = 65536
@@ -45,7 +47,7 @@ _ENCODE_CHUNK = 65536
 
 # -- kmeans (per-segment, on device) ----------------------------------------
 
-def _kmeans_one_segment(data: Array, init: Array) -> Array:
+def _kmeans_one_segment(data: Array, init: Array, iters: int) -> Array:
     """Lloyd iterations for one segment. data [N, ds], init [C, ds] -> [C, ds]."""
     n = data.shape[0]
     c = init.shape[0]
@@ -66,14 +68,15 @@ def _kmeans_one_segment(data: Array, init: Array) -> Array:
         # empty clusters keep their previous centroid
         return jnp.where(counts[:, None] > 0, new, cent)
 
-    return jax.lax.fori_loop(0, _KMEANS_ITERS, step, init)
+    return jax.lax.fori_loop(0, iters, step, init)
 
 
-@jax.jit
-def _kmeans_fit(data_seg: Array, init: Array) -> Array:
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _kmeans_fit(data_seg: Array, init: Array, iters: int = _KMEANS_ITERS) -> Array:
     """data_seg [M, N, ds], init [M, C, ds] -> codebook [M, C, ds].
     lax.map keeps peak memory at one segment's [N, C] assignment matrix."""
-    return jax.lax.map(lambda t: _kmeans_one_segment(t[0], t[1]), (data_seg, init))
+    return jax.lax.map(
+        lambda t: _kmeans_one_segment(t[0], t[1], iters), (data_seg, init))
 
 
 # -- encode ------------------------------------------------------------------
@@ -161,7 +164,8 @@ class ProductQuantizer:
 
     def __init__(self, dim: int, segments: int, centroids: int, metric: str,
                  encoder: str = vi.PQ_ENCODER_KMEANS,
-                 distribution: str = vi.PQ_DISTRIBUTION_LOG_NORMAL):
+                 distribution: str = vi.PQ_DISTRIBUTION_LOG_NORMAL,
+                 rotation: str = vi.PQ_ROTATION_NONE):
         if segments <= 0:
             segments = dim  # auto (= dims), pq_config.go default
         if dim % segments != 0:
@@ -176,6 +180,18 @@ class ProductQuantizer:
             raise vi.ConfigValidationError("pq does not support hamming")
         if encoder == vi.PQ_ENCODER_TILE and dim != segments:
             raise vi.ConfigValidationError("tile encoder requires segments == dims")
+        if rotation not in (vi.PQ_ROTATION_NONE, vi.PQ_ROTATION_OPQ):
+            raise vi.ConfigValidationError(
+                f"pq.rotation must be 'none' or 'opq', got {rotation!r}")
+        if rotation == vi.PQ_ROTATION_OPQ:
+            if metric == vi.DISTANCE_MANHATTAN:
+                # L1 is not rotation-invariant: rotated-space ADC distances
+                # would rank by a different geometry than the index serves
+                raise vi.ConfigValidationError(
+                    "pq.rotation 'opq' requires an l2/dot/cosine distance")
+            if encoder == vi.PQ_ENCODER_TILE:
+                raise vi.ConfigValidationError(
+                    "pq.rotation 'opq' requires the kmeans encoder")
         self.dim = dim
         self.segments = segments
         self.centroids = centroids
@@ -183,9 +199,12 @@ class ProductQuantizer:
         self.metric = metric
         self.encoder = encoder
         self.distribution = distribution
+        self.rotation = rotation
+        self.rotation_matrix: Optional[np.ndarray] = None  # [D, D] orthogonal
         self.code_dtype = np.uint8 if centroids <= 256 else np.uint16
         self.codebook: Optional[np.ndarray] = None  # [M, C, ds] float32
         self._codebook_dev: Optional[Array] = None
+        self._rot_dev: Optional[Array] = None
 
     # fit ---------------------------------------------------------------
 
@@ -197,11 +216,15 @@ class ProductQuantizer:
             vectors = vectors[sel]
         if self.encoder == vi.PQ_ENCODER_TILE:
             self.codebook = self._fit_tile(vectors)
+        elif self.rotation == vi.PQ_ROTATION_OPQ:
+            self._fit_opq(vectors, seed)
         else:
             self.codebook = self._fit_kmeans(vectors, seed)
         self._codebook_dev = None
+        self._rot_dev = None  # a re-fit replaces the rotation too
 
-    def _fit_kmeans(self, vectors: np.ndarray, seed: int) -> np.ndarray:
+    def _fit_kmeans(self, vectors: np.ndarray, seed: int,
+                    iters: int = _KMEANS_ITERS) -> np.ndarray:
         n = vectors.shape[0]
         m, c, ds = self.segments, self.centroids, self.ds
         data_seg = np.ascontiguousarray(
@@ -213,8 +236,31 @@ class ProductQuantizer:
         if init.shape[1] < c:  # fewer samples than centroids: tile them
             reps = -(-c // init.shape[1])
             init = np.tile(init, (1, reps, 1))[:, :c]
-        cb = _kmeans_fit(jnp.asarray(data_seg), jnp.asarray(init))
+        cb = _kmeans_fit(jnp.asarray(data_seg), jnp.asarray(init), iters)
         return np.asarray(cb, dtype=np.float32)
+
+    def _fit_opq(self, vectors: np.ndarray, seed: int) -> None:
+        """OPQ-NP (Ge et al. 2013): alternate per-segment kmeans in the
+        rotated space with a Procrustes update of the orthogonal rotation
+        R = argmin ||XR - recon|| = U V^T from svd(X^T recon). The
+        quantizer then lives entirely in the rotated space (codebook,
+        codes, ADC distances — all rotation-invariant for the matmul
+        metrics); decode() maps reconstructions back. On TPU the query-side
+        cost is one [B, D] x [D, D] matmul folded into the jitted search.
+        The reference has no analog — its PQ segments the raw dims."""
+        x = vectors  # [N, D] fit sample
+        r = np.eye(self.dim, dtype=np.float32)
+        for _ in range(_OPQ_ITERS):
+            xr = x @ r
+            self.codebook = self._fit_kmeans(xr, seed, iters=_OPQ_INNER_ITERS)
+            self._codebook_dev = None
+            recon = self.decode_rotated(self.encode_rotated(xr))
+            # Procrustes: [D, D] svd — trivial at vector dims
+            u, _s, vt = np.linalg.svd(x.T @ recon)
+            r = (u @ vt).astype(np.float32)
+        self.rotation_matrix = r
+        xr = x @ r
+        self.codebook = self._fit_kmeans(xr, seed)  # final full-depth fit
 
     def _fit_tile(self, vectors: np.ndarray) -> np.ndarray:
         """Distribution-based scalar quantile encoder (tile_encoder.go): per
@@ -246,8 +292,27 @@ class ProductQuantizer:
             self._codebook_dev = jnp.asarray(self.codebook)
         return self._codebook_dev
 
+    def rotation_dev(self) -> Array:
+        """[D, D] device rotation for the jitted search paths — identity
+        when no rotation is fitted, so callers apply it unconditionally
+        (one tiny MXU matmul)."""
+        if self._rot_dev is None:
+            r = (self.rotation_matrix if self.rotation_matrix is not None
+                 else np.eye(self.dim, dtype=np.float32))
+            self._rot_dev = jnp.asarray(r)
+        return self._rot_dev
+
     def encode(self, vectors: np.ndarray) -> np.ndarray:
-        """[N, D] float32 -> [N, M] uint8/16 codes (Encode, :348)."""
+        """[N, D] float32 -> [N, M] codes; rotates into the quantizer's
+        space first when an OPQ rotation is fitted."""
+        vectors = np.asarray(vectors, dtype=np.float32)
+        if self.rotation_matrix is not None:
+            vectors = vectors @ self.rotation_matrix
+        return self.encode_rotated(vectors)
+
+    def encode_rotated(self, vectors: np.ndarray) -> np.ndarray:
+        """[N, D] ALREADY-ROTATED float32 -> [N, M] uint8/16 codes
+        (Encode, :348)."""
         vectors = np.asarray(vectors, dtype=np.float32)
         n = vectors.shape[0]
         m, ds = self.segments, self.ds
@@ -263,16 +328,28 @@ class ProductQuantizer:
             out[off:end] = codes.astype(self.code_dtype)
         return out
 
-    def decode(self, codes: np.ndarray) -> np.ndarray:
-        """[N, M] codes -> [N, D] reconstructed float32 (centroid lookup)."""
+    def decode_rotated(self, codes: np.ndarray) -> np.ndarray:
+        """[N, M] codes -> [N, D] reconstruction in the quantizer's
+        (rotated) space — what the ADC distance paths compare against."""
         codes = np.asarray(codes)
         n, m = codes.shape
         recon = self.codebook[np.arange(m)[None, :], codes.astype(np.int64)]  # [N, M, ds]
         return recon.reshape(n, self.dim).astype(np.float32)
 
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        """[N, M] codes -> [N, D] reconstructed float32 in the ORIGINAL
+        space (rotation undone — R is orthogonal, so inverse = transpose)."""
+        recon = self.decode_rotated(codes)
+        if self.rotation_matrix is not None:
+            recon = recon @ self.rotation_matrix.T
+        return recon
+
     # persistence -------------------------------------------------------
 
     def save(self, path: str) -> None:
+        extra = {}
+        if self.rotation_matrix is not None:
+            extra["rotation_matrix"] = self.rotation_matrix
         np.savez(
             path,
             codebook=self.codebook,
@@ -282,6 +359,8 @@ class ProductQuantizer:
             metric=self.metric,
             encoder=self.encoder,
             distribution=self.distribution,
+            rotation=self.rotation,
+            **extra,
         )
 
     @classmethod
@@ -294,6 +373,10 @@ class ProductQuantizer:
             metric=str(z["metric"]),
             encoder=str(z["encoder"]),
             distribution=str(z["distribution"]),
+            # pre-rotation files have no rotation key: default none
+            rotation=str(z["rotation"]) if "rotation" in z else vi.PQ_ROTATION_NONE,
         )
         pq.codebook = z["codebook"].astype(np.float32)
+        if "rotation_matrix" in z:
+            pq.rotation_matrix = z["rotation_matrix"].astype(np.float32)
         return pq

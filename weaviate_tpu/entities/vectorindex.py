@@ -56,6 +56,9 @@ PQ_ENCODER_KMEANS = "kmeans"
 PQ_ENCODER_TILE = "tile"
 PQ_DISTRIBUTION_LOG_NORMAL = "log-normal"
 PQ_DISTRIBUTION_NORMAL = "normal"
+# TPU extension: learned orthogonal rotation before quantization (OPQ)
+PQ_ROTATION_NONE = "none"
+PQ_ROTATION_OPQ = "opq"
 
 
 @dataclass
@@ -75,6 +78,10 @@ class PQConfig:
     # (buys back the reference's PQ recall loss; 0 = auto R)
     rescore: bool = True
     rescore_limit: int = 0
+    # TPU extension: 'opq' fits an orthogonal rotation (OPQ-NP) that
+    # decorrelates segments — big raw-ADC recall gains on clustered
+    # data for the codes-only tier; query-side cost is one tiny matmul
+    rotation: str = PQ_ROTATION_NONE
 
     @classmethod
     def from_dict(cls, d: dict) -> "PQConfig":
@@ -90,6 +97,7 @@ class PQConfig:
             ),
             rescore=bool(d.get("rescore", True)),
             rescore_limit=int(d.get("rescoreLimit", 0)),
+            rotation=str(d.get("rotation", PQ_ROTATION_NONE)),
         )
 
     def to_dict(self) -> dict:
@@ -101,6 +109,7 @@ class PQConfig:
             "encoder": {"type": self.encoder.type, "distribution": self.encoder.distribution},
             "rescore": self.rescore,
             "rescoreLimit": self.rescore_limit,
+            "rotation": self.rotation,
         }
 
 
@@ -207,6 +216,9 @@ class HnswUserConfig:
                 raise ConfigValidationError("pq.centroids must be in [1, 65536]")
             if self.pq.encoder.type not in (PQ_ENCODER_KMEANS, PQ_ENCODER_TILE):
                 raise ConfigValidationError(f"invalid pq encoder {self.pq.encoder.type!r}")
+            if self.pq.rotation not in (PQ_ROTATION_NONE, PQ_ROTATION_OPQ):
+                raise ConfigValidationError(
+                    f"invalid pq rotation {self.pq.rotation!r} (none|opq)")
 
 
 IMMUTABLE_FIELDS = (

@@ -468,6 +468,7 @@ class MeshVectorIndex(VectorIndex):
             metric=self.metric,
             encoder=self.config.pq.encoder.type,
             distribution=self.config.pq.encoder.distribution,
+            rotation=self.config.pq.rotation,
         )
         pq.fit(host[occupied])
         self._enable_pq(pq, host, save=True)
@@ -638,6 +639,7 @@ class MeshVectorIndex(VectorIndex):
                         self._pq._dev_codebook(),
                         self._store,
                         jnp.asarray(q),
+                        self._pq.rotation_dev(),
                         kk,
                         r_chunk,
                         self.metric,
@@ -728,6 +730,7 @@ class MeshVectorIndex(VectorIndex):
                 cb_chunks,
                 flat_cb,
                 jnp.asarray(q),
+                self._pq.rotation_dev(),
                 kk,
                 self.metric,
                 use_allow,

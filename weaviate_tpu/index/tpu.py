@@ -240,7 +240,7 @@ _PQ_SCAN_CHUNK = 32768
 )
 def _search_pq_recon(codes, recon_norms, tombs, n, codebook, rescore_store, q,
                      allow_words, k, r_chunk, metric, use_allow, exact=False,
-                     active_chunks=None, do_rescore=True):
+                     active_chunks=None, do_rescore=True, rot=None):
     """PQ scan the MXU way: asymmetric ADC distance equals the distance to
     the RECONSTRUCTED row (segments are disjoint dims), so each chunk's
     codes gather their centroids into a [chunk, D] block that feeds one
@@ -271,8 +271,13 @@ def _search_pq_recon(codes, recon_norms, tombs, n, codebook, rescore_store, q,
     tombs_c = tombs[:ext].reshape(nchunks, chunk)
     allow_c = allow_words[: ext // 32].reshape(nchunks, chunk // 32) if use_allow else None
 
-    qd = q.astype(jnp.bfloat16)
-    q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+    # OPQ: the ADC scan compares against ROTATED-space reconstructions, so
+    # the query rotates too; the float rescore below stays in the original
+    # space (the rescore store holds unrotated rows)
+    qr = q if rot is None else jnp.matmul(
+        q.astype(jnp.float32), rot, preferred_element_type=jnp.float32)
+    qd = qr.astype(jnp.bfloat16)
+    q_sq = jnp.sum(qr.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
 
     def step(_, xs):
         ci, codes_l, norms_l, tombs_l = xs[0], xs[1], xs[2], xs[3]
@@ -958,6 +963,7 @@ class TpuVectorIndex(VectorIndex):
             metric=self.metric,
             encoder=self.config.pq.encoder.type,
             distribution=self.config.pq.encoder.distribution,
+            rotation=self.config.pq.rotation,
         )
         vecs = np.asarray(self._store[: self.n], dtype=np.float32)
         pq.fit(vecs)
@@ -1202,6 +1208,7 @@ class TpuVectorIndex(VectorIndex):
                 rg,
                 active_g,
                 interpret,
+                self._pq.rotation_dev(),
             ),
             "fused pq codes kernel")
 
@@ -1381,6 +1388,7 @@ class TpuVectorIndex(VectorIndex):
                     getattr(self.config, "exact_topk", False),
                     -(-self.n // _SCAN_CHUNK),
                     False,
+                    self._pq.rotation_dev(),
                 )
             )
             top, slots = _unpack(packed)

@@ -214,14 +214,20 @@ def _vmem(shape, dtype):
 
 def pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
                  allow_words, use_allow, k, metric, rg, active_g=G,
-                 interpret=False):
+                 interpret=False, rot=None):
     """Full codes-only fused search -> ([B, k] ADC dists, [B, k] slots, -1
     missing). Mirrors gmin_scan.gmin_topk: fast scan -> top-RG groups ->
     exact-ADC rescore of RG*G members -> top-k. flat_cb is [M*C, ds] f32
     (row-major codebook) for the candidate reconstruction gather — tiny
-    (rg*G rows per query), XLA-side."""
+    (rg*G rows per query), XLA-side. rot ([D, D], identity when no OPQ)
+    maps queries into the quantizer's rotated space — distances are
+    rotation-invariant for the matmul metrics, so results rank the
+    original space."""
     from weaviate_tpu.ops.topk import bitmap_to_mask, rescore_distances
 
+    if rot is not None:
+        q = jnp.matmul(q.astype(jnp.float32), rot,
+                       preferred_element_type=jnp.float32)
     cap, m = codes.shape
     ncols = cap // G
     b, d = q.shape
@@ -274,12 +280,12 @@ def pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
 )
 def search_pq_gmin(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
                    allow_words, use_allow, k, metric, rg, active_g=G,
-                   interpret=False):
+                   interpret=False, rot=None):
     """Jitted packed wrapper (pack_topk layout), the codes-only twin of
     gmin_scan.search_gmin."""
     from weaviate_tpu.ops.topk import pack_topk
 
     top, idx = pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks,
                             flat_cb, allow_words, use_allow, k, metric, rg,
-                            active_g, interpret)
+                            active_g, interpret, rot)
     return pack_topk(top, idx)

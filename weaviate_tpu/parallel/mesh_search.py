@@ -205,7 +205,7 @@ def mesh_search_gmin_step(
 )
 def mesh_search_pq_gmin_step(
     codes, recon_norms, tombs, n_per_shard, allow_words, cb_chunks, flat_cb,
-    queries, k, metric, use_allow, rg, active_g, interpret, mesh,
+    queries, rot, k, metric, use_allow, rg, active_g, interpret, mesh,
 ):
     """Codes-only fused ADC kNN, mesh-sharded: each chip runs the SAME
     reconstruction-as-matmul Pallas scan the single-chip index uses
@@ -219,12 +219,12 @@ def mesh_search_pq_gmin_step(
     n_dev = mesh.devices.size
     n_loc = codes.shape[0] // n_dev
 
-    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb_c, fcb, q):
+    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb_c, fcb, q, r):
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
         d_top, i_top = pq_gmin.pq_gmin_topk(
             codes_l, norms_l, tombs_l, n_mine, q, cb_c, fcb, allow_l,
-            use_allow, k, metric, rg, active_g, interpret)
+            use_allow, k, metric, rg, active_g, interpret, r)
         i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
         return _merge_across_shards(d_top, i_glob, k)
 
@@ -233,12 +233,12 @@ def mesh_search_pq_gmin_step(
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
-            P(SHARD_AXIS), P(), P(), P(),
+            P(SHARD_AXIS), P(), P(), P(), P(),
         ),
         out_specs=P(),
         check_vma=False,
     )(codes, recon_norms, tombs, n_per_shard, allow_words, cb_chunks,
-      flat_cb, queries)
+      flat_cb, queries, rot)
 
 
 @functools.partial(
@@ -248,7 +248,7 @@ def mesh_search_pq_gmin_step(
 )
 def mesh_search_pq_step(
     codes, recon_norms, tombs, n_per_shard, allow_words, codebook,
-    rescore_store, queries, k, r_chunk, metric, use_allow, exact,
+    rescore_store, queries, rot, k, r_chunk, metric, use_allow, exact,
     do_rescore, mesh,
 ):
     """Mesh twin of the single-chip PQ reconstruction scan
@@ -276,7 +276,7 @@ def mesh_search_pq_step(
     chunk = min(n_loc, _MESH_SCAN_CHUNK)
     nchunks = n_loc // chunk
 
-    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb, rs_l, q):
+    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb, rs_l, q, r):
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
         b = q.shape[0]
@@ -286,8 +286,13 @@ def mesh_search_pq_step(
         norms_c = norms_l.reshape(nchunks, chunk)
         tombs_c = tombs_l.reshape(nchunks, chunk)
         allow_c = allow_l.reshape(nchunks, chunk // 32) if use_allow else None
-        qd = q.astype(jnp.bfloat16)
-        q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        # OPQ: the ADC scan runs in the quantizer's rotated space; the
+        # float rescore below uses the RAW query (the rescore slab holds
+        # unrotated rows)
+        qr = jnp.matmul(q.astype(jnp.float32), r,
+                        preferred_element_type=jnp.float32)
+        qd = qr.astype(jnp.bfloat16)
+        q_sq = jnp.sum(qr ** 2, axis=-1, keepdims=True)
 
         def step(_, xs):
             ci, cl, nl, tl = xs[0], xs[1], xs[2], xs[3]
@@ -339,12 +344,12 @@ def mesh_search_pq_step(
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
-            P(SHARD_AXIS), P(), P(SHARD_AXIS, None), P(),
+            P(SHARD_AXIS), P(), P(SHARD_AXIS, None), P(), P(),
         ),
         out_specs=P(),
         check_vma=False,
     )(codes, recon_norms, tombs, n_per_shard, allow_words, codebook,
-      rescore_store, queries)
+      rescore_store, queries, rot)
 
 
 @functools.partial(
