@@ -557,7 +557,57 @@ def run_cpu_matrix(rng):
     rows["filtered_scaling_cpu"] = frow
     _merge_matrix(rows)
 
-    # -- row 5: restart replay (vector-log bulk replay, commit 6d39c68) ---
+    # -- row 5: BM25 keyword search (host path, vectorized scoring) -------
+    log("cpu matrix: BM25 (n=50k docs, 40 terms/doc)...")
+    import random
+    import tempfile as _tf
+    import uuid as _uuidlib
+
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.server import App
+    from weaviate_tpu.usecases.traverser import GetParams
+
+    words = [f"w{i}" for i in range(5000)]
+    prng = random.Random(0)
+    n_b = 50_000
+    bdir = _tf.mkdtemp(prefix="benchbm25")
+    brow = dict(common)
+    brow["n_docs"] = n_b
+    try:
+        app = App(data_path=bdir)
+        app.schema.add_class({
+            "class": "Kw", "vectorIndexType": "noop",
+            "properties": [{"name": "body", "dataType": ["text"]}]})
+        kidx = app.db.get_index("Kw")
+        for s in range(0, n_b, 10_000):
+            kidx.put_batch([
+                StorObj(class_name="Kw", uuid=str(_uuidlib.UUID(int=i + 1)),
+                        properties={"body": " ".join(prng.choices(words, k=40))})
+                for i in range(s, s + 10_000)])
+        tr = app.traverser
+        for nterms in (2, 8):
+            qs = [" ".join(prng.choices(words, k=nterms)) for _ in range(48)]
+            tr.get_class(GetParams(class_name="Kw",
+                                   keyword_ranking={"query": qs[0]}, limit=10))
+            t0 = time.perf_counter()
+            for qtext in qs:
+                tr.get_class(GetParams(
+                    class_name="Kw", keyword_ranking={"query": qtext}, limit=10))
+            brow[f"qps_{nterms}term"] = round(
+                len(qs) / (time.perf_counter() - t0), 1)
+        app.shutdown()
+    finally:
+        import shutil
+
+        shutil.rmtree(bdir, ignore_errors=True)
+    brow["provenance"] = (
+        "BM25F keyword search, vectorized posting scoring + generation-"
+        "cached length tables (round 4 — was ~17 QPS on the per-posting "
+        "Python loop)")
+    rows["bm25_cpu"] = brow
+    _merge_matrix(rows)
+
+    # -- row 6: restart replay (vector-log bulk replay, commit 6d39c68) ---
     n_r = 50_000
     log(f"cpu matrix: restart replay (n={n_r})...")
     from weaviate_tpu.entities import vectorindex as vi

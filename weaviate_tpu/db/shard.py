@@ -154,7 +154,8 @@ class Shard:
         self.searcher = FilterSearcher(
             self.inverted, class_def, geo_search=self._geo_search
         )
-        self.bm25 = BM25Searcher(self.inverted, class_def, invert_cfg)
+        self.bm25 = BM25Searcher(self.inverted, class_def, invert_cfg,
+                                 gen_fn=lambda: self._write_gen)
         # background per-bucket pair compaction (segment_group_compaction.go)
         self.store.start_compaction_cycle()
         self.status = STATUS_READY
@@ -193,7 +194,8 @@ class Shard:
             self.inverted.update_schema(class_def)
             self._init_geo_indexes()
             self.searcher = FilterSearcher(self.inverted, class_def, geo_search=self._geo_search)
-            self.bm25 = BM25Searcher(self.inverted, class_def, self.invert_cfg)
+            self.bm25 = BM25Searcher(self.inverted, class_def, self.invert_cfg,
+                                     gen_fn=lambda: self._write_gen)
 
     def update_vector_config(self, cfg) -> None:
         self.vector_index.update_user_config(cfg)

@@ -32,12 +32,43 @@ DEFAULT_B = 0.75
 
 
 class BM25Searcher:
-    def __init__(self, inverted: InvertedIndex, class_def, config: Optional[dict] = None):
+    def __init__(self, inverted: InvertedIndex, class_def,
+                 config: Optional[dict] = None, gen_fn=None):
         self.inverted = inverted
         self.class_def = class_def
         bm = (config or {}).get("bm25") or {}
         self.k1 = float(bm.get("k1", DEFAULT_K1))
         self.b = float(bm.get("b", DEFAULT_B))
+        # per-prop document-length table cache, keyed by the shard's write
+        # generation (gen_fn): rebuilding it costs a full map_get + sum over
+        # EVERY doc, which used to dominate query time (~40 ms at 50k docs)
+        self._gen_fn = gen_fn
+        self._len_cache: dict[str, tuple] = {}
+
+    def _prop_lengths(self, prop_name: str, lb):
+        """-> (sorted doc-id u64 array, f32 lengths aligned to it, avg).
+        Cached per write generation when gen_fn is wired (the Shard path);
+        standalone users pay the rebuild each call."""
+        gen = self._gen_fn() if self._gen_fn is not None else None
+        if gen is not None:
+            hit = self._len_cache.get(prop_name)
+            if hit is not None and hit[0] == gen:
+                return hit[1], hit[2], hit[3]
+        lengths = lb.map_get(b"len") if lb is not None else {}
+        if lengths:
+            docs = np.frombuffer(b"".join(lengths.keys()), dtype="<u8")
+            vals = np.frombuffer(b"".join(lengths.values()),
+                                 dtype="<u4").astype(np.float32)
+            order = np.argsort(docs)
+            docs, vals = docs[order], vals[order]
+            avg = float(vals.mean())
+        else:
+            docs = np.empty(0, dtype=np.uint64)
+            vals = np.empty(0, dtype=np.float32)
+            avg = 1.0
+        if gen is not None:
+            self._len_cache[prop_name] = (gen, docs, vals, avg)
+        return docs, vals, avg
 
     def _searchable_props(self, properties: Optional[Sequence[str]]) -> list[tuple[str, float]]:
         """-> [(prop, weight)]; supports "prop^2" boost syntax."""
@@ -87,31 +118,40 @@ class BM25Searcher:
             lb = self.inverted.store.bucket(length_bucket(prop_name))
             if sb is None:
                 continue
-            lengths = lb.map_get(b"len") if lb is not None else {}
-            if lengths:
-                total = sum(struct.unpack("<I", v)[0] for v in lengths.values())
-                avg_len = total / len(lengths)
-            else:
-                avg_len = 1.0
+            len_docs, len_vals, avg_len = self._prop_lengths(prop_name, lb)
             for term in terms:
                 postings = sb.map_get(term.encode("utf-8"))
                 if not postings:
                     continue
                 df = len(postings)
                 idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
-                for did_b, tf_b in postings.items():
-                    (doc_id,) = struct.unpack("<Q", did_b)
-                    if allow_list is not None and not allow_list.contains(doc_id):
+                # vectorized posting scoring: the per-entry Python loop with
+                # three struct.unpacks used to dominate high-df terms
+                doc_ids = np.frombuffer(b"".join(postings.keys()), dtype="<u8")
+                tf = np.frombuffer(b"".join(postings.values()),
+                                   dtype="<f4").astype(np.float64)
+                if allow_list is not None:
+                    keep = allow_list.contains_array(doc_ids)
+                    if not keep.any():
                         continue
-                    (tf,) = struct.unpack("<f", tf_b)
-                    L_b = lengths.get(did_b)
-                    L = struct.unpack("<I", L_b)[0] if L_b else avg_len
-                    denom = tf + self.k1 * (1 - self.b + self.b * (L / avg_len))
-                    s = weight * idf * tf * (self.k1 + 1) / denom
-                    scores[doc_id] = scores.get(doc_id, 0.0) + s
-                    if additional_explanations:
-                        explains.setdefault(doc_id, {})[f"BM25F_{term}_frequency"] = tf
-                        explains[doc_id][f"BM25F_{term}_propLength"] = L
+                    doc_ids, tf = doc_ids[keep], tf[keep]
+                if len_docs.size:
+                    pos = np.searchsorted(len_docs, doc_ids)
+                    pos_c = np.clip(pos, 0, len_docs.size - 1)
+                    found = len_docs[pos_c] == doc_ids
+                    length = np.where(found, len_vals[pos_c], avg_len)
+                else:
+                    length = np.full(doc_ids.shape, avg_len)
+                denom = tf + self.k1 * (1 - self.b + self.b * (length / avg_len))
+                s = weight * idf * tf * (self.k1 + 1) / denom
+                get = scores.get
+                for d, sv in zip(doc_ids.tolist(), s.tolist()):
+                    scores[d] = get(d, 0.0) + sv
+                if additional_explanations:
+                    for d, tfv, lv in zip(doc_ids.tolist(), tf.tolist(),
+                                          length.tolist()):
+                        explains.setdefault(d, {})[f"BM25F_{term}_frequency"] = tfv
+                        explains[d][f"BM25F_{term}_propLength"] = lv
 
         top = heapq.nlargest(limit, scores.items(), key=lambda kv: (kv[1], -kv[0]))
         return [(d, s, explains.get(d) if additional_explanations else None) for d, s in top]
