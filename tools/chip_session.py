@@ -1,0 +1,144 @@
+"""Chip-return playbook: the FIRST thing to run when the TPU relay answers.
+
+The relay has been wedged for rounds 2-4; past wedges were caused by
+Pallas kernels exceeding VMEM on the live chip (see ops/gmin_scan.py
+_VMEM_BUDGET). This script runs the escalation the round-3 verdict
+prescribes, each step in a SUBPROCESS with a hard timeout, and STOPS at
+the first hang instead of re-poking a wedged relay:
+
+  1. probe            tiny matmul on the device (proves the claim leg)
+  2. gmin canary      smallest fused-kernel shape, compiled by Mosaic
+  3. gmin mid shape   128k x 128, serving-like batch
+  4. gmin SIFT shape  1M x 128, batch 16384 (the headline shape)
+  5. pq codes canary  fused PQ-ADC kernel at 200k, segments=32
+  6. bench.py         headline JSON line (kernel line must say gmin)
+  7. BENCH_MATRIX=1   full matrix regen on hardware
+
+Usage:  python tools/chip_session.py            # real chip
+        CHIP_SESSION_CPU=1 python tools/...     # CPU flow smoke test
+
+Every step's rc + duration appends to chip_session.log next to this file.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "chip_session.log")
+CPU_MODE = bool(os.environ.get("CHIP_SESSION_CPU"))
+
+_FORCE_CPU = (
+    "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+    if CPU_MODE else ""
+)
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def step(name: str, code: str, timeout: int) -> bool:
+    """Run `code` in a fresh interpreter. False => STOP the session (a hang
+    here means the relay is wedged or wedging; keep hands off)."""
+    log(f"step {name}: starting (timeout {timeout}s)")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _FORCE_CPU + code],
+            cwd=REPO, timeout=timeout, capture_output=True, text=True,
+            env={**os.environ, "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+        )
+    except subprocess.TimeoutExpired:
+        log(f"step {name}: HUNG after {timeout}s — relay wedged or wedging; "
+            "STOPPING the session (do not re-poke)")
+        return False
+    dt = time.time() - t0
+    tail = (proc.stdout + proc.stderr)[-800:].strip()
+    log(f"step {name}: rc={proc.returncode} in {dt:.1f}s\n{tail}")
+    return proc.returncode == 0
+
+
+GMIN_SHAPE = """
+import numpy as np, jax, jax.numpy as jnp
+from weaviate_tpu.ops import gmin_scan
+n, d, b, k = {n}, {d}, {b}, 10
+interpret = jax.default_backend() not in ("tpu", "axon")
+rng = np.random.default_rng(0)
+store = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+norms = jnp.sum(store**2, axis=1)
+tombs = jnp.zeros((n,), jnp.bool_)
+q = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+words = jnp.zeros((n // 32,), jnp.uint32)
+ncols = n // gmin_scan.G
+qb, scg, fp = gmin_scan.plan_tiles(b, d, ncols, gmin_scan.G, 4)
+assert fp <= gmin_scan._VMEM_BUDGET, f"over budget: {{fp}}"
+import time; t0 = time.perf_counter()
+top, idx = gmin_scan.gmin_topk(store, norms, tombs, n, q, words, False,
+                               k, "l2-squared", 64, gmin_scan.G, interpret)
+top = np.asarray(top)
+print(f"gmin {{n}}x{{d}} b={{b}}: ok in {{time.perf_counter()-t0:.1f}}s "
+      f"(tiles qb={{qb}} scg={{scg}} vmem={{fp>>20}}MB)")
+"""
+
+PQ_CANARY = """
+import numpy as np, jax
+from weaviate_tpu.entities import vectorindex as vi
+from weaviate_tpu.index.tpu import TpuVectorIndex
+import tempfile, time
+rng = np.random.default_rng(0)
+n, d = 200_000, 128
+vecs = rng.standard_normal((n, d)).astype(np.float32)
+cfg = vi.HnswUserConfig.from_dict({"distance": "l2-squared",
+    "pq": {"enabled": True, "segments": 32, "centroids": 256,
+           "rescore": False}}, "hnsw_tpu")
+idx = TpuVectorIndex(cfg, tempfile.mkdtemp(), persist=False)
+idx.add_batch(np.arange(n), vecs); idx.flush()
+t0 = time.perf_counter()
+ids, dist = idx.search_by_vectors(vecs[:256], 10)
+assert idx._pqg_state._gmin_validated, "pq codes kernel did not serve"
+print(f"pq codes kernel served 256 queries in {time.perf_counter()-t0:.1f}s")
+"""
+
+
+def main() -> int:
+    log("=== chip session start ===" + (" [CPU smoke mode]" if CPU_MODE else ""))
+    if not step("probe", "import jax; x = jax.numpy.ones((8, 8)); "
+                "print((x @ x).sum())", 90):
+        return 3
+    if not step("gmin-canary", GMIN_SHAPE.format(n=16384, d=32, b=64), 300):
+        return 4
+    if not step("gmin-mid", GMIN_SHAPE.format(n=131072, d=128, b=1024), 300):
+        return 4
+    if not step("gmin-sift", GMIN_SHAPE.format(n=1_048_576, d=128, b=16384), 600):
+        return 4
+    if not step("pq-canary", PQ_CANARY, 600):
+        return 4
+    env_bits = "" if not CPU_MODE else (
+        "BENCH_N=30000 BENCH_BATCH=256 BENCH_QUERY_BATCHES=2 BENCH_GT=128 ")
+    log("running bench.py headline...")
+    rc = subprocess.call(
+        f"{env_bits}{sys.executable} "
+        + ("-c \"import jax; jax.config.update('jax_platforms','cpu'); "
+           "import bench; bench.main()\"" if CPU_MODE else "bench.py"),
+        shell=True, cwd=REPO, timeout=3600)
+    log(f"bench.py rc={rc}")
+    if rc == 0 and not CPU_MODE:
+        log("running BENCH_MATRIX=1...")
+        rc = subprocess.call(
+            f"BENCH_MATRIX=1 {sys.executable} bench.py", shell=True,
+            cwd=REPO, timeout=7200)
+        log(f"bench matrix rc={rc}")
+    log("=== chip session done ===")
+    return 0 if rc == 0 else 5
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
