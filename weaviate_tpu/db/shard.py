@@ -155,7 +155,7 @@ class Shard:
             self.inverted, class_def, geo_search=self._geo_search
         )
         self.bm25 = BM25Searcher(self.inverted, class_def, invert_cfg,
-                                 gen_fn=lambda: self._write_gen)
+                                 gen_fn=self._locked_gen)
         # background per-bucket pair compaction (segment_group_compaction.go)
         self.store.start_compaction_cycle()
         self.status = STATUS_READY
@@ -195,7 +195,7 @@ class Shard:
             self._init_geo_indexes()
             self.searcher = FilterSearcher(self.inverted, class_def, geo_search=self._geo_search)
             self.bm25 = BM25Searcher(self.inverted, class_def, self.invert_cfg,
-                                     gen_fn=lambda: self._write_gen)
+                                     gen_fn=self._locked_gen)
 
     def update_vector_config(self, cfg) -> None:
         self.vector_index.update_user_config(cfg)
@@ -436,6 +436,15 @@ class Shard:
         return [StorObj.from_binary(r, include_vector) if r is not None else None
                 for r in raws]
 
+    def _locked_gen(self) -> int:
+        """Write generation observed UNDER the shard lock: mutators hold the
+        lock for their whole body and bump the generation first, so a value
+        read here can never correspond to a mid-flight mutation. Readers
+        cache with a read-compute-reread protocol: if the two reads agree,
+        no mutation overlapped the compute."""
+        with self._lock:
+            return self._write_gen
+
     def build_allow_list(self, flt: Optional[LocalFilter]) -> Optional[Bitmap]:
         """filters -> allowList (shard_read.go:377 buildAllowList).
 
@@ -444,21 +453,23 @@ class Shard:
         without this the inverted-index evaluation AND the device-words
         pack (which caches on the Bitmap object — index/tpu.py
         _allow_words) re-run on every query of a repeated filter. Any
-        write bumps the generation and invalidates."""
+        write bumps the generation and invalidates; the double generation
+        read refuses to cache when a write overlapped the evaluation."""
         if flt is None:
             return None
         try:
             key = json.dumps(flt.to_dict(), sort_keys=True, default=str)
         except Exception:  # noqa: BLE001 — unhashable filter: just evaluate
             return self.searcher.doc_ids(flt)
-        gen = self._write_gen
+        gen = self._locked_gen()
         hit = self._allow_cache.get(key)
         if hit is not None and hit[0] == gen:
             return hit[1]
         allow = self.searcher.doc_ids(flt)
-        if len(self._allow_cache) >= 16:  # small FIFO: hot filters are few
-            self._allow_cache.pop(next(iter(self._allow_cache)))
-        self._allow_cache[key] = (gen, allow)
+        if self._locked_gen() == gen:
+            if len(self._allow_cache) >= 16:  # small FIFO: hot filters are few
+                self._allow_cache.pop(next(iter(self._allow_cache)))
+            self._allow_cache[key] = (gen, allow)
         return allow
 
     def object_vector_search(

@@ -44,6 +44,23 @@ class BM25Searcher:
         # EVERY doc, which used to dominate query time (~40 ms at 50k docs)
         self._gen_fn = gen_fn
         self._len_cache: dict[str, tuple] = {}
+        self._count_cache: Optional[tuple] = None
+
+    def _doc_count(self) -> int:
+        """inverted.doc_count() materializes the full roaring doc set —
+        ~1.6 ms at 50k docs; cache it per write generation like the length
+        tables."""
+        gen = self._gen_fn() if self._gen_fn is not None else None
+        if gen is not None and self._count_cache is not None \
+                and self._count_cache[0] == gen:
+            return self._count_cache[1]
+        c = self.inverted.doc_count()
+        # cache only if no write started meanwhile: the writer bumps the
+        # generation BEFORE mutating, so a count read mid-write must not be
+        # pinned under the new generation
+        if gen is not None and (self._gen_fn() == gen):
+            self._count_cache = (gen, c)
+        return c
 
     def _prop_lengths(self, prop_name: str, lb):
         """-> (sorted doc-id u64 array, f32 lengths aligned to it, avg).
@@ -66,7 +83,9 @@ class BM25Searcher:
             docs = np.empty(0, dtype=np.uint64)
             vals = np.empty(0, dtype=np.float32)
             avg = 1.0
-        if gen is not None:
+        # same mid-write guard as _doc_count: never pin a table read while
+        # a write (which bumps the generation first) is in flight
+        if gen is not None and self._gen_fn() == gen:
             self._len_cache[prop_name] = (gen, docs, vals, avg)
         return docs, vals, avg
 
@@ -101,7 +120,7 @@ class BM25Searcher:
     ) -> list[tuple[int, float, Optional[dict]]]:
         """-> [(doc_id, score, explain|None)] sorted by score desc."""
         props = self._searchable_props(properties)
-        n_docs = max(self.inverted.doc_count(), 1)
+        n_docs = max(self._doc_count(), 1)
         scores: dict[int, float] = {}
         explains: dict[int, dict] = {}
 
