@@ -11,6 +11,7 @@ Reference bucket layout (shard_write_inverted*.go, inverted/):
 
 from __future__ import annotations
 
+import os
 import struct
 from collections import Counter as PyCounter
 from typing import Optional
@@ -45,6 +46,15 @@ def length_bucket(prop: str) -> str:
     return f"property_{prop}__length"
 
 
+# Persisted marker for the searchable/length subkey byte order. Round 5
+# switched new stores to big-endian doc-id subkeys (segment byte-lex order
+# == numeric order -> the postings fast path skips its argsort); stores
+# written before the marker existed keep little-endian and are pinned to it
+# on first reopen, so old segments never get decoded with the wrong order
+# or mixed with new-format writes.
+SUBKEY_MARKER = ".searchable_subkeys"
+
+
 class InvertedIndex:
     def __init__(self, store: Store, class_def: ClassDef):
         self.store = store
@@ -52,6 +62,42 @@ class InvertedIndex:
         self.analyzer = Analyzer(class_def)
         self._all = store.create_or_load_bucket("_all_docs", STRATEGY_ROARINGSET)
         self._ensure_buckets()
+        self.subkey_fmt = self._init_subkey_format()
+        self.subkey_dtype = ">u8" if self.subkey_fmt == ">Q" else "<u8"
+
+    def _init_subkey_format(self) -> str:
+        """-> ">Q" (new stores) or "<Q" (legacy data without a marker)."""
+        path = os.path.join(self.store.root, SUBKEY_MARKER)
+        try:
+            with open(path) as f:
+                return ">Q" if f.read().strip() == "be" else "<Q"
+        except FileNotFoundError:
+            pass
+        has_data = False
+        for prop in self.class_def.properties:
+            for bn in (searchable_bucket(prop.name), length_bucket(prop.name)):
+                b = self.store.bucket(bn)
+                if b is not None and (b.segment_count() or len(b._mem)):
+                    has_data = True
+                    break
+            if has_data:
+                break
+        fmt = "<Q" if has_data else ">Q"
+        # crash-atomic + durable: the marker decides how every fsynced
+        # subkey byte on disk is decoded, so it must never be weaker than
+        # the data it describes (temp file -> fsync -> rename -> dir fsync)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("le" if fmt == "<Q" else "be")
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+        dfd = os.open(self.store.root, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        return fmt
 
     def _ensure_buckets(self) -> None:
         for prop in self.class_def.properties:
@@ -128,7 +174,11 @@ class InvertedIndex:
                     toks = tokens.get(name)
                     if not toks:
                         continue
-                    did = struct.pack("<Q", doc_id)
+                    # subkey byte order per the store's persisted marker
+                    # (big-endian on new stores: segment byte-lex order ==
+                    # numeric order, so the BM25 postings fast path decodes
+                    # pre-sorted arrays — see lsm.map_get_arrays key_dtype)
+                    did = struct.pack(self.subkey_fmt, doc_id)
                     for t, tf in PyCounter(toks).items():
                         sput.append((t, did, struct.pack("<f", float(tf))))
                     lput.append((b"len", did, struct.pack("<I", len(toks))))
@@ -201,7 +251,7 @@ class InvertedIndex:
     def delete_object(self, doc_id: int, properties: dict) -> None:
         tokens_by_prop = self.analyzer.analyze(properties)
         self._all.roaring_remove_many(ALL_DOCS_KEY, [doc_id])
-        did = struct.pack("<Q", doc_id)
+        did = struct.pack(self.subkey_fmt, doc_id)  # matches add_object
         for prop in self.class_def.properties:
             pt = prop.primitive_type()
             if pt is None or pt.base in (DataType.GEO_COORDINATES, DataType.BLOB):
