@@ -1,0 +1,178 @@
+"""Component-level timing of the headline search at the SIFT shape on the
+live chip: where does the 1.2 s/batch actually go?
+
+Times (median of reps, after warmup):
+  kernel      group_min_scores pallas call alone
+  select      approx_min_k over the [B, ncols] group-min matrix
+  topk        full gmin_topk (kernel + select + gather-rescore + top-k)
+  legacy      _search_full (round-1 lax.scan kernel, rescore_r=128)
+  kernel_nt   variant kernel: store pre-transposed [G, d, ncols], dot
+              without the in-loop .T
+  kernel_c4   variant: transposed layout + groups processed 4-at-a-time as
+              one [qb,d]@[d,4*scg] matmul per slice (bigger MXU ops, fewer
+              fori iterations)
+
+Usage: python tools/profile_gmin.py [N] [B]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from weaviate_tpu.ops import gmin_scan
+from weaviate_tpu.ops.gmin_scan import G
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+D = 128
+K = 10
+RG = 64
+REPS = 5
+
+
+def timed(name, fn, *args):
+    fn(*args)  # warmup/compile
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    med = sorted(ts)[len(ts) // 2]
+    qps = B / med
+    print(f"{name:12s} {med * 1e3:9.1f} ms/batch  {qps:10.0f} qps")
+    return med
+
+
+def _nt_kernel(q_ref, s_ref, b_ref, o_ref, *, alpha, g):
+    qd = q_ref[...].astype(jnp.bfloat16)
+
+    def body(gi, acc):
+        qx = jnp.dot(qd, s_ref[gi].astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+        return jnp.minimum(acc, b_ref[gi] + alpha * qx)
+
+    o_ref[...] = jax.lax.fori_loop(0, g, body,
+                                   jnp.full(o_ref.shape, jnp.inf, jnp.float32))
+
+
+def nt_scores(q, store3t, bias2, alpha, qb, scg):
+    b, d = q.shape
+    g, _, ncols = store3t.shape
+    grid = (ncols // scg, b // qb)
+    return pl.pallas_call(
+        functools.partial(_nt_kernel, alpha=alpha, g=g),
+        out_shape=jax.ShapeDtypeStruct((b, ncols), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((qb, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((g, d, scg), lambda i, j: (0, 0, i)),
+            pl.BlockSpec((g, scg), lambda i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((qb, scg), lambda i, j: (j, i)),
+    )(q, store3t, bias2)
+
+
+def _c4_kernel(q_ref, s_ref, b_ref, o_ref, *, alpha, g, gc):
+    """s_ref [g//gc, d, gc*scg]: gc groups side-by-side per slice — one
+    bigger matmul per slice, min-reduce across the gc column blocks."""
+    qd = q_ref[...].astype(jnp.bfloat16)
+    scg = o_ref.shape[1]
+
+    def body(si, acc):
+        qx = jnp.dot(qd, s_ref[si].astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)  # [qb, gc*scg]
+        sc = b_ref[si] + alpha * qx
+        m = sc[:, :scg]
+        for t in range(1, gc):
+            m = jnp.minimum(m, sc[:, t * scg:(t + 1) * scg])
+        return jnp.minimum(acc, m)
+
+    o_ref[...] = jax.lax.fori_loop(0, g // gc, body,
+                                   jnp.full(o_ref.shape, jnp.inf, jnp.float32))
+
+
+def c4_scores(q, store4, bias4, alpha, qb, scg, gc):
+    b, d = q.shape
+    nslice = store4.shape[0]
+    ncols = store4.shape[2] // gc
+    grid = (ncols // scg, b // qb)
+    return pl.pallas_call(
+        functools.partial(_c4_kernel, alpha=alpha, g=nslice * gc, gc=gc),
+        out_shape=jax.ShapeDtypeStruct((b, ncols), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((qb, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((nslice, d, gc * scg), lambda i, j: (0, 0, i)),
+            pl.BlockSpec((nslice, gc * scg), lambda i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((qb, scg), lambda i, j: (j, i)),
+    )(q, store4, bias4)
+
+
+def main():
+    print(f"backend={jax.default_backend()} N={N} B={B} D={D}")
+    rng = np.random.default_rng(0)
+    store = jnp.asarray(rng.standard_normal((N, D)), jnp.float32)
+    norms = jnp.sum(store**2, axis=1)
+    tombs = jnp.zeros((N,), jnp.bool_)
+    q = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+    words = jnp.zeros((N // 32,), jnp.uint32)
+    ncols = N // G
+    qb, scg, fp = gmin_scan.plan_tiles(B, D, ncols, G, 4)
+    print(f"tiles qb={qb} scg={scg} vmem={fp >> 20}MB")
+
+    alpha = -2.0
+    bias2 = norms.reshape(G, ncols)
+    store3 = store.reshape(G, ncols, D)
+
+    fn_k = jax.jit(functools.partial(gmin_scan.group_min_scores, alpha=alpha))
+    timed("kernel", fn_k, q, store3, bias2)
+
+    gmin = fn_k(q, store3, bias2)
+    jax.block_until_ready(gmin)
+    fn_s = jax.jit(lambda x: jax.lax.approx_min_k(x, RG, recall_target=0.99))
+    timed("select", fn_s, gmin)
+
+    fn_t = functools.partial(
+        gmin_scan.gmin_topk, k=K, metric="l2-squared", rg=RG,
+        active_g=G, interpret=False)
+    timed("topk", lambda: fn_t(store, norms, tombs, N, q, words, False))
+
+    from weaviate_tpu.index.tpu import _search_full
+    fn_l = jax.jit(_search_full, static_argnames=(
+        "k", "metric", "use_allow", "exact", "active_chunks", "rescore_r"))
+    timed("legacy", lambda: fn_l(
+        store, norms, tombs, N, q, words, k=K, metric="l2-squared",
+        use_allow=False, rescore_r=128))
+
+    store3t = jnp.ascontiguousarray(jnp.transpose(store3, (0, 2, 1)))
+    jax.block_until_ready(store3t)
+    timed("kernel_nt", jax.jit(functools.partial(
+        nt_scores, alpha=alpha, qb=qb, scg=scg)), q, store3t, bias2)
+
+    for gc in (2, 4):
+        scg_c = max(128, scg // gc)
+        # tile-wise interleave: tile i of the slice is gc consecutive
+        # width-scg_c blocks, block t = group si*gc+t, columns i*scg_c..
+        view = store3t.reshape(G // gc, gc, D, ncols // scg_c, scg_c)
+        s4 = jnp.ascontiguousarray(
+            view.transpose(0, 2, 3, 1, 4).reshape(G // gc, D, ncols * gc))
+        b4 = jnp.ascontiguousarray(
+            bias2.reshape(G // gc, gc, ncols // scg_c, scg_c)
+            .transpose(0, 2, 1, 3).reshape(G // gc, ncols * gc))
+        jax.block_until_ready(s4)
+        print(f"  gc={gc}: scg={scg_c} slice_width={gc * scg_c}")
+        timed(f"kernel_c{gc}", jax.jit(functools.partial(
+            c4_scores, alpha=alpha, qb=qb, scg=scg_c, gc=gc)), q, s4, b4)
+
+
+if __name__ == "__main__":
+    main()

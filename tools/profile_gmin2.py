@@ -1,0 +1,106 @@
+"""Jitted stage-level timing of the fused search at the headline shape:
+isolates the candidate-rescore gather as the suspected bottleneck and
+measures the contiguous-block gather alternative.
+
+Usage: python tools/profile_gmin2.py [N] [B]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from weaviate_tpu.ops import gmin_scan
+from weaviate_tpu.ops.gmin_scan import G
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+D = 128
+K = 10
+RG = 32
+REPS = 5
+
+
+def timed(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    med = sorted(ts)[len(ts) // 2]
+    print(f"{name:16s} {med * 1e3:9.1f} ms/batch  {B / med:10.0f} qps")
+    return med
+
+
+def main():
+    print(f"backend={jax.default_backend()} N={N} B={B} D={D} RG={RG}")
+    rng = np.random.default_rng(0)
+    store = jnp.asarray(rng.standard_normal((N, D)), jnp.float32)
+    norms = jnp.sum(store**2, axis=1)
+    tombs = jnp.zeros((N,), jnp.bool_)
+    q = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+    words = jnp.zeros((N // 32,), jnp.uint32)
+    ncols = N // G
+
+    # full jitted serving entry (what bench.py measures minus host work)
+    fn_full = functools.partial(
+        gmin_scan.search_gmin, use_allow=False, k=K, metric="l2-squared",
+        rg=RG, active_g=G, interpret=False)
+    timed("search_gmin", fn_full, store, norms, tombs, N, q, words)
+
+    # kernel + select only
+    alpha = -2.0
+    bias2 = norms.reshape(G, ncols)
+    store3 = store.reshape(G, ncols, D)
+    fn_k = jax.jit(functools.partial(gmin_scan.group_min_scores, alpha=alpha))
+    timed("kernel", fn_k, q, store3, bias2)
+    gmin = fn_k(q, store3, bias2)
+    jax.block_until_ready(gmin)
+    fn_s = jax.jit(lambda x: jax.lax.approx_min_k(x, RG, recall_target=0.99)[1])
+    timed("select", fn_s, gmin)
+    gidx = fn_s(gmin)
+    jax.block_until_ready(gidx)
+
+    # the strided-member gather as gmin_topk does it (jitted, incl. rescore)
+    offs = (jnp.arange(G) * ncols)[None, None, :]
+
+    @jax.jit
+    def gather_strided(gidx_, q_):
+        slots = (gidx_[:, :, None] + offs).reshape(gidx_.shape[0], RG * G)
+        cand = jnp.take(store, slots, axis=0)
+        return jnp.einsum("bd,brd->br", q_.astype(jnp.float32), cand)
+
+    timed("gather_strided", gather_strided, gidx, q)
+
+    # contiguous-block alternative: pretend groups were 16 adjacent slots —
+    # one take of [rg] 8KB rows per query from a [ncols, G*D] view
+    store_blk = store.reshape(ncols, G * D)
+
+    @jax.jit
+    def gather_blocked(gidx_, q_):
+        cand = jnp.take(store_blk, gidx_, axis=0).reshape(
+            gidx_.shape[0], RG * G, D)
+        return jnp.einsum("bd,brd->br", q_.astype(jnp.float32), cand)
+
+    timed("gather_blocked", gather_blocked, gidx, q)
+
+    # upper bound: no gather at all — rescore on a dense slab
+    slab = jnp.asarray(rng.standard_normal((B, RG * G, D)), jnp.float32)
+
+    @jax.jit
+    def rescore_only(slab_, q_):
+        return jnp.einsum("bd,brd->br", q_.astype(jnp.float32), slab_)
+
+    timed("rescore_nogather", rescore_only, slab, q)
+
+
+if __name__ == "__main__":
+    main()

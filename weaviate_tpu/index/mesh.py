@@ -151,11 +151,14 @@ class MeshVectorIndex(VectorIndex):
         recomputed at replay time, so the same log restores onto any mesh."""
         self._restoring = True
         try:
-            for op, ids, vecs in VectorLog.replay_batches(self._log.path):
+            replay_stats: dict = {}
+            for op, ids, vecs in VectorLog.replay_batches(self._log.path, stats=replay_stats):
                 if op == "add":
                     self._bulk_stage_add(ids, vecs)
                 else:
                     self._stage_delete(int(ids), log=False)
+            VectorLog.report_replay_stats(self._log.path, replay_stats)
+            self.last_replay_stats = replay_stats
             if self._pq_path and os.path.exists(self._pq_path):
                 from weaviate_tpu.compress.pq import ProductQuantizer
 

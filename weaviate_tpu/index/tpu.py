@@ -56,7 +56,7 @@ _MIN_CAPACITY = 16384
 _LOG_ADD = 1
 _LOG_DELETE = 2
 _LOG_MAGIC = b"WTVL"
-_LOG_VERSION = 1
+_LOG_VERSION = 2  # v2 = per-record checksums + skip-ahead corrupt-region replay
 
 # query-batch padding buckets (limit distinct compiled shapes)
 _B_BUCKETS = (1, 4, 16, 64, 256, 1024)
@@ -424,82 +424,241 @@ def _prep_bulk_run(ids: np.ndarray, vecs: np.ndarray, metric: str, known_fn):
 
 
 class VectorLog:
-    """Append-only durability log for the device store (commit-log analog)."""
+    """Append-only durability log for the device store (commit-log analog).
+
+    v2 record layout (header magic WTVL, version 2):
+      ADD:    op(1)=1 | doc_id(<Q) | dim(<I) | ck(<I) | dim x <f4 payload
+      DELETE: op(1)=2 | doc_id(<Q) | ck(<I)
+    where ck is the 32-bit additive byte checksum of every record byte
+    EXCEPT the ck field itself. An additive sum (not crc32) is deliberate:
+    it detects any single flipped byte, and the vectorized replay can
+    verify a million records with two numpy row-sums instead of a Python
+    crc loop. The checksum is what makes mid-log corruption DETECTABLE,
+    which in turn makes skip-ahead replay safe: on a bad record, replay
+    scans forward for the next offset where a whole record parses AND
+    checksums (false resync ~2^-32 per candidate) and continues from
+    there, counting the skipped bytes — the flat-store analog of the
+    reference's corrupt-region repair (corrupt_commit_logs_fixer.go:1),
+    which replays around damage rather than abandoning everything after
+    it. v1 logs (no checksum) still replay with the old
+    stop-at-first-bad-record behavior.
+    """
 
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(path), exist_ok=True)
         fresh = True
         if os.path.exists(path):
-            # a crash can leave a torn/corrupt tail. Replay stops at the
-            # first bad record, so anything appended AFTER that point would
-            # be durably written yet unreachable — silent data loss on the
-            # next restart. Truncate to the valid prefix before reusing the
-            # file (corrupt_commit_logs_fixer.go: corrupt tails are cut,
-            # never appended past).
+            # a crash can leave a torn/corrupt tail; anything appended after
+            # an unreadable region would be durably written yet unreachable —
+            # silent data loss on the next restart. For v2 logs the cut point
+            # is the end of the LAST valid record (mid-file damage stays in
+            # place for skip-ahead replay to route around); for v1 logs it is
+            # the first bad record, as before.
             size = os.path.getsize(path)
             valid = self._valid_prefix_len(path)
             if valid < size:
+                cut = valid
+                if self._version(path) >= 2:
+                    cut = max(valid, self._last_valid_end(path))
                 with open(path, "r+b") as f:
-                    f.truncate(valid)
-            fresh = valid == 0
+                    f.truncate(cut)
+                fresh = cut == 0
+            else:
+                fresh = valid == 0
+            if not fresh and self._version(path) < 2:
+                # one-time in-place upgrade: appends always write v2
+                # checksummed records, and mixing formats within one file
+                # would make v1 replay mis-parse every appended vector
+                # (checksum bytes read as payload) — rewrite the whole log
+                # as v2 before reusing it.
+                self._upgrade_v1(path)
         self._f = open(path, "ab")
         if fresh:
             self._f.write(_LOG_MAGIC + struct.pack("<H", _LOG_VERSION))
             self._f.flush()
 
     @staticmethod
+    def report_replay_stats(path: str, stats: dict) -> None:
+        """One shared skip-report so the single-chip and mesh restores (and
+        any future caller) cannot drift in what they tell the operator."""
+        if stats.get("skipped_bytes"):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "vector log %s: skipped %d corrupt byte(s) across %d "
+                "region(s) during replay; records inside the damage are "
+                "lost, everything outside it was recovered",
+                path, stats["skipped_bytes"], stats.get("skipped_regions", 0))
+
+    @staticmethod
+    def _upgrade_v1(path: str) -> None:
+        tmp = path + ".upgrade"
+        with open(tmp, "wb") as f:
+            f.write(_LOG_MAGIC + struct.pack("<H", _LOG_VERSION))
+            for op, doc_id, vec in VectorLog.replay(path):
+                if op == "add":
+                    f.write(VectorLog._enc_add(doc_id, vec))
+                else:
+                    head = struct.pack("<BQ", _LOG_DELETE, doc_id)
+                    f.write(head + struct.pack("<I", VectorLog._sum32(head)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    # -- format helpers ------------------------------------------------------
+
+    @staticmethod
+    def _version(path: str) -> int:
+        with open(path, "rb") as f:
+            head = f.read(6)
+        if len(head) < 6 or head[:4] != _LOG_MAGIC:
+            return 0
+        return struct.unpack_from("<H", head, 4)[0]
+
+    @staticmethod
+    def _sum32(*parts) -> int:
+        s = 0
+        for p in parts:
+            s += int(np.frombuffer(p, np.uint8).sum(dtype=np.uint64))
+        return s & 0xFFFFFFFF
+
+    @staticmethod
+    def _enc_add(doc_id: int, v: np.ndarray) -> bytes:
+        head = struct.pack("<BQI", _LOG_ADD, doc_id, v.shape[0])
+        payload = v.tobytes()
+        return head + struct.pack("<I", VectorLog._sum32(head, payload)) + payload
+
+    @staticmethod
+    def _validate_v2(data, off: int, n: int):
+        """If a valid v2 record starts at off, return (op, end); else None."""
+        op = data[off]
+        if op == _LOG_ADD:
+            if off + 17 > n:
+                return None
+            dim, ck = struct.unpack_from("<II", data, off + 9)
+            if not 0 < dim <= 65536:
+                return None
+            end = off + 17 + 4 * dim
+            if end > n:
+                return None
+            if VectorLog._sum32(data[off : off + 13], data[off + 17 : end]) != ck:
+                return None
+            return (_LOG_ADD, end)
+        if op == _LOG_DELETE:
+            if off + 13 > n:
+                return None
+            (ck,) = struct.unpack_from("<I", data, off + 9)
+            if VectorLog._sum32(data[off : off + 9]) != ck:
+                return None
+            return (_LOG_DELETE, off + 13)
+        return None
+
+    @staticmethod
+    def _resync_v2(data, buf: np.ndarray, off: int, n: int):
+        """Smallest off' >= off where a whole v2 record parses and checksums,
+        or None. Candidate positions (op byte is 1 or 2) are found with one
+        vectorized pass per 1 MiB window; each candidate pays one record-sized
+        checksum, so the scan cost is bounded by the damaged span, not the
+        log size."""
+        pos = off
+        while pos < n:
+            win = min(pos + (1 << 20), n)
+            cands = np.flatnonzero((buf[pos:win] == _LOG_ADD) | (buf[pos:win] == _LOG_DELETE))
+            for idx in cands.tolist():
+                p = pos + idx
+                if VectorLog._validate_v2(data, p, n) is not None:
+                    return p
+            pos = win
+        return None
+
+    @staticmethod
     def _valid_prefix_len(path: str) -> int:
-        """Byte length of the longest parseable record prefix — the exact
-        point replay()/replay_batches() would stop at. 0 means the header
-        itself is unusable (the file must be re-initialized). Scans record
-        HEADERS only (seek past payloads), so a multi-GB log costs one
-        sequential header walk, not a whole-file read."""
+        """Byte length of the longest parseable record prefix. 0 means the
+        header itself is unusable (the file must be re-initialized). Scans
+        record HEADERS only (seek past payloads), so a multi-GB log costs one
+        sequential header walk, not a whole-file read. Does NOT verify
+        checksums — it bounds where the cheap walk stops, not data integrity
+        (replay re-verifies every record)."""
         with open(path, "rb") as f:
             size = os.fstat(f.fileno()).st_size
             head = f.read(6)
             if len(head) < 6 or head[:4] != _LOG_MAGIC:
                 return 0
+            v2 = struct.unpack_from("<H", head, 4)[0] >= 2
+            add_hdr = 17 if v2 else 13
+            del_len = 13 if v2 else 9
             off = 6
             while off < size:
                 f.seek(off)
-                hdr = f.read(13)
+                hdr = f.read(add_hdr)
                 if not hdr:
                     return off
                 op = hdr[0]
                 if op == _LOG_ADD:
-                    if len(hdr) < 13:
+                    if len(hdr) < add_hdr:
                         return off
                     (dim,) = struct.unpack_from("<I", hdr, 9)
-                    end = off + 13 + 4 * dim
+                    if v2 and not 0 < dim <= 65536:
+                        return off
+                    end = off + add_hdr + 4 * dim
                     if end > size:
                         return off
                     off = end
                 elif op == _LOG_DELETE:
-                    if len(hdr) < 9:
+                    if len(hdr) < del_len:
                         return off
-                    off += 9
+                    off += del_len
                 else:
                     return off
             return off
 
+    @staticmethod
+    def _last_valid_end(path: str) -> int:
+        """End offset of the last valid v2 record anywhere in the file (the
+        truncation point that preserves recoverable data past mid-file
+        damage). Walks record offsets only; vectors are never materialized."""
+        with open(path, "rb") as f:
+            data = f.read()
+        n = len(data)
+        if n < 6 or data[:4] != _LOG_MAGIC:
+            return 0
+        buf = np.frombuffer(data, np.uint8)
+        off, last = 6, 6
+        while off < n:
+            v = VectorLog._validate_v2(data, off, n)
+            if v is None:
+                nxt = VectorLog._resync_v2(data, buf, off + 1, n)
+                if nxt is None:
+                    return last
+                off = nxt
+                continue
+            off = last = v[1]
+        return last
+
+    # -- appends -------------------------------------------------------------
+
     def append_add(self, doc_id: int, vector: np.ndarray) -> None:
         v = np.ascontiguousarray(vector, dtype=np.float32)
-        self._f.write(struct.pack("<BQI", _LOG_ADD, doc_id, v.shape[0]) + v.tobytes())
+        self._f.write(self._enc_add(doc_id, v))
 
     def append_add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
-        """Vectorized bulk append: one write() for the whole batch."""
+        """Vectorized bulk append: one write() for the whole batch, with the
+        per-record checksums computed as two numpy row-sums."""
         n, dim = vectors.shape
-        rec_len = 13 + 4 * dim
+        rec_len = 17 + 4 * dim
         buf = np.zeros((n, rec_len), np.uint8)
         buf[:, 0] = _LOG_ADD
         buf[:, 1:9] = doc_ids.astype("<u8").view(np.uint8).reshape(n, 8)
         buf[:, 9:13] = np.frombuffer(struct.pack("<I", dim), np.uint8)
-        buf[:, 13:] = np.ascontiguousarray(vectors, dtype="<f4").view(np.uint8).reshape(n, 4 * dim)
+        buf[:, 17:] = np.ascontiguousarray(vectors, dtype="<f4").view(np.uint8).reshape(n, 4 * dim)
+        sums = buf[:, :13].sum(axis=1, dtype=np.uint64) + buf[:, 17:].sum(axis=1, dtype=np.uint64)
+        buf[:, 13:17] = (sums & 0xFFFFFFFF).astype("<u4").view(np.uint8).reshape(n, 4)
         self._f.write(buf.tobytes())
 
     def append_delete(self, doc_id: int) -> None:
-        self._f.write(struct.pack("<BQ", _LOG_DELETE, doc_id))
+        head = struct.pack("<BQ", _LOG_DELETE, doc_id)
+        self._f.write(head + struct.pack("<I", self._sum32(head)))
 
     def flush(self) -> None:
         self._f.flush()
@@ -512,14 +671,20 @@ class VectorLog:
             self._f.close()
 
     @staticmethod
-    def replay(path: str):
-        """Yield ('add', doc_id, vec) / ('delete', doc_id, None). Tolerates a
-        torn tail (corrupt_commit_logs_fixer.go behavior: replay what parses)."""
+    def replay(path: str, stats: Optional[dict] = None):
+        """Yield ('add', doc_id, vec) / ('delete', doc_id, None). v2 logs
+        verify per-record checksums and SKIP corrupt regions (resuming at the
+        next valid record, with the loss counted in `stats`); v1 logs keep
+        the old stop-at-first-bad-record behavior. A torn tail is tolerated
+        either way (corrupt_commit_logs_fixer.go behavior)."""
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
             data = f.read()
-        if data[:4] != _LOG_MAGIC:
+        if data[:4] != _LOG_MAGIC or len(data) < 6:
+            return
+        if struct.unpack_from("<H", data, 4)[0] >= 2:
+            yield from VectorLog._replay_v2(data, stats, batched=False)
             return
         off = 6
         n = len(data)
@@ -545,16 +710,19 @@ class VectorLog:
                 return
 
     @staticmethod
-    def replay_batches(path: str):
+    def replay_batches(path: str, stats: Optional[dict] = None):
         """Vectorized replay: maximal runs of same-dim add records parse as
         ONE numpy view — ('add', ids [n] u64, vecs [n, dim] f32) — with
-        ('delete', doc_id, None) singles in order. Same torn-tail tolerance
+        ('delete', doc_id, None) singles in order. Same corruption tolerance
         as replay(); restores parse the log ~10x faster this way."""
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
             data = f.read()
-        if data[:4] != _LOG_MAGIC:
+        if data[:4] != _LOG_MAGIC or len(data) < 6:
+            return
+        if struct.unpack_from("<H", data, 4)[0] >= 2:
+            yield from VectorLog._replay_v2(data, stats, batched=True)
             return
         buf = np.frombuffer(data, np.uint8)
         off = 6
@@ -591,6 +759,74 @@ class VectorLog:
             except struct.error:
                 return
 
+    @staticmethod
+    def _replay_v2(data: bytes, stats: Optional[dict], batched: bool):
+        """Shared v2 walk. Valid add-runs still parse as one numpy view (the
+        checksum column verifies vectorized, two row-sums per run); any
+        record that fails validation starts a skip-ahead scan, and the
+        skipped span is accumulated into `stats` so callers can REPORT the
+        loss instead of silently shrinking the store."""
+        buf = np.frombuffer(data, np.uint8)
+        off = 6
+        n = len(data)
+
+        def _skip(start: int):
+            nxt = VectorLog._resync_v2(data, buf, start + 1, n)
+            end = n if nxt is None else nxt
+            if stats is not None:
+                stats["skipped_bytes"] = stats.get("skipped_bytes", 0) + (end - start)
+                stats["skipped_regions"] = stats.get("skipped_regions", 0) + 1
+            return nxt
+
+        while off < n:
+            op = data[off]
+            if op == _LOG_ADD and off + 17 <= n:
+                dim, ck0 = struct.unpack_from("<II", data, off + 9)
+                rec = 17 + 4 * dim
+                max_run = (n - off) // rec if 0 < dim <= 65536 else 0
+                if max_run == 0:
+                    off = _skip(off)
+                    if off is None:
+                        return
+                    continue
+                view = buf[off : off + max_run * rec].reshape(max_run, rec)
+                ok = view[:, 0] == _LOG_ADD
+                dim_b = np.frombuffer(struct.pack("<I", dim), np.uint8)
+                ok &= (view[:, 9:13] == dim_b).all(axis=1)
+                sums = view[:, :13].sum(axis=1, dtype=np.uint64) + view[:, 17:].sum(
+                    axis=1, dtype=np.uint64
+                )
+                stored = np.ascontiguousarray(view[:, 13:17]).view("<u4").ravel()
+                ok &= (sums & 0xFFFFFFFF) == stored
+                run = max_run if bool(ok.all()) else int(np.argmin(ok))
+                if run == 0:  # first record is corrupt — resync
+                    off = _skip(off)
+                    if off is None:
+                        return
+                    continue
+                sel = view[:run]
+                ids = np.ascontiguousarray(sel[:, 1:9]).view("<u8").ravel()
+                vecs = np.ascontiguousarray(sel[:, 17:]).view("<f4").reshape(run, dim)
+                if batched:
+                    yield ("add", ids, vecs)
+                else:
+                    for i in range(run):
+                        yield ("add", int(ids[i]), vecs[i].copy())
+                off += run * rec
+            elif op == _LOG_DELETE and off + 13 <= n:
+                if VectorLog._validate_v2(data, off, n) is None:
+                    off = _skip(off)
+                    if off is None:
+                        return
+                    continue
+                (doc_id,) = struct.unpack_from("<Q", data, off + 1)
+                yield ("delete", doc_id, None)
+                off += 13
+            else:
+                off = _skip(off)
+                if off is None:
+                    return
+
     def rewrite(self, entries) -> None:
         """Condense: atomically rewrite the log with only live entries."""
         tmp = self.path + ".tmp"
@@ -598,7 +834,7 @@ class VectorLog:
             f.write(_LOG_MAGIC + struct.pack("<H", _LOG_VERSION))
             for doc_id, vec in entries:
                 v = np.ascontiguousarray(vec, dtype=np.float32)
-                f.write(struct.pack("<BQI", _LOG_ADD, doc_id, v.shape[0]) + v.tobytes())
+                f.write(self._enc_add(doc_id, v))
             f.flush()
             os.fsync(f.fileno())
         self._f.close()
@@ -684,11 +920,14 @@ class TpuVectorIndex(VectorIndex):
         device, which beats persisting them."""
         self._restoring = True
         try:
-            for op, ids, vecs in VectorLog.replay_batches(self._log.path):
+            replay_stats: dict = {}
+            for op, ids, vecs in VectorLog.replay_batches(self._log.path, stats=replay_stats):
                 if op == "add":
                     self._bulk_stage_add(ids, vecs)
                 else:
                     self._stage_delete(int(ids), log=False)
+            VectorLog.report_replay_stats(self._log.path, replay_stats)
+            self.last_replay_stats = replay_stats
             if os.path.exists(self._pq_path):
                 from weaviate_tpu.compress.pq import ProductQuantizer
 

@@ -124,8 +124,9 @@ class _Unit:
         return docs, self._score(docs, tf)
 
     def lookup(self, cand_ids):
-        """-> (mask over cand_ids, scores at mask) for candidates present in
-        this unit's postings — O(|cand| log df), never touches the rest."""
+        """-> (mask over cand_ids, scores at mask, posting positions at
+        mask) for candidates present in this unit's postings —
+        O(|cand| log df), never touches the rest."""
         if not self.ids.size:
             return None
         pos = np.clip(np.searchsorted(self.ids, cand_ids), 0, self.ids.size - 1)
@@ -133,7 +134,7 @@ class _Unit:
         if not found.any():
             return None
         sel = pos[found]
-        return found, self._score(self.ids[sel], self.tf[sel])
+        return found, self._score(self.ids[sel], self.tf[sel]), sel
 
 
 class BM25Searcher:
@@ -336,7 +337,7 @@ class BM25Searcher:
                 if cand_ids.size:
                     hit = u.lookup(cand_ids)
                     if hit is not None:
-                        found, add = hit
+                        found, add, _ = hit
                         cand_scores[found] += add
             growth += u.ub
             # theta (the k-th best partial) is only worth a merge+partition
@@ -384,9 +385,7 @@ class BM25Searcher:
                 hit = u.lookup(top_ids)
                 if hit is None:
                     continue
-                found, _ = hit
-                sel = np.clip(np.searchsorted(u.ids, top_ids[found]), 0,
-                              u.ids.size - 1)
+                found, _, sel = hit
                 lens = u._lengths(u.ids[sel])
                 for d, tfv, lv in zip(top_ids[found].tolist(),
                                       u.tf[sel].tolist(), lens.tolist()):

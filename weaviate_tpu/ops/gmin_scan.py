@@ -50,6 +50,17 @@ _RESCORE_BLOCK = 2048  # query rows per rescore map step (bounds the gather)
 _VMEM_BUDGET = 12 * 1024 * 1024
 
 
+def mosaic_g(ag: int, g: int = G) -> int:
+    """Mosaic-legal live-group count: the bias input is a 2D [ag, scg]
+    block, and Mosaic requires a 2D block's second-to-last dim to be
+    8-divisible or equal to the array dim — interpret mode accepts ag=13,
+    the real chip rejects it (found in the round-5 hardware session).
+    Round up to the next multiple of 8, capped at g (equality is always
+    legal). Padded slices carry inf bias, so they cost VMEM + FLOPs but
+    never change results."""
+    return min(g, -(-ag // 8) * 8)
+
+
 def _tile_footprint(qb: int, scg: int, d: int, ag: int, store_bytes: int) -> int:
     """Estimated VMEM bytes for one grid step: double-buffered input blocks
     (query tile, [ag, scg, d] store slices, bias), double-buffered output,
@@ -66,6 +77,7 @@ def plan_tiles(b: int, d: int, ncols: int, ag: int,
     whose VMEM footprint fits the budget. Wide vectors (d >= ~512 at f32)
     shrink the store tile first, then the query tile; callers must refuse
     the kernel when even the smallest tiling is over budget."""
+    ag = mosaic_g(ag)  # footprint must price the padded slices the kernel loads
     qb = min(_QB, b)
     scg = min(_SCG, ncols)
     while scg > 128 and _tile_footprint(qb, scg, d, ag, store_bytes) > _VMEM_BUDGET:
@@ -162,7 +174,7 @@ def group_min_scores(q, store3, bias2, alpha: float, *, active_g: int = G,
     up to 2x after geometric growth)."""
     b, d = q.shape
     g, ncols, _ = store3.shape
-    ag = max(1, min(int(active_g), g))
+    ag = mosaic_g(max(1, min(int(active_g), g)), g)
     qb, scg, _ = plan_tiles(b, d, ncols, ag, store3.dtype.itemsize)
     grid = (ncols // scg, b // qb)  # queries innermost: store tile loads once
     return pl.pallas_call(

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from weaviate_tpu.ops.gmin_scan import G, _VMEM_BUDGET
+from weaviate_tpu.ops.gmin_scan import G, _VMEM_BUDGET, mosaic_g
 
 _MSEG = 8     # segments reconstructed per one-hot matmul chunk
 _QB = 256     # query rows per grid step (upper bound)
@@ -49,6 +49,7 @@ def plan_tiles_pq(b: int, d: int, ncols: int, ag: int, m: int, c: int,
     gmin_scan.plan_tiles: callers must refuse the kernel when even the
     smallest tiling exceeds the VMEM budget (an oversized kernel reaching
     Mosaic has wedged the TPU relay before)."""
+    ag = mosaic_g(ag)  # footprint must price the padded slices the kernel loads
     mseg = min(_MSEG, m)
     qb = min(_QB, b)
     scg = min(_SCG, ncols)
@@ -186,7 +187,7 @@ def pq_group_min_scores(q, codes3, bias2, cb_chunks, alpha: float, *,
     g, ncols, m = codes3.shape
     nchunks, mc, _ = cb_chunks.shape
     c = mc // min(_MSEG, m)
-    ag = max(1, min(int(active_g), g))
+    ag = mosaic_g(max(1, min(int(active_g), g)), g)
     qb, scg, mseg, _ = plan_tiles_pq(b, d, ncols, ag, m, c)
     grid = (ncols // scg, b // qb)  # queries innermost: recon runs once/tile
     return pl.pallas_call(

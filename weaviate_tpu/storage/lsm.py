@@ -28,6 +28,7 @@ import os
 import struct
 import threading
 import time
+import zlib
 from typing import Iterable, Iterator, Optional
 
 import numpy as np
@@ -42,7 +43,9 @@ STRATEGY_ROARINGSET = "roaringset"
 STRATEGIES = (STRATEGY_REPLACE, STRATEGY_SET, STRATEGY_MAP, STRATEGY_ROARINGSET)
 
 _SEG_MAGIC = b"WTSG"
-_WAL_MAGIC = b"WTWL"
+_WAL_MAGIC = b"WTWL"   # v1: bare records, no per-record integrity
+_WAL_MAGIC2 = b"WTW2"  # v2: <len u32><crc32 u32> framed records, skip-ahead replay
+_WAL_MAX_REC = 1 << 26  # resync sanity bound: no legitimate record is >64 MiB
 _TOMBSTONE = b"\x00__wt_tombstone__"
 _MISSING = object()  # distinguishes absent map subkeys from None tombstones
 
@@ -515,8 +518,14 @@ class Bucket:
         self._replay_wal()
         self._wal = open(self._wal_path, "ab")
         if self._wal.tell() == 0:
-            self._wal.write(_WAL_MAGIC)
+            self._wal.write(_WAL_MAGIC2)
             self._wal.flush()
+            self._wal_v2 = True
+        else:
+            # append in the format the file already carries; v1 files keep
+            # v1 records until the next memtable flush rotates them to v2
+            with open(self._wal_path, "rb") as f:
+                self._wal_v2 = f.read(4) == _WAL_MAGIC2
         # native multi_get lifetime protection: calls run OUTSIDE the bucket
         # lock on a segment snapshot, so compaction must retire (not close)
         # segments while any call is in flight
@@ -549,13 +558,33 @@ class Bucket:
 
     # -- WAL -----------------------------------------------------------------
 
-    def _wal_append(self, op: int, *parts: bytes) -> None:
+    @staticmethod
+    def _wal_payload(rec) -> bytes:
+        """op(1) nparts(1) then length-prefixed frames — the record body."""
         buf = io.BytesIO()
-        buf.write(bytes([op]))
-        buf.write(bytes([len(parts)]))
-        for p in parts:
+        buf.write(bytes([rec[0]]))
+        buf.write(bytes([len(rec) - 1]))
+        for p in rec[1:]:
             _write_frame(buf, p)
-        self._wal.write(buf.getvalue())
+        return buf.getvalue()
+
+    def _wal_encode(self, records) -> bytes:
+        """v2 frames each record as <len u32><crc32 u32><payload>: the crc
+        makes a flipped byte DETECTABLE, and the length lets replay resync
+        past a damaged record instead of abandoning everything after it
+        (corrupt_commit_logs_fixer.go:1 semantics). Files that still carry
+        the v1 magic keep receiving bare v1 records — formats never mix
+        within one file; every memtable flush rotates the file to v2."""
+        out = io.BytesIO()
+        for rec in records:
+            payload = self._wal_payload(rec)
+            if self._wal_v2:
+                out.write(struct.pack("<II", len(payload), zlib.crc32(payload)))
+            out.write(payload)
+        return out.getvalue()
+
+    def _wal_append(self, op: int, *parts: bytes) -> None:
+        self._wal.write(self._wal_encode([(op, *parts)]))
         self._last_write = time.monotonic()
         if self.sync_writes:
             self._wal.flush()
@@ -565,24 +594,21 @@ class Bucket:
         """Many (op, *parts) records in ONE file write (and one fsync when
         sync_writes) — batch imports append thousands of postings per call
         and per-record writes would dominate."""
-        buf = io.BytesIO()
-        w = buf.write
-        for rec in records:
-            w(bytes([rec[0]]))
-            w(bytes([len(rec) - 1]))
-            for p in rec[1:]:
-                _write_frame(buf, p)
-        self._wal.write(buf.getvalue())
+        self._wal.write(self._wal_encode(records))
         self._last_write = time.monotonic()
         if self.sync_writes:
             self._wal.flush()
             os.fsync(self._wal.fileno())
 
     def _replay_wal(self) -> None:
+        self.wal_replay_stats: dict = {}
         if not os.path.exists(self._wal_path):
             return
         with open(self._wal_path, "rb") as f:
             data = f.read()
+        if data[:4] == _WAL_MAGIC2:
+            self._replay_wal_v2(data)
+            return
         if data[:4] != _WAL_MAGIC:
             return
         mv = memoryview(data)
@@ -600,6 +626,88 @@ class Bucket:
                 self._apply(op, parts)
         except (struct.error, IndexError, ValueError):
             return  # torn tail: replay what parsed
+
+    def _replay_wal_v2(self, data: bytes) -> None:
+        """Replay a crc-framed WAL, SKIPPING corrupt regions: on a bad
+        length or crc mismatch, scan forward for the next offset whose
+        framing parses and checksums (cheap pre-filters: sane length, valid
+        op byte, plausible part count — only survivors pay a crc), apply
+        everything after it, and report the skipped span instead of
+        silently dropping the tail."""
+        n = len(data)
+        off = 4
+        stats = self.wal_replay_stats
+
+        def _valid_at(pos: int) -> Optional[int]:
+            """Record end if a valid v2 record starts at pos, else None."""
+            if pos + 8 > n:
+                return None
+            ln, crc = struct.unpack_from("<II", data, pos)
+            if not 2 <= ln <= min(_WAL_MAX_REC, n - pos - 8):
+                return None
+            body = data[pos + 8 : pos + 8 + ln]
+            if body[0] not in (_W_PUT, _W_DELETE, _W_RS_ADD_MANY, _W_RS_DEL_MANY):
+                return None
+            if body[1] > 16:
+                return None
+            if zlib.crc32(body) != crc:
+                return None
+            return pos + 8 + ln
+
+        buf = np.frombuffer(data, np.uint8)
+
+        def _skip(start: int) -> Optional[int]:
+            # vectorized candidate pre-filter (same shape as
+            # VectorLog._resync_v2): a valid record has a legal op byte at
+            # +8 and a plausible part count at +9, so one numpy pass per
+            # 1 MiB window shortlists positions and only survivors pay the
+            # length-sanity + crc check — a multi-MB damaged span costs
+            # window scans, not per-byte Python iterations
+            pos = start + 1
+            hit = None
+            last = n - 10  # a minimal record is 8 header + 2 body bytes
+            while pos <= last and hit is None:
+                win = min(pos + (1 << 20), last + 1)
+                ops = buf[pos + 8 : win + 8]
+                nparts = buf[pos + 9 : win + 9]
+                cands = np.flatnonzero(
+                    ((ops >= _W_PUT) & (ops <= _W_RS_DEL_MANY)) & (nparts <= 16))
+                for idx in cands.tolist():
+                    if _valid_at(pos + idx) is not None:
+                        hit = pos + idx
+                        break
+                pos = win
+            stop = hit if hit is not None else n
+            stats["skipped_bytes"] = stats.get("skipped_bytes", 0) + (stop - start)
+            stats["skipped_regions"] = stats.get("skipped_regions", 0) + 1
+            return hit
+
+        while off < n:
+            end = _valid_at(off)
+            if end is None:
+                nxt = _skip(off)
+                if nxt is None:
+                    break
+                off = nxt
+                continue
+            body = memoryview(data)[off + 8 : end]
+            op, nparts = body[0], body[1]
+            parts = []
+            p_off = 2
+            for _ in range(nparts):
+                p, p_off = _read_frame(body, p_off)
+                parts.append(p)
+            self._apply(op, parts)
+            off = end
+        if stats.get("skipped_bytes"):
+            logging.getLogger(__name__).warning(
+                "WAL %s: skipped %d corrupt byte(s) across %d region(s) "
+                "during replay; records inside the damage are lost, "
+                "everything outside it was recovered",
+                self._wal_path,
+                stats["skipped_bytes"],
+                stats.get("skipped_regions", 0),
+            )
 
     def _apply(self, op: int, parts: list[bytes]) -> None:
         m = self._mem
@@ -1025,12 +1133,13 @@ class Bucket:
             self._seg_counter += 1
             self._segments.append(Segment(seg_path))
             self._mem = self._new_memtable()
-            # truncate WAL
+            # truncate WAL (always rotates to the v2 crc-framed format)
             self._wal.close()
             self._wal = open(self._wal_path, "wb")
-            self._wal.write(_WAL_MAGIC)
+            self._wal.write(_WAL_MAGIC2)
             self._wal.flush()
             os.fsync(self._wal.fileno())
+            self._wal_v2 = True
 
     def segment_count(self) -> int:
         with self._lock:
