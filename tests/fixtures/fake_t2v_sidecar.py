@@ -1,0 +1,67 @@
+"""Standalone fake transformers-inference sidecar: the HTTP contract the
+text2vec-transformers module speaks (modules/text2vec-transformers/clients
+in the reference; POST /vectors {"text": ...} -> {"vector": [...]}, GET
+/meta, GET /.well-known/ready). Run as a real process so the container
+acceptance tier reproduces the docker-compose topology (server container +
+inference container over TCP) without requiring a docker daemon.
+
+Usage: python tests/fixtures/fake_t2v_sidecar.py <port> [dim]
+Prints "READY <port>" on stdout once listening.
+"""
+
+import hashlib
+import json
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def embed(text: str, dim: int):
+    """Deterministic, normalized pseudo-embedding: same text -> same vector
+    across processes (restart journeys depend on this)."""
+    out = []
+    i = 0
+    while len(out) < dim:
+        h = hashlib.sha256(f"{i}:{text}".encode()).digest()
+        out.extend(b / 255.0 - 0.5 for b in h)
+        i += 1
+    v = out[:dim]
+    norm = sum(x * x for x in v) ** 0.5 or 1.0
+    return [x / norm for x in v]
+
+
+def main():
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    dim = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/meta", "/.well-known/ready", "/.well-known/live"):
+                return self._send({"model": "fake-t2v", "dim": dim})
+            self._send({"error": "not found"}, 404)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if self.path.rstrip("/") == "/vectors":
+                text = body.get("text") or ""
+                return self._send({"text": text, "vector": embed(text, dim)})
+            self._send({"error": "not found"}, 404)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    print(f"READY {httpd.server_address[1]}", flush=True)
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -177,3 +177,65 @@ def test_gmin_uneven_rescore_block(tmp_path):
     want = np.repeat(np.arange(25, dtype=np.uint64), 84)
     np.testing.assert_array_equal(ids.ravel(), want)
     np.testing.assert_allclose(dists.ravel(), 0.0, atol=1e-4)
+
+
+def test_gmin_block_rescore_equals_strided(tmp_path):
+    """The [ncols, G*D] block-gather rescore (round-5 gather fix: rg
+    contiguous slices per query instead of rg*G scattered rows) must be
+    bit-identical to the strided-take path it replaces."""
+    import jax.numpy as jnp
+
+    from weaviate_tpu.ops import gmin_scan
+
+    rng = np.random.default_rng(3)
+    n, d, b, k = 700, 32, 64, 10
+    cap = 16384
+    store = np.zeros((cap, d), np.float32)
+    store[:n] = rng.standard_normal((n, d)).astype(np.float32)
+    sq = jnp.asarray((store.astype(np.float64) ** 2).sum(1).astype(np.float32))
+    store_j = jnp.asarray(store)
+    tombs = np.zeros(cap, bool)
+    tombs[5:50:7] = True  # some tombstones
+    q = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+    words = jnp.zeros((cap // 32,), jnp.uint32)
+    args = (store_j, sq, jnp.asarray(tombs), n, q, words, False,
+            k, "l2-squared", 8, 1, True)
+    d0, i0 = gmin_scan.gmin_topk(*args)
+    blk = gmin_scan.build_rescore_blocks(store_j)
+    d1, i1 = gmin_scan.gmin_topk(*args, blk)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_pq_gmin_block_rescore_equals_strided(tmp_path):
+    """Codes twin of the block-rescore equivalence check."""
+    import jax.numpy as jnp
+
+    from weaviate_tpu.compress.pq import ProductQuantizer
+    from weaviate_tpu.ops import pq_gmin
+
+    rng = np.random.default_rng(4)
+    n, d, b, k = 900, 32, 64, 10
+    cap = 16384
+    vecs = rng.standard_normal((n, d)).astype(np.float32)
+    pq = ProductQuantizer(dim=d, segments=8, centroids=16, metric="l2-squared")
+    pq.fit(vecs)
+    codes = np.zeros((cap, 8), np.uint8)
+    codes[:n] = pq.encode(vecs)
+    recon = pq.decode(codes[:n])
+    rn = np.zeros(cap, np.float32)
+    rn[:n] = (recon.astype(np.float64) ** 2).sum(1).astype(np.float32)
+    cb_chunks = jnp.asarray(
+        pq_gmin.build_cb_chunks(pq.codebook, 8), jnp.bfloat16)
+    flat_cb = jnp.asarray(pq.codebook.reshape(-1, pq.codebook.shape[2]))
+    codes_j = jnp.asarray(codes)
+    q = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+    words = jnp.zeros((cap // 32,), jnp.uint32)
+    args = (codes_j, jnp.asarray(rn), jnp.zeros((cap,), bool), n, q,
+            cb_chunks, flat_cb, words, False, k, "l2-squared", 8, 1, True,
+            None)
+    d0, i0 = pq_gmin.pq_gmin_topk(*args)
+    blk = pq_gmin.build_codes_blocks(codes_j)
+    d1, i1 = pq_gmin.pq_gmin_topk(*args, blk)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))

@@ -303,7 +303,8 @@ def test_wal_single_flip_loses_at_most_one_record(tmp_path):
             f"case {case}: flip at {pos} lost undamaged keys {missing} "
             f"(damaged={damaged})")
         if missing:
-            assert b2.wal_replay_stats.get("skipped_bytes", 0) > 0
+            st = b2.wal_replay_stats
+            assert st.get("skipped_bytes", 0) + st.get("torn_tail_bytes", 0) > 0
 
 
 def test_wal_multi_region_and_reporting(tmp_path):
@@ -325,6 +326,53 @@ def test_wal_multi_region_and_reporting(tmp_path):
         want = None if i in (3, 15, 27) else f"value{i}".encode()
         assert b2.get(f"key{i:02d}".encode()) == want
     assert b2.wal_replay_stats["skipped_regions"] == 3
+
+
+def test_wal_heals_after_corruption(tmp_path):
+    """The first reopen after damage reports the skip and HEALS the file;
+    a second reopen must see a clean WAL (no re-scan, no re-warn) and
+    appends after healing must survive another restart."""
+    src = str(tmp_path / "b")
+    b = Bucket(src, STRATEGY_REPLACE)
+    for i in range(20):
+        b.put(f"k{i:02d}".encode(), f"v{i}".encode())
+    b.flush()
+    wal = os.path.join(src, "bucket.wal")
+    extents = _wal_extents(wal)
+    data = bytearray(open(wal, "rb").read())
+    s, _ = extents[7]
+    data[s + 12] ^= 0xFF
+    with open(wal, "wb") as f:
+        f.write(bytes(data))
+    b2 = Bucket(src, STRATEGY_REPLACE)
+    assert b2.wal_replay_stats.get("skipped_regions") == 1
+    b2.put(b"after-heal", b"yes")
+    b2.flush()
+    b3 = Bucket(src, STRATEGY_REPLACE)
+    assert b3.wal_replay_stats == {}, b3.wal_replay_stats  # healed: clean
+    assert b3.get(b"after-heal") == b"yes"
+    for i in range(20):
+        want = None if i == 7 else f"v{i}".encode()
+        assert b3.get(f"k{i:02d}".encode()) == want
+
+
+def test_wal_torn_tail_not_reported_as_corruption(tmp_path):
+    """A crash-torn tail (truncated final record) is healed silently:
+    counted as torn_tail_bytes, never warned as corruption."""
+    src = str(tmp_path / "b")
+    b = Bucket(src, STRATEGY_REPLACE)
+    for i in range(10):
+        b.put(f"k{i}".encode(), f"v{i}".encode())
+    b.flush()
+    wal = os.path.join(src, "bucket.wal")
+    data = open(wal, "rb").read()
+    with open(wal, "wb") as f:
+        f.write(data[:-5])  # tear the last record
+    b2 = Bucket(src, STRATEGY_REPLACE)
+    st = b2.wal_replay_stats
+    assert st.get("skipped_bytes", 0) == 0 and st.get("skipped_regions", 0) == 0
+    assert st.get("torn_tail_bytes", 0) > 0
+    assert b2.get(b"k9") is None and b2.get(b"k8") == b"v8"
 
 
 def test_wal_v1_file_still_replays_and_appends(tmp_path):
@@ -372,3 +420,26 @@ def test_wal_corruption_property(tmp_path_factory, data):
     for i in range(n):
         if i not in damaged:
             assert b2.get(f"k{i}".encode()) is not None
+
+
+def test_wal_oversized_roaring_record_is_chunked(tmp_path):
+    """A roaring bulk add larger than one WAL record's id budget must split
+    into multiple records (each under the replay resync bound) and replay
+    losslessly — the write path may never produce a record replay would
+    reject as corrupt."""
+    import numpy as np
+
+    from weaviate_tpu.storage.lsm import STRATEGY_ROARINGSET, _WAL_MAX_REC
+
+    src = str(tmp_path / "rs")
+    b = Bucket(src, STRATEGY_ROARINGSET)
+    n = Bucket._RS_IDS_PER_REC + 1234  # one full record + a remainder
+    ids = np.arange(n, dtype=np.uint64)
+    b.roaring_add_many(b"tok", ids)
+    b.flush()
+    wal = os.path.join(src, "bucket.wal")
+    for s, e in _wal_extents(wal):
+        assert e - s - 8 <= _WAL_MAX_REC
+    b2 = Bucket(src, STRATEGY_ROARINGSET)
+    got = b2.roaring_get(b"tok")
+    assert len(got) == n

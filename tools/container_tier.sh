@@ -1,0 +1,35 @@
+#!/bin/sh
+# Container acceptance tier (reference analog: test/docker/compose.go).
+#
+# With a docker daemon: builds the real image, boots it against the fake
+# vectorizer sidecar, and drives the SAME pytest journey over the container
+# (CONTAINER_BASE_URL mode). Without docker (the dev environment): the
+# journey runs against the exact Dockerfile entrypoint as subprocesses —
+# see tests/test_container_tier.py.
+set -e
+cd "$(dirname "$0")/.."
+
+if command -v docker >/dev/null 2>&1 && docker info >/dev/null 2>&1; then
+    echo "== docker available: building image =="
+    docker build -t weaviate-tpu-test .
+    echo "== starting fake t2v sidecar on the host =="
+    python tests/fixtures/fake_t2v_sidecar.py 18098 32 &
+    SIDECAR_PID=$!
+    trap 'kill $SIDECAR_PID 2>/dev/null; docker rm -f wtpu-tier 2>/dev/null' EXIT
+    sleep 1
+    echo "== booting the container (host network, compose env) =="
+    docker run -d --name wtpu-tier --network=host \
+        -e PERSISTENCE_DATA_PATH=/var/lib/weaviate \
+        -e QUERY_DEFAULTS_LIMIT=25 \
+        -e ENABLE_MODULES=text2vec-transformers,backup-filesystem \
+        -e DEFAULT_VECTORIZER_MODULE=text2vec-transformers \
+        -e TRANSFORMERS_INFERENCE_API=http://127.0.0.1:18098 \
+        -e BACKUP_FILESYSTEM_PATH=/var/lib/weaviate/backups \
+        weaviate-tpu-test
+    echo "== driving the journey against the container =="
+    CONTAINER_BASE_URL=http://127.0.0.1:8080 CONTAINER_SKIP_RESTART=1 \
+        python -m pytest tests/test_container_tier.py -v
+else
+    echo "== no docker daemon: subprocess topology (same journey) =="
+    python -m pytest tests/test_container_tier.py -v
+fi

@@ -1,0 +1,110 @@
+"""Relay-proof stage timing: the axon relay costs ~70-140 ms per device
+round trip, so single-call timings measure enqueue, not execution
+(tools/profile_gmin.py's µs-scale numbers were bogus). Here each stage runs
+ITERS times INSIDE one jit via lax.scan, with the carry perturbing the
+query so XLA cannot hoist or CSE the body; wall time / ITERS is true
+device time to within one relay round trip.
+
+Stages at the headline shape (N=1M, B=16384, D=128):
+  kernel        group_min_scores (pallas fast scan) alone
+  kernsel       kernel + approx_min_k group selection
+  topk_strided  full gmin_topk, strided-row candidate gather (old path)
+  topk_block    full gmin_topk, contiguous block gather (round-5 path)
+  legacy        _search_full lax.scan kernel, rescore_r=128 (round-1 path)
+
+Usage: python tools/profile_gmin3.py [N] [B] [ITERS]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from weaviate_tpu.ops import gmin_scan
+from weaviate_tpu.ops.gmin_scan import G
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+ITERS = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+D = 128
+K = 10
+RG = 32
+INTERP = None  # set in main: interpret mode off-TPU so the script smokes on CPU
+
+
+def loop_timed(name, fn, q, *rest):
+    """fn(q, *rest) -> array; runs ITERS chained iterations in ONE jit."""
+
+    @jax.jit
+    def run(q0, *r):
+        def body(carry, _):
+            out = fn(q0 + carry, *r)
+            # fold one element back into the carry: serializes iterations
+            return 1e-9 * out.ravel()[0].astype(jnp.float32), None
+
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=ITERS)
+        return c
+
+    out = run(q, *rest)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(q, *rest))
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:14s} {dt * 1e3:9.1f} ms/batch  {B / dt:10.0f} qps", flush=True)
+    return dt
+
+
+def main():
+    global INTERP
+    INTERP = jax.default_backend() not in ("tpu", "axon")
+    print(f"backend={jax.default_backend()} N={N} B={B} D={D} "
+          f"RG={RG} ITERS={ITERS}", flush=True)
+    rng = np.random.default_rng(0)
+    store = jnp.asarray(rng.standard_normal((N, D)), jnp.float32)
+    norms = jnp.sum(store**2, axis=1)
+    tombs = jnp.zeros((N,), jnp.bool_)
+    q = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+    words = jnp.zeros((N // 32,), jnp.uint32)
+    ncols = N // G
+    alpha = -2.0
+    bias2 = norms.reshape(G, ncols)
+    store3 = store.reshape(G, ncols, D)
+
+    loop_timed("kernel",
+               lambda qq, s3, b2: gmin_scan.group_min_scores(qq, s3, b2, alpha, interpret=INTERP),
+               q, store3, bias2)
+
+    loop_timed("kernsel",
+               lambda qq, s3, b2: jax.lax.approx_min_k(
+                   gmin_scan.group_min_scores(qq, s3, b2, alpha, interpret=INTERP),
+                   RG, recall_target=0.99)[1].astype(jnp.float32),
+               q, store3, bias2)
+
+    def topk(qq, s, nrm, tb, w, blk):
+        d_, i_ = gmin_scan.gmin_topk(s, nrm, tb, N, qq, w, False,
+                                     K, "l2-squared", RG, G, INTERP, blk)
+        return d_
+
+    loop_timed("topk_strided", lambda qq, s, nrm, tb, w: topk(qq, s, nrm, tb, w, None),
+               q, store, norms, tombs, words)
+
+    blk = gmin_scan.build_rescore_blocks(store)
+    jax.block_until_ready(blk)
+    loop_timed("topk_block", topk, q, store, norms, tombs, words, blk)
+
+    from weaviate_tpu.index.tpu import _search_full
+
+    loop_timed("legacy",
+               lambda qq, s, nrm, tb, w: _search_full(
+                   s, nrm, tb, N, qq, w, K, "l2-squared", False,
+                   rescore_r=128).astype(jnp.float32),
+               q, store, norms, tombs, words)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,19 @@ def main(argv=None) -> int:
     ap.add_argument("--data-path", default=None)
     args = ap.parse_args(argv)
 
+    # honor JAX_PLATFORMS even when a site hook (sitecustomize) imported
+    # jax before this process's env was consulted — the 12-factor contract
+    # is that the container env picks the backend, and without this a host
+    # that pins a device backend silently overrides `JAX_PLATFORMS=cpu`
+    # (first insert then blocks on an unreachable accelerator)
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception as e:  # noqa: BLE001 — serving beats backend pinning
+            print(f"warning: could not apply JAX_PLATFORMS: {e}", flush=True)
+
     from weaviate_tpu.config import load_config
     from weaviate_tpu.server import App, RestServer
     from weaviate_tpu.server.grpc_server import GrpcServer

@@ -170,13 +170,16 @@ class HnswIndex(VectorIndex):
                 if os.path.exists(dim_file):
                     self.dim = int(open(dim_file).read().strip())
         if self._log is not None:
-            for op, doc_id, vec in VectorLog.replay(self._log.path):
+            replay_stats: dict = {}
+            for op, doc_id, vec in VectorLog.replay(self._log.path, stats=replay_stats):
                 if op == "add":
                     v = np.asarray(vec, dtype=np.float32)  # already normalized at log time
                     self._ensure_handle(v.shape[0])
                     self._lib.hnsw_add(self._h, doc_id, _f32p(np.ascontiguousarray(v)))
                 elif self._h is not None:
                     self._lib.hnsw_delete(self._h, doc_id)
+            VectorLog.report_replay_stats(self._log.path, replay_stats)
+            self.last_replay_stats = replay_stats
 
     def _ef(self, k: int) -> int:
         ef = self.config.ef

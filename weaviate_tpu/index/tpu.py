@@ -902,6 +902,11 @@ class TpuVectorIndex(VectorIndex):
 
         self._pqg_state = KernelState()
         self._pqg_cb = None  # (pq identity, cb_chunks dev, flat_cb dev)
+        # per-store-generation [ncols, G*D] rescore-block layouts (see
+        # gmin_scan.build_rescore_blocks): keyed by the exact device array
+        # object — every write replaces the donated store array, so object
+        # identity IS the write generation. Strong refs keep ids stable.
+        self._blk_cache: dict = {}
         # compiled-shape keys (b, k, rg, active_g, use_allow) that completed a
         # materialized search — each key is its own Mosaic compilation, so one
         # small-shape success must not vouch for a larger VMEM footprint
@@ -1356,14 +1361,34 @@ class TpuVectorIndex(VectorIndex):
             return False
         return self._gmin_rg(k) > 0
 
+    def _gen_blocks(self, arr, build_fn):
+        """Generation-cached block layout for `arr` (the store, the bf16
+        rescore store, or the PQ codes): rebuilt only when the underlying
+        array object changes (donated updates replace it). On every miss,
+        entries whose source array is no longer a live index member are
+        dropped FIRST — a replaced store generation plus its block layout
+        (~1 GB HBM at 1M x 128 f32) must free before the new one builds,
+        and still-valid entries for the other arrays stay cached."""
+        hit = self._blk_cache.get(id(arr))
+        if hit is not None and hit[0] is arr:
+            return hit[1]
+        live = {id(x) for x in (self._store, self._rescore_dev, self._codes)
+                if x is not None}
+        for k in [k for k in self._blk_cache if k not in live]:
+            del self._blk_cache[k]
+        blk = build_fn(arr)
+        self._blk_cache[id(arr)] = (arr, blk)
+        return blk
+
     def _search_full_gmin(self, q: np.ndarray, kk: int, allow_words,
                           store=None, sq_norms=None):
         from weaviate_tpu.ops import gmin_scan
 
         interpret = jax.default_backend() not in ("tpu", "axon")
         ncols = self.capacity // gmin_scan.G
+        s = self._store if store is None else store
         return gmin_scan.search_gmin(
-            self._store if store is None else store,
+            s,
             self._sq_norms if sq_norms is None else sq_norms,
             self._tombs,
             self.n,
@@ -1376,6 +1401,7 @@ class TpuVectorIndex(VectorIndex):
             self._gmin_rg(kk),
             -(-self.n // ncols),  # live store slices only
             interpret,
+            self._gen_blocks(s, gmin_scan.build_rescore_blocks),
         )
 
     def _gmin_packed_or_none(self, q: np.ndarray, kk: int, allow_words,
@@ -1448,6 +1474,7 @@ class TpuVectorIndex(VectorIndex):
                 active_g,
                 interpret,
                 self._pq.rotation_dev(),
+                self._gen_blocks(self._codes, pq_gmin.build_codes_blocks),
             ),
             "fused pq codes kernel")
 

@@ -213,9 +213,20 @@ def _vmem(shape, dtype):
     return pltpu.VMEM(shape, dtype)
 
 
+@jax.jit
+def build_codes_blocks(codes):
+    """[cap, M] codes -> [ncols, G*M] group-block layout (the codes twin of
+    gmin_scan.build_rescore_blocks): the ADC rescore's candidate gather
+    drops from rg*G scattered M-byte rows per query to rg contiguous
+    G*M-byte slices. Cached by the index per codes generation."""
+    cap, m = codes.shape
+    ncols = cap // G
+    return codes.reshape(G, ncols, m).transpose(1, 0, 2).reshape(ncols, G * m)
+
+
 def pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
                  allow_words, use_allow, k, metric, rg, active_g=G,
-                 interpret=False, rot=None):
+                 interpret=False, rot=None, codes_blk=None):
     """Full codes-only fused search -> ([B, k] ADC dists, [B, k] slots, -1
     missing). Mirrors gmin_scan.gmin_topk: fast scan -> top-RG groups ->
     exact-ADC rescore of RG*G members -> top-k. flat_cb is [M*C, ds] f32
@@ -223,7 +234,8 @@ def pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
     (rg*G rows per query), XLA-side. rot ([D, D], identity when no OPQ)
     maps queries into the quantizer's rotated space — distances are
     rotation-invariant for the matmul metrics, so results rank the
-    original space."""
+    original space. codes_blk: optional build_codes_blocks(codes) output
+    for the block-gather rescore path."""
     from weaviate_tpu.ops.topk import bitmap_to_mask, rescore_distances
 
     if rot is not None:
@@ -253,21 +265,30 @@ def pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
     _, gidx = jax.lax.approx_min_k(gmin, rg, recall_target=0.99)
 
     # exact-ADC rescore of the kept groups' members: reconstruct candidates
-    # from the codebook (a small gather — rg*G rows/query) and score in f32
+    # from the codebook (a small gather — rg*G rows/query) and score in f32.
+    # Candidate codes, bias validity, and recon norms all ride [ncols, G]
+    # block gathers (rg descriptors/query), never per-slot takes.
     offs = (jnp.arange(G) * ncols)[None, None, :]
     slots = (gidx[:, :, None] + offs).reshape(b, rg * G)
-    cand_codes = jnp.take(codes, slots, axis=0).astype(jnp.int32)  # [B,RG,M]
+    if codes_blk is not None:
+        cand_codes = jnp.take(codes_blk, gidx, axis=0).reshape(
+            b, rg, G, m).reshape(b, rg * G, m).astype(jnp.int32)
+    else:
+        cand_codes = jnp.take(codes, slots, axis=0).astype(jnp.int32)
     seg_off = (jnp.arange(m, dtype=jnp.int32) * c)[None, None, :]
     cand = jnp.take(flat_cb, cand_codes + seg_off, axis=0).reshape(
         b, rg * G, d)
+    bias_blk = bias2.T  # [ncols, G]
+    cand_bias = jnp.take(bias_blk, gidx, axis=0).reshape(b, rg * G)
     if metric == "l2-squared":
         q_sq = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
         qx = jnp.einsum("bd,brd->br", q.astype(jnp.float32), cand)
-        nrm = jnp.take(recon_norms, slots)
+        nrm_blk = recon_norms.reshape(G, ncols).T
+        nrm = jnp.take(nrm_blk, gidx, axis=0).reshape(b, rg * G)
         ed = jnp.maximum(q_sq - 2.0 * qx + nrm, 0.0)
     else:
         ed = rescore_distances(cand, q, metric)
-    ed = jnp.where(jnp.isinf(jnp.take(bias, slots)), jnp.inf, ed)
+    ed = jnp.where(jnp.isinf(cand_bias), jnp.inf, ed)
     neg, pos = jax.lax.top_k(-ed, k)
     top = -neg
     idx = jnp.take_along_axis(slots, pos, axis=1)
@@ -281,12 +302,12 @@ def pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
 )
 def search_pq_gmin(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
                    allow_words, use_allow, k, metric, rg, active_g=G,
-                   interpret=False, rot=None):
+                   interpret=False, rot=None, codes_blk=None):
     """Jitted packed wrapper (pack_topk layout), the codes-only twin of
     gmin_scan.search_gmin."""
     from weaviate_tpu.ops.topk import pack_topk
 
     top, idx = pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks,
                             flat_cb, allow_words, use_allow, k, metric, rg,
-                            active_g, interpret, rot)
+                            active_g, interpret, rot, codes_blk)
     return pack_topk(top, idx)

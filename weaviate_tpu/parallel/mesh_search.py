@@ -180,9 +180,12 @@ def mesh_search_gmin_step(
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
         norms = norms_l if use_norms else jnp.zeros_like(norms_l)
+        # per-shard block layout computed in-graph: the mesh path has no
+        # host-side generation cache, and the transpose is ~ms at slab scale
+        blk_l = gmin_scan.build_rescore_blocks(store_l)
         d_top, i_top = gmin_scan.gmin_topk(
             store_l, norms, tombs_l, n_mine, q, allow_l, use_allow,
-            k, metric, rg, active_g, interpret)
+            k, metric, rg, active_g, interpret, blk_l)
         i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
         return _merge_across_shards(d_top, i_glob, k)
 
@@ -224,7 +227,8 @@ def mesh_search_pq_gmin_step(
         n_mine = n_all[my]
         d_top, i_top = pq_gmin.pq_gmin_topk(
             codes_l, norms_l, tombs_l, n_mine, q, cb_c, fcb, allow_l,
-            use_allow, k, metric, rg, active_g, interpret, r)
+            use_allow, k, metric, rg, active_g, interpret, r,
+            pq_gmin.build_codes_blocks(codes_l))
         i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
         return _merge_across_shards(d_top, i_glob, k)
 

@@ -578,6 +578,14 @@ class Bucket:
         out = io.BytesIO()
         for rec in records:
             payload = self._wal_payload(rec)
+            if len(payload) > _WAL_MAX_REC:
+                # replay's resync sanity bound would treat a larger record
+                # as corruption and silently drop it on restart — refuse
+                # loudly at write time instead (roaring bulk ops chunk
+                # their id payloads below this, see roaring_add_many)
+                raise LsmError(
+                    f"WAL record of {len(payload)} bytes exceeds the "
+                    f"{_WAL_MAX_REC}-byte record bound")
             if self._wal_v2:
                 out.write(struct.pack("<II", len(payload), zlib.crc32(payload)))
             out.write(payload)
@@ -633,10 +641,18 @@ class Bucket:
         framing parses and checksums (cheap pre-filters: sane length, valid
         op byte, plausible part count — only survivors pay a crc), apply
         everything after it, and report the skipped span instead of
-        silently dropping the tail."""
+        silently dropping the tail.
+
+        A trailing invalid span with no valid record after it is an
+        ordinary crash-torn TAIL, not corruption: it's counted separately
+        (torn_tail_bytes) and not warned about. After any damage the file
+        is HEALED in place — rewritten with only the valid records — so
+        the same bytes are never re-scanned or re-warned on the next
+        restart, and appends never land after dead bytes."""
         n = len(data)
         off = 4
         stats = self.wal_replay_stats
+        valid_spans: list[tuple[int, int]] = []
 
         def _valid_at(pos: int) -> Optional[int]:
             """Record end if a valid v2 record starts at pos, else None."""
@@ -677,9 +693,12 @@ class Bucket:
                         hit = pos + idx
                         break
                 pos = win
-            stop = hit if hit is not None else n
-            stats["skipped_bytes"] = stats.get("skipped_bytes", 0) + (stop - start)
-            stats["skipped_regions"] = stats.get("skipped_regions", 0) + 1
+            if hit is None:
+                # nothing valid after: a torn tail, not mid-file corruption
+                stats["torn_tail_bytes"] = stats.get("torn_tail_bytes", 0) + (n - start)
+            else:
+                stats["skipped_bytes"] = stats.get("skipped_bytes", 0) + (hit - start)
+                stats["skipped_regions"] = stats.get("skipped_regions", 0) + 1
             return hit
 
         while off < n:
@@ -698,6 +717,7 @@ class Bucket:
                 p, p_off = _read_frame(body, p_off)
                 parts.append(p)
             self._apply(op, parts)
+            valid_spans.append((off, end))
             off = end
         if stats.get("skipped_bytes"):
             logging.getLogger(__name__).warning(
@@ -708,6 +728,17 @@ class Bucket:
                 stats["skipped_bytes"],
                 stats.get("skipped_regions", 0),
             )
+        if stats.get("skipped_bytes") or stats.get("torn_tail_bytes"):
+            # heal: rewrite with only the valid records (atomic), so the
+            # damage is scanned and reported exactly once
+            tmp = self._wal_path + ".heal"
+            with open(tmp, "wb") as f:
+                f.write(_WAL_MAGIC2)
+                for s, e in valid_spans:
+                    f.write(data[s:e])
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._wal_path)
 
     def _apply(self, op: int, parts: list[bytes]) -> None:
         m = self._mem
@@ -812,11 +843,25 @@ class Bucket:
             self._mem.delete_pair(key, subkey)
             self._maybe_flush()
 
+    # u64 doc ids per roaring WAL record: 2M ids = 16 MiB, safely under the
+    # replay record bound with headroom for the key frame
+    _RS_IDS_PER_REC = 1 << 21
+
+    @classmethod
+    def _rs_recs(cls, op: int, key: bytes, a: np.ndarray):
+        """Split one roaring bulk op into record-bound-sized WAL records —
+        add/remove semantics are unchanged by splitting."""
+        step = cls._RS_IDS_PER_REC
+        if len(a) <= step:
+            return [(op, key, a.tobytes())]
+        return [(op, key, a[i : i + step].tobytes())
+                for i in range(0, len(a), step)]
+
     def roaring_add_many(self, key: bytes, doc_ids: Iterable[int]) -> None:
         assert self.strategy == STRATEGY_ROARINGSET
         ids = np.fromiter(doc_ids, dtype="<u8")
         with self._lock:
-            self._wal_append(_W_RS_ADD_MANY, key, ids.tobytes())
+            self._wal_append_many(self._rs_recs(_W_RS_ADD_MANY, key, ids))
             self._mem.add_many(key, ids)
             self._maybe_flush()
 
@@ -833,7 +878,8 @@ class Bucket:
             return
         with self._lock:
             self._wal_append_many(
-                [(_W_RS_ADD_MANY, k, a.tobytes()) for k, a in staged])
+                [r for k, a in staged
+                 for r in self._rs_recs(_W_RS_ADD_MANY, k, a)])
             add = self._mem.add_many
             for k, a in staged:
                 add(k, a)
@@ -843,7 +889,7 @@ class Bucket:
         assert self.strategy == STRATEGY_ROARINGSET
         ids = np.fromiter(doc_ids, dtype="<u8")
         with self._lock:
-            self._wal_append(_W_RS_DEL_MANY, key, ids.tobytes())
+            self._wal_append_many(self._rs_recs(_W_RS_DEL_MANY, key, ids))
             self._mem.del_many(key, ids)
             self._maybe_flush()
 
