@@ -395,12 +395,11 @@ def run_matrix(rng, vecs, queries, idx_l2, gt, headline=None):
     }
     flush()
 
-    # config 4: PQ-compressed (segments=32, bf16 rescore-store scan)
-    log("matrix: PQ (segments=32, rescored)...")
-    pq_out = _pq_tier_rows(vecs, queries, gt, backend=common["backend"])
-    results["pq_seg32_rescored"] = {
-        **pq_out["rescored"], "fit_seconds": pq_out["fit_seconds"],
-    }
+    # filtered selectivity sweep on the live backend (VERDICT r4 #5): the
+    # gather vs masked-scan crossover, tuned from hardware measurement
+    log("matrix: filtered scaling sweep (1%/10%/50%)...")
+    results["filtered_scaling"] = _filtered_scaling_row(
+        rng, idx_l2, vecs, common["backend"])
     flush()
 
     # config 2: cosine — real glove-100-angular when available
@@ -443,8 +442,158 @@ def run_matrix(rng, vecs, queries, idx_l2, gt, headline=None):
     log("matrix: gRPC 256-query batch e2e (n=50k objects)...")
     results["grpc_batch256"] = _grpc_e2e(rng)
     flush()
+
+    # BM25 host vs device on the live backend (hybrid's keyword half):
+    # smaller corpus than the CPU row — the device engine's per-query cost
+    # is a relay round trip, which is what this row exists to measure
+    n_kw = int(os.environ.get("BENCH_BM25_TPU_N", 200_000))
+    log(f"matrix: BM25 host vs device dense-row (n={n_kw} docs)...")
+    results["bm25"] = _bm25_row(n_kw)
+    flush()
+
+    # config 4 LAST: PQ-compressed (segments=32, bf16 rescore-store scan).
+    # The PQ-ADC Mosaic kernel is the one compile that has wedged the relay
+    # (chip_session.log 03:20); every row above is already flushed when it
+    # runs, so a wedge here costs only this row.
+    log("matrix: PQ (segments=32, rescored)...")
+    pq_out = _pq_tier_rows(vecs, queries, gt, backend=common["backend"])
+    results["pq_seg32_rescored"] = {
+        **pq_out["rescored"], "fit_seconds": pq_out["fit_seconds"],
+    }
+    flush()
     log(f"wrote {MATRIX_FILE}: {json.dumps(results)}")
     return results
+
+
+def _filtered_scaling_row(rng, idx_f, fvecs, backend: str) -> dict:
+    """Filtered-search selectivity sweep (1%/10%/50%) over an existing
+    index: gather vs masked-scan path choice, allowList pack cost, QPS,
+    roofline, recall. Shared by the CPU matrix and the hardware matrix so
+    the crossover is tuned from the SAME measurement shape on both
+    backends (reference semantics: hnsw/search.go:73-77 flat cutoff)."""
+    from weaviate_tpu.storage.bitmap import Bitmap
+
+    n_f = len(fvecs)
+    b_f = 256
+    fq = fvecs[rng.integers(0, n_f, b_f)] + 0.05 * rng.standard_normal(
+        (b_f, DIM), dtype=np.float32)
+    frow: dict = {"n": n_f, "batch": b_f, "selectivities": {}}
+    for sel in (0.01, 0.10, 0.50):
+        ids_sel = np.nonzero(rng.random(n_f) < sel)[0].astype(np.uint64)
+        allow = Bitmap(ids_sel, _sorted=True)
+        gather_path = len(allow) < idx_f.config.flat_search_cutoff
+        entry = {"allow_size": int(len(allow)),
+                 "path": "gather" if gather_path else "masked-scan"}
+        if not gather_path:
+            # host pack cost: cold (scatter table + packbits + upload) vs
+            # cached (repeated queries with the same filter)
+            t0 = time.perf_counter()
+            idx_f._allow_words(allow)
+            entry["pack_cold_ms"] = round((time.perf_counter() - t0) * 1000, 2)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                idx_f._allow_words(allow)
+            entry["pack_cached_ms"] = round(
+                (time.perf_counter() - t0) / 5 * 1000, 3)
+        idx_f.search_by_vectors(fq, K, allow_list=allow)  # warm/compile
+        t0 = time.perf_counter()
+        reps = 2
+        for _ in range(reps):
+            ids_out, _d = idx_f.search_by_vectors(fq, K, allow_list=allow)
+        q_ms = (time.perf_counter() - t0) / reps * 1000
+        entry["query_ms"] = round(q_ms, 1)
+        entry["qps"] = round(b_f / (q_ms / 1000), 1)
+        # the gather path only computes distances over the allowed rows —
+        # charge it allow_size flops/bytes, not full-N
+        n_scanned = len(allow) if gather_path else n_f
+        entry["roofline"] = _roofline(
+            entry["qps"], n_scanned, DIM, b_f, DIM * 4, backend)
+        if "pack_cold_ms" in entry:
+            entry["pack_pct_of_query"] = round(
+                100 * entry["pack_cached_ms"] / q_ms, 2)
+        # recall vs exact GT over the allowed subset (64 queries)
+        gt_f = exact_gt(fvecs[ids_sel.astype(np.int64)], fq[:64], K)
+        sentinel = np.iinfo(np.uint64).max
+        hits = sum(
+            len(set(int(x) for x in ids_out[i][:K] if x != sentinel)
+                & set(ids_sel[gt_f[i]].tolist()))
+            for i in range(64))
+        entry["recall@10"] = round(hits / (64 * K), 4)
+        frow["selectivities"][f"{int(sel*100)}pct"] = entry
+        log(f"  {sel:.0%}: {entry}")
+    return frow
+
+
+def _bm25_row(n_docs: int) -> dict:
+    """BM25F keyword QPS at serving steady state: host MaxScore engine,
+    then the SAME shard with the device dense-row engine engaged
+    (inverted/bm25_device.py) — the keyword half of hybrid on the chip.
+    Per-query relay round trips are in the measurement on purpose: that is
+    the serving cost a hybrid query actually pays."""
+    import random
+    import shutil
+    import tempfile as _tf
+    import uuid as _uuidlib
+
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.inverted.bm25_device import DeviceBM25
+    from weaviate_tpu.server import App
+    from weaviate_tpu.usecases.traverser import GetParams
+
+    words = [f"w{i}" for i in range(5000)]
+    prng = random.Random(0)
+    row: dict = {"n_docs": n_docs}
+    bdir = _tf.mkdtemp(prefix="benchbm25")
+    try:
+        app = App(data_path=bdir)
+        app.schema.add_class({
+            "class": "Kw", "vectorIndexType": "noop",
+            "properties": [{"name": "body", "dataType": ["text"]}]})
+        kidx = app.db.get_index("Kw")
+        for s in range(0, n_docs, 10_000):
+            kidx.put_batch([
+                StorObj(class_name="Kw", uuid=str(_uuidlib.UUID(int=i + 1)),
+                        properties={"body": " ".join(prng.choices(words, k=40))})
+                for i in range(s, min(s + 10_000, n_docs))])
+        # serving steady state, like the gRPC row: memtables flushed,
+        # postings compacted to single segments
+        shard = next(iter(kidx.shards.values()))
+        shard.inverted.store.flush_memtables()
+        shard.inverted.store.compact_once(1)
+        tr = app.traverser
+
+        # Zipf-distributed query terms: the hot-term postings LRU + WAND
+        # pruning workload real text produces
+        ranks = np.arange(1, len(words) + 1)
+        zp = (1.0 / ranks) / (1.0 / ranks).sum()
+        zrng = np.random.default_rng(1)
+        warr = np.array(words)
+        qsets = {f"{nt}term": [" ".join(prng.choices(words, k=nt))
+                               for _ in range(64)] for nt in (2, 8)}
+        qsets["8term_zipf"] = [" ".join(warr[zrng.choice(len(words), 8, p=zp)])
+                               for _ in range(96)]
+
+        def sweep(tag: str) -> None:
+            for label, qs in qsets.items():
+                tr.get_class(GetParams(class_name="Kw",
+                                       keyword_ranking={"query": qs[0]},
+                                       limit=10))
+                t0 = time.perf_counter()
+                for qtext in qs:
+                    tr.get_class(GetParams(
+                        class_name="Kw", keyword_ranking={"query": qtext},
+                        limit=10))
+                row[f"qps_{label}{tag}"] = round(
+                    len(qs) / (time.perf_counter() - t0), 1)
+
+        sweep("")
+        shard.bm25_device = DeviceBM25(shard.bm25)
+        sweep("_device")
+        shard.bm25_device = None
+        app.shutdown()
+    finally:
+        shutil.rmtree(bdir, ignore_errors=True)
+    return row
 
 
 def _grpc_e2e(rng, n=50_000):
@@ -631,59 +780,11 @@ def run_cpu_matrix(rng):
 
     # -- row 4: filtered-search scaling at n=1M (VERDICT r3 item 6) -------
     n_f = int(os.environ.get("BENCH_CPU_FILTER_N", 1_000_000))
-    b_f = 256
     log(f"cpu matrix: filtered scaling (n={n_f}, 1%/10%/50% allowLists)...")
-    from weaviate_tpu.storage.bitmap import Bitmap
-
     fvecs = make_data(n_f, DIM, rng)
-    fq = fvecs[rng.integers(0, n_f, b_f)] + 0.05 * rng.standard_normal(
-        (b_f, DIM), dtype=np.float32)
     idx_f, _ = _build_index(fvecs)
     frow = dict(common)
-    frow.update({"n": n_f, "batch": b_f, "selectivities": {}})
-    for sel in (0.01, 0.10, 0.50):
-        ids_sel = np.nonzero(rng.random(n_f) < sel)[0].astype(np.uint64)
-        allow = Bitmap(ids_sel, _sorted=True)
-        gather_path = len(allow) < idx_f.config.flat_search_cutoff
-        entry = {"allow_size": int(len(allow)),
-                 "path": "gather" if gather_path else "masked-scan"}
-        if not gather_path:
-            # host pack cost: cold (scatter table + packbits + upload) vs
-            # cached (repeated queries with the same filter)
-            t0 = time.perf_counter()
-            idx_f._allow_words(allow)
-            entry["pack_cold_ms"] = round((time.perf_counter() - t0) * 1000, 2)
-            t0 = time.perf_counter()
-            for _ in range(5):
-                idx_f._allow_words(allow)
-            entry["pack_cached_ms"] = round(
-                (time.perf_counter() - t0) / 5 * 1000, 3)
-        idx_f.search_by_vectors(fq, K, allow_list=allow)  # warm/compile
-        t0 = time.perf_counter()
-        reps = 2
-        for _ in range(reps):
-            ids_out, _d = idx_f.search_by_vectors(fq, K, allow_list=allow)
-        q_ms = (time.perf_counter() - t0) / reps * 1000
-        entry["query_ms"] = round(q_ms, 1)
-        entry["qps"] = round(b_f / (q_ms / 1000), 1)
-        # the gather path only computes distances over the allowed rows —
-        # charge it allow_size flops/bytes, not full-N
-        n_scanned = len(allow) if gather_path else n_f
-        entry["roofline"] = _roofline(
-            entry["qps"], n_scanned, DIM, b_f, DIM * 4, "cpu")
-        if "pack_cold_ms" in entry:
-            entry["pack_pct_of_query"] = round(
-                100 * entry["pack_cached_ms"] / q_ms, 2)
-        # recall vs exact GT over the allowed subset (64 queries)
-        gt_f = exact_gt(fvecs[ids_sel.astype(np.int64)], fq[:64], K)
-        sentinel = np.iinfo(np.uint64).max
-        hits = sum(
-            len(set(int(x) for x in ids_out[i][:K] if x != sentinel)
-                & set(ids_sel[gt_f[i]].tolist()))
-            for i in range(64))
-        entry["recall@10"] = round(hits / (64 * K), 4)
-        frow["selectivities"][f"{int(sel*100)}pct"] = entry
-        log(f"  {sel:.0%}: {entry}")
+    frow.update(_filtered_scaling_row(rng, idx_f, fvecs, "cpu"))
     idx_f.drop()
     del idx_f, fvecs
     frow["provenance"] = (
@@ -694,73 +795,20 @@ def run_cpu_matrix(rng):
     rows["filtered_scaling_cpu"] = frow
     _merge_matrix(rows)
 
-    # -- row 5: BM25 keyword search (host path, vectorized scoring) -------
-    log("cpu matrix: BM25 (n=50k docs, 40 terms/doc)...")
-    import random
-    import tempfile as _tf
-    import uuid as _uuidlib
-
-    from weaviate_tpu.entities.storobj import StorObj
-    from weaviate_tpu.server import App
-    from weaviate_tpu.usecases.traverser import GetParams
-
-    words = [f"w{i}" for i in range(5000)]
-    prng = random.Random(0)
+    # -- row 5: BM25 keyword search (host MaxScore + device dense rows) ---
     n_b = int(os.environ.get("BENCH_BM25_N", 500_000))
-    bdir = _tf.mkdtemp(prefix="benchbm25")
+    log(f"cpu matrix: BM25 (n={n_b} docs, 40 terms/doc)...")
     brow = dict(common)
-    brow["n_docs"] = n_b
-    try:
-        app = App(data_path=bdir)
-        app.schema.add_class({
-            "class": "Kw", "vectorIndexType": "noop",
-            "properties": [{"name": "body", "dataType": ["text"]}]})
-        kidx = app.db.get_index("Kw")
-        for s in range(0, n_b, 10_000):
-            kidx.put_batch([
-                StorObj(class_name="Kw", uuid=str(_uuidlib.UUID(int=i + 1)),
-                        properties={"body": " ".join(prng.choices(words, k=40))})
-                for i in range(s, s + 10_000)])
-        # serving steady state, like the gRPC row: memtables flushed,
-        # postings compacted to single segments
-        shard = next(iter(kidx.shards.values()))
-        shard.inverted.store.flush_memtables()
-        shard.inverted.store.compact_once(1)
-        tr = app.traverser
-        for nterms in (2, 8):
-            qs = [" ".join(prng.choices(words, k=nterms)) for _ in range(64)]
-            tr.get_class(GetParams(class_name="Kw",
-                                   keyword_ranking={"query": qs[0]}, limit=10))
-            t0 = time.perf_counter()
-            for qtext in qs:
-                tr.get_class(GetParams(
-                    class_name="Kw", keyword_ranking={"query": qtext}, limit=10))
-            brow[f"qps_{nterms}term"] = round(
-                len(qs) / (time.perf_counter() - t0), 1)
-        # Zipf-distributed query terms: the hot-term postings LRU + WAND
-        # pruning workload real text produces
-        ranks = np.arange(1, len(words) + 1)
-        zp = (1.0 / ranks) / (1.0 / ranks).sum()
-        zrng = np.random.default_rng(1)
-        warr = np.array(words)
-        zqs = [" ".join(warr[zrng.choice(len(words), 8, p=zp)])
-               for _ in range(96)]
-        t0 = time.perf_counter()
-        for qtext in zqs:
-            tr.get_class(GetParams(
-                class_name="Kw", keyword_ranking={"query": qtext}, limit=10))
-        brow["qps_8term_zipf"] = round(len(zqs) / (time.perf_counter() - t0), 1)
-        app.shutdown()
-    finally:
-        import shutil
-
-        shutil.rmtree(bdir, ignore_errors=True)
+    brow.update(_bm25_row(n_b))
     brow["provenance"] = (
         "BM25F keyword search at serving steady state: MaxScore/WAND-pruned "
         "vectorized term-at-a-time scoring over fixed-stride postings "
         "decode, big-endian pre-sorted subkeys, generation-cached "
         "length/posting tables (round 5 — 13x the round-4 engine at 8 "
-        "terms/500k docs; round 4 itself was 66x the round-3 Python loop)")
+        "terms/500k docs; round 4 itself was 66x the round-3 Python loop). "
+        "*_device rows: the dense-row device engine "
+        "(inverted/bm25_device.py) on the same shard — per-query device "
+        "round trips included, rows cached per write generation")
     rows["bm25_cpu"] = brow
     _merge_matrix(rows)
 

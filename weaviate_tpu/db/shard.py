@@ -156,6 +156,7 @@ class Shard:
         )
         self.bm25 = BM25Searcher(self.inverted, class_def, invert_cfg,
                                  gen_fn=self._locked_gen)
+        self.bm25_device = self._maybe_device_bm25()
         # background per-bucket pair compaction (segment_group_compaction.go)
         self.store.start_compaction_cycle()
         self.status = STATUS_READY
@@ -196,6 +197,17 @@ class Shard:
             self.searcher = FilterSearcher(self.inverted, class_def, geo_search=self._geo_search)
             self.bm25 = BM25Searcher(self.inverted, class_def, self.invert_cfg,
                                      gen_fn=self._locked_gen)
+            self.bm25_device = self._maybe_device_bm25()
+
+    def _maybe_device_bm25(self):
+        """Device BM25 engine when opted in (invertedIndexConfig.bm25.device
+        or WEAVIATE_TPU_BM25_DEVICE=1); None keeps the host MaxScore path."""
+        bm = (self.invert_cfg or {}).get("bm25") or {}
+        if not (bm.get("device") or os.environ.get("WEAVIATE_TPU_BM25_DEVICE")):
+            return None
+        from weaviate_tpu.inverted.bm25_device import DeviceBM25
+
+        return DeviceBM25(self.bm25)
 
     def update_vector_config(self, cfg) -> None:
         self.vector_index.update_user_config(cfg)
@@ -672,7 +684,8 @@ class Shard:
         """BM25 / filter-only / list search (search.go objectSearch)."""
         if keyword_ranking:
             allow = self.build_allow_list(flt)
-            hits = self.bm25.search(
+            engine = self.bm25_device if self.bm25_device is not None else self.bm25
+            hits = engine.search(
                 keyword_ranking.get("query", ""),
                 limit + offset,
                 properties=keyword_ranking.get("properties") or None,

@@ -64,10 +64,10 @@ class _Unit:
     table of its property, scored lazily (fully or at given positions)."""
 
     __slots__ = ("ids", "tf", "idf", "weight", "len_docs", "len_vals",
-                 "avg_len", "ub", "term", "k1", "b", "dense")
+                 "avg_len", "ub", "term", "k1", "b", "dense", "prop")
 
     def __init__(self, ids, tf, idf, weight, len_docs, len_vals, avg_len,
-                 k1, b, term):
+                 k1, b, term, prop=""):
         self.ids = ids
         self.tf = tf
         self.idf = idf
@@ -78,6 +78,7 @@ class _Unit:
         self.k1 = k1
         self.b = b
         self.term = term
+        self.prop = prop
         # doc ids 0..n-1 with no gaps (the common append-only shard): length
         # lookup is a direct index, no binary search
         self.dense = bool(len_docs.size) and len_docs[0] == 0 and \
@@ -288,7 +289,8 @@ class BM25Searcher:
                 df = ids.size
                 idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
                 units.append(_Unit(ids, tf, idf, weight, len_docs, len_vals,
-                                   avg_len, self.k1, self.b, term))
+                                   avg_len, self.k1, self.b, term,
+                                   prop=prop_name))
         return units
 
     @staticmethod

@@ -1,0 +1,195 @@
+"""Device (TPU) BM25 engine: dense impact rows + one top_k per query.
+
+The keyword half of hybrid search, on the same chip as the vector half.
+Reference behavior: adapters/repos/db/inverted/bm25_searcher.go:77 (BM25F
+over map buckets); this engine produces the same ranking as the host
+MaxScore engine (inverted/bm25.py) and falls back to it wherever the
+host path is strictly better:
+
+- additional_explanations (per-term breakdown needs posting positions),
+- empty/unknown terms only, or a corpus too small to be worth a device
+  round trip (DEVICE_MIN_POSTINGS),
+- backend init failure (no usable jax device).
+
+Dense rows are cached on device per (property, term) under the shard
+write generation — the same invalidation discipline as the host engine's
+posting/length caches (bm25.py), including the mid-write guard: the
+writer bumps the generation BEFORE mutating, so a row built mid-write is
+never pinned under the new generation. allowLists ride along as a dense
+bool mask, cached per (filter key, generation) like the vector side's
+scatter-packed masks (index/tpu.py).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.index.interface import AllowList
+from weaviate_tpu.inverted.bm25 import BM25Searcher
+
+# below this many total postings the host engine wins: one relay round
+# trip costs more than scoring a handful of arrays in numpy
+DEVICE_MIN_POSTINGS = 0  # tuned by bench; 0 = always device when eligible
+
+# device bytes pinned for dense rows (a row is n_pad * 4 bytes; at 1M docs
+# each cached term costs ~4 MB)
+_ROW_CACHE_MAX_BYTES = 256 * 1024 * 1024
+
+
+class DeviceBM25:
+    """Wraps a host BM25Searcher; owns the device row/mask caches."""
+
+    def __init__(self, searcher: BM25Searcher, gen_fn=None):
+        self.searcher = searcher
+        self._gen_fn = gen_fn if gen_fn is not None else searcher._gen_fn
+        # (prop, term) -> (gen, n_pad, device row [n_pad] f32)
+        self._rows: OrderedDict[tuple, tuple] = OrderedDict()
+        self._row_bytes = 0
+        # filter key -> (gen, n_pad, device bool mask [n_pad])
+        # id(bitmap) -> (gen, n_pad, device mask, pinned bitmap)
+        self._masks: dict[int, tuple] = {}
+        self._jax = None  # lazy import: module import must not init backend
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _backend(self):
+        if self._jax is None:
+            import os  # noqa: PLC0415
+
+            import jax  # noqa: PLC0415
+
+            from weaviate_tpu.ops import bm25_scan  # noqa: PLC0415
+
+            # honor JAX_PLATFORMS even when a site hook imported jax before
+            # this process's env was consulted (same 12-factor contract as
+            # __main__.py) — without this, a host pinned to an unreachable
+            # accelerator hangs HERE on first keyword query instead of
+            # serving on the backend the env asked for
+            if os.environ.get("JAX_PLATFORMS"):
+                jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+            jax.devices()  # raises if no backend comes up
+            self._jax = (jax, bm25_scan)
+        return self._jax
+
+    def _gen(self):
+        return self._gen_fn() if self._gen_fn is not None else None
+
+    def _evict_dead(self, gen) -> None:
+        """Drop rows/masks from older generations before building new ones
+        (the old generation's device memory must be reclaimable NOW — a
+        reindex sweep would otherwise double the footprint)."""
+        dead = [k for k, v in self._rows.items() if v[0] != gen]
+        for k in dead:
+            _, _, row = self._rows.pop(k)
+            self._row_bytes -= row.nbytes
+        self._masks = {k: v for k, v in self._masks.items() if v[0] == gen}
+
+    # -- dense row cache -----------------------------------------------------
+
+    def _dense_row(self, unit, n_pad: int, gen):
+        """Fully-scaled dense impact row for one scoring unit, built on
+        device and cached under the write generation."""
+        jax, bm25_scan = self._backend()
+        import jax.numpy as jnp  # noqa: PLC0415
+
+        key = (unit.prop, unit.term, unit.weight)
+        hit = self._rows.get(key)
+        if hit is not None and hit[0] == gen and hit[1] == n_pad:
+            self._rows.move_to_end(key)
+            return hit[2]
+        # full per-posting scores, host side (f64 math, one pass) — the
+        # scatter into doc-id space is the device's job
+        scores = unit._score(unit.ids, unit.tf).astype(np.float32)
+        ids = unit.ids.astype(np.int64)
+        ids = np.where(ids < n_pad, ids, n_pad).astype(np.int32)
+        ids, scores = bm25_scan.pad_postings(ids, scores, n_pad)
+        zeros = jnp.zeros((n_pad + 1,), jnp.float32)
+        row = bm25_scan.build_dense_row(
+            jnp.asarray(ids), jnp.asarray(scores), zeros)
+        if gen is not None and self._gen() == gen:
+            old = self._rows.pop(key, None)
+            if old is not None:
+                self._row_bytes -= old[2].nbytes
+            self._rows[key] = (gen, n_pad, row)
+            self._row_bytes += row.nbytes
+            while self._row_bytes > _ROW_CACHE_MAX_BYTES and len(self._rows) > 1:
+                _, (_, _, e) = self._rows.popitem(last=False)
+                self._row_bytes -= e.nbytes
+        return row
+
+    def _allow_mask(self, allow_list: AllowList, n_pad: int, gen):
+        jax, _ = self._backend()
+        import jax.numpy as jnp  # noqa: PLC0415
+
+        # keyed by the Bitmap's identity, with the Bitmap itself PINNED in
+        # the entry: without the strong ref, an evicted/uncached filter's
+        # Bitmap could be freed and a different filter's Bitmap could
+        # recycle the same address within one generation — the hit check
+        # compares the stored object so a recycled id can never alias
+        key = id(allow_list)
+        hit = self._masks.get(key)
+        if hit is not None and hit[0] == gen and hit[1] == n_pad \
+                and hit[3] is allow_list:
+            return hit[2]
+        host = np.zeros((n_pad,), dtype=bool)
+        ids = allow_list.to_array().astype(np.int64)
+        host[ids[ids < n_pad]] = True
+        mask = jnp.asarray(host)
+        if gen is not None and self._gen() == gen:
+            if len(self._masks) >= 16:
+                self._masks.pop(next(iter(self._masks)))
+            self._masks[key] = (gen, n_pad, mask, allow_list)
+        return mask
+
+    # -- search --------------------------------------------------------------
+
+    def search(
+        self,
+        query: str,
+        limit: int,
+        properties: Optional[Sequence[str]] = None,
+        allow_list: Optional[AllowList] = None,
+        additional_explanations: bool = False,
+    ) -> list[tuple[int, float, Optional[dict]]]:
+        """Same contract as BM25Searcher.search. Explanations and device
+        init failures fall back to the host engine."""
+        if additional_explanations or limit <= 0:
+            return self.searcher.search(
+                query, limit, properties=properties, allow_list=allow_list,
+                additional_explanations=additional_explanations)
+        s = self.searcher
+        props = s._searchable_props(properties)
+        n_docs = max(s._doc_count(), 1)
+        gen = self._gen()
+        units = s._build_units(query, props, n_docs)
+        if not units:
+            return []
+        total_postings = sum(u.ids.size for u in units)
+        if total_postings < DEVICE_MIN_POSTINGS:
+            return s.search(query, limit, properties=properties,
+                            allow_list=allow_list)
+        try:
+            jax, bm25_scan = self._backend()
+            import jax.numpy as jnp  # noqa: PLC0415
+        except Exception:
+            return s.search(query, limit, properties=properties,
+                            allow_list=allow_list)
+
+        max_id = max(int(u.ids[-1]) for u in units)  # ids are doc-sorted
+        n_pad = bm25_scan.n_bucket(max_id)
+        self._evict_dead(gen)
+        total = self._dense_row(units[0], n_pad, gen)
+        for u in units[1:]:
+            total = bm25_scan.add_rows(total, self._dense_row(u, n_pad, gen))
+        mask = self._allow_mask(allow_list, n_pad, gen) \
+            if allow_list is not None else None
+        k = min(bm25_scan.k_bucket(limit), n_pad)
+        scores, ids = bm25_scan.dense_topk(total, k, mask)
+        scores = np.asarray(scores)[:limit]
+        ids = np.asarray(ids)[:limit]
+        keep = ids >= 0
+        return [(int(d), float(v), None)
+                for d, v in zip(ids[keep], scores[keep])]
