@@ -589,6 +589,18 @@ def _bm25_row(n_docs: int) -> dict:
         sweep("")
         shard.bm25_device = DeviceBM25(shard.bm25)
         sweep("_device")
+        # batched lane: the whole query set as ONE get_class_batched call —
+        # one device matmul + one fetch (the gRPC BatchSearch shape)
+        for label, qs in qsets.items():
+            plist = [GetParams(class_name="Kw",
+                               keyword_ranking={"query": qtext}, limit=10)
+                     for qtext in qs]
+            tr.get_class_batched(plist)  # warm at the REAL (q_pad, u_pad)
+            t0 = time.perf_counter()
+            res = tr.get_class_batched(plist)
+            row[f"qps_{label}_device_batch"] = round(
+                len(qs) / (time.perf_counter() - t0), 1)
+            assert not any(isinstance(r, Exception) for r in res)
         shard.bm25_device = None
         app.shutdown()
     finally:

@@ -153,6 +153,129 @@ def test_recycled_bitmap_id_never_aliases_mask(tmp_path):
         "stale mask from a recycled id must not leak into results"
 
 
+def test_search_batch_matches_per_query(tmp_path):
+    """One matmul for Q queries == Q single searches (f32 tolerance),
+    including empty-term and no-hit queries in the same batch."""
+    rng = np.random.default_rng(17)
+    vocab = np.array([f"w{i}" for i in range(100)])
+    inv = _build(tmp_path, _corpus(rng, 300, vocab), "batch")
+    host = BM25Searcher(inv, CLASS_DEF)
+    dev = DeviceBM25(host)
+    prng = random.Random(3)
+    queries = [" ".join(prng.choices(list(vocab), k=prng.choice([1, 2, 4, 8])))
+               for _ in range(40)]
+    queries[7] = "zzz-not-in-vocab"      # no units at all
+    queries[23] = ""                      # empty query
+    batched = dev.search_batch(queries, 10)
+    assert batched is not None and len(batched) == len(queries)
+    assert batched[7] == [] and batched[23] == []
+    for q, got in zip(queries, batched):
+        want = dev.search(q, 10)
+        assert len(got) == len(want)
+        for (g_id, g_s, _), (w_id, w_s, _) in zip(got, want):
+            assert g_s == pytest.approx(w_s, rel=1e-5, abs=1e-5)
+        truth = _score_map(host, q, None)
+        for g_id, g_s, _ in got:
+            assert truth[g_id] == pytest.approx(g_s, rel=1e-5, abs=1e-5)
+
+
+def test_duplicate_and_nonpositive_boosts(tmp_path):
+    """properties=["body","body"] double-counts in EVERY path (selection
+    matrix accumulates); non-positive boosts fall back to the host engine
+    (the score>0 empty-slot sentinel cannot represent them)."""
+    rng = np.random.default_rng(33)
+    vocab = np.array([f"w{i}" for i in range(40)])
+    inv = _build(tmp_path, _corpus(rng, 120, vocab), "boosts")
+    host = BM25Searcher(inv, CLASS_DEF)
+    dev = DeviceBM25(host)
+    q = " ".join(vocab[:3])
+
+    dup = ["body", "body"]
+    h = host.search(q, 8, properties=dup)
+    d = dev.search(q, 8, properties=dup)
+    b = dev.search_batch([q], 8, properties=dup)[0]
+    assert [x[1] for x in d] == pytest.approx([x[1] for x in h], rel=1e-5)
+    assert [x[1] for x in b] == pytest.approx([x[1] for x in h], rel=1e-5)
+
+    neg = ["body^-1"]
+    h_neg = host.search(q, 8, properties=neg)
+    d_neg = dev.search(q, 8, properties=neg)
+    assert len(d_neg) == len(h_neg) > 0, \
+        "negative boosts must serve (host fallback), not return empty"
+    assert [x[1] for x in d_neg] == pytest.approx(
+        [x[1] for x in h_neg], rel=1e-5)
+    assert dev.search_batch([q], 8, properties=neg) is None, \
+        "batch lane must decline non-positive boosts"
+
+
+def test_search_batch_slices_under_stack_budget(tmp_path, monkeypatch):
+    """With a tiny transient-stack budget the batch must split into
+    multiple matmul slices and still produce identical results."""
+    from weaviate_tpu.inverted import bm25_device as mod
+
+    rng = np.random.default_rng(29)
+    vocab = np.array([f"w{i}" for i in range(60)])
+    inv = _build(tmp_path, _corpus(rng, 150, vocab), "slice")
+    host = BM25Searcher(inv, CLASS_DEF)
+    dev = DeviceBM25(host)
+    prng = random.Random(11)
+    queries = [" ".join(prng.choices(list(vocab), k=4)) for _ in range(20)]
+    full = dev.search_batch(queries, 10)
+    # budget of ~2 rows at this n_pad: every query pair forces a new slice
+    monkeypatch.setattr(mod, "_BATCH_STACK_MAX_BYTES", 16384 * 4 * 2)
+    dev2 = DeviceBM25(BM25Searcher(inv, CLASS_DEF))
+    sliced = dev2.search_batch(queries, 10)
+    assert len(sliced) == len(full)
+    for a, b in zip(sliced, full):
+        assert [d for d, _, _ in a] == [d for d, _, _ in b]
+        assert [v for _, v, _ in a] == pytest.approx(
+            [v for _, v, _ in b], rel=1e-6)  # matmul padding reorders f32 adds
+
+
+def test_get_class_batched_kw_lane(tmp_path):
+    """Explorer groups plain bm25 slots into the batched lane; filtered/
+    explained slots take the per-query path; results match the host shard."""
+    from weaviate_tpu.db.shard import Shard
+    from weaviate_tpu.server import App
+    from weaviate_tpu.usecases.traverser import GetParams
+
+    app = App(data_path=str(tmp_path / "kwapp"))
+    app.schema.add_class({
+        "class": "Kw", "vectorIndexType": "noop",
+        "invertedIndexConfig": {"bm25": {"device": True}},
+        "properties": [{"name": "t", "dataType": ["text"]}]})
+    kidx = app.db.get_index("Kw")
+    vocab = [f"w{i}" for i in range(30)]
+    from weaviate_tpu.entities.storobj import StorObj
+    kidx.put_batch([
+        StorObj(class_name="Kw", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"t": " ".join(
+                    np.random.default_rng(i).choice(vocab, size=10))})
+        for i in range(200)])
+    try:
+        qs = [" ".join(vocab[i:i + 3]) for i in range(12)]
+        plist = [GetParams(class_name="Kw",
+                           keyword_ranking={"query": q}, limit=5)
+                 for q in qs]
+        # one slot with a filter: must take the per-query path, not break
+        from weaviate_tpu.entities.filters import LocalFilter
+        plist.append(GetParams(
+            class_name="Kw", keyword_ranking={"query": qs[0]}, limit=5,
+            filters=LocalFilter.from_dict({
+                "path": ["t"], "operator": "Like", "valueText": "w1*"})))
+        batched = app.traverser.get_class_batched(plist)
+        assert not any(isinstance(r, Exception) for r in batched), batched
+        shard = next(iter(kidx.shards.values()))
+        assert shard.bm25_device is not None
+        for p, got in zip(plist, batched):
+            solo = app.traverser.get_class(p)
+            assert [r.obj.uuid for r in got] == [r.obj.uuid for r in solo]
+            assert [r.score for r in got] == pytest.approx(
+                [r.score for r in solo], rel=1e-5)
+    finally:
+        app.shutdown()
+
+
 def test_explanations_fall_back_to_host(tmp_path):
     rng = np.random.default_rng(5)
     vocab = np.array([f"w{i}" for i in range(30)])

@@ -94,7 +94,12 @@ from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.tpu import TpuVectorIndex
 import tempfile, time
 rng = np.random.default_rng(0)
-n, d = 200_000, 128
+# 50k, not 200k: both relay wedges this round followed OUR watchdog
+# killing a process mid-device-op (01:20 profiler SIGTERM; 03:30 this
+# step's 600s kill during what was likely a slow fit, not a hang). The
+# canary must be light enough that 600s is generous — proving the Mosaic
+# compile + serve is the point, steady-state scale is bench's job.
+n, d = 50_000, 128
 vecs = rng.standard_normal((n, d)).astype(np.float32)
 cfg = vi.HnswUserConfig.from_dict({"distance": "l2-squared",
     "pq": {"enabled": True, "segments": 32, "centroids": 256,
@@ -106,6 +111,10 @@ ids, dist = idx.search_by_vectors(vecs[:256], 10)
 assert idx._pqg_state._gmin_validated, "pq codes kernel did not serve"
 print(f"pq codes kernel served 256 queries in {time.perf_counter()-t0:.1f}s")
 """
+# NOTE on step timeouts: a kill mid-device-op is itself a suspected wedge
+# trigger. Timeouts exist so a truly dead relay cannot hold the session
+# hostage, but they are sized GENEROUSLY; never tighten one to "speed up"
+# a session, and never add steps between bench and the capture it feeds.
 
 
 def main() -> int:
@@ -141,6 +150,20 @@ def main() -> int:
             f"BENCH_MATRIX=1 {sys.executable} bench.py", shell=True,
             cwd=REPO, timeout=7200)
         log(f"bench matrix rc={rc}")
+    if rc == 0 and not CPU_MODE and not os.environ.get("CHIP_SKIP_PROFILE"):
+        # stage breakdown at the headline shape with the block rescore —
+        # records WHERE serving time goes on real hardware (in-jit amortized,
+        # so relay latency cannot fake it)
+        log("running profile_gmin3 (stage breakdown)...")
+        try:
+            prc = subprocess.call(
+                f"{sys.executable} tools/profile_gmin3.py 1048576 16384 4 "
+                ">> chip_profile.log 2>&1",
+                shell=True, cwd=REPO, timeout=1800)
+            log(f"profile rc={prc}")
+        except subprocess.TimeoutExpired:
+            log("profile HUNG — leaving relay alone")
+            return 5
     if rc == 0 and not os.environ.get("CHIP_SKIP_PQ"):
         step("pq-canary", PQ_CANARY, 600)  # wedge here loses nothing
     log("=== chip session done ===")

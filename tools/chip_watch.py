@@ -41,16 +41,61 @@ def probe() -> bool:
 
 
 def main() -> int:
+    # single-instance guard: two watchers would race their chip sessions
+    # onto the one device the moment the relay recovers
+    pidfile = os.path.join(REPO, "chip_watch.pid")
+    if os.path.exists(pidfile):
+        try:
+            other = int(open(pidfile).read().strip())
+        except ValueError:
+            other = None  # unreadable: take over
+        if other is not None:
+            try:
+                os.kill(other, 0)
+                alive = True
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                alive = True  # pid exists under another uid: still live
+            if alive:
+                log(f"another watcher (pid {other}) is live — exiting")
+                return 2
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        return _watch_loop()
+    finally:
+        # always clear the pidfile on exit so a recycled pid can never
+        # block a future watcher from launching
+        try:
+            if open(pidfile).read().strip() == str(os.getpid()):
+                os.remove(pidfile)
+        except OSError:
+            pass
+
+
+def _watch_loop() -> int:
     deadline = time.time() + MAX_HOURS * 3600
     attempt = 0
     while time.time() < deadline:
         attempt += 1
         if probe():
             log(f"probe #{attempt}: ALIVE — launching chip session")
-            with open(os.path.join(REPO, "chip_watch_session.log"), "a") as out:
-                rc = subprocess.call(
-                    [sys.executable, "tools/chip_session.py"], cwd=REPO,
-                    stdout=out, stderr=subprocess.STDOUT, timeout=4 * 3600)
+            # cap must exceed the session's own worst-case step timeouts
+            # (~4h with CHIP_ESCALATE): a watcher kill mid-device-op is
+            # itself a suspected wedge trigger, so this is a last resort,
+            # caught so the watcher reports instead of crashing
+            try:
+                with open(os.path.join(REPO, "chip_watch_session.log"),
+                          "a") as out:
+                    rc = subprocess.call(
+                        [sys.executable, "tools/chip_session.py"], cwd=REPO,
+                        stdout=out, stderr=subprocess.STDOUT,
+                        timeout=6 * 3600)
+            except subprocess.TimeoutExpired:
+                log("chip session exceeded 6h backstop — killed; see "
+                    "chip_watch_session.log")
+                return 4
             log(f"chip session rc={rc}")
             return rc
         log(f"probe #{attempt}: wedged; sleeping {PROBE_EVERY}s")

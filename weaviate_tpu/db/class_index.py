@@ -278,6 +278,21 @@ class ClassIndex:
             all_results = [f.result() for f in futs]
         return _merge_shard_results(all_results, b, k)
 
+    def keyword_search_batch(
+        self, queries: list[str], limit: int, offset: int = 0,
+        properties=None, include_vector: bool = False,
+    ):
+        """Batched plain-BM25 lane (device dense rows): engages only on a
+        single-local-shard layout — multi-shard scatter-gather would need a
+        per-shard batch + merge, which the per-query path already does.
+        None -> caller falls back to per-query searches."""
+        targets = self._all_shard_targets()
+        if len(targets) != 1 or targets[0][1] is None:
+            return None
+        return targets[0][1].keyword_search_batch(
+            queries, limit, offset=offset, properties=properties,
+            include_vector=include_vector)
+
     def object_vector_search_async(
         self, vectors: np.ndarray, k: int, include_vector: bool = False
     ):

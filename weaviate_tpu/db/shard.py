@@ -203,7 +203,9 @@ class Shard:
         """Device BM25 engine when opted in (invertedIndexConfig.bm25.device
         or WEAVIATE_TPU_BM25_DEVICE=1); None keeps the host MaxScore path."""
         bm = (self.invert_cfg or {}).get("bm25") or {}
-        if not (bm.get("device") or os.environ.get("WEAVIATE_TPU_BM25_DEVICE")):
+        env = os.environ.get("WEAVIATE_TPU_BM25_DEVICE", "").strip().lower()
+        env_on = env not in ("", "0", "false", "off", "no")
+        if not (bm.get("device") or env_on):
             return None
         from weaviate_tpu.inverted.bm25_device import DeviceBM25
 
@@ -729,6 +731,39 @@ class Shard:
         take = doc_ids[offset : offset + limit]
         objs = self.objects_by_doc_ids([int(i) for i in take], include_vector)
         return [SearchResult(obj=o, shard=self.name) for o in objs if o is not None]
+
+    def keyword_search_batch(
+        self,
+        queries: list[str],
+        limit: int,
+        offset: int = 0,
+        properties=None,
+        include_vector: bool = False,
+    ) -> Optional[list[list[SearchResult]]]:
+        """Batched plain-BM25 lane: Q queries -> one device dispatch + one
+        fetch (inverted/bm25_device.py search_batch). None when the device
+        engine is off/unavailable — callers run the per-query path.
+        Offset is applied to the RANKED hits before hydration — identical
+        paging to object_search's keyword branch, so a doc deleted between
+        scoring and hydration shortens the page rather than shifting it."""
+        if self.bm25_device is None:
+            return None
+        hit_lists = self.bm25_device.search_batch(queries, limit + offset,
+                                                  properties=properties)
+        if hit_lists is None:
+            return None
+        out: list[list[SearchResult]] = []
+        for hits in hit_lists:
+            hits = hits[offset:offset + limit]
+            objs = self.objects_by_doc_ids([h[0] for h in hits], include_vector)
+            rows = []
+            for (doc_id, score, _), obj in zip(hits, objs):
+                if obj is None:
+                    continue
+                rows.append(SearchResult(obj=obj, score=float(score),
+                                         shard=self.name))
+            out.append(rows)
+        return out
 
     def _list_after(self, doc_ids, after_uuid: str, limit: int, include_vector: bool):
         objs = self.objects_by_doc_ids([int(i) for i in doc_ids], include_vector)

@@ -38,6 +38,12 @@ DEVICE_MIN_POSTINGS = 0  # tuned by bench; 0 = always device when eligible
 # each cached term costs ~4 MB)
 _ROW_CACHE_MAX_BYTES = 256 * 1024 * 1024
 
+# transient device bytes one batched matmul may stack ([U_pad, n_pad] f32);
+# batches whose distinct-unit set would exceed this are processed in
+# slices (one dispatch + one fetch per slice) — bounds the working set so
+# a wide BatchSearch cannot starve concurrent vector queries of HBM
+_BATCH_STACK_MAX_BYTES = 256 * 1024 * 1024
+
 
 class DeviceBM25:
     """Wraps a host BM25Searcher; owns the device row/mask caches."""
@@ -51,6 +57,7 @@ class DeviceBM25:
         # filter key -> (gen, n_pad, device bool mask [n_pad])
         # id(bitmap) -> (gen, n_pad, device mask, pinned bitmap)
         self._masks: dict[int, tuple] = {}
+        self._npad_hwm: Optional[tuple] = None  # (gen, n_pad floor)
         self._jax = None  # lazy import: module import must not init backend
 
     # -- plumbing ------------------------------------------------------------
@@ -63,12 +70,19 @@ class DeviceBM25:
 
             from weaviate_tpu.ops import bm25_scan  # noqa: PLC0415
 
-            # honor JAX_PLATFORMS even when a site hook imported jax before
-            # this process's env was consulted (same 12-factor contract as
-            # __main__.py) — without this, a host pinned to an unreachable
-            # accelerator hangs HERE on first keyword query instead of
-            # serving on the backend the env asked for
-            if os.environ.get("JAX_PLATFORMS"):
+            # honor the CURRENT process env even when a site hook imported
+            # jax earlier and froze jax.config.jax_platforms to the env of
+            # that moment (same 12-factor contract as __main__.py) —
+            # without this, a host pinned to an unreachable accelerator
+            # hangs HERE on first keyword query instead of serving on the
+            # backend the env asks for. Env-wins is deliberate: config
+            # cannot distinguish "explicitly updated" from "snapshotted at
+            # import", so the live env var is the operator's intent; a
+            # script that pins the platform via jax.config.update must set
+            # JAX_PLATFORMS too (tests/conftest.py does exactly that).
+            live = getattr(getattr(jax._src, "xla_bridge", None),
+                           "_backends", None)  # don't fight a LIVE backend
+            if os.environ.get("JAX_PLATFORMS") and not live:
                 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
             jax.devices()  # raises if no backend comes up
             self._jax = (jax, bm25_scan)
@@ -76,6 +90,20 @@ class DeviceBM25:
 
     def _gen(self):
         return self._gen_fn() if self._gen_fn is not None else None
+
+    def _npad(self, max_id: int, gen) -> int:
+        """Dense-row length for this request: the bucket for max_id, but
+        never below the generation's high-water mark — without the
+        monotone floor, queries alternating between low-id and high-id
+        terms would invalidate each other's cached rows (n_pad is part of
+        the row-cache hit check) and re-scatter every time."""
+        from weaviate_tpu.ops import bm25_scan  # noqa: PLC0415
+
+        want = bm25_scan.n_bucket(max_id)
+        if self._npad_hwm is not None and self._npad_hwm[0] == gen:
+            want = max(want, self._npad_hwm[1])
+        self._npad_hwm = (gen, want)
+        return want
 
     def _evict_dead(self, gen) -> None:
         """Drop rows/masks from older generations before building new ones
@@ -162,6 +190,12 @@ class DeviceBM25:
                 additional_explanations=additional_explanations)
         s = self.searcher
         props = s._searchable_props(properties)
+        if any(w <= 0 for _, w in props):
+            # non-positive boosts ("prop^0", "prop^-1") break the
+            # score-0-means-empty sentinel the device packing relies on —
+            # the host engine ranks them correctly, so it serves them
+            return s.search(query, limit, properties=properties,
+                            allow_list=allow_list)
         n_docs = max(s._doc_count(), 1)
         gen = self._gen()
         units = s._build_units(query, props, n_docs)
@@ -179,7 +213,7 @@ class DeviceBM25:
                             allow_list=allow_list)
 
         max_id = max(int(u.ids[-1]) for u in units)  # ids are doc-sorted
-        n_pad = bm25_scan.n_bucket(max_id)
+        n_pad = self._npad(max_id, gen)
         self._evict_dead(gen)
         total = self._dense_row(units[0], n_pad, gen)
         for u in units[1:]:
@@ -187,9 +221,101 @@ class DeviceBM25:
         mask = self._allow_mask(allow_list, n_pad, gen) \
             if allow_list is not None else None
         k = min(bm25_scan.k_bucket(limit), n_pad)
-        scores, ids = bm25_scan.dense_topk(total, k, mask)
-        scores = np.asarray(scores)[:limit]
-        ids = np.asarray(ids)[:limit]
+        packed = bm25_scan.dense_topk(total, k, mask)
+        scores, ids = bm25_scan.unpack_topk(packed, k)  # ONE blocking fetch
+        scores = scores[:limit]
+        ids = ids[:limit]
         keep = ids >= 0
         return [(int(d), float(v), None)
                 for d, v in zip(ids[keep], scores[keep])]
+
+    def search_batch(
+        self,
+        queries: Sequence[str],
+        limit: int,
+        properties: Optional[Sequence[str]] = None,
+    ) -> Optional[list[list[tuple[int, float, None]]]]:
+        """Q plain keyword queries in ONE device dispatch + ONE fetch:
+        stack the distinct units' dense rows [U, n], build a [Q, U]
+        selection matrix host-side, and let batch_topk's matmul produce
+        every query's top-k. Returns None when the device path is
+        unavailable (callers fall back to per-query host scoring).
+        No allowList/explanations here — those park a query outside the
+        batch lane (usecases/traverser.py get_class_batched eligibility)."""
+        if limit <= 0:
+            return [[] for _ in queries]
+        try:
+            jax, bm25_scan = self._backend()
+            import jax.numpy as jnp  # noqa: PLC0415
+        except Exception:
+            return None
+        s = self.searcher
+        props = s._searchable_props(properties)
+        if any(w <= 0 for _, w in props):
+            return None  # non-positive boosts: host engine (see search())
+        n_docs = max(s._doc_count(), 1)
+        gen = self._gen()
+        per_query_units = [s._build_units(q, props, n_docs) for q in queries]
+        all_units = [u for units in per_query_units for u in units]
+        if not all_units:
+            return [[] for _ in queries]
+        max_id = max(int(u.ids[-1]) for u in all_units)
+        n_pad = self._npad(max_id, gen)
+        self._evict_dead(gen)
+        # greedy slicing under the transient-stack budget: each slice's
+        # DISTINCT units fit _BATCH_STACK_MAX_BYTES once stacked; a slice
+        # still amortizes its dispatch+fetch over many queries
+        max_units = max(int(_BATCH_STACK_MAX_BYTES // (n_pad * 4)),
+                        max(len(u) for u in per_query_units), 1)
+        out: list[list[tuple[int, float, None]]] = []
+        qi = 0
+        while qi < len(queries):
+            ukeys: dict[tuple, object] = {}
+            slice_units: list = []
+            j = qi
+            while j < len(queries):
+                units = per_query_units[j]
+                new = {(u.prop, u.term, u.weight): u for u in units
+                       if (u.prop, u.term, u.weight) not in ukeys}
+                if ukeys and len(ukeys) + len(new) > max_units:
+                    break
+                ukeys.update(new)
+                slice_units.append(units)
+                j += 1
+            out.extend(self._matmul_slice(
+                slice_units, ukeys, n_pad, gen, limit, jnp, bm25_scan))
+            qi = j
+        return out
+
+    def _matmul_slice(self, per_query_units, ukeys, n_pad, gen, limit,
+                      jnp, bm25_scan):
+        """One batch_topk dispatch + one fetch for a slice of queries whose
+        distinct units are already bounded by the caller."""
+        if not ukeys:
+            return [[] for _ in per_query_units]
+        rows = [self._dense_row(u, n_pad, gen) for u in ukeys.values()]
+        u_pad = bm25_scan.k_bucket(len(rows))
+        if u_pad > len(rows):
+            zero = jnp.zeros((n_pad,), jnp.float32)
+            rows.extend([zero] * (u_pad - len(rows)))
+        upos = {key: i for i, key in enumerate(ukeys)}
+        qc = bm25_scan._QCHUNK
+        q_pad = -(-len(per_query_units) // qc) * qc
+        sel = np.zeros((q_pad, u_pad), dtype=np.float32)
+        for qi, units in enumerate(per_query_units):
+            for u in units:
+                # += not =: a repeated property (["body", "body"]) yields
+                # duplicate units that the per-query paths score twice
+                sel[qi, upos[(u.prop, u.term, u.weight)]] += 1.0
+        k = min(bm25_scan.k_bucket(limit), n_pad)
+        packed = bm25_scan.batch_topk(jnp.stack(rows), jnp.asarray(sel), k)
+        scores_all, ids_all = bm25_scan.topk_ops.unpack_topk(
+            np.asarray(packed))  # ONE blocking fetch for the slice
+        out: list[list[tuple[int, float, None]]] = []
+        for qi in range(len(per_query_units)):
+            scores = scores_all[qi][:limit]
+            ids = ids_all[qi][:limit]
+            keep = ids >= 0
+            out.append([(int(d), float(v), None)
+                        for d, v in zip(ids[keep], scores[keep])])
+        return out

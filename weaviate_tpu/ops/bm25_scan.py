@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from weaviate_tpu.ops import topk as topk_ops
+
 Array = jax.Array
 
 # doc-capacity bucket: dense rows are padded to a multiple of this so the
@@ -94,13 +96,52 @@ def add_rows(acc: Array, row: Array) -> Array:
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def dense_topk(total: Array, k: int, allow_mask: Array | None = None
-               ) -> tuple[Array, Array]:
+               ) -> Array:
     """total [n] f32 summed scores (+ optional allow_mask [n] bool) ->
-    (scores [k], doc_ids [k] int32), score-descending; empty slots surface
-    as score 0 / id -1 (BM25 scores are strictly positive, so 0 is a safe
-    floor)."""
+    packed [2k] int32: bitcast f32 scores in [:k], doc ids in [k:], both
+    score-descending; empty slots surface as score 0 / id -1 (BM25 scores
+    are strictly positive, so 0 is a safe floor). Packed like
+    ops/topk.pack_topk: one device->host fetch instead of two — over the
+    axon relay each blocking fetch is a full round trip."""
     if allow_mask is not None:
         total = jnp.where(allow_mask, total, 0.0)
     scores, ids = jax.lax.top_k(total, k)
-    ids = jnp.where(scores > 0.0, ids, -1)
-    return scores, ids.astype(jnp.int32)
+    ids = jnp.where(scores > 0.0, ids, -1).astype(jnp.int32)
+    return topk_ops.pack_topk(scores[None, :], ids[None, :])[0]
+
+
+def unpack_topk(packed, k: int):
+    """Host-side twin of dense_topk's packing -> (scores f32 [k], ids
+    int32 [k]). Same [*, 2k] convention as ops/topk.unpack_topk (one
+    packing layout, one place to change it)."""
+    scores, ids = topk_ops.unpack_topk(np.asarray(packed)[None, :])
+    return scores[0], ids[0]
+
+
+_QCHUNK = 32  # query rows per lax.map step: bounds the [Q, n] totals block
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def batch_topk(rows: Array, sel: Array, k: int) -> Array:
+    """Batched keyword scoring as ONE MXU matmul: rows [U, n] stacked
+    dense impact rows, sel [Q, U] f32 query-term selection (1.0 where unit
+    u scores query q) -> packed [Q, 2k] int32 (dense_topk packing per
+    row).
+
+    totals = sel @ rows gives every query's summed scores in one dispatch
+    — over a relay this replaces Q x (adds + top_k + fetch) round trips
+    with one dispatch + one fetch; on local HBM it turns Q vector adds
+    into systolic-array work. Q is processed in _QCHUNK-row map steps so
+    the transient totals block is [_QCHUNK, n], not [Q, n] (256 queries x
+    1M docs would be a 1 GB materialization). Q must be a _QCHUNK
+    multiple (caller pads; padded rows are all-zero -> all ids -1)."""
+    q, u = sel.shape
+
+    def chunk(s_blk):
+        totals = jnp.dot(s_blk, rows, preferred_element_type=jnp.float32)
+        scores, ids = jax.lax.top_k(totals, k)
+        ids = jnp.where(scores > 0.0, ids, -1).astype(jnp.int32)
+        return topk_ops.pack_topk(scores, ids)
+
+    packed = jax.lax.map(chunk, sel.reshape(q // _QCHUNK, _QCHUNK, u))
+    return packed.reshape(q, 2 * k)

@@ -189,6 +189,11 @@ class Explorer:
         that query's error; the other slots are unaffected)."""
         out: list[Optional[list[SearchResult] | Exception]] = [None] * len(params_list)
         batchable: dict[tuple, list[int]] = {}
+        # plain-BM25 slots: one device matmul per (class, limit, offset,
+        # properties) group when the class serves device BM25 on a single
+        # local shard (ClassIndex.keyword_search_batch); ineligible layouts
+        # fall back to the per-query path below
+        kw_batchable: dict[tuple, list[int]] = {}
         for i, p in enumerate(params_list):
             try:
                 limit = p.limit or self.query_limit
@@ -206,13 +211,29 @@ class Explorer:
                 ):
                     key = (p.class_name, limit, p.offset, p.include_vector)
                     batchable.setdefault(key, []).append(i)
+                elif (
+                    p.keyword_ranking is not None
+                    and p.keyword_ranking.get("query")
+                    and not p.keyword_ranking.get("autocorrect")
+                    and not p.keyword_ranking.get("additionalExplanations")
+                    and not (p.hybrid or p.near_vector or p.group_by
+                             or p.group or p.sort or p.after)
+                    and p.filters is None
+                ):
+                    props = tuple(p.keyword_ranking.get("properties") or ())
+                    kkey = (p.class_name, limit, p.offset, props,
+                            p.include_vector)
+                    kw_batchable.setdefault(kkey, []).append(i)
                 else:
                     out[i] = self._get_one(p)
             except Exception as e:
                 out[i] = e
         # two-phase: enqueue every group's device dispatch first, THEN
         # finalize — groups (and concurrent requests) overlap device compute
-        # with hydration instead of serializing
+        # with hydration instead of serializing. The keyword lane (which
+        # blocks on its own fetch) runs BETWEEN enqueue and finalize, so a
+        # mixed keyword+vector batch overlaps the keyword matmul with the
+        # in-flight vector dispatches instead of serializing two round trips.
         pending: list[tuple] = []
         for (class_name, limit, offset, inc_vec), idxs in batchable.items():
             try:
@@ -235,6 +256,24 @@ class Explorer:
                         out[i] = self._get_one(params_list[i])
                     except Exception as e2:
                         out[i] = e2
+        for (class_name, limit, offset, props, inc_vec), idxs in kw_batchable.items():
+            res = None
+            try:
+                idx = self._index(class_name)
+                res = idx.keyword_search_batch(
+                    [params_list[i].keyword_ranking["query"] for i in idxs],
+                    limit, offset=offset, properties=list(props) or None,
+                    include_vector=inc_vec)
+            except Exception:
+                res = None  # fall through to the per-query path
+            for j, i in enumerate(idxs):
+                try:
+                    if res is not None:
+                        out[i] = self._postprocess(params_list[i], res[j])
+                    else:
+                        out[i] = self._get_one(params_list[i])
+                except Exception as e2:
+                    out[i] = e2
         for idxs, offset, done in pending:
             try:
                 res = done()
