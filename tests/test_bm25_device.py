@@ -286,6 +286,70 @@ def test_explanations_fall_back_to_host(tmp_path):
     assert any("frequency" in k for h in hits for k in h[2])
 
 
+def test_device_engine_under_concurrent_writes(tmp_path):
+    """Writers bump the shard generation mid-search; the device row/mask
+    caches must never serve a stale generation's scores, and no search may
+    raise. Final state: device ranking == host ranking."""
+    import threading
+
+    from weaviate_tpu.db.shard import Shard
+
+    cd = ClassDef(name="Kw", properties=[
+        Property(name="t", data_type=["text"]),
+    ], vector_index_type="noop")
+    cfg = parse_and_validate_config("noop", {})
+    shard = Shard("c0", str(tmp_path / "conc"), cd, cfg,
+                  invert_cfg={"bm25": {"device": True}})
+    vocab = [f"w{i}" for i in range(20)]
+    shard.put_batch([
+        StorObj(class_name="Kw", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"t": " ".join(
+                    np.random.default_rng(i).choice(vocab, size=8))})
+        for i in range(100)])
+    errs: list = []
+    stop = threading.Event()
+
+    def writer():
+        i = 1000
+        while not stop.is_set():
+            try:
+                shard.put_object(StorObj(
+                    class_name="Kw", uuid=str(uuidlib.UUID(int=i + 1)),
+                    properties={"t": " ".join(vocab[:4])}))
+                i += 1
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+    def reader():
+        q = " ".join(vocab[:3])
+        while not stop.is_set():
+            try:
+                shard.object_search(5, keyword_ranking={"query": q})
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+    threads = [threading.Thread(target=writer)] + \
+        [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(2.5)
+    stop.set()
+    for t in threads:
+        t.join()
+    try:
+        assert not errs, errs[:3]
+        q = " ".join(vocab[:3])
+        dev_hits = shard.object_search(10, keyword_ranking={"query": q})
+        shard.bm25_device = None
+        host_hits = shard.object_search(10, keyword_ranking={"query": q})
+        key = lambda r: (-round(r.score, 4), r.obj.uuid)  # noqa: E731
+        assert [r.obj.uuid for r in sorted(dev_hits, key=key)] == \
+            [r.obj.uuid for r in sorted(host_hits, key=key)]
+    finally:
+        shard.shutdown()
+
+
 def test_shard_opt_in_serves_device_path(tmp_path):
     from weaviate_tpu.db.shard import Shard
 

@@ -40,6 +40,7 @@ for every posting in the unit at the cost of one numpy max().
 from __future__ import annotations
 
 import math
+import threading
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -155,6 +156,11 @@ class BM25Searcher:
         # decoded (prop, term) posting arrays, LRU under the write generation
         self._post_cache: OrderedDict[tuple, tuple] = OrderedDict()
         self._post_cache_bytes = 0
+        # guards the three generation caches: concurrent readers share one
+        # searcher per shard (hit->move_to_end racing another thread's
+        # evict/insert would KeyError, and unsynchronized byte accounting
+        # drifts permanently)
+        self._cache_lock = threading.RLock()
         # subkey byte order pinned by the store's marker (legacy LE stores
         # decode correctly, just without the pre-sorted fast decode)
         self._key_dtype = getattr(inverted, "subkey_dtype", ">u8")
@@ -164,15 +170,17 @@ class BM25Searcher:
         ~1.6 ms at 50k docs; cache it per write generation like the length
         tables."""
         gen = self._gen_fn() if self._gen_fn is not None else None
-        if gen is not None and self._count_cache is not None \
-                and self._count_cache[0] == gen:
-            return self._count_cache[1]
+        with self._cache_lock:
+            if gen is not None and self._count_cache is not None \
+                    and self._count_cache[0] == gen:
+                return self._count_cache[1]
         c = self.inverted.doc_count()
         # cache only if no write started meanwhile: the writer bumps the
         # generation BEFORE mutating, so a count read mid-write must not be
         # pinned under the new generation
         if gen is not None and (self._gen_fn() == gen):
-            self._count_cache = (gen, c)
+            with self._cache_lock:
+                self._count_cache = (gen, c)
         return c
 
     def _prop_lengths(self, prop_name: str, lb):
@@ -181,9 +189,10 @@ class BM25Searcher:
         standalone users pay the rebuild each call."""
         gen = self._gen_fn() if self._gen_fn is not None else None
         if gen is not None:
-            hit = self._len_cache.get(prop_name)
-            if hit is not None and hit[0] == gen:
-                return hit[1], hit[2], hit[3]
+            with self._cache_lock:
+                hit = self._len_cache.get(prop_name)
+                if hit is not None and hit[0] == gen:
+                    return hit[1], hit[2], hit[3]
         r = lb.map_get_arrays(b"len", key_dtype=self._key_dtype, val_dtype="<u4") \
             if lb is not None else None
         if r is None and lb is not None:  # tombstones etc: generic decode
@@ -204,7 +213,8 @@ class BM25Searcher:
         # same mid-write guard as _doc_count: never pin a table read while
         # a write (which bumps the generation first) is in flight
         if gen is not None and self._gen_fn() == gen:
-            self._len_cache[prop_name] = (gen, docs, vals, avg)
+            with self._cache_lock:
+                self._len_cache[prop_name] = (gen, docs, vals, avg)
         return docs, vals, avg
 
     def _postings(self, sb, prop_name: str, term: str):
@@ -215,10 +225,11 @@ class BM25Searcher:
         gen = self._gen_fn() if self._gen_fn is not None else None
         key = (prop_name, term)
         if gen is not None:
-            hit = self._post_cache.get(key)
-            if hit is not None and hit[0] == gen:
-                self._post_cache.move_to_end(key)
-                return hit[1], hit[2]
+            with self._cache_lock:
+                hit = self._post_cache.get(key)
+                if hit is not None and hit[0] == gen:
+                    self._post_cache.move_to_end(key)
+                    return hit[1], hit[2]
         r = sb.map_get_arrays(term.encode("utf-8"), key_dtype=self._key_dtype)
         if r is None:  # odd-shaped or tombstoned postings: generic path
             postings = sb.map_get(term.encode("utf-8"))
@@ -234,15 +245,16 @@ class BM25Searcher:
         else:
             ids, tf = r
         if gen is not None and self._gen_fn() == gen:
-            old = self._post_cache.pop(key, None)
-            if old is not None:
-                self._post_cache_bytes -= old[1].nbytes + old[2].nbytes
-            self._post_cache[key] = (gen, ids, tf)
-            self._post_cache_bytes += ids.nbytes + tf.nbytes
-            while self._post_cache_bytes > _POST_CACHE_MAX_BYTES \
-                    and len(self._post_cache) > 1:
-                _, (_, e_ids, e_tf) = self._post_cache.popitem(last=False)
-                self._post_cache_bytes -= e_ids.nbytes + e_tf.nbytes
+            with self._cache_lock:
+                old = self._post_cache.pop(key, None)
+                if old is not None:
+                    self._post_cache_bytes -= old[1].nbytes + old[2].nbytes
+                self._post_cache[key] = (gen, ids, tf)
+                self._post_cache_bytes += ids.nbytes + tf.nbytes
+                while self._post_cache_bytes > _POST_CACHE_MAX_BYTES \
+                        and len(self._post_cache) > 1:
+                    _, (_, e_ids, e_tf) = self._post_cache.popitem(last=False)
+                    self._post_cache_bytes -= e_ids.nbytes + e_tf.nbytes
         return ids, tf
 
     def _searchable_props(self, properties: Optional[Sequence[str]]) -> list[tuple[str, float]]:

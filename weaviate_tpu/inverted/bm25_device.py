@@ -22,6 +22,7 @@ scatter-packed masks (index/tpu.py).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -54,10 +55,14 @@ class DeviceBM25:
         # (prop, term) -> (gen, n_pad, device row [n_pad] f32)
         self._rows: OrderedDict[tuple, tuple] = OrderedDict()
         self._row_bytes = 0
-        # filter key -> (gen, n_pad, device bool mask [n_pad])
         # id(bitmap) -> (gen, n_pad, device mask, pinned bitmap)
         self._masks: dict[int, tuple] = {}
         self._npad_hwm: Optional[tuple] = None  # (gen, n_pad floor)
+        # guards _rows/_masks/_row_bytes/_npad_hwm: concurrent readers
+        # share one engine per shard (shard.object_search takes no lock on
+        # the read path), and two threads evicting at once must not race
+        # the pops or drift the byte accounting
+        self._cache_lock = threading.RLock()
         self._jax = None  # lazy import: module import must not init backend
 
     # -- plumbing ------------------------------------------------------------
@@ -100,20 +105,35 @@ class DeviceBM25:
         from weaviate_tpu.ops import bm25_scan  # noqa: PLC0415
 
         want = bm25_scan.n_bucket(max_id)
-        if self._npad_hwm is not None and self._npad_hwm[0] == gen:
-            want = max(want, self._npad_hwm[1])
-        self._npad_hwm = (gen, want)
+        with self._cache_lock:
+            cur = self._npad_hwm
+            if cur is not None and cur[0] == gen:
+                want = max(want, cur[1])
+                self._npad_hwm = (gen, want)
+            elif cur is None or self._gen() == gen:
+                # only the LIVE generation may reset the floor — a
+                # straggler from an older generation must not clobber the
+                # newer generation's high-water mark
+                self._npad_hwm = (gen, want)
         return want
 
-    def _evict_dead(self, gen) -> None:
-        """Drop rows/masks from older generations before building new ones
-        (the old generation's device memory must be reclaimable NOW — a
-        reindex sweep would otherwise double the footprint)."""
-        dead = [k for k, v in self._rows.items() if v[0] != gen]
-        for k in dead:
-            _, _, row = self._rows.pop(k)
-            self._row_bytes -= row.nbytes
-        self._masks = {k: v for k, v in self._masks.items() if v[0] == gen}
+    def _evict_dead(self) -> None:
+        """Drop rows/masks whose generation is no longer LIVE before
+        building new ones (the old generation's device memory must be
+        reclaimable NOW — a reindex sweep would otherwise double the
+        footprint). Compares against the generation read at eviction time,
+        NOT a caller-supplied one: an in-flight query that captured the
+        previous generation must never wipe the current generation's
+        cache."""
+        live = self._gen()
+        with self._cache_lock:
+            dead = [k for k, v in self._rows.items() if v[0] != live]
+            for k in dead:
+                entry = self._rows.pop(k, None)
+                if entry is not None:
+                    self._row_bytes -= entry[2].nbytes
+            self._masks = {k: v for k, v in self._masks.items()
+                           if v[0] == live}
 
     # -- dense row cache -----------------------------------------------------
 
@@ -124,12 +144,15 @@ class DeviceBM25:
         import jax.numpy as jnp  # noqa: PLC0415
 
         key = (unit.prop, unit.term, unit.weight)
-        hit = self._rows.get(key)
-        if hit is not None and hit[0] == gen and hit[1] == n_pad:
-            self._rows.move_to_end(key)
-            return hit[2]
+        with self._cache_lock:
+            hit = self._rows.get(key)
+            if hit is not None and hit[0] == gen and hit[1] == n_pad:
+                self._rows.move_to_end(key)
+                return hit[2]
         # full per-posting scores, host side (f64 math, one pass) — the
-        # scatter into doc-id space is the device's job
+        # scatter into doc-id space is the device's job. Built OUTSIDE the
+        # lock: two threads may redundantly build the same row (last write
+        # wins), but a slow scatter never blocks other queries' cache hits.
         scores = unit._score(unit.ids, unit.tf).astype(np.float32)
         ids = unit.ids.astype(np.int64)
         ids = np.where(ids < n_pad, ids, n_pad).astype(np.int32)
@@ -138,14 +161,16 @@ class DeviceBM25:
         row = bm25_scan.build_dense_row(
             jnp.asarray(ids), jnp.asarray(scores), zeros)
         if gen is not None and self._gen() == gen:
-            old = self._rows.pop(key, None)
-            if old is not None:
-                self._row_bytes -= old[2].nbytes
-            self._rows[key] = (gen, n_pad, row)
-            self._row_bytes += row.nbytes
-            while self._row_bytes > _ROW_CACHE_MAX_BYTES and len(self._rows) > 1:
-                _, (_, _, e) = self._rows.popitem(last=False)
-                self._row_bytes -= e.nbytes
+            with self._cache_lock:
+                old = self._rows.pop(key, None)
+                if old is not None:
+                    self._row_bytes -= old[2].nbytes
+                self._rows[key] = (gen, n_pad, row)
+                self._row_bytes += row.nbytes
+                while self._row_bytes > _ROW_CACHE_MAX_BYTES \
+                        and len(self._rows) > 1:
+                    _, (_, _, e) = self._rows.popitem(last=False)
+                    self._row_bytes -= e.nbytes
         return row
 
     def _allow_mask(self, allow_list: AllowList, n_pad: int, gen):
@@ -158,18 +183,20 @@ class DeviceBM25:
         # recycle the same address within one generation — the hit check
         # compares the stored object so a recycled id can never alias
         key = id(allow_list)
-        hit = self._masks.get(key)
-        if hit is not None and hit[0] == gen and hit[1] == n_pad \
-                and hit[3] is allow_list:
-            return hit[2]
+        with self._cache_lock:
+            hit = self._masks.get(key)
+            if hit is not None and hit[0] == gen and hit[1] == n_pad \
+                    and hit[3] is allow_list:
+                return hit[2]
         host = np.zeros((n_pad,), dtype=bool)
         ids = allow_list.to_array().astype(np.int64)
         host[ids[ids < n_pad]] = True
         mask = jnp.asarray(host)
         if gen is not None and self._gen() == gen:
-            if len(self._masks) >= 16:
-                self._masks.pop(next(iter(self._masks)))
-            self._masks[key] = (gen, n_pad, mask, allow_list)
+            with self._cache_lock:
+                if len(self._masks) >= 16:
+                    self._masks.pop(next(iter(self._masks)), None)
+                self._masks[key] = (gen, n_pad, mask, allow_list)
         return mask
 
     # -- search --------------------------------------------------------------
@@ -214,7 +241,7 @@ class DeviceBM25:
 
         max_id = max(int(u.ids[-1]) for u in units)  # ids are doc-sorted
         n_pad = self._npad(max_id, gen)
-        self._evict_dead(gen)
+        self._evict_dead()
         total = self._dense_row(units[0], n_pad, gen)
         for u in units[1:]:
             total = bm25_scan.add_rows(total, self._dense_row(u, n_pad, gen))
@@ -261,7 +288,7 @@ class DeviceBM25:
             return [[] for _ in queries]
         max_id = max(int(u.ids[-1]) for u in all_units)
         n_pad = self._npad(max_id, gen)
-        self._evict_dead(gen)
+        self._evict_dead()
         # greedy slicing under the transient-stack budget: each slice's
         # DISTINCT units fit _BATCH_STACK_MAX_BYTES once stacked; a slice
         # still amortizes its dispatch+fetch over many queries
