@@ -587,7 +587,8 @@ def _bm25_row(n_docs: int) -> dict:
                     len(qs) / (time.perf_counter() - t0), 1)
 
         sweep("")
-        shard.bm25_device = DeviceBM25(shard.bm25)
+        engine = DeviceBM25(shard.bm25)
+        shard.bm25_device = engine
         sweep("_device")
         # batched lane: the whole query set as ONE get_class_batched call —
         # one device matmul + one fetch (the gRPC BatchSearch shape)
@@ -601,6 +602,20 @@ def _bm25_row(n_docs: int) -> dict:
             row[f"qps_{label}_device_batch"] = round(
                 len(qs) / (time.perf_counter() - t0), 1)
             assert not any(isinstance(r, Exception) for r in res)
+        st = engine.last_batch_stats
+        if st and st["u"]:
+            # matmul roofline of the last batched sweep: flops 2·Q·U·n_pad,
+            # HBM traffic = the [U, n_pad] f32 row matrix read once
+            import jax as _jax
+
+            bknd = "tpu-v5e" if _jax.default_backend() in ("tpu", "axon") \
+                else "cpu"
+            # flops = 2 * n_pad * sum(q_slice*u_slice): a multi-slice sweep
+            # does NOT multiply every query by every slice's units
+            row["roofline_device_batch"] = _roofline(
+                row["qps_8term_zipf_device_batch"], st["n_pad"],
+                st["qu"] / st["q"], st["q"], st["u"] * 4, bknd)
+            row["device_batch_shape"] = st
         shard.bm25_device = None
         app.shutdown()
     finally:

@@ -64,6 +64,9 @@ class DeviceBM25:
         # the pops or drift the byte accounting
         self._cache_lock = threading.RLock()
         self._jax = None  # lazy import: module import must not init backend
+        # shape of the most recent search_batch dispatch (bench roofline
+        # reads it: flops = 2*q*u*n per matmul, bytes = u*n*4 row traffic)
+        self.last_batch_stats: Optional[dict] = None
 
     # -- plumbing ------------------------------------------------------------
 
@@ -223,8 +226,13 @@ class DeviceBM25:
             # the host engine ranks them correctly, so it serves them
             return s.search(query, limit, properties=properties,
                             allow_list=allow_list)
-        n_docs = max(s._doc_count(), 1)
+        # gen BEFORE _doc_count/_build_units: the _dense_row insert guard
+        # re-reads the generation after compute, so the guarded window must
+        # span EVERYTHING idf depends on — captured after the count, a
+        # write landing in between could pin stale-idf rows under the new
+        # generation and serve them until the next write
         gen = self._gen()
+        n_docs = max(s._doc_count(), 1)
         units = s._build_units(query, props, n_docs)
         if not units:
             return []
@@ -280,8 +288,8 @@ class DeviceBM25:
         props = s._searchable_props(properties)
         if any(w <= 0 for _, w in props):
             return None  # non-positive boosts: host engine (see search())
+        gen = self._gen()  # before _doc_count — same window as search()
         n_docs = max(s._doc_count(), 1)
-        gen = self._gen()
         per_query_units = [s._build_units(q, props, n_docs) for q in queries]
         all_units = [u for units in per_query_units for u in units]
         if not all_units:
@@ -295,6 +303,8 @@ class DeviceBM25:
         max_units = max(int(_BATCH_STACK_MAX_BYTES // (n_pad * 4)),
                         max(len(u) for u in per_query_units), 1)
         out: list[list[tuple[int, float, None]]] = []
+        stats = {"q": len(queries), "u": 0, "n_pad": n_pad, "slices": 0,
+                 "qu": 0}  # qu = sum over slices of q_slice*u_slice
         qi = 0
         while qi < len(queries):
             ukeys: dict[tuple, object] = {}
@@ -311,7 +321,11 @@ class DeviceBM25:
                 j += 1
             out.extend(self._matmul_slice(
                 slice_units, ukeys, n_pad, gen, limit, jnp, bm25_scan))
+            stats["u"] += len(ukeys)
+            stats["qu"] += len(slice_units) * len(ukeys)
+            stats["slices"] += 1
             qi = j
+        self.last_batch_stats = stats
         return out
 
     def _matmul_slice(self, per_query_units, ukeys, n_pad, gen, limit,
