@@ -603,7 +603,10 @@ def _bm25_row(n_docs: int) -> dict:
                 len(qs) / (time.perf_counter() - t0), 1)
             assert not any(isinstance(r, Exception) for r in res)
         st = engine.last_batch_stats
-        if st and st["u"]:
+        # st must be the ZIPF sweep's own dispatch (the last one timed): a
+        # host-path fallback clears it, so a stale shape can never pair
+        # with host QPS into a fabricated device roofline
+        if st and st["u"] and st["q"] == len(qsets["8term_zipf"]):
             # matmul roofline of the last batched sweep: flops 2·Q·U·n_pad,
             # HBM traffic = the [U, n_pad] f32 row matrix read once
             import jax as _jax

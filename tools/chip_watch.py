@@ -40,6 +40,40 @@ def probe() -> bool:
         return False
 
 
+def _commit_artifacts() -> None:
+    """Measurement artifacts only (json + logs, no source): land them the
+    moment a session succeeds so a later wedge/restart cannot lose the
+    capture."""
+    paths = [f for f in ("bench_matrix.json", "chip_session.log",
+                         "chip_profile.log")
+             if os.path.exists(os.path.join(REPO, f))]
+    if not paths:
+        log("no artifact files exist — nothing to commit")
+        return
+    try:
+        # pathspec-limited partial commit: commits ONLY these paths'
+        # working-tree state, so anything a developer pre-staged can never
+        # be swept into the automated artifact commit; exits non-zero when
+        # nothing changed (logged, not fatal). Paths are filtered to those
+        # on disk because ONE unmatched pathspec fails the entire commit.
+        subprocess.run(["git", "add", "-f", "--"] + paths, cwd=REPO,
+                       timeout=60)
+        r = subprocess.run(
+            ["git", "commit", "-m",
+             "TPU capture: bench matrix regenerated on hardware\n\n"
+             "Automated artifact commit by tools/chip_watch.py after a\n"
+             "successful chip session (measurement data only, no source).\n\n"
+             "No-Verification-Needed: measurement-artifact-only commit",
+             "--"] + paths,
+            cwd=REPO, timeout=60, capture_output=True, text=True)
+        if r.returncode == 0:
+            log("artifacts committed")
+        else:
+            log("no artifact commit: " + (r.stdout + r.stderr).strip()[-120:])
+    except Exception as e:  # noqa: BLE001 — never fail the watcher on git
+        log(f"artifact commit failed: {e}")
+
+
 def main() -> int:
     # single-instance guard: two watchers would race their chip sessions
     # onto the one device the moment the relay recovers
@@ -97,6 +131,8 @@ def _watch_loop() -> int:
                     "chip_watch_session.log")
                 return 4
             log(f"chip session rc={rc}")
+            if rc == 0:
+                _commit_artifacts()
             return rc
         log(f"probe #{attempt}: wedged; sleeping {PROBE_EVERY}s")
         time.sleep(PROBE_EVERY)

@@ -277,6 +277,9 @@ class DeviceBM25:
         unavailable (callers fall back to per-query host scoring).
         No allowList/explanations here — those park a query outside the
         batch lane (usecases/traverser.py get_class_batched eligibility)."""
+        # cleared on EVERY path that doesn't dispatch: a caller reading
+        # stats after a fallback must see None, not a previous batch's shape
+        self.last_batch_stats = None
         if limit <= 0:
             return [[] for _ in queries]
         try:
