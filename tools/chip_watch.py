@@ -56,8 +56,11 @@ def _commit_artifacts() -> None:
         # be swept into the automated artifact commit; exits non-zero when
         # nothing changed (logged, not fatal). Paths are filtered to those
         # on disk because ONE unmatched pathspec fails the entire commit.
+        branch = subprocess.run(
+            ["git", "rev-parse", "--abbrev-ref", "HEAD"], cwd=REPO,
+            timeout=60, capture_output=True, text=True).stdout.strip()
         subprocess.run(["git", "add", "-f", "--"] + paths, cwd=REPO,
-                       timeout=60)
+                       timeout=60, capture_output=True)
         r = subprocess.run(
             ["git", "commit", "-m",
              "TPU capture: bench matrix regenerated on hardware\n\n"
@@ -67,8 +70,12 @@ def _commit_artifacts() -> None:
              "--"] + paths,
             cwd=REPO, timeout=60, capture_output=True, text=True)
         if r.returncode == 0:
-            log("artifacts committed")
+            log(f"artifacts committed on branch '{branch}'")
         else:
+            # un-stage what we force-added: a failed commit must not leave
+            # artifacts in the index for a later developer commit to sweep
+            subprocess.run(["git", "reset", "-q", "HEAD", "--"] + paths,
+                           cwd=REPO, timeout=60, capture_output=True)
             log("no artifact commit: " + (r.stdout + r.stderr).strip()[-120:])
     except Exception as e:  # noqa: BLE001 — never fail the watcher on git
         log(f"artifact commit failed: {e}")
