@@ -276,6 +276,60 @@ def test_get_class_batched_kw_lane(tmp_path):
         app.shutdown()
 
 
+def test_batched_hybrid_matches_solo(tmp_path):
+    """Hybrid slots batch both legs (one keyword matmul + one dense kNN
+    dispatch); results must equal per-slot get_class across alphas 0 /
+    0.5 / 1, with explicit vectors and keyword-only slots mixed."""
+    from weaviate_tpu.server import App
+    from weaviate_tpu.usecases.traverser import GetParams
+
+    app = App(data_path=str(tmp_path / "hyb"))
+    app.schema.add_class({
+        "class": "Hy", "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "invertedIndexConfig": {"bm25": {"device": True}},
+        "properties": [{"name": "t", "dataType": ["text"]}]})
+    hidx = app.db.get_index("Hy")
+    vocab = [f"w{i}" for i in range(25)]
+    rng = np.random.default_rng(4)
+    hidx.put_batch([
+        StorObj(class_name="Hy", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"t": " ".join(
+                    np.random.default_rng(i).choice(vocab, size=8))},
+                vector=rng.standard_normal(16).astype(np.float32))
+        for i in range(200)])
+    tr = app.traverser
+    try:
+        prng = random.Random(2)
+        plist = []
+        for alpha in (0.0, 0.5, 1.0):
+            for _ in range(4):
+                q = " ".join(prng.choices(vocab, k=3))
+                v = rng.standard_normal(16).astype(np.float32).tolist()
+                plist.append(GetParams(
+                    class_name="Hy",
+                    hybrid={"query": q, "vector": v, "alpha": alpha},
+                    limit=6))
+        batched = tr.get_class_batched(plist)
+        assert not any(isinstance(r, Exception) for r in batched), batched
+        shard = next(iter(hidx.shards.values()))
+        assert shard.bm25_device is not None \
+            and shard.bm25_device.last_batch_stats is not None, \
+            "hybrid sparse leg must have used the batched device engine"
+        for p, got in zip(plist, batched):
+            # the LEGACY per-slot path is the baseline — get_class itself
+            # routes through the batched lane, which would compare the new
+            # code against itself
+            solo = tr.explorer._get_one(p)
+            assert [r.score for r in got] == pytest.approx(
+                [r.score for r in solo], rel=1e-4, abs=1e-5)
+            key = lambda r: (-round(r.score or 0, 4), r.obj.uuid)  # noqa: E731
+            assert [r.obj.uuid for r in sorted(got, key=key)] == \
+                [r.obj.uuid for r in sorted(solo, key=key)]
+    finally:
+        app.shutdown()
+
+
 def test_explanations_fall_back_to_host(tmp_path):
     rng = np.random.default_rng(5)
     vocab = np.array([f"w{i}" for i in range(30)])

@@ -194,6 +194,10 @@ class Explorer:
         # local shard (ClassIndex.keyword_search_batch); ineligible layouts
         # fall back to the per-query path below
         kw_batchable: dict[tuple, list[int]] = {}
+        # hybrid slots: BOTH legs batch — Q hybrid queries ride one keyword
+        # matmul + one dense kNN dispatch instead of 2Q device calls;
+        # fusion stays host-side per slot (alpha/fusionType vary freely)
+        hyb_batchable: dict[tuple, list[int]] = {}
         for i, p in enumerate(params_list):
             try:
                 limit = p.limit or self.query_limit
@@ -224,6 +228,18 @@ class Explorer:
                     kkey = (p.class_name, limit, p.offset, props,
                             p.include_vector)
                     kw_batchable.setdefault(kkey, []).append(i)
+                elif (
+                    p.hybrid is not None
+                    and (p.hybrid.get("query")
+                         or p.hybrid.get("vector") is not None)
+                    and not (p.near_vector or p.keyword_ranking or p.group_by
+                             or p.group or p.sort or p.after)
+                    and p.filters is None
+                ):
+                    props = tuple(p.hybrid.get("properties") or ())
+                    hkey = (p.class_name, limit, p.offset, props,
+                            p.include_vector)
+                    hyb_batchable.setdefault(hkey, []).append(i)
                 else:
                     out[i] = self._get_one(p)
             except Exception as e:
@@ -274,6 +290,16 @@ class Explorer:
                         out[i] = self._get_one(params_list[i])
                 except Exception as e2:
                     out[i] = e2
+        for (class_name, limit, offset, props, inc_vec), idxs in hyb_batchable.items():
+            try:
+                self._hybrid_group(out, params_list, idxs, class_name, limit,
+                                   offset, list(props) or None, inc_vec)
+            except Exception:
+                for i in idxs:
+                    try:
+                        out[i] = self._get_one(params_list[i])
+                    except Exception as e2:
+                        out[i] = e2
         for idxs, offset, done in pending:
             try:
                 res = done()
@@ -337,6 +363,70 @@ class Explorer:
                 )
                 return self._postprocess(params, res, skip_sort=bool(params.sort))
         return self._postprocess(params, res)
+
+    def _hybrid_group(self, out, params_list, idxs, class_name, limit,
+                      offset, props, inc_vec) -> None:
+        """Batched hybrid: one keyword matmul + one dense kNN dispatch for
+        a group of same-class hybrid slots, fused host-side per slot with
+        each slot's own alpha/fusionType — semantics identical to
+        _hybrid() run per slot (same fetch oversampling, same leg
+        skipping at alpha 0/1)."""
+        idx = self._index(class_name)
+        fetch = max(limit * 4, 100)
+        slots = [params_list[i] for i in idxs]
+        alphas = [float(s.hybrid.get("alpha", 0.75)) for s in slots]
+        queries = [s.hybrid.get("query") or "" for s in slots]
+        cd = self.schema.get_class(idx.class_name) \
+            if self.modules is not None else None
+        vecs: list = []
+        for s, a, q in zip(slots, alphas, queries):
+            v = s.hybrid.get("vector")
+            if v is None and a > 0 and q and self.modules is not None:
+                v = self.modules.vectorize_query(cd, {"concepts": [q]})
+            vecs.append(v if a > 0 else None)
+
+        # dense leg ENQUEUED FIRST (async when the index supports it) so
+        # its device round trip overlaps the sparse matmul below — the two
+        # legs are independent, same two-phase idea as the pure-dense lane
+        dense_lists: list[list] = [[] for _ in slots]
+        dn = [j for j in range(len(slots)) if vecs[j] is not None]
+        dense_done = None
+        if dn:
+            dvecs = np.stack([np.asarray(vecs[j], np.float32) for j in dn])
+            if hasattr(idx, "object_vector_search_async"):
+                dense_done = idx.object_vector_search_async(
+                    dvecs, fetch, include_vector=inc_vec)
+            else:
+                dres = idx.object_vector_search(
+                    dvecs, fetch, include_vector=inc_vec)
+                dense_done = (lambda dres=dres: dres)
+
+        sparse_lists: list[list] = [[] for _ in slots]
+        sp = [j for j in range(len(slots)) if alphas[j] < 1 and queries[j]]
+        if sp:
+            res_kw = idx.keyword_search_batch(
+                [queries[j] for j in sp], fetch, properties=props,
+                include_vector=inc_vec)
+            if res_kw is not None:
+                for j, r in zip(sp, res_kw):
+                    sparse_lists[j] = r
+            else:  # no device engine: per-slot host keyword (dense leg
+                   # above still batches)
+                for j in sp:
+                    sparse_lists[j] = idx.object_search(
+                        fetch, keyword_ranking={
+                            "query": queries[j], "properties": props},
+                        include_vector=inc_vec)
+
+        if dense_done is not None:
+            for j, r in zip(dn, dense_done()):
+                dense_lists[j] = r
+
+        for j, i in enumerate(idxs):
+            s = slots[j]
+            fused = hybrid_mod.fuse(sparse_lists[j], dense_lists[j],
+                                    alphas[j], s.hybrid.get("fusionType"))
+            out[i] = self._postprocess(s, fused[offset:offset + limit])
 
     # -- hybrid (explorer.go:227, hybrid/searcher.go) ------------------------
 
