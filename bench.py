@@ -451,6 +451,10 @@ def run_matrix(rng, vecs, queries, idx_l2, gt, headline=None):
     results["bm25"] = _bm25_row(n_kw)
     flush()
 
+    log("matrix: hybrid solo vs batched...")
+    results["hybrid_batch"] = _hybrid_batch_row()
+    flush()
+
     # config 4 LAST: PQ-compressed (segments=32, bf16 rescore-store scan).
     # The PQ-ADC Mosaic kernel is the one compile that has wedged the relay
     # (chip_session.log 03:20); every row above is already flushed when it
@@ -620,6 +624,70 @@ def _bm25_row(n_docs: int) -> dict:
                 st["qu"] / st["q"], st["q"], st["u"] * 4, bknd)
             row["device_batch_shape"] = st
         shard.bm25_device = None
+        app.shutdown()
+    finally:
+        shutil.rmtree(bdir, ignore_errors=True)
+    return row
+
+
+def _hybrid_batch_row(n_docs: int = 20_000, dim: int = 64,
+                      n_q: int = 64) -> dict:
+    """Hybrid serving: per-slot legacy path vs the batched lane (one
+    overlapped dense dispatch + one keyword matmul per group)."""
+    import random
+    import shutil
+    import tempfile as _tf
+    import uuid as _uuidlib
+
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.server import App
+    from weaviate_tpu.usecases.traverser import GetParams
+
+    rng = np.random.default_rng(7)
+    prng = random.Random(7)
+    words = [f"w{i}" for i in range(2000)]
+    bdir = _tf.mkdtemp(prefix="benchhyb")
+    row: dict = {"n_docs": n_docs, "dim": dim, "n_queries": n_q,
+                 "alpha": 0.5}
+    try:
+        app = App(data_path=bdir)
+        app.schema.add_class({
+            "class": "Hy", "vectorIndexType": "hnsw_tpu",
+            "vectorIndexConfig": {"distance": "l2-squared"},
+            "invertedIndexConfig": {"bm25": {"device": True}},
+            "properties": [{"name": "body", "dataType": ["text"]}]})
+        hidx = app.db.get_index("Hy")
+        for s in range(0, n_docs, 5_000):
+            hidx.put_batch([
+                StorObj(class_name="Hy", uuid=str(_uuidlib.UUID(int=i + 1)),
+                        properties={"body": " ".join(
+                            prng.choices(words, k=20))},
+                        vector=rng.standard_normal(dim).astype(np.float32))
+                for i in range(s, min(s + 5_000, n_docs))])
+        shard = next(iter(hidx.shards.values()))
+        shard.inverted.store.flush_memtables()
+        shard.inverted.store.compact_once(1)
+        plist = [GetParams(
+            class_name="Hy", limit=10,
+            hybrid={"query": " ".join(prng.choices(words, k=4)),
+                    "vector": rng.standard_normal(dim).astype(
+                        np.float32).tolist(),
+                    "alpha": 0.5})
+            for _ in range(n_q)]
+        ex = app.traverser.explorer
+        ex._get_one(plist[0])                       # warm legacy path
+        t0 = time.perf_counter()
+        for p in plist:
+            ex._get_one(p)
+        row["qps_solo"] = round(n_q / (time.perf_counter() - t0), 1)
+        app.traverser.get_class_batched(plist)       # warm batched lane
+        t0 = time.perf_counter()
+        res = app.traverser.get_class_batched(plist)
+        row["qps_batched"] = round(n_q / (time.perf_counter() - t0), 1)
+        assert not any(isinstance(r, Exception) for r in res)
+        assert shard.bm25_device is not None \
+            and shard.bm25_device.last_batch_stats is not None
+        row["speedup"] = round(row["qps_batched"] / max(row["qps_solo"], 1e-9), 2)
         app.shutdown()
     finally:
         shutil.rmtree(bdir, ignore_errors=True)
@@ -840,6 +908,18 @@ def run_cpu_matrix(rng):
         "(inverted/bm25_device.py) on the same shard — per-query device "
         "round trips included, rows cached per write generation")
     rows["bm25_cpu"] = brow
+    _merge_matrix(rows)
+
+    # -- row 5b: batched hybrid (2 dispatches for Q slots vs 2Q) ----------
+    log("cpu matrix: hybrid solo vs batched (n=20k, d=64)...")
+    hrow = dict(common)
+    hrow.update(_hybrid_batch_row())
+    hrow["provenance"] = (
+        "hybrid search, 64 slots alpha=0.5: per-slot legacy path (2 device "
+        "dispatches per query) vs the round-5 batched lane (one async dense "
+        "kNN dispatch overlapped with one keyword selection-matrix matmul "
+        "for the whole group; fusion host-side per slot)")
+    rows["hybrid_batch_cpu"] = hrow
     _merge_matrix(rows)
 
     # -- row 6: restart replay (vector-log bulk replay, commit 6d39c68) ---

@@ -423,10 +423,16 @@ class Explorer:
                 dense_lists[j] = r
 
         for j, i in enumerate(idxs):
-            s = slots[j]
-            fused = hybrid_mod.fuse(sparse_lists[j], dense_lists[j],
-                                    alphas[j], s.hybrid.get("fusionType"))
-            out[i] = self._postprocess(s, fused[offset:offset + limit])
+            # per-slot isolation AFTER the device work: one slot failing in
+            # fusion/postprocess must not discard the whole group's results
+            # and re-pay 2Q dispatches through the per-query fallback
+            try:
+                s = slots[j]
+                fused = hybrid_mod.fuse(sparse_lists[j], dense_lists[j],
+                                        alphas[j], s.hybrid.get("fusionType"))
+                out[i] = self._postprocess(s, fused[offset:offset + limit])
+            except Exception as e:  # noqa: BLE001
+                out[i] = e
 
     # -- hybrid (explorer.go:227, hybrid/searcher.go) ------------------------
 
