@@ -906,7 +906,13 @@ def run_cpu_matrix(rng):
         "terms/500k docs; round 4 itself was 66x the round-3 Python loop). "
         "*_device rows: the dense-row device engine "
         "(inverted/bm25_device.py) on the same shard — per-query device "
-        "round trips included, rows cached per write generation")
+        "round trips included, rows cached per write generation. NOTE: at "
+        "n=500k on the 1-core CPU backend the zipf sweep's ~1 GB row "
+        "working set exceeds the row-cache budget "
+        "(WEAVIATE_TPU_BM25_ROW_CACHE_MB) and thrashes — the host engine "
+        "is the right default there; the device lane targets chip HBM, "
+        "where the budget fits hot-term sets and each dispatch replaces a "
+        "relay round trip")
     rows["bm25_cpu"] = brow
     _merge_matrix(rows)
 

@@ -22,6 +22,7 @@ scatter-packed masks (index/tpu.py).
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Optional, Sequence
@@ -36,8 +37,17 @@ from weaviate_tpu.inverted.bm25 import BM25Searcher
 DEVICE_MIN_POSTINGS = 0  # tuned by bench; 0 = always device when eligible
 
 # device bytes pinned for dense rows (a row is n_pad * 4 bytes; at 1M docs
-# each cached term costs ~4 MB)
-_ROW_CACHE_MAX_BYTES = 256 * 1024 * 1024
+# each cached term costs ~4 MB). A batch sweep whose distinct-term working
+# set exceeds this THRASHES (each slice's builds evict the previous
+# slice's rows, so the next sweep rebuilds everything); on a 16 GB-HBM
+# chip 512 MB alongside a 512 MB store is the right trade, and heavy
+# keyword fleets can raise it via WEAVIATE_TPU_BM25_ROW_CACHE_MB.
+try:
+    _ROW_CACHE_MAX_BYTES = int(
+        os.environ.get("WEAVIATE_TPU_BM25_ROW_CACHE_MB") or 512
+    ) * 1024 * 1024
+except ValueError:  # malformed value must not take the server down
+    _ROW_CACHE_MAX_BYTES = 512 * 1024 * 1024
 
 # transient device bytes one batched matmul may stack ([U_pad, n_pad] f32);
 # batches whose distinct-unit set would exceed this are processed in
@@ -72,8 +82,6 @@ class DeviceBM25:
 
     def _backend(self):
         if self._jax is None:
-            import os  # noqa: PLC0415
-
             import jax  # noqa: PLC0415
 
             from weaviate_tpu.ops import bm25_scan  # noqa: PLC0415
