@@ -12,7 +12,6 @@ import time
 import uuid as uuidlib
 
 import numpy as np
-import pytest
 
 from weaviate_tpu.entities.schema import ClassDef, Property
 from weaviate_tpu.entities.storobj import StorObj

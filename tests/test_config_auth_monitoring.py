@@ -14,7 +14,7 @@ from weaviate_tpu.auth import (
     ForbiddenError,
     UnauthorizedError,
 )
-from weaviate_tpu.config import Config, ConfigError, load_config
+from weaviate_tpu.config import ConfigError, load_config
 from weaviate_tpu.monitoring import noop_metrics
 
 

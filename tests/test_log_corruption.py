@@ -18,6 +18,8 @@ import struct
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis", reason="optional dep not in this image")
 from hypothesis import given, settings, strategies as st
 
 from weaviate_tpu.index.tpu import VectorLog, _LOG_ADD, _LOG_DELETE

@@ -3,10 +3,8 @@
 Models the reference's lsmkv unit/integration tiers (strategy tests,
 bucket_recover_from_wal.go behavior)."""
 
-import numpy as np
 import pytest
 
-from weaviate_tpu.storage.bitmap import Bitmap
 from weaviate_tpu.storage.docid import Counter
 from weaviate_tpu.storage.lsm import (
     STRATEGY_MAP,

@@ -12,7 +12,9 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
-from cryptography.hazmat.primitives import hashes, serialization
+
+pytest.importorskip("cryptography", reason="optional dep not in this image")
+from cryptography.hazmat.primitives import hashes
 from cryptography.hazmat.primitives.asymmetric import padding, rsa
 
 from weaviate_tpu.auth.auth import Authenticator, Principal, UnauthorizedError

@@ -11,6 +11,9 @@ import math
 import uuid as uuidlib
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis", reason="optional dep not in this image")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -9,6 +9,8 @@ import shutil
 import tempfile
 
 import pytest
+
+pytest.importorskip("hypothesis", reason="optional dep not in this image")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -3,7 +3,6 @@ and dynamic-ef behavior (reference: recall_geo_spatial_test.go,
 dynamic_ef_test.go)."""
 
 import numpy as np
-import pytest
 
 from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.geo import GeoIndex, haversine_m

@@ -1,0 +1,104 @@
+"""JGL005/JGL004 satellite regressions: the index-type registry survives an
+8-thread hammer (the lock added after graftlint flagged the unlocked
+mutation), and device-fallback observability always counts while logging at
+most once per interval."""
+
+import logging
+import threading
+
+
+from weaviate_tpu.entities import vectorindex as vi
+from weaviate_tpu.monitoring.metrics import (
+    get_metrics,
+    record_device_fallback,
+)
+
+N_THREADS = 8
+N_ROUNDS = 200
+
+
+def test_register_index_type_hammered_from_8_threads():
+    added = [f"hammer-{t}-{i}" for t in range(N_THREADS) for i in range(N_ROUNDS)]
+    errors = []
+    start = threading.Barrier(N_THREADS)
+
+    def worker(t):
+        try:
+            start.wait()
+            for i in range(N_ROUNDS):
+                name = f"hammer-{t}-{i}"
+                vi.register_index_type(
+                    name, lambda d, _n=name: vi.HnswUserConfig.from_dict(d, "hnsw"))
+                # interleave reads: lookups race the writers in production
+                # (schema create resolves types while modules register)
+                cfg = vi.parse_and_validate_config(name, None)
+                assert cfg is not None
+                assert name in vi.registered_index_types()
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(N_THREADS)]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors, errors
+        registered = set(vi.registered_index_types())
+        assert set(added) <= registered
+    finally:
+        with vi._parsers_lock:
+            for name in added:
+                vi._PARSERS.pop(name, None)
+
+
+def _counter_value(component, reason):
+    c = get_metrics().device_fallbacks.labels(component=component, reason=reason)
+    return c._value.get()
+
+
+def test_record_device_fallback_counts_every_call(caplog):
+    before = _counter_value("test.comp", "unit")
+    with caplog.at_level(logging.WARNING, logger="weaviate_tpu.monitoring.fallback"):
+        logged = [record_device_fallback("test.comp", "unit",
+                                         RuntimeError("boom"), interval=3600)
+                  for _ in range(50)]
+    assert _counter_value("test.comp", "unit") == before + 50
+    # rate limit: exactly one log line for the burst
+    assert logged.count(True) == 1
+    msgs = [r for r in caplog.records
+            if "test.comp" in r.getMessage() and "reason=unit" in r.getMessage()]
+    assert len(msgs) == 1
+
+
+def test_record_device_fallback_hammered_from_8_threads(caplog):
+    before = _counter_value("test.hammer", "burst")
+    start = threading.Barrier(N_THREADS)
+    logged_flags = []
+    lock = threading.Lock()
+
+    def worker():
+        start.wait()
+        for _ in range(N_ROUNDS):
+            flag = record_device_fallback("test.hammer", "burst", interval=3600)
+            with lock:
+                logged_flags.append(flag)
+
+    with caplog.at_level(logging.WARNING, logger="weaviate_tpu.monitoring.fallback"):
+        threads = [threading.Thread(target=worker) for _ in range(N_THREADS)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+    # every call counted, no lost increments
+    assert _counter_value("test.hammer", "burst") == before + N_THREADS * N_ROUNDS
+    # the log gate admits exactly one writer per interval
+    assert logged_flags.count(True) == 1
+
+
+def test_record_device_fallback_log_false_still_counts(caplog):
+    before = _counter_value("test.silent", "counted")
+    with caplog.at_level(logging.WARNING, logger="weaviate_tpu.monitoring.fallback"):
+        assert record_device_fallback("test.silent", "counted", log=False) is False
+    assert _counter_value("test.silent", "counted") == before + 1
+    assert not [r for r in caplog.records if "test.silent" in r.getMessage()]

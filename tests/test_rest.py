@@ -260,7 +260,7 @@ def test_pprof_surface(port):
     assert b"_dispatch" in dump or b"h_pprof_goroutine" in dump
 
     # short CPU profile while a busy thread runs -> its frames show up
-    import threading, time as _t
+    import threading
 
     stop = threading.Event()
 

@@ -3,7 +3,6 @@ regression net proving the whole API surface answers (status codes only;
 the per-surface suites assert content)."""
 
 import json
-import tempfile
 import urllib.error
 import urllib.request
 import uuid as uuidlib

@@ -1,0 +1,63 @@
+"""Tier-1 enforcement of the graftlint invariants over the real tree:
+zero violations outside the baseline, a healthy (shrink-only) baseline,
+and a working CLI gate. Pure AST — no JAX device needed — so every future
+PR pays this cost in milliseconds."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftlint import (
+    DEFAULT_BASELINE,
+    analyze_tree,
+    apply_baseline,
+    load_baseline,
+)
+
+PACKAGE = os.path.join(REPO, "weaviate_tpu")
+BASELINE = os.path.join(REPO, DEFAULT_BASELINE)
+
+
+def _run():
+    findings = analyze_tree(PACKAGE, root=REPO)
+    return apply_baseline(findings, load_baseline(BASELINE))
+
+
+def test_tree_has_zero_unbaselined_violations():
+    new, _, _ = _run()
+    assert new == [], (
+        "graftlint found violations outside the baseline — fix them or "
+        "suppress inline with a reason (do NOT grow the baseline):\n"
+        + "\n".join(f.render() for f in new))
+
+
+def test_baseline_has_no_stale_entries():
+    # the ratchet: once a baselined finding is fixed, the entry must be
+    # pruned in the same PR (python -m tools.graftlint weaviate_tpu
+    # --prune-baseline), so the baseline can only shrink
+    _, _, stale = _run()
+    assert stale == [], (
+        "stale baseline entries (their findings are fixed) — run "
+        "--prune-baseline: "
+        + json.dumps(stale, indent=2))
+
+
+def test_baseline_entries_all_carry_real_justifications():
+    base = load_baseline(BASELINE)
+    assert base["entries"], "baseline unexpectedly empty (fine, but update this test)"
+    for e in base["entries"]:
+        j = e.get("justification", "")
+        assert j and "TODO" not in j, f"unjustified baseline entry: {e}"
+
+
+def test_cli_gate_is_green_on_the_tree():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "weaviate_tpu",
+         "--strict-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -8,7 +8,6 @@ import numpy as np
 import pytest
 
 from weaviate_tpu.db import DB
-from weaviate_tpu.entities.schema import ClassDef, Property
 from weaviate_tpu.schema import AutoSchema, SchemaManager, SchemaValidationError
 from weaviate_tpu.usecases.objects import BatchManager, NotFoundError, ObjectsManager, ObjectsError
 from weaviate_tpu.usecases.traverser import Explorer, GetParams, Traverser

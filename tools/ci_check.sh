@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Pre-PR gate: graftlint + ruff + tier-1 tests. Run from the repo root:
+#   bash tools/ci_check.sh
+# Exits nonzero on the first failing stage. Documented in README.md.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+fail=0
+
+echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="
+if ! python -m tools.graftlint weaviate_tpu --strict-baseline; then
+    echo "ci_check: graftlint FAILED — fix the findings or suppress inline" \
+         "with a reason; the baseline may only shrink" >&2
+    fail=1
+fi
+
+echo "== ruff (pycodestyle/pyflakes/bugbear subset from pyproject.toml) =="
+if command -v ruff >/dev/null 2>&1; then
+    if ! ruff check weaviate_tpu tools tests; then
+        echo "ci_check: ruff FAILED" >&2
+        fail=1
+    fi
+elif python -c "import ruff" >/dev/null 2>&1; then
+    if ! python -m ruff check weaviate_tpu tools tests; then
+        echo "ci_check: ruff FAILED" >&2
+        fail=1
+    fi
+else
+    echo "ci_check: ruff not installed in this environment — skipping" \
+         "(config lives in pyproject.toml [tool.ruff])"
+fi
+
+echo "== mypy (permissive config from pyproject.toml) =="
+if python -c "import mypy" >/dev/null 2>&1; then
+    if ! python -m mypy weaviate_tpu; then
+        echo "ci_check: mypy FAILED" >&2
+        fail=1
+    fi
+else
+    echo "ci_check: mypy not installed in this environment — skipping" \
+         "(config lives in pyproject.toml [tool.mypy])"
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "ci_check: lint stage failed; not running tests" >&2
+    exit "$fail"
+fi
+
+echo "== tier-1 tests (ROADMAP.md verify command) =="
+t1_log="$(mktemp)"  # per-run log: no clashes between users / concurrent runs
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee "$t1_log"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" | tr -cd . | wc -c)"
+rm -f "$t1_log"
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: tier-1 tests FAILED (rc=$rc)" >&2
+    exit "$rc"
+fi
+
+echo "ci_check: all stages green"

@@ -1,0 +1,17 @@
+"""graftlint: TPU-hot-path static analysis for weaviate_tpu.
+
+Run `python -m tools.graftlint weaviate_tpu` from the repo root. See
+docs/static_analysis.md for the rule catalogue and the baseline policy.
+"""
+
+from tools.graftlint.engine import (  # noqa: F401
+    Finding,
+    analyze_source,
+    analyze_tree,
+    apply_baseline,
+    build_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = "tools/graftlint/baseline.json"

@@ -1,0 +1,109 @@
+"""CLI: python -m tools.graftlint <target> [options].
+
+Exit codes: 0 clean (or every finding baselined), 1 findings outside the
+baseline (or stale baseline entries under --strict-baseline), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftlint import (
+    DEFAULT_BASELINE,
+    analyze_tree,
+    apply_baseline,
+    build_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tools.graftlint.rules import RULE_DOCS
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="TPU-hot-path static analysis (JGL001-JGL006)")
+    ap.add_argument("target", nargs="?",
+                    help="package directory or file to analyze")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(use only when shrinking it)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop stale entries whose findings are fixed")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="stale baseline entries are an error (the ratchet)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULE_DOCS):
+            print(f"{code}  {RULE_DOCS[code]}")
+        return 0
+    if not args.target:
+        ap.print_usage(sys.stderr)
+        print("graftlint: error: a target is required", file=sys.stderr)
+        return 2
+    if not os.path.exists(args.target):
+        print(f"graftlint: error: no such target {args.target!r}",
+              file=sys.stderr)
+        return 2
+
+    findings = analyze_tree(args.target)
+
+    if args.update_baseline:
+        old = load_baseline(args.baseline) if os.path.exists(args.baseline) \
+            else None
+        write_baseline(args.baseline, build_baseline(findings, old))
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}; fill in the justifications")
+        return 0
+
+    waived = 0
+    stale: list[dict] = []
+    if args.no_baseline:
+        new = findings
+    else:
+        baseline = load_baseline(args.baseline)
+        new, waived, stale = apply_baseline(findings, baseline)
+        if args.prune_baseline and stale:
+            live = build_baseline([f for f in findings if f not in new],
+                                  baseline)
+            write_baseline(args.baseline, live)
+            print(f"graftlint: pruned {len(stale)} stale entr(y|ies) from "
+                  f"{args.baseline}")
+            stale = []
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "baselined": waived,
+            "stale_baseline_entries": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"graftlint: STALE baseline entry {e['code']} "
+                  f"{e['path']} [{e['symbol']}] — shrink the baseline "
+                  "(--prune-baseline)")
+        summary = (f"graftlint: {len(new)} finding(s), {waived} baselined, "
+                   f"{len(stale)} stale baseline entr(y|ies)")
+        print(summary, file=sys.stderr)
+
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,197 @@
+"""graftlint engine: file walking, suppression comments, baseline ratchet.
+
+The engine is deliberately JAX-free — it parses source with `ast` only, so
+the tier-1 static-analysis test runs with no device and no heavyweight
+imports. Rule logic lives in rules.py; this module owns everything around
+it: which files to look at, which findings the author explicitly waived on
+the line (`# graftlint: disable=JGL001 <reason>`), and which findings the
+project has accepted wholesale in the baseline file.
+
+Baseline semantics (the ratchet): entries are keyed by
+(code, path, symbol) with a count. A finding group is baselined while its
+found count stays <= the recorded count; any growth surfaces only the
+overflow. Entries whose findings shrank or vanished are reported as STALE —
+the policy is that the baseline may only shrink, so stale entries should be
+pruned (``--prune-baseline``) in the same PR that fixed them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+    r"(?:\s+(?P<reason>\S.*))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    symbol: str        # enclosing qualname ("<module>" at top level)
+    message: str
+
+    def key(self) -> tuple:
+        return (self.code, self.path, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.code} "
+                f"[{self.symbol}] {self.message}")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    codes: frozenset
+    reason: Optional[str]
+    used_codes: set = dataclasses.field(default_factory=set)
+
+
+def parse_suppressions(source: str) -> dict[int, Suppression]:
+    """Line -> suppression. A comment suppresses findings reported on ITS
+    line only (for a multi-line call, that is the line the call starts on).
+    A reason is required: a bare disable is itself reported (JGL000)."""
+    out: dict[int, Suppression] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = SUPPRESS_RE.search(text)
+        if m:
+            codes = frozenset(c.strip() for c in m.group(1).split(","))
+            out[i] = Suppression(i, codes, m.group("reason"))
+    return out
+
+
+def analyze_source(source: str, rel_path: str) -> list[Finding]:
+    """All findings for one file, with line suppressions applied. Reasonless
+    or unused suppression comments are themselves findings (JGL000) so a
+    stale waiver cannot silently linger."""
+    from tools.graftlint import rules
+
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("JGL999", rel_path, e.lineno or 1, 0, "<module>",
+                        f"file does not parse: {e.msg}")]
+    raw = rules.run_rules(tree, source, rel_path)
+    sup = parse_suppressions(source)
+    kept: list[Finding] = []
+    for f in raw:
+        s = sup.get(f.line)
+        if s is not None and f.code in s.codes:
+            s.used_codes.add(f.code)
+            continue
+        kept.append(f)
+    for s in sup.values():
+        dead = sorted(s.codes - s.used_codes)  # per code, so one live code
+        if not s.reason:                       # can't shelter a stale one
+            kept.append(Finding(
+                "JGL000", rel_path, s.line, 0, "<module>",
+                "suppression without a reason — write "
+                "`# graftlint: disable=CODE why this is intentional`"))
+        elif dead:
+            kept.append(Finding(
+                "JGL000", rel_path, s.line, 0, "<module>",
+                f"unused suppression for {', '.join(dead)} — "
+                "the finding is gone; delete the code from the comment"))
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return kept
+
+
+def iter_python_files(target: str, root: str) -> Iterable[tuple[str, str]]:
+    """Yield (abs_path, rel_path) for every .py under `target` (a package
+    directory or a single file), rel to `root`, skipping generated code."""
+    if os.path.isfile(target):
+        if not target.endswith("_pb2.py"):  # generated code is skipped in
+            yield target, os.path.relpath(  # both walk modes
+                target, root).replace(os.sep, "/")
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py") or fn.endswith("_pb2.py"):
+                continue  # protobuf output is generated, not authored
+            p = os.path.join(dirpath, fn)
+            yield p, os.path.relpath(p, root).replace(os.sep, "/")
+
+
+def analyze_tree(target: str, root: Optional[str] = None) -> list[Finding]:
+    root = root or os.getcwd()
+    findings: list[Finding] = []
+    for abs_path, rel_path in iter_python_files(target, root):
+        with open(abs_path, encoding="utf-8") as f:
+            findings.extend(analyze_source(f.read(), rel_path))
+    return findings
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"version": 1, "entries": []}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data.get("entries"), list):
+        raise ValueError(f"{path}: baseline must hold an 'entries' list")
+    return data
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict
+) -> tuple[list[Finding], int, list[dict]]:
+    """-> (unbaselined findings, number waived, stale baseline entries)."""
+    budget: dict[tuple, dict] = {}
+    for e in baseline.get("entries", []):
+        budget[(e["code"], e["path"], e["symbol"])] = {
+            "left": int(e.get("count", 1)), "entry": e, "hit": 0}
+    new: list[Finding] = []
+    waived = 0
+    for f in findings:
+        b = budget.get(f.key())
+        if b is not None and b["left"] > 0:
+            b["left"] -= 1
+            b["hit"] += 1
+            waived += 1
+        else:
+            new.append(f)
+    stale = [b["entry"] for b in budget.values()
+             if b["hit"] < int(b["entry"].get("count", 1))]
+    return new, waived, stale
+
+
+def build_baseline(findings: list[Finding], old: Optional[dict] = None) -> dict:
+    """Group findings into baseline entries, carrying forward any
+    justifications already recorded for the same key."""
+    just = {}
+    if old:
+        for e in old.get("entries", []):
+            if e.get("justification"):
+                just[(e["code"], e["path"], e["symbol"])] = e["justification"]
+    counts: dict[tuple, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {"code": c, "path": p, "symbol": s, "count": n,
+         "justification": just.get((c, p, s), "TODO: justify or fix")}
+        for (c, p, s), n in sorted(counts.items())
+    ]
+    return {
+        "version": 1,
+        "policy": "the baseline may only shrink — never add entries to "
+                  "admit new violations; fix them or suppress inline with "
+                  "a reason",
+        "entries": entries,
+    }
+
+
+def write_baseline(path: str, baseline: dict) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(baseline, f, indent=2, sort_keys=False)
+        f.write("\n")

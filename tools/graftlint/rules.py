@@ -1,0 +1,504 @@
+"""graftlint rules: the six project-specific TPU-hot-path checks.
+
+Every rule has a code, a one-line fix-it in its message, and a scope:
+
+  JGL001  implicit device->host sync inside a hot module
+  JGL002  jit-cache churn (jit in a function body, lambda targets,
+          unhashable static specs)
+  JGL003  tracer leak (traced values stored on self / globals from inside
+          a jitted function)
+  JGL004  silent fallback (broad except on a device-dispatch path with no
+          log/metric and no re-raise)
+  JGL005  module-level mutable state mutated without a lock
+  JGL006  dtype drift (float64 spellings in kernel-adjacent code)
+
+Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
+compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
+JGL001/JGL004/JGL006; JGL002/JGL003/JGL005 apply package-wide. JGL001
+additionally skips boundary functions whose JOB is host materialization —
+that allowlist lives here, in one place, so reviewers see every waiver.
+
+The analysis is intentionally type-free (pure ast): device residency is
+tracked with a small per-function dataflow over names assigned from jnp.*
+calls, jax.device_put, module-level jitted functions, and the known device
+attributes of the index classes. That catches the real regressions (a new
+`.item()` or `np.asarray(self._store...)` on the serving path) without a
+type checker; what it over-reports lands in the baseline with a written
+justification, which is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.graftlint.engine import Finding
+
+# -- scope configuration -----------------------------------------------------
+
+HOT_PREFIXES = (
+    "weaviate_tpu/ops/",
+    "weaviate_tpu/parallel/mesh_search.py",
+    "weaviate_tpu/index/tpu.py",
+    "weaviate_tpu/index/mesh.py",
+    "weaviate_tpu/compress/pq.py",
+    "weaviate_tpu/inverted/bm25_device.py",
+)
+
+# (path, qualname) pairs whose JOB is crossing the device->host boundary:
+# JGL001 stays silent inside them. Keep this list tiny and obvious.
+JGL001_BOUNDARY = {
+    ("weaviate_tpu/index/tpu.py", "_unpack"),
+    ("weaviate_tpu/ops/topk.py", "unpack_topk"),
+    ("weaviate_tpu/ops/bm25_scan.py", "unpack_topk"),
+}
+
+# instance attributes that hold device arrays in the index/engine classes;
+# reading them into float()/np.asarray() is a sync
+DEVICE_ATTRS = frozenset({
+    "_store", "_codes", "_tombs", "_sq_norms", "_recon_norms",
+    "_rescore_dev", "_rescore_sq_norms", "_shards", "_masks", "_rows",
+})
+
+MUTATING_METHODS = frozenset({
+    "append", "add", "update", "pop", "popitem", "clear", "setdefault",
+    "extend", "remove", "insert", "move_to_end", "discard",
+})
+
+RULE_DOCS = {
+    "JGL000": "suppression hygiene: every inline disable needs a reason and "
+              "must still match a finding",
+    "JGL001": "implicit device->host sync in a hot module — batch the "
+              "fetch at the boundary instead",
+    "JGL002": "jit-cache churn — hoist jax.jit to module scope / cache the "
+              "compiled callable; never jit a lambda or pass an unhashable "
+              "static spec",
+    "JGL003": "tracer leak — a traced value stored on self/globals escapes "
+              "the trace; return it instead",
+    "JGL004": "silent fallback — a broad except on a device-dispatch path "
+              "must log (rate-limited) and count a fallback metric, or "
+              "re-raise",
+    "JGL005": "module-level mutable state mutated without holding a lock — "
+              "serving threads share module globals",
+    "JGL006": "dtype drift — float64 in kernel-adjacent code silently "
+              "doubles bandwidth and falls off the MXU fast path",
+    "JGL999": "file does not parse",
+}
+
+
+def is_hot(rel_path: str) -> bool:
+    """Hot-module check; prefixes also match at an interior path boundary so
+    a checkout analyzed from outside the repo root still scopes correctly."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in HOT_PREFIXES)
+
+
+# -- small AST helpers -------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit, or functools.partial(jax.jit, ...) around it."""
+    d = dotted(node)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        f = dotted(node.func)
+        if f in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+        _is_jit_expr(d) for d in fn.decorator_list)
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    return node.value if isinstance(node, ast.Constant) and isinstance(
+        node.value, str) else None
+
+
+# -- module-level pre-pass ---------------------------------------------------
+
+class ModuleIndex:
+    """Facts the rules need before walking function bodies: names of
+    module-level jitted callables (JGL001 dataflow), module-level mutable
+    registries and locks (JGL005)."""
+
+    def __init__(self, tree: ast.Module):
+        self.jitted_fns: set[str] = set()
+        self.registries: dict[str, int] = {}   # name -> def line
+        self.locks: set[str] = set()
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _jit_decorated(node):
+                    self.jitted_fns.add(node.name)
+                continue
+            targets: list[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            if self._is_mutable_literal(value):
+                for n in names:
+                    if n != "__all__":
+                        self.registries[n] = node.lineno
+            elif _is_jit_expr(value):
+                self.jitted_fns.update(names)
+            if isinstance(value, ast.Call) and (dotted(value.func) or "") in (
+                    "threading.Lock", "threading.RLock", "Lock", "RLock"):
+                self.locks.update(names)
+
+    @staticmethod
+    def _is_mutable_literal(value: ast.expr) -> bool:
+        if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            return True
+        if isinstance(value, ast.Call):
+            f = dotted(value.func) or ""
+            return f.split(".")[-1] in (
+                "dict", "list", "set", "OrderedDict", "defaultdict", "deque")
+        return False
+
+
+# -- the walker --------------------------------------------------------------
+
+class RuleWalker(ast.NodeVisitor):
+    def __init__(self, rel_path: str, mod: ModuleIndex):
+        self.rel = rel_path
+        self.hot = is_hot(rel_path)
+        self.mod = mod
+        self.findings: list[Finding] = []
+        self.scope: list[str] = []            # qualname stack
+        self.fn_depth = 0
+        self.loop_depth = 0
+        self.jit_depth = 0                    # inside a jit-decorated fn
+        self.with_locks = 0                   # enclosing `with <lock>:` blocks
+        self.device_vars: list[set[str]] = []  # per-function device names
+        self.global_names: list[set[str]] = []
+
+    # -- plumbing --
+
+    def qualname(self) -> str:
+        return ".".join(self.scope) or "<module>"
+
+    def emit(self, code: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            code, self.rel, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), self.qualname(), message))
+
+    def _track_device(self, name: str) -> None:
+        if self.device_vars:
+            self.device_vars[-1].add(name)
+
+    def _is_device_value(self, node: ast.AST) -> bool:
+        """Heuristic: does this expression hold a device array?"""
+        if isinstance(node, ast.Subscript):
+            return self._is_device_value(node.value)
+        if isinstance(node, ast.Name):
+            return bool(self.device_vars) and node.id in self.device_vars[-1]
+        if isinstance(node, ast.Attribute):
+            return node.attr in DEVICE_ATTRS
+        if isinstance(node, ast.Call):
+            f = dotted(node.func) or ""
+            if f.startswith(("jnp.", "jax.lax.", "jax.numpy.")):
+                return True
+            if f in ("jax.device_put",):
+                return True
+            root = f.split(".")[0]
+            return f in self.mod.jitted_fns or root in self.mod.jitted_fns
+        return False
+
+    # -- scope visitors --
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def _visit_fn(self, node) -> None:
+        # decorators and default values evaluate in the ENCLOSING scope at
+        # def time — visit them before entering the function, so a
+        # module-level `@functools.partial(jax.jit, ...)` is not mistaken
+        # for a per-call jit (while a nested function's jit decorator still
+        # correctly reads as inside the outer body)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            self.visit(default)
+        self.scope.append(node.name)
+        self.fn_depth += 1
+        jitted = _jit_decorated(node)
+        if jitted:
+            self.jit_depth += 1
+        self.device_vars.append(set())
+        self.global_names.append(set())
+        outer_loops, self.loop_depth = self.loop_depth, 0
+        for stmt in node.body:  # decorators/defaults already visited above
+            self.visit(stmt)
+        self.loop_depth = outer_loops
+        self.global_names.pop()
+        self.device_vars.pop()
+        if jitted:
+            self.jit_depth -= 1
+        self.fn_depth -= 1
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Global(self, node: ast.Global) -> None:
+        if self.global_names:
+            self.global_names[-1].update(node.names)
+
+    def _visit_loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(self._looks_like_lock(item.context_expr)
+                     for item in node.items)
+        if locked:
+            self.with_locks += 1
+        self.generic_visit(node)
+        if locked:
+            self.with_locks -= 1
+
+    def _looks_like_lock(self, expr: ast.expr) -> bool:
+        d = dotted(expr) or ""
+        last = d.split(".")[-1].lower()
+        return d.split(".")[-1] in self.mod.locks or "lock" in last \
+            or "mutex" in last
+
+    # -- JGL001 / JGL002 / JGL006 on calls --
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_sync(node)
+        self._check_jit_churn(node)
+        self._check_mutation_call(node)
+        self.generic_visit(node)
+
+    def _check_sync(self, node: ast.Call) -> None:
+        if not self.hot or (self.rel, self.qualname()) in JGL001_BOUNDARY:
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args:
+                self.emit("JGL001", node,
+                          "`.item()` forces a device->host sync per element; "
+                          "fetch the whole batch once at the boundary")
+                return
+            if f.attr == "block_until_ready":
+                self.emit("JGL001", node,
+                          "`block_until_ready()` stalls the dispatch "
+                          "pipeline; only benchmarks may block")
+                return
+        fd = dotted(f) or ""
+        arg = node.args[0] if node.args else None
+        if fd in ("np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "jax.device_get"):
+            if arg is not None and self._is_device_value(arg):
+                self.emit("JGL001", node,
+                          f"`{fd}(...)` on a device value is a blocking "
+                          "transfer; keep the data on device or batch the "
+                          "fetch at the boundary")
+        elif fd in ("float", "int", "bool") and arg is not None \
+                and self._is_device_value(arg):
+            self.emit("JGL001", node,
+                      f"`{fd}()` on a device value syncs one scalar per "
+                      "call; fetch arrays once and convert host-side")
+
+    def _check_jit_churn(self, node: ast.Call) -> None:
+        fd = dotted(node.func)
+        is_partial_jit = (
+            fd in ("functools.partial", "partial") and node.args
+            and _is_jit_expr(node.args[0]))
+        if fd not in ("jax.jit", "jit") and not is_partial_jit:
+            return
+        jit_call = node
+        if self.fn_depth > 0:
+            where = "a loop body" if self.loop_depth else "a function body"
+            self.emit("JGL002", node,
+                      f"jax.jit invoked inside {where} builds a fresh cache "
+                      "entry per call path; hoist the jitted callable to "
+                      "module scope (or cache it once)")
+        for a in jit_call.args:
+            if isinstance(a, ast.Lambda):
+                self.emit("JGL002", a,
+                          "jitting a lambda gives every call site a distinct "
+                          "function identity (zero cache hits); def a named "
+                          "function at module scope")
+        for kw in jit_call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames") and isinstance(
+                    kw.value, (ast.List, ast.Set, ast.Dict)):
+                self.emit("JGL002", kw.value,
+                          f"{kw.arg} given a mutable literal is unhashable "
+                          "under cache lookup; use a tuple")
+
+    # -- JGL003: tracer leak --
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.jit_depth:
+            for t in node.targets:
+                self._check_leak_target(t)
+        self._check_registry_mutation_target(node)
+        self._track_assign(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.jit_depth:
+            self._check_leak_target(node.target)
+        self._check_registry_mutation_target(node)
+        self.generic_visit(node)
+
+    def _check_leak_target(self, t: ast.expr) -> None:
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            self.emit("JGL003", t,
+                      f"storing to `self.{t.attr}` inside a jitted function "
+                      "leaks a tracer (and re-runs only while tracing); "
+                      "return the value instead")
+        elif isinstance(t, ast.Name) and self.global_names \
+                and t.id in self.global_names[-1]:
+            self.emit("JGL003", t,
+                      f"assigning global `{t.id}` inside a jitted function "
+                      "leaks a tracer; return the value instead")
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._check_leak_target(e)
+
+    def _track_assign(self, node: ast.Assign) -> None:
+        if not self.device_vars:
+            return
+        if self._is_device_value(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._track_device(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            self._track_device(e.id)
+
+    # -- JGL004: silent fallback --
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self.hot and self._broad(node.type) and self.fn_depth > 0:
+            if not self._handler_is_honest(node):
+                self.emit(
+                    "JGL004", node,
+                    "broad `except` degrades to a host fallback with no "
+                    "trace: log once (rate-limited) and count a fallback "
+                    "metric — see monitoring.metrics.record_device_fallback")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _broad(t: Optional[ast.expr]) -> bool:
+        return t is None or dotted(t) in ("Exception", "BaseException")
+
+    def _handler_is_honest(self, node: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call):
+                # the last attribute alone, so chained receivers like
+                # logging.getLogger(__name__).warning(...) still count
+                if isinstance(sub.func, ast.Attribute):
+                    last = sub.func.attr
+                else:
+                    last = (dotted(sub.func) or "").split(".")[-1]
+                if last in ("warning", "error", "exception", "critical",
+                            "log", "inc", "observe", "record_device_fallback",
+                            "count_exception", "fail"):
+                    return True
+        return False
+
+    # -- JGL005: unlocked registry mutation --
+
+    def _check_registry_mutation_target(self, node) -> None:
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in self.mod.registries \
+                    and base is not t:
+                self._emit_registry(node, base.id, "item assignment")
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in self.mod.registries \
+                    and base is not t:
+                self._emit_registry(node, base.id, "del")
+        self.generic_visit(node)
+
+    def _check_mutation_call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.mod.registries:
+            self._emit_registry(node, f.value.id, f".{f.attr}()")
+
+    def _emit_registry(self, node, name: str, how: str) -> None:
+        # mutation at import time (module scope) is serialized by the import
+        # lock; only function bodies race
+        if self.fn_depth == 0 or self.with_locks > 0:
+            return
+        self.emit("JGL005", node,
+                  f"module-level `{name}` mutated ({how}) without holding a "
+                  "lock; serving threads share this object — wrap the "
+                  "mutation in `with <module lock>:`")
+
+    # -- JGL006: dtype drift --
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.hot:
+            d = dotted(node)
+            if d in ("np.float64", "numpy.float64", "jnp.float64",
+                     "np.double", "numpy.double"):
+                self.emit("JGL006", node,
+                          f"`{d}` in kernel-adjacent code: TPUs have no f64 "
+                          "units — use float32 (or keep f64 strictly "
+                          "host-side and cast before upload)")
+        self.generic_visit(node)
+
+    def visit_keyword(self, node: ast.keyword) -> None:
+        if self.hot and node.arg in ("dtype",) \
+                and _const_str(node.value) in ("float64", "double"):
+            self.emit("JGL006", node.value,
+                      "dtype=\"float64\" in kernel-adjacent code: use "
+                      "float32 on the device path")
+        self.generic_visit(node)
+
+
+def run_rules(tree: ast.Module, source: str, rel_path: str) -> list[Finding]:
+    mod = ModuleIndex(tree)
+    walker = RuleWalker(rel_path, mod)
+    walker.visit(tree)
+    return walker.findings

@@ -15,7 +15,6 @@ Stages at the headline shape (N=1M, B=16384, D=128):
 Usage: python tools/profile_gmin3.py [N] [B] [ITERS]
 """
 
-import functools
 import sys
 import time
 

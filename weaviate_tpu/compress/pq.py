@@ -25,7 +25,6 @@ path is one MXU matmul. Enable it when a shard outgrows HBM.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax

@@ -27,7 +27,7 @@ import json
 import struct
 import time
 import uuid as uuidlib
-from typing import Iterable, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

@@ -17,7 +17,9 @@ Index types:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, asdict
+import threading
+
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 
@@ -256,10 +258,20 @@ def validate_config_update(old: HnswUserConfig, new: HnswUserConfig) -> None:
 
 
 _PARSERS: dict[str, Callable[[Optional[dict]], HnswUserConfig]] = {}
+# modules register index types at import AND at runtime (plugin reload),
+# while serving threads resolve configs concurrently — mutation takes the
+# lock; lookups ride the GIL-atomic dict read
+_parsers_lock = threading.Lock()
 
 
 def register_index_type(name: str, parser: Callable[[Optional[dict]], HnswUserConfig]) -> None:
-    _PARSERS[name] = parser
+    with _parsers_lock:
+        _PARSERS[name] = parser
+
+
+def registered_index_types() -> list[str]:
+    with _parsers_lock:
+        return sorted(_PARSERS)
 
 
 def parse_and_validate_config(index_type: str, cfg: Optional[dict]) -> HnswUserConfig:

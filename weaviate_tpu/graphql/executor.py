@@ -10,7 +10,6 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-import numpy as np
 
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.graphql.parser import (

@@ -46,6 +46,7 @@ import numpy as np
 from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.interface import AllowList, VectorIndex
 from weaviate_tpu.index.tpu import VectorLog, _bucket_b, _bucket_rows
+from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.parallel.mesh_search import (
     _MESH_SCAN_CHUNK,
     make_mesh,
@@ -56,7 +57,6 @@ from weaviate_tpu.parallel.mesh_search import (
     mesh_search_pq_step,
     mesh_search_step,
     mesh_write_rows_step,
-    replicated,
     shard_spec,
 )
 
@@ -70,6 +70,13 @@ def _pow2_at_least(n: int, floor: int) -> int:
     while c < n:
         c *= 2
     return c
+
+
+@jax.jit
+def _downcast_bf16(store):
+    """One cached compilation for the compress-time store downcast; the
+    output keeps the input's mesh sharding."""
+    return store.astype(jnp.bfloat16)
 
 
 class MeshVectorIndex(VectorIndex):
@@ -492,10 +499,10 @@ class MeshVectorIndex(VectorIndex):
         self._host_vecs = np.array(host, dtype=np.float32)
         if self.dtype == jnp.float32:
             self.dtype = jnp.bfloat16
-            self._store = jax.jit(
-                lambda s: s.astype(jnp.bfloat16),
-                out_shardings=shard_spec(self.mesh, None),
-            )(self._store)
+            # module-level jitted downcast (sharding propagates from the
+            # input); re-jitting a lambda here would compile per call
+            self._store = jax.device_put(
+                _downcast_bf16(self._store), shard_spec(self.mesh, None))
         self.compressed = True
         if save and self._pq_path:
             pq.save(self._pq_path)
@@ -686,7 +693,10 @@ class MeshVectorIndex(VectorIndex):
         kernel execution — so tests can assert eligibility directly."""
         from weaviate_tpu.ops import gmin_scan
 
-        if self._gmin_broken or getattr(self.config, "exact_topk", False):
+        if getattr(self.config, "exact_topk", False):
+            return None  # config opt-out, not degradation
+        if self._gmin_broken:
+            record_device_fallback("index.mesh.gmin", "degraded", log=False)
             return None
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return None
@@ -715,7 +725,8 @@ class MeshVectorIndex(VectorIndex):
         active_g = max(1, -(-int(self._counts.max()) // ncols_l)) if ncols_l else 1
         rg = pq_gmin.eligible_rg(
             self._pqg_state, getattr(self.config, "exact_topk", False),
-            self.metric, self._pq, q.shape[0], ncols_l, kk, self.dim, active_g)
+            self.metric, self._pq, q.shape[0], ncols_l, kk, self.dim, active_g,
+            component="index.mesh.pq_gmin")
         if rg is None:
             return None
         m, c = self._pq.segments, self._pq.centroids
@@ -742,7 +753,7 @@ class MeshVectorIndex(VectorIndex):
                 interpret,
                 self.mesh,
             ),
-            "mesh pq codes kernel")
+            "mesh pq codes kernel", component="index.mesh.pq_gmin")
         return None if packed is None else np.asarray(packed)
 
     def _gmin_step_or_none(self, q: np.ndarray, kk: int, words, use_allow):
@@ -780,7 +791,7 @@ class MeshVectorIndex(VectorIndex):
                 interpret,
                 self.mesh,
             ),
-            "mesh gmin kernel")
+            "mesh gmin kernel", component="index.mesh.gmin")
         return None if packed is None else np.asarray(packed)
 
     def search_by_vector(

@@ -48,7 +48,8 @@ import numpy as np
 
 from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.interface import AllowList, VectorIndex
-from weaviate_tpu.ops.distances import DISTANCE_FNS, normalize_rows
+from weaviate_tpu.monitoring.metrics import record_device_fallback
+from weaviate_tpu.ops.distances import DISTANCE_FNS
 from weaviate_tpu.ops.topk import bitmap_to_mask, merge_top_k
 
 _CHUNK = 8192          # rows staged per device write (fixed => no recompiles)
@@ -946,10 +947,15 @@ class TpuVectorIndex(VectorIndex):
                         # a pq.npz this build cannot use — rejected config
                         # (hamming), corrupt zip, missing key, dim mismatch —
                         # must not make the shard unloadable: serve
-                        # uncompressed with a warning
+                        # uncompressed with a warning AND a fallback count
+                        # (a fleet of shards quietly serving uncompressed is
+                        # a capacity incident, not a log line)
                         import logging
 
                         self.config.pq.enabled = False
+                        record_device_fallback(
+                            "index.tpu.restore", "pq_codebook_rejected", e,
+                            log=False)
                         logging.getLogger(__name__).warning(
                             "persisted pq codebook rejected (%s: %s); "
                             "serving uncompressed", type(e).__name__, e)
@@ -1351,7 +1357,10 @@ class TpuVectorIndex(VectorIndex):
         return rg if rg >= k else 0
 
     def _use_gmin(self, b: int, k: int) -> bool:
-        if self._gmin_broken or getattr(self.config, "exact_topk", False):
+        if getattr(self.config, "exact_topk", False):
+            return False  # config opt-out, not degradation
+        if self._gmin_broken:
+            record_device_fallback("index.tpu.gmin", "degraded", log=False)
             return False
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return False
@@ -1431,7 +1440,7 @@ class TpuVectorIndex(VectorIndex):
         return gmin_scan.guarded_kernel_call(
             self, key,
             lambda: self._search_full_gmin(q, kk, allow_words, store, sq_norms),
-            "fused gmin kernel")
+            "fused gmin kernel", component="index.tpu.gmin")
 
     def _pq_gmin_packed_or_none(self, q: np.ndarray, b: int, k: int,
                                 allow_list):
@@ -1446,7 +1455,8 @@ class TpuVectorIndex(VectorIndex):
         active_g = max(1, -(-self.n // ncols))
         rg = pq_gmin.eligible_rg(
             self._pqg_state, getattr(self.config, "exact_topk", False),
-            self.metric, self._pq, q.shape[0], ncols, kk, self.dim, active_g)
+            self.metric, self._pq, q.shape[0], ncols, kk, self.dim, active_g,
+            component="index.tpu.pq_gmin")
         if rg is None:
             return None
         m, c = self._pq.segments, self._pq.centroids
@@ -1476,7 +1486,7 @@ class TpuVectorIndex(VectorIndex):
                 self._pq.rotation_dev(),
                 self._gen_blocks(self._codes, pq_gmin.build_codes_blocks),
             ),
-            "fused pq codes kernel")
+            "fused pq codes kernel", component="index.tpu.pq_gmin")
 
     def _rescore_r(self, k: int) -> int:
         """Fast-scan candidate depth: 0 disables (exactTopK config or
@@ -1564,7 +1574,7 @@ class TpuVectorIndex(VectorIndex):
             packed = np.asarray(packed)
         else:
             sq = self._sq_norms if sq_norms is None else sq_norms
-            packed = np.asarray(
+            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch per search dispatch (results packed [B,2k] so it is a single transfer)
                 _search_full(
                     self._store if store is None else store,
                     sq if self.metric == vi.DISTANCE_L2 else None,
@@ -1637,7 +1647,7 @@ class TpuVectorIndex(VectorIndex):
         words = (allow_words if allow_words is not None
                  else jnp.zeros((self.capacity // 32,), jnp.uint32))
         if self.metric in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
-            packed = np.asarray(
+            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch per PQ search dispatch
                 _search_pq_recon(
                     self._codes,
                     self._recon_norms,
@@ -1663,7 +1673,7 @@ class TpuVectorIndex(VectorIndex):
             ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
             return ids[:, :k], top[:, :k]
         lut = build_lut(jnp.asarray(q), self._pq._dev_codebook(), self.metric)
-        packed = np.asarray(
+        packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch of the LUT-scan dispatch
             _search_pq(
                 self._codes,
                 self._tombs,
@@ -1713,11 +1723,11 @@ class TpuVectorIndex(VectorIndex):
             # float rows live host-side under PQ: upload the gathered block
             sub = np.zeros((r, self.dim), np.float32)
             sub[: slots.size] = self._host_vecs[slots]
-            packed = np.asarray(
+            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch of the gather-path dispatch
                 _score_rows(jnp.asarray(sub), jnp.asarray(q), jnp.asarray(row_valid), kk, self.metric)
             )
         else:
-            packed = np.asarray(
+            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch of the gather-path dispatch
                 _search_gathered(
                     self._store, jnp.asarray(q), jnp.asarray(rows), jnp.asarray(row_valid), kk, self.metric
                 )

@@ -31,6 +31,7 @@ import numpy as np
 
 from weaviate_tpu.index.interface import AllowList
 from weaviate_tpu.inverted.bm25 import BM25Searcher
+from weaviate_tpu.monitoring.metrics import record_device_fallback
 
 # below this many total postings the host engine wins: one relay round
 # trip costs more than scoring a handful of arrays in numpy
@@ -251,7 +252,11 @@ class DeviceBM25:
         try:
             jax, bm25_scan = self._backend()
             import jax.numpy as jnp  # noqa: PLC0415
-        except Exception:
+        except Exception as e:
+            # a dead backend silently serving every keyword query at host
+            # speed is the bench.py zipf regression all over again — count
+            # it and log (rate-limited) before degrading
+            record_device_fallback("bm25_device.search", "backend_init", e)
             return s.search(query, limit, properties=properties,
                             allow_list=allow_list)
 
@@ -293,7 +298,10 @@ class DeviceBM25:
         try:
             jax, bm25_scan = self._backend()
             import jax.numpy as jnp  # noqa: PLC0415
-        except Exception:
+        except Exception as e:
+            record_device_fallback("bm25_device.search_batch", "backend_init",
+                                   e, note="batch lane falls back to "
+                                   "per-query host scoring")
             return None
         s = self.searcher
         props = s._searchable_props(properties)

@@ -14,7 +14,6 @@ from __future__ import annotations
 import os
 import struct
 from collections import Counter as PyCounter
-from typing import Optional
 
 from weaviate_tpu.entities.schema import ClassDef, DataType
 from weaviate_tpu.inverted.analyzer import Analyzer

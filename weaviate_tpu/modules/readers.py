@@ -18,7 +18,7 @@ result's _additional map.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 from weaviate_tpu.modules.interface import (
     AdditionalProperties,

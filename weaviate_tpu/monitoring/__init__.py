@@ -1,3 +1,8 @@
-from weaviate_tpu.monitoring.metrics import Metrics, get_metrics, noop_metrics
+from weaviate_tpu.monitoring.metrics import (
+    Metrics,
+    get_metrics,
+    noop_metrics,
+    record_device_fallback,
+)
 
-__all__ = ["Metrics", "get_metrics", "noop_metrics"]
+__all__ = ["Metrics", "get_metrics", "noop_metrics", "record_device_fallback"]

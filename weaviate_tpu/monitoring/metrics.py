@@ -15,7 +15,9 @@ layer mounts it on PROMETHEUS_MONITORING_PORT like configure_api.go:116-121.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Optional
 
 from prometheus_client import (
@@ -144,6 +146,15 @@ class Metrics:
             "weaviate_replication_operations_total", "replication coordinator ops",
             ("operation", "status"))
 
+        # device-dispatch degradation (graftlint JGL004): every path that
+        # silently falls back from the TPU to a host engine counts here, so
+        # a fleet serving at CPU speed is visible on a dashboard instead of
+        # only in a benchmark regression
+        self.device_fallbacks = c(
+            "weaviate_device_fallback_total",
+            "device dispatches that degraded to a host fallback",
+            ("component", "reason"))
+
     def expose(self) -> bytes:
         """Text exposition (the /metrics handler body)."""
         return generate_latest(self.registry)
@@ -165,3 +176,44 @@ def get_metrics() -> Metrics:
 def noop_metrics() -> Metrics:
     """Fresh isolated registry (tests / embedded use)."""
     return Metrics(CollectorRegistry())
+
+
+# -- device-fallback observability (graftlint JGL004) -------------------------
+
+FALLBACK_LOG_INTERVAL_S = 60.0
+
+_fallback_log_lock = threading.Lock()
+_fallback_last_log: dict[tuple[str, str], float] = {}
+
+
+def record_device_fallback(
+    component: str,
+    reason: str,
+    exc: Optional[BaseException] = None,
+    *,
+    note: str = "",
+    log: bool = True,
+    interval: float = FALLBACK_LOG_INTERVAL_S,
+) -> bool:
+    """Make host degradation observable: ALWAYS increment the fallback
+    counter, and log at most once per (component, reason) per `interval`
+    seconds so a hot loop that falls back per request cannot flood the log.
+    Callers that already emit a richer one-shot message pass log=False and
+    still get counted. -> True when a log line was emitted."""
+    get_metrics().device_fallbacks.labels(
+        component=component, reason=reason).inc()
+    if not log:
+        return False
+    now = time.monotonic()
+    with _fallback_log_lock:
+        last = _fallback_last_log.get((component, reason))
+        if last is not None and now - last < interval:
+            return False
+        _fallback_last_log[(component, reason)] = now
+    detail = f" ({type(exc).__name__}: {exc})" if exc is not None else ""
+    logging.getLogger("weaviate_tpu.monitoring.fallback").warning(
+        "device dispatch degraded to host fallback: component=%s reason=%s%s%s"
+        " — further occurrences are counted in weaviate_device_fallback_total"
+        " and logged at most every %.0fs",
+        component, reason, detail, f" [{note}]" if note else "", interval)
+    return True

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from weaviate_tpu.monitoring.metrics import record_device_fallback
+
 G = 16          # group size (min columns per selected group)
 _SCG = 512      # group-columns per grid step (VMEM upper bound; see plan_tiles)
 _QB = 512       # query rows per grid step (upper bound)
@@ -105,7 +107,8 @@ class KernelState:
         self._gmin_broken = False
 
 
-def guarded_kernel_call(index, key, thunk, kernel_desc: str):
+def guarded_kernel_call(index, key, thunk, kernel_desc: str,
+                        component: str = "ops.gmin_scan"):
     """Per-compiled-shape validation state machine, shared by the
     single-chip and mesh indexes so their fallback behavior cannot diverge.
 
@@ -121,6 +124,10 @@ def guarded_kernel_call(index, key, thunk, kernel_desc: str):
     import numpy as np
 
     if key in index._gmin_shape_broken:
+        # count EVERY degraded dispatch, not just the first rejection — a
+        # steady weaviate_device_fallback_total rate is what makes an index
+        # quietly serving on the slow kernel dashboard-visible
+        record_device_fallback(component, "degraded", log=False)
         return None
     try:
         out = thunk()
@@ -131,6 +138,9 @@ def guarded_kernel_call(index, key, thunk, kernel_desc: str):
             raise
         import logging
 
+        # the per-shape warnings below are already one-shot; the counter is
+        # what makes a fleet-wide Mosaic regression visible on a dashboard
+        record_device_fallback(component, "mosaic_reject", e, log=False)
         index._gmin_shape_broken.add(key)
         if not index._gmin_validated and len(index._gmin_shape_broken) >= 3:
             index._gmin_broken = True

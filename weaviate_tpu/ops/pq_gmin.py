@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.ops.gmin_scan import G, _VMEM_BUDGET, mosaic_g
 
 _MSEG = 8     # segments reconstructed per one-hot matmul chunk
@@ -80,12 +81,16 @@ _MATMUL_METRICS = ("l2-squared", "dot", "cosine")
 
 
 def eligible_rg(state, exact_topk: bool, metric: str, pq, b: int, ncols: int,
-                kk: int, dim: int, active_g: int):
+                kk: int, dim: int, active_g: int,
+                component: str = "ops.pq_gmin"):
     """Shared eligibility gate for the fused codes kernel -> rg (kept
     groups) when this shape may serve, else None. ONE copy for the
     single-chip and mesh dispatches so their gating cannot diverge (the
     same contract KernelState enforces for fallback state)."""
-    if state._gmin_broken or exact_topk:
+    if exact_topk:
+        return None  # config opt-out, not degradation
+    if state._gmin_broken:
+        record_device_fallback(component, "degraded", log=False)
         return None
     if metric not in _MATMUL_METRICS:
         return None

@@ -74,6 +74,15 @@ def _tsne_program(n: int, dims: int, iterations: int, learning_rate: float):
             g = 4.0 * jnp.sum(((pe - q) * q_num)[:, :, None] * diff, axis=1)
             mom = jnp.where(i < exaggeration_until, 0.5, 0.8)
             vel = mom * vel - learning_rate * g
+            # trust region: cap each point's step at a fraction of the
+            # current embedding spread. Small result sets have P entries of
+            # O(1) (vs O(1/n) at scale), so the exaggerated attraction is an
+            # unstable oscillator at any fixed learning rate — uncapped, one
+            # overshoot flings cluster mates to opposite ends and the
+            # post-exaggeration forces are too weak to recover.
+            spread = jnp.sqrt(jnp.max(jnp.sum(y ** 2, axis=-1))) + 1e-8
+            vnorm = jnp.sqrt(jnp.sum(vel ** 2, axis=-1, keepdims=True))
+            vel = vel * jnp.minimum(1.0, 0.25 * spread / jnp.maximum(vnorm, 1e-30))
             y = y + vel
             return y - jnp.mean(y, axis=0, keepdims=True), vel
 
@@ -94,8 +103,10 @@ def tsne_project(
 ) -> np.ndarray:
     """Project [n, d] float vectors to [n, dims] with exact t-SNE.
 
-    perplexity <= 0 selects the reference's auto rule: min(5, n-1)
-    (projector.go defaultPerplexity-style guard for small result sets).
+    perplexity <= 0 selects the auto rule: min(5, (n-1)/3) with a floor of
+    1 (projector.go defaultPerplexity-style guard, tightened to honor the
+    n > 3*perplexity rule of thumb — at perplexity ~ n-1 the affinities go
+    uniform and tiny result sets project to noise).
     n < 2 short-circuits (a single point projects to the origin).
     """
     import jax.numpy as jnp
@@ -107,7 +118,7 @@ def tsne_project(
     if n == 1:
         return np.zeros((1, dims), dtype=np.float32)
     if perplexity <= 0:
-        perplexity = float(min(5, n - 1))
+        perplexity = float(min(5.0, max(1.0, (n - 1) / 3.0)))
     perplexity = float(min(perplexity, n - 1))
 
     p = _affinities(x, perplexity)

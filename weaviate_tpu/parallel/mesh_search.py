@@ -38,6 +38,17 @@ from weaviate_tpu.ops.topk import bitmap_to_mask, merge_top_k, pack_topk
 
 SHARD_AXIS = "shard"
 
+if hasattr(jax, "shard_map"):  # jax >= 0.6 spells it jax.shard_map(check_vma=)
+    def shard_map_compat(f, *, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+else:  # older jax: jax.experimental.shard_map.shard_map(check_rep=)
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map_compat(f, *, mesh, in_specs, out_specs):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+
 # rows of a slab scored per scan step (bounds the [B, chunk] block in HBM,
 # same rationale as index/tpu.py _SCAN_CHUNK)
 _MESH_SCAN_CHUNK = 131072
@@ -143,7 +154,7 @@ def mesh_search_step(
         i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
         return _merge_across_shards(d_top, i_glob, k)
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -151,7 +162,6 @@ def mesh_search_step(
             P(SHARD_AXIS), P(),
         ),
         out_specs=P(),
-        check_vma=False,
     )(store, sq_norms, tombs, n_per_shard, allow_words, queries)
 
 
@@ -189,7 +199,7 @@ def mesh_search_gmin_step(
         i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
         return _merge_across_shards(d_top, i_glob, k)
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -197,7 +207,6 @@ def mesh_search_gmin_step(
             P(SHARD_AXIS), P(),
         ),
         out_specs=P(),
-        check_vma=False,
     )(store, sq_norms, tombs, n_per_shard, allow_words, queries)
 
 
@@ -232,7 +241,7 @@ def mesh_search_pq_gmin_step(
         i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
         return _merge_across_shards(d_top, i_glob, k)
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -240,7 +249,6 @@ def mesh_search_pq_gmin_step(
             P(SHARD_AXIS), P(), P(), P(), P(),
         ),
         out_specs=P(),
-        check_vma=False,
     )(codes, recon_norms, tombs, n_per_shard, allow_words, cb_chunks,
       flat_cb, queries, rot)
 
@@ -343,7 +351,7 @@ def mesh_search_pq_step(
         i_glob = jnp.where(jnp.isinf(d_top), -1, i_top + my * n_loc)
         return _merge_across_shards(d_top, i_glob, k)
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -351,7 +359,6 @@ def mesh_search_pq_step(
             P(SHARD_AXIS), P(), P(SHARD_AXIS, None), P(), P(),
         ),
         out_specs=P(),
-        check_vma=False,
     )(codes, recon_norms, tombs, n_per_shard, allow_words, codebook,
       rescore_store, queries, rot)
 
@@ -374,7 +381,7 @@ def mesh_write_rows_step(arr2d, arr1d, chunks2d, vals1d, offsets, takes, mesh):
         return (jnp.where(active, written2, a2_l),
                 jnp.where(active, written1, a1_l))
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -382,7 +389,6 @@ def mesh_write_rows_step(arr2d, arr1d, chunks2d, vals1d, offsets, takes, mesh):
             P(SHARD_AXIS, None), P(), P(),
         ),
         out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS)),
-        check_vma=False,
     )(arr2d, arr1d, chunks2d, vals1d, offsets, takes)
 
 
@@ -418,14 +424,13 @@ def mesh_insert_step(store, sq_norms, chunks, offsets, takes, use_norms, mesh):
             new_norms = norms_l
         return new_store, new_norms
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None, None), P(), P(),
         ),
         out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS)),
-        check_vma=False,
     )(store, sq_norms, chunks, offsets, takes)
 
 
@@ -443,9 +448,9 @@ def mesh_delete_step(tombs, rows, mesh):
         local = jnp.where(mine, rows_r - lo, n_loc)
         return tombs_l.at[local].set(True, mode="drop")
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
-        out_specs=P(SHARD_AXIS), check_vma=False,
+        out_specs=P(SHARD_AXIS),
     )(tombs, rows)
 
 
@@ -458,9 +463,9 @@ def mesh_grow_2d(store, new_loc, mesh):
         out = jnp.zeros((new_loc, store_l.shape[1]), store_l.dtype)
         return jax.lax.dynamic_update_slice(out, store_l, (0, 0))
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS, None),),
-        out_specs=P(SHARD_AXIS, None), check_vma=False,
+        out_specs=P(SHARD_AXIS, None),
     )(store)
 
 
@@ -470,9 +475,9 @@ def mesh_grow_1d(arr, new_loc, mesh):
         out = jnp.zeros((new_loc,), arr_l.dtype)
         return jax.lax.dynamic_update_slice(out, arr_l, (0,))
 
-    return jax.shard_map(
+    return shard_map_compat(
         shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS),),
-        out_specs=P(SHARD_AXIS), check_vma=False,
+        out_specs=P(SHARD_AXIS),
     )(arr)
 
 

@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from weaviate_tpu.auth import AuthError, ForbiddenError, UnauthorizedError
+from weaviate_tpu.auth import ForbiddenError, UnauthorizedError
 from weaviate_tpu.schema.manager import SchemaError
 from weaviate_tpu.usecases.objects import NotFoundError, ObjectsError
 from weaviate_tpu.version import __version__ as VERSION

@@ -22,7 +22,6 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Optional
 
 from weaviate_tpu.entities.schema import ClassDef
 
