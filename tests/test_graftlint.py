@@ -327,6 +327,18 @@ def test_stale_code_in_multi_code_suppression_is_flagged():
     assert out == ["JGL000"], out
 
 
+def test_suppression_syntax_inside_string_literal_is_inert():
+    # documenting the disable syntax in a string must neither trip JGL000
+    # nor waive a real finding sharing the line — only COMMENT tokens count
+    doc = 'MSG = "use # graftlint: disable=JGL001 like this"\n'
+    assert codes(doc) == []
+    waive_attempt = (
+        "def f(y):\n"
+        '    return y.item(), "# graftlint: disable=JGL001 nope"\n'
+    )
+    assert codes(waive_attempt) == ["JGL001"]
+
+
 # -- baseline mechanics -------------------------------------------------------
 
 def _mk(code="JGL001", path="p.py", symbol="f", line=1):
@@ -367,6 +379,75 @@ def test_cli_list_rules_and_usage_errors():
     assert r.returncode == 0 and "JGL001" in r.stdout and "JGL006" in r.stdout
     assert _cli().returncode == 2
     assert _cli("definitely/not/a/path.py").returncode == 2
+    # a non-Python file target is a usage error, not a JGL999 parse finding
+    r = _cli("README.md")
+    assert r.returncode == 2 and "not a Python file" in r.stderr
+
+
+def test_cli_errors_when_nothing_is_analyzed(tmp_path):
+    # a _pb2.py target or an empty directory analyzes zero files — a green
+    # "0 finding(s)" there would be a false pass, so it is a usage error
+    pb2 = tmp_path / "weaviate_tpu" / "ops"
+    pb2.mkdir(parents=True)
+    (pb2 / "gen_pb2.py").write_text("x = 1\n")
+    r = _cli(str(pb2 / "gen_pb2.py"))
+    assert r.returncode == 2 and "no Python files" in r.stderr
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _cli(str(empty)).returncode == 2
+
+
+def test_undecodable_and_unparsable_files_report_jgl999(tmp_path):
+    # a legal latin-1 coding declaration must be honored (PEP 263), and
+    # bytes the declared codec can't decode — or null bytes ast.parse
+    # rejects with ValueError — must surface as JGL999, not a traceback
+    from tools.graftlint import analyze_tree
+
+    pkg = tmp_path / "weaviate_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    (pkg / "latin.py").write_bytes(
+        b"# -*- coding: latin-1 -*-\n# caf\xe9\ndef f(y):\n"
+        b"    return y.item()\n")
+    (pkg / "nul.py").write_bytes(b"x = 1\x00\n")
+    (pkg / "badenc.py").write_bytes(b"# -*- coding: utf-8 -*-\n# \xff\xfe\n")
+    out = {f.path.rsplit("/", 1)[-1]: f.code
+           for f in analyze_tree(str(tmp_path / "weaviate_tpu"))}
+    assert out["latin.py"] == "JGL001"  # decoded fine, rule still fires
+    assert out["nul.py"] == "JGL999"
+    assert out["badenc.py"] == "JGL999"
+
+
+def test_symlinked_target_path_keys_like_the_direct_one(tmp_path):
+    # reaching the repo through a symlink must not re-anchor findings at
+    # the filesystem root and bypass the committed baseline
+    from tools.graftlint import analyze_tree
+
+    link = tmp_path / "repolink"
+    os.symlink(REPO, str(link))
+    direct = [f.path for f in
+              analyze_tree(os.path.join(REPO, "weaviate_tpu", "ops"))]
+    via_link = [f.path for f in
+                analyze_tree(str(link / "weaviate_tpu" / "ops"))]
+    assert via_link == direct
+    for p in via_link:
+        assert p.startswith("weaviate_tpu/"), p
+    # an explicit root given through the symlink resolves the same way
+    rooted = [f.path for f in
+              analyze_tree(str(link / "weaviate_tpu" / "ops"),
+                           root=str(link))]
+    assert rooted == direct
+
+
+def test_root_target_keeps_whole_baseline_in_scope():
+    # scope "." (target IS the root) must match every entry — otherwise a
+    # whole-repo run bypasses the baseline and --update-baseline merges
+    # the old baseline back in as duplicates
+    from tools.graftlint.__main__ import _split_by_scope
+
+    entries = [{"code": "JGL001", "path": "weaviate_tpu/ops/a.py",
+                "symbol": "f", "count": 1}]
+    inside, outside = _split_by_scope(entries, ".")
+    assert inside == entries and outside == []
 
 
 def test_cli_findings_drive_exit_code(tmp_path):
@@ -377,3 +458,92 @@ def test_cli_findings_drive_exit_code(tmp_path):
     assert r.returncode == 1 and "JGL001" in r.stdout
     bad.write_text("def f(y):\n    return y\n")
     assert _cli(str(bad), "--no-baseline").returncode == 0
+
+
+def test_finding_paths_are_cwd_independent(tmp_path):
+    # baseline entries are keyed by path; if paths depended on the cwd,
+    # running from elsewhere would mark every entry stale and
+    # --prune-baseline would empty the baseline
+    from tools.graftlint import analyze_tree
+
+    pkg = tmp_path / "weaviate_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text("def f(y):\n    return y.item()\n")
+    target = str(tmp_path / "weaviate_tpu")
+
+    here = os.getcwd()
+    os.chdir(str(tmp_path))
+    try:
+        from_tmp = [f.path for f in analyze_tree(target)]
+    finally:
+        os.chdir(here)
+    from_repo = [f.path for f in analyze_tree(target)]
+    assert from_tmp == from_repo == ["weaviate_tpu/ops/bad.py"]
+
+    # the real package anchors at the repo root, matching baseline keys
+    in_repo = analyze_tree(os.path.join(REPO, "weaviate_tpu", "__init__.py"))
+    for f in in_repo:
+        assert f.path.startswith("weaviate_tpu/"), f.path
+
+
+def test_cli_default_baseline_found_from_any_cwd(tmp_path):
+    # DEFAULT_BASELINE is repo-root-anchored: invoked from an unrelated
+    # cwd with an absolute target, the gate must still load the committed
+    # baseline (a cwd-relative default would load empty and exit 1)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         os.path.join(REPO, "weaviate_tpu"), "--strict-baseline"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s)" in r.stderr, r.stderr
+
+
+def test_prune_with_partial_target_keeps_out_of_scope_entries(tmp_path):
+    # pruning after a run over weaviate_tpu/ops must not discard entries
+    # for index/ etc. — those files were never analyzed, so their entries
+    # are unknown, not stale
+    import json as _json
+
+    ops = tmp_path / "weaviate_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "a.py").write_text("def f(y):\n    return y.item()\n")
+    base = tmp_path / "b.json"
+    base.write_text(_json.dumps({"version": 1, "entries": [
+        {"code": "JGL001", "path": "weaviate_tpu/ops/a.py", "symbol": "f",
+         "count": 1, "justification": "live, in scope"},
+        {"code": "JGL001", "path": "weaviate_tpu/ops/gone.py", "symbol": "g",
+         "count": 1, "justification": "stale, in scope"},
+        {"code": "JGL001", "path": "weaviate_tpu/index/x.py", "symbol": "h",
+         "count": 1, "justification": "out of scope, must survive"},
+    ]}))
+    r = _cli(str(ops), "--root", str(tmp_path),
+             "--baseline", str(base), "--prune-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    kept = {(e["path"], e["justification"])
+            for e in _json.loads(base.read_text())["entries"]}
+    assert kept == {
+        ("weaviate_tpu/ops/a.py", "live, in scope"),
+        ("weaviate_tpu/index/x.py", "out of scope, must survive"),
+    }, kept
+
+
+def test_partial_target_does_not_report_out_of_scope_entries_stale(tmp_path):
+    # same scoping under --strict-baseline: an entry for an unanalyzed
+    # file must not fail the ratchet
+    import json as _json
+
+    ops = tmp_path / "weaviate_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "a.py").write_text("def f(y):\n    return y.item()\n")
+    base = tmp_path / "b.json"
+    base.write_text(_json.dumps({"version": 1, "entries": [
+        {"code": "JGL001", "path": "weaviate_tpu/ops/a.py", "symbol": "f",
+         "count": 1, "justification": "live"},
+        {"code": "JGL001", "path": "weaviate_tpu/index/x.py", "symbol": "h",
+         "count": 1, "justification": "not analyzed this run"},
+    ]}))
+    r = _cli(str(ops), "--root", str(tmp_path),
+             "--baseline", str(base), "--strict-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -16,7 +16,7 @@ from weaviate_tpu.config import load_config
 from weaviate_tpu.server import App, RestServer
 
 
-def _req(port, method, path, body=None, token=None, raw=False):
+def _req(port, method, path, body=None, token=None, raw=False, timeout=30):
     url = f"http://127.0.0.1:{port}{path}"
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method)
@@ -24,7 +24,7 @@ def _req(port, method, path, body=None, token=None, raw=False):
     if token:
         req.add_header("Authorization", f"Bearer {token}")
     try:
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             payload = resp.read()
             if raw:
                 return resp.status, payload
@@ -291,7 +291,10 @@ def test_pprof_surface(port):
 def test_pprof_device_trace(port):
     """/debug/pprof/trace captures a JAX device trace (the TPU twin of
     pprof's execution trace) and reports where it was written."""
-    st, body = _req(port, "GET", "/debug/pprof/trace?seconds=0.2", raw=True)
+    # Starting/stopping the JAX device profiler costs ~15s on its own and
+    # degrades further when the full suite loads the machine; the default
+    # 30s socket timeout flakes under that contention.
+    st, body = _req(port, "GET", "/debug/pprof/trace?seconds=0.2", raw=True, timeout=180)
     assert st == 200, body[:300]
     assert b"device trace written to" in body
     # the reported directory exists and holds the capture

@@ -20,7 +20,7 @@ from tools.graftlint import (
 )
 
 PACKAGE = os.path.join(REPO, "weaviate_tpu")
-BASELINE = os.path.join(REPO, DEFAULT_BASELINE)
+BASELINE = DEFAULT_BASELINE  # already absolute, anchored to the repo root
 
 
 def _run():

@@ -4,14 +4,22 @@ Run `python -m tools.graftlint weaviate_tpu` from the repo root. See
 docs/static_analysis.md for the rule catalogue and the baseline policy.
 """
 
+import os
+
 from tools.graftlint.engine import (  # noqa: F401
+    _REPO_ROOT,
     Finding,
     analyze_source,
     analyze_tree,
     apply_baseline,
     build_baseline,
     load_baseline,
+    target_scope,
     write_baseline,
 )
 
-DEFAULT_BASELINE = "tools/graftlint/baseline.json"
+# Anchored to the repo root, not the cwd: finding paths are repo-relative,
+# so loading the baseline from a relative path would silently come up empty
+# (all findings "new") when the CLI is invoked from elsewhere.
+DEFAULT_BASELINE = os.path.join(
+    _REPO_ROOT, "tools", "graftlint", "baseline.json")

@@ -17,9 +17,30 @@ from tools.graftlint import (
     apply_baseline,
     build_baseline,
     load_baseline,
+    target_scope,
     write_baseline,
 )
+from tools.graftlint.engine import default_root, iter_python_files
 from tools.graftlint.rules import RULE_DOCS
+
+
+def _split_by_scope(entries: list[dict], scope: str) -> tuple[list, list]:
+    """Baseline entries (inside, outside) the analyzed target. A partial
+    run (say, weaviate_tpu/ops) must leave entries for unanalyzed files
+    untouched: they are not stale just because nobody looked."""
+    if scope == ".":  # target IS the root: everything is in scope
+        return list(entries), []
+    inside: list[dict] = []
+    outside: list[dict] = []
+    for e in entries:
+        dest = inside if (e["path"] == scope
+                          or e["path"].startswith(scope + "/")) else outside
+        dest.append(e)
+    return inside, outside
+
+
+def _entry_key(e: dict) -> tuple:
+    return (e["code"], e["path"], e["symbol"])
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -29,7 +50,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("target", nargs="?",
                     help="package directory or file to analyze")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
-                    help=f"baseline file (default {DEFAULT_BASELINE})")
+                    help="baseline file (default tools/graftlint/"
+                         "baseline.json at the repo root)")
+    ap.add_argument("--root", default=None,
+                    help="directory finding paths are relative to "
+                         "(default: the repo root when the target is "
+                         "inside it, else inferred from the target)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignoring the baseline")
     ap.add_argument("--update-baseline", action="store_true",
@@ -55,13 +81,30 @@ def main(argv: list[str] | None = None) -> int:
         print(f"graftlint: error: no such target {args.target!r}",
               file=sys.stderr)
         return 2
+    if os.path.isfile(args.target) and not args.target.endswith(".py"):
+        print(f"graftlint: error: {args.target!r} is not a Python file",
+              file=sys.stderr)
+        return 2
+    rp = os.path.realpath(args.target)
+    if not any(iter_python_files(rp, args.root or default_root(rp))):
+        # e.g. a _pb2.py file or a directory with no Python files: a green
+        # "0 finding(s)" would claim something was checked when nothing was
+        print(f"graftlint: error: no Python files to analyze under "
+              f"{args.target!r}", file=sys.stderr)
+        return 2
 
-    findings = analyze_tree(args.target)
+    findings = analyze_tree(args.target, root=args.root)
+    scope = target_scope(args.target, root=args.root)
 
     if args.update_baseline:
         old = load_baseline(args.baseline) if os.path.exists(args.baseline) \
             else None
-        write_baseline(args.baseline, build_baseline(findings, old))
+        base = build_baseline(findings, old)
+        if old:  # entries for files outside the target were not re-analyzed
+            _, outside = _split_by_scope(old.get("entries", []), scope)
+            base["entries"] = sorted(base["entries"] + outside,
+                                     key=_entry_key)
+        write_baseline(args.baseline, base)
         print(f"graftlint: wrote {len(findings)} finding(s) to "
               f"{args.baseline}; fill in the justifications")
         return 0
@@ -72,10 +115,14 @@ def main(argv: list[str] | None = None) -> int:
         new = findings
     else:
         baseline = load_baseline(args.baseline)
-        new, waived, stale = apply_baseline(findings, baseline)
+        inside, outside = _split_by_scope(baseline.get("entries", []), scope)
+        new, waived, stale = apply_baseline(
+            findings, dict(baseline, entries=inside))
         if args.prune_baseline and stale:
             live = build_baseline([f for f in findings if f not in new],
                                   baseline)
+            live["entries"] = sorted(live["entries"] + outside,
+                                     key=_entry_key)
             write_baseline(args.baseline, live)
             print(f"graftlint: pruned {len(stale)} stale entr(y|ies) from "
                   f"{args.baseline}")

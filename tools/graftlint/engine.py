@@ -19,9 +19,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import os
 import re
+import tokenize
 from typing import Iterable, Optional
 
 SUPPRESS_RE = re.compile(
@@ -58,13 +60,23 @@ class Suppression:
 def parse_suppressions(source: str) -> dict[int, Suppression]:
     """Line -> suppression. A comment suppresses findings reported on ITS
     line only (for a multi-line call, that is the line the call starts on).
-    A reason is required: a bare disable is itself reported (JGL000)."""
+    A reason is required: a bare disable is itself reported (JGL000).
+    Only real COMMENT tokens count — the disable syntax inside a string
+    literal (say, a docstring documenting it) is inert."""
     out: dict[int, Suppression] = {}
-    for i, text in enumerate(source.splitlines(), start=1):
-        m = SUPPRESS_RE.search(text)
-        if m:
-            codes = frozenset(c.strip() for c in m.group(1).split(","))
-            out[i] = Suppression(i, codes, m.group("reason"))
+    if "graftlint:" not in source:  # skip tokenizing the common case
+        return out
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if m:
+                codes = frozenset(c.strip() for c in m.group(1).split(","))
+                line = tok.start[0]
+                out[line] = Suppression(line, codes, m.group("reason"))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # unparseable source is already reported as JGL999
     return out
 
 
@@ -76,9 +88,11 @@ def analyze_source(source: str, rel_path: str) -> list[Finding]:
 
     try:
         tree = ast.parse(source)
-    except SyntaxError as e:
-        return [Finding("JGL999", rel_path, e.lineno or 1, 0, "<module>",
-                        f"file does not parse: {e.msg}")]
+    except (SyntaxError, ValueError) as e:  # ValueError: e.g. null bytes
+        return [Finding("JGL999", rel_path,
+                        getattr(e, "lineno", None) or 1, 0, "<module>",
+                        f"file does not parse: "
+                        f"{getattr(e, 'msg', None) or e}")]
     raw = rules.run_rules(tree, source, rel_path)
     sup = parse_suppressions(source)
     kept: list[Finding] = []
@@ -108,9 +122,9 @@ def iter_python_files(target: str, root: str) -> Iterable[tuple[str, str]]:
     """Yield (abs_path, rel_path) for every .py under `target` (a package
     directory or a single file), rel to `root`, skipping generated code."""
     if os.path.isfile(target):
-        if not target.endswith("_pb2.py"):  # generated code is skipped in
-            yield target, os.path.relpath(  # both walk modes
-                target, root).replace(os.sep, "/")
+        if target.endswith(".py") and not target.endswith("_pb2.py"):
+            yield target, os.path.relpath(  # generated code is skipped in
+                target, root).replace(os.sep, "/")  # both walk modes
         return
     for dirpath, dirnames, filenames in os.walk(target):
         dirnames[:] = sorted(d for d in dirnames
@@ -122,12 +136,56 @@ def iter_python_files(target: str, root: str) -> Iterable[tuple[str, str]]:
             yield p, os.path.relpath(p, root).replace(os.sep, "/")
 
 
+# tools/graftlint/engine.py -> graftlint -> tools -> repo root
+# (realpath: targets reached through a symlinked checkout path must key
+# findings identically, or the committed baseline stops matching)
+_REPO_ROOT = os.path.realpath(os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def default_root(target: str) -> str:
+    """Anchor for finding paths (and therefore baseline keys). Never the
+    cwd — a baseline entry must name the same file no matter where the CLI
+    is invoked from, or --prune-baseline would treat every entry as stale
+    and empty the baseline. Inside this repo the anchor is the repo root
+    (paths match the committed baseline exactly); for a package checkout
+    elsewhere it is the target's parent (package-relative paths, which the
+    hot-module prefixes still match); for a loose file it is the
+    filesystem root, keeping the full directory context that hot-module
+    scoping matches at interior path boundaries."""
+    abs_target = os.path.realpath(target)
+    try:
+        if os.path.commonpath([abs_target, _REPO_ROOT]) == _REPO_ROOT:
+            return _REPO_ROOT
+    except ValueError:  # e.g. different drives on Windows
+        pass
+    if os.path.isdir(abs_target):
+        return os.path.dirname(abs_target)
+    return os.path.abspath(os.sep)
+
+
+def target_scope(target: str, root: Optional[str] = None) -> str:
+    """The analyzed target as a finding-style relative posix path. Baseline
+    entries outside this scope were never analyzed in this run, so they
+    must be neither waived, reported stale, nor pruned."""
+    root = os.path.realpath(root) if root else default_root(target)
+    return os.path.relpath(
+        os.path.realpath(target), root).replace(os.sep, "/")
+
+
 def analyze_tree(target: str, root: Optional[str] = None) -> list[Finding]:
-    root = root or os.getcwd()
+    target = os.path.realpath(target)  # symlinked paths key like direct ones
+    root = os.path.realpath(root) if root else default_root(target)
     findings: list[Finding] = []
     for abs_path, rel_path in iter_python_files(target, root):
-        with open(abs_path, encoding="utf-8") as f:
-            findings.extend(analyze_source(f.read(), rel_path))
+        try:
+            with tokenize.open(abs_path) as f:  # honors PEP 263 codings
+                source = f.read()
+        except (UnicodeDecodeError, SyntaxError, LookupError, ValueError) as e:
+            findings.append(Finding("JGL999", rel_path, 1, 0, "<module>",
+                                    f"file does not decode: {e}"))
+            continue
+        findings.extend(analyze_source(source, rel_path))
     return findings
 
 
