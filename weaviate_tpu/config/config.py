@@ -136,6 +136,20 @@ class MemUseConfig:
 
 
 @dataclass
+class CoalescerConfig:
+    """Cross-request query coalescing (serving/coalescer.py). TPU extension:
+    concurrent single-query kNN requests admission-queue per
+    (shard, k, metric, filter-signature) lane and flush as one padded
+    device dispatch on bucket-fill or deadline. Disabled => the serving
+    path is byte-for-byte the direct dispatch (zero queue hops)."""
+
+    enabled: bool = False
+    window_ms: float = 1.5        # deadline flush window per lane
+    max_batch: int = 256          # rows that force an immediate flush
+    max_request_rows: int = 16    # wider requests bypass to the direct path
+
+
+@dataclass
 class AutoSchemaConfig:
     enabled: bool = True
     default_string: str = "text"
@@ -174,6 +188,7 @@ class Config:
     # TPU extensions
     device_mesh_shards: int = 0  # 0 = one shard per local device
     store_dtype: str = "float32"
+    coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -187,6 +202,15 @@ class Config:
             raise ConfigError("DISK_USE_READONLY_PERCENTAGE must be 0..100")
         if self.store_dtype not in ("float32", "bfloat16"):
             raise ConfigError("STORE_DTYPE must be float32|bfloat16")
+        if self.coalescer.window_ms < 0:
+            raise ConfigError("QUERY_COALESCER_WINDOW_MS must be >= 0")
+        if self.coalescer.max_batch < 2:
+            raise ConfigError("QUERY_COALESCER_MAX_BATCH must be >= 2")
+        if not (1 <= self.coalescer.max_request_rows
+                <= self.coalescer.max_batch):
+            raise ConfigError(
+                "QUERY_COALESCER_MAX_REQUEST_ROWS must be in "
+                "[1, QUERY_COALESCER_MAX_BATCH]")
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -262,6 +286,12 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
 
     cfg.device_mesh_shards = _int(e, "TPU_DEVICE_MESH_SHARDS", 0)
     cfg.store_dtype = e.get("TPU_STORE_DTYPE", "float32")
+
+    cfg.coalescer.enabled = _bool(e, "QUERY_COALESCER_ENABLED")
+    cfg.coalescer.window_ms = _float(e, "QUERY_COALESCER_WINDOW_MS", 1.5)
+    cfg.coalescer.max_batch = _int(e, "QUERY_COALESCER_MAX_BATCH", 256)
+    cfg.coalescer.max_request_rows = _int(
+        e, "QUERY_COALESCER_MAX_REQUEST_ROWS", 16)
 
     cfg.validate()
     return cfg

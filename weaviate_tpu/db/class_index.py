@@ -245,6 +245,16 @@ class ClassIndex:
             out.append((name, self._local_shard(name)))
         return out
 
+    def single_local_shard(self):
+        """The one local shard when this class is a single-local-shard
+        layout — the layout the shard-level serving lanes (query coalescer,
+        gRPC raw batch lane, async deferred hydration) require; None
+        otherwise (multi-shard / remote layouts fan out per shard)."""
+        targets = self._all_shard_targets()
+        if len(targets) == 1 and targets[0][1] is not None:
+            return targets[0][1]
+        return None
+
     def object_vector_search(
         self,
         vectors: np.ndarray,

@@ -104,6 +104,20 @@ class SearchResult:
                 f"shard={self.shard!r})")
 
 
+def filter_signature(flt: Optional[LocalFilter]) -> Optional[str]:
+    """Stable content key for a filter: "" for no filter, None when the
+    filter cannot be keyed (unserializable). ONE definition shared by the
+    shard's allowList cache and the query coalescer's lane keys, so two
+    requests that coalesce into a lane are exactly the requests that would
+    resolve to the same cached allowList."""
+    if flt is None:
+        return ""
+    try:
+        return json.dumps(flt.to_dict(), sort_keys=True, default=str)
+    except Exception:  # noqa: BLE001 — unhashable filter content
+        return None
+
+
 def _uuid_bytes(u: str) -> bytes:
     # canonical-form fast path (~4x over uuid.UUID); anything else — braces,
     # urn: prefix — takes the full parser. The 32-hex-after-dash-strip check
@@ -471,9 +485,8 @@ class Shard:
         read refuses to cache when a write overlapped the evaluation."""
         if flt is None:
             return None
-        try:
-            key = json.dumps(flt.to_dict(), sort_keys=True, default=str)
-        except Exception:  # noqa: BLE001 — unhashable filter: just evaluate
+        key = filter_signature(flt)
+        if key is None:  # unhashable filter: just evaluate
             return self.searcher.doc_ids(flt)
         gen = self._locked_gen()
         hit = self._allow_cache.get(key)
@@ -514,19 +527,28 @@ class Shard:
             q = q[None, :]
         t1 = time.perf_counter()
         if target_distance is not None:
-            out: list[list[SearchResult]] = []
-            for row in q:
-                ids_1, dists_1 = self.vector_index.search_by_vector_distance(
-                    row, target_distance, max_limit=k, allow_list=allow
-                )
-                out.append(self._hydrate(ids_1, dists_1, include_vector))
+            row_ids, row_dists = self._search_by_vectors_distance(
+                q, target_distance, k, allow)
+            t2 = time.perf_counter()
+            # pad the ragged per-row results back to one rectangle so the
+            # winners hydrate in ONE batched pass (inf marks absent slots,
+            # exactly the device kernels' padding convention)
+            width = max((len(r) for r in row_ids), default=0)
+            ids = np.zeros((q.shape[0], width), dtype=np.uint64)
+            dists = np.full((q.shape[0], width), np.inf, dtype=np.float32)
+            for i, (ri, rd) in enumerate(zip(row_ids, row_dists)):
+                ids[i, : len(ri)] = ri
+                dists[i, : len(ri)] = rd
+            hydrated = self._hydrate_batch(ids, dists, include_vector)
             if m is not None:
                 m.filtered_vector_search.labels(cls, self.name).observe(
-                    (time.perf_counter() - t1) * 1000.0)
+                    (t2 - t1) * 1000.0)
+                m.filtered_vector_objects.labels(cls, self.name).observe(
+                    (time.perf_counter() - t2) * 1000.0)
                 m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
                 m.query_dimensions.labels("nearVector", "search", cls).inc(
                     int(q.shape[0] * q.shape[1]))
-            return out
+            return hydrated
         ids, dists = self.vector_index.search_by_vectors(q, k, allow)
         t2 = time.perf_counter()
         hydrated = self._hydrate_batch(ids, dists, include_vector)
@@ -538,6 +560,49 @@ class Shard:
             m.query_dimensions.labels("nearVector", "search", cls).inc(
                 int(q.shape[0] * q.shape[1]))
         return hydrated
+
+    def _search_by_vectors_distance(
+        self, q: np.ndarray, target: float, max_limit: int, allow
+    ) -> tuple[list[np.ndarray], list[np.ndarray]]:
+        """Batched target-distance search: the iterative limit-doubling of
+        VectorIndex.search_by_vector_distance (search.go:90-157), except
+        every round is ONE bucketed device dispatch over the rows that still
+        need widening — B rows cost ~1 dispatch instead of B dispatch
+        chains. -> ragged ([ids...], [dists...]) per row, ascending."""
+        b = q.shape[0]
+        out_ids: list = [None] * b
+        out_dists: list = [None] * b
+        vidx = self.vector_index
+        live = len(vidx)
+        pending = list(range(b))
+        limit = 64
+        while pending:
+            kk = min(limit, max_limit)
+            ids, dists = vidx.search_by_vectors(q[pending], kk, allow)
+            nxt: list[int] = []
+            for j, row in enumerate(pending):
+                rd = np.asarray(dists[j], dtype=np.float32)
+                got = ~np.isinf(rd)
+                rid, rd = np.asarray(ids[j])[got], rd[got]
+                if rid.size == 0:
+                    out_ids[row], out_dists[row] = rid, rd
+                elif ((rd > target).any()
+                      or rid.size >= min(max_limit, live)
+                      # fewer results than asked => the reachable set (e.g.
+                      # a small allowList) is exhausted; widening further
+                      # would re-dispatch the identical search. This also
+                      # subsumes the per-row loop's limit>=max_limit stop:
+                      # at kk == max_limit a full row hits the size branch
+                      # above, a short row is exhausted here.
+                      or rid.size < kk):
+                    keep = rd <= target
+                    out_ids[row] = rid[keep][:max_limit]
+                    out_dists[row] = rd[keep][:max_limit]
+                else:
+                    nxt.append(row)
+            pending = nxt
+            limit *= 2
+        return out_ids, out_dists
 
     def object_vector_search_async(
         self, vectors: np.ndarray, k: int, include_vector: bool = False
@@ -637,10 +702,6 @@ class Shard:
             return None
         vbuf, voffs, vflags = r2
         return vbuf, voffs, vflags, dists[valid], counts
-
-    def _hydrate(self, ids, dists, include_vector: bool) -> list[SearchResult]:
-        return self._hydrate_batch(
-            np.asarray(ids)[None, :], np.asarray(dists)[None, :], include_vector)[0]
 
     def _hydrate_batch(
         self, ids, dists, include_vector: bool

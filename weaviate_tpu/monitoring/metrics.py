@@ -30,6 +30,10 @@ from prometheus_client import (
 
 _MS_BUCKETS = (0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 10000)
 
+# occupancy buckets (requests/rows per coalesced dispatch): powers of two to
+# mirror the index's query-padding buckets
+_COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
 
 class Metrics:
     """All metric vecs; label names mirror the reference's (class_name,
@@ -145,6 +149,31 @@ class Metrics:
         self.replication_ops = c(
             "weaviate_replication_operations_total", "replication coordinator ops",
             ("operation", "status"))
+
+        # cross-request query coalescer (serving/coalescer.py). Registered
+        # here, once, at Metrics construction — the same pattern as
+        # weaviate_device_fallback_total: the serving path only ever touches
+        # already-registered vecs (inside try/except in the coalescer), so a
+        # broken/missing metrics stack can never take down query serving.
+        self.coalescer_queue_depth = g(
+            "weaviate_coalescer_queue_depth",
+            "query rows admission-queued awaiting a coalesced device dispatch")
+        self.coalescer_batch_requests = Histogram(
+            "weaviate_coalescer_batch_requests",
+            "requests per coalesced device dispatch (occupancy)",
+            registry=r, buckets=_COUNT_BUCKETS)
+        self.coalescer_batch_rows = Histogram(
+            "weaviate_coalescer_batch_rows",
+            "query rows per coalesced device dispatch (occupancy)",
+            registry=r, buckets=_COUNT_BUCKETS)
+        self.coalescer_wait = h(
+            "weaviate_coalescer_wait_ms",
+            "time a request spent in the admission queue before its "
+            "dispatch started")
+        self.coalescer_bypass = c(
+            "weaviate_coalescer_bypass_total",
+            "requests that bypassed the coalescer queue to the direct path",
+            ("reason",))
 
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so

@@ -126,10 +126,33 @@ class App:
             self.db, self.schema, auto_schema=self.auto_schema,
             modules=self.modules, metrics=self.metrics)
         self.batch = BatchManager(self.objects)
+        # cross-request query coalescing (serving/coalescer.py): disabled =>
+        # self.coalescer is None and every read path below is untouched
+        # (zero queue hops) — the knob must be a true no-op when off
+        cc = self.config.coalescer
+        if cc.enabled:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from weaviate_tpu.serving.coalescer import QueryCoalescer
+
+            self.coalescer = QueryCoalescer(
+                window_s=cc.window_ms / 1000.0,
+                max_batch=cc.max_batch,
+                max_request_rows=cc.max_request_rows,
+                metrics=self.metrics)
+            # persistent slot pool for concurrent batch fan-out (REST
+            # /v1/graphql/batch): per-request executors would pay thread
+            # churn on the exact hot path the coalescer optimizes
+            self.serving_pool = ThreadPoolExecutor(
+                max_workers=32, thread_name_prefix="serving-batch")
+        else:
+            self.coalescer = None
+            self.serving_pool = None
         self.explorer = Explorer(
             self.db, self.schema, modules=self.modules,
             query_limit=self.config.query_defaults_limit,
-            max_results=self.config.query_maximum_results)
+            max_results=self.config.query_maximum_results,
+            coalescer=self.coalescer)
         self.traverser = Traverser(
             self.explorer,
             max_concurrent=self.config.maximum_concurrent_get_requests)
@@ -201,6 +224,13 @@ class App:
         }
 
     def shutdown(self) -> None:
+        # first: queued coalescer waiters must wake (with a shutdown error
+        # that sends their serving threads to the direct path) before the
+        # shards they would dispatch to go away
+        if self.coalescer is not None:
+            self.coalescer.shutdown()
+        if self.serving_pool is not None:
+            self.serving_pool.shutdown(wait=False)
         self.disk_monitor.shutdown()
         if self.cluster_node is not None:
             self.cluster_node.shutdown()

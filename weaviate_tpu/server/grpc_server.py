@@ -201,10 +201,9 @@ class SearchServicer:
         idx = self.app.db.get_index(resolved) if resolved else None
         if idx is None:
             return None
-        targets = idx._all_shard_targets()
-        if len(targets) != 1 or targets[0][1] is None:
+        shard = idx.single_local_shard()
+        if shard is None:
             return None
-        shard = targets[0][1]
         if not shard.raw_plane_ready():
             return None  # before ANY device work: the general path searches once
         q = np.empty((len(reqs), dim), dtype=np.float32)
@@ -226,9 +225,17 @@ class SearchServicer:
         query yields a reply with error_message; the other slots still ride
         the shared device dispatch."""
         start = time.perf_counter()
-        raw = self._raw_batch_lane(request, start)
-        if raw is not None:
-            return raw
+        # with the coalescer on, a NARROW batch (up to max_request_rows —
+        # the widest request the coalescer admits) skips the raw lane: its
+        # own dispatch would run underfilled, while the general path merges
+        # the slots with other in-flight requests into one padded dispatch.
+        # STRICTLY wider batches keep the raw lane — they already fill a
+        # dispatch and its reply marshalling is strictly cheaper.
+        co = getattr(self.app, "coalescer", None)
+        if co is None or len(request.requests) > co.max_request_rows:
+            raw = self._raw_batch_lane(request, start)
+            if raw is not None:
+                return raw
         slot_params: list = [None] * len(request.requests)
         parse_errs: dict[int, str] = {}
         for i, r in enumerate(request.requests):

@@ -450,6 +450,19 @@ class Handler(BaseHTTPRequestHandler):
         body = self._json_body() or []
         if not isinstance(body, list):
             raise HTTPError(400, "graphql batch body must be a list")
+        pool = getattr(self.app, "serving_pool", None)
+        if pool is not None and len(body) > 1:
+            # coalescing on: run the slots CONCURRENTLY so their kNN
+            # dispatches admission-queue into one padded device batch (the
+            # REST twin of gRPC BatchSearch) instead of serializing one
+            # one-wide dispatch per slot. graphql.execute returns per-query
+            # error envelopes, so slot isolation matches the serial path.
+            out = list(pool.map(
+                lambda q: self.app.graphql.execute(
+                    q.get("query") or "", q.get("variables")),
+                body))
+            self._reply(200, out)
+            return
         self._reply(200, [
             self.app.graphql.execute(q.get("query") or "", q.get("variables"))
             for q in body

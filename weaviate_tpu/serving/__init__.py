@@ -1,0 +1,9 @@
+"""Serving-layer subsystems that sit between the API frontends and the
+shard read path (currently: the cross-request query coalescer)."""
+
+from weaviate_tpu.serving.coalescer import (
+    CoalescerShutdownError,
+    QueryCoalescer,
+)
+
+__all__ = ["CoalescerShutdownError", "QueryCoalescer"]

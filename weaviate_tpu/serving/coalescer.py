@@ -1,0 +1,484 @@
+"""Cross-request query coalescing: continuous micro-batching for kNN.
+
+The shard read path is batch-first (`Shard.object_vector_search` scores a
+whole [B, D] query block in one device dispatch), but that only batches the
+vectors INSIDE one request: 256 concurrent single-query REST/GraphQL/gRPC
+users cost 256 one-wide dispatches. The distance kernel only approaches
+roofline at meaningful batch width, so under concurrent single-query load
+the device spends its time on dispatch overhead instead of math.
+
+This module closes that gap with an admission queue in front of the shard:
+concurrent requests land in a *lane* keyed by everything that must match for
+their rows to share one device dispatch — (shard, k, metric,
+filter-signature, include_vector) — and a lane flushes as ONE padded
+dispatch when either
+
+  (a) its row count fills the configured batch-width bucket (`max_batch`,
+      snapped DOWN to the same padding buckets the index's `_bucket_b`
+      rounds query widths to, so a full lane hits the same jit cache as
+      direct dispatches without exceeding the configured cap), or
+  (b) the deadline window (default ~1.5 ms) since the lane's first arrival
+      expires — the Orca/vLLM-style continuous-batching tradeoff: bounded
+      added latency buys full-width dispatches.
+
+Dispatch rides the existing two-phase path (`object_vector_search_async`):
+the flush thread enqueues device work in dispatch order, while finalize +
+hydration (and the sync filtered-lane searches) run on a small dispatch
+pool so lanes overlap device compute with hydration and with each other.
+Results scatter back to per-request waiters. k is deliberately part of the
+lane key — requests only share a dispatch at IDENTICAL k — because the
+bit-identical contract (coalesced == direct, pinned by the tests) would
+not survive dispatching at max-k and trimming: approximate k-selection
+(lax.approx_min_k on TPU) is not prefix-stable across different k.
+
+Bypass (the caller uses the direct path, counted per reason): requests
+wider than `max_request_rows` (they already fill a dispatch on their own),
+filters with no stable signature (a per-request allowList can never share a
+lane), COLD filter signatures (first sighting within the recency TTL — a
+unique per-tenant filter would otherwise pay the full window in a
+singleton lane for zero merging; only filters proven hot by a recent
+repeat are queued), multi-shard/remote layouts, and a shut-down coalescer.
+
+The flush thread only ADMITS and ENQUEUES: each lane's blocking work
+(async finalize + hydration, or the sync filtered search) runs on a small
+dispatch pool, so one slow lane — an expensive allowList build, a big
+hydration — cannot head-of-line-block other lanes' flushes.
+
+Error handling is all-or-nothing per lane: a dispatch exception (or
+shutdown) propagates to EVERY queued waiter — no request may hang on a
+dead batch. The flush loop itself is defended: any unexpected error fails
+the affected lanes and the loop keeps serving.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+# lane keys reuse the shard's filter-content key, so two requests share a
+# lane exactly when they would resolve to the same cached allowList; batch
+# caps snap to the index's query-padding buckets so coalesced shapes hit
+# the same jit cache as direct dispatches. record_device_fallback hoisted
+# to module scope (PR 1 pattern): failure paths must not die on an import.
+from weaviate_tpu.db.shard import filter_signature
+from weaviate_tpu.index.tpu import _B_BUCKETS
+from weaviate_tpu.monitoring.metrics import record_device_fallback
+
+
+class CoalescerShutdownError(RuntimeError):
+    """Raised to waiters whose lane was still queued at shutdown."""
+
+
+def _bucket_floor(n: int) -> int:
+    """Largest index padding bucket <= n (the DOWN twin of tpu._bucket_b):
+    a full lane then lands exactly on a bucket without ever exceeding the
+    operator's configured cap. Beyond the largest bucket the index pads in
+    multiples of it, so the floor follows the same rule."""
+    top = _B_BUCKETS[-1]
+    if n >= top:
+        return (n // top) * top
+    best = _B_BUCKETS[0]
+    for s in _B_BUCKETS:
+        if s <= n:
+            best = s
+    return best
+
+
+class _Waiter:
+    """One queued request: its rows plus the rendezvous the serving thread
+    blocks on."""
+
+    __slots__ = ("vectors", "event", "result", "error", "enqueued_at")
+
+    def __init__(self, vectors: np.ndarray):
+        self.vectors = vectors
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.enqueued_at = time.monotonic()
+
+    def wait(self):
+        """Block until the lane resolves -> per-row result lists."""
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _Lane:
+    """Accumulating batch for one (shard, k, metric, filter-sig, inc_vec)
+    key. Never touched outside the coalescer lock until popped for flush."""
+
+    __slots__ = ("key", "shard", "flt", "k", "include_vector", "items",
+                 "rows", "deadline")
+
+    def __init__(self, key, shard, flt, k: int, include_vector: bool,
+                 deadline: float):
+        self.key = key
+        self.shard = shard
+        self.flt = flt
+        self.k = k
+        self.include_vector = include_vector
+        self.items: list[_Waiter] = []
+        self.rows = 0
+        self.deadline = deadline
+
+
+class QueryCoalescer:
+    def __init__(self, window_s: float = 0.0015, max_batch: int = 256,
+                 max_request_rows: int = 16, metrics=None,
+                 pipeline_depth: int = 1):
+        self.window_s = max(float(window_s), 0.0)
+        # snap DOWN to the index's padding buckets: a full lane then
+        # compiles/hits the exact shape a direct dispatch of that width
+        # would, and the configured cap is never exceeded (snapping up
+        # would silently inflate the operator's dispatch-size bound 4x)
+        self.max_batch = max(_bucket_floor(max(int(max_batch), 2)), 2)
+        if self.max_batch != int(max_batch):
+            import logging
+
+            # visible, or an operator watching the occupancy histogram top
+            # out below their configured cap has nothing to explain it
+            logging.getLogger(__name__).info(
+                "query coalescer max_batch %d snapped DOWN to padding "
+                "bucket %d (buckets: %s)", int(max_batch), self.max_batch,
+                _B_BUCKETS)
+        # re-clamp AFTER the snap: config validates against the unsnapped
+        # cap, and a single admitted request must never overflow a dispatch
+        self.max_request_rows = max(
+            1, min(int(max_request_rows), self.max_batch))
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._lanes: dict[tuple, _Lane] = {}
+        self._full: list[_Lane] = []  # popped at submit time, flush ASAP
+        self._queued_rows = 0
+        self._closed = False
+        # filter-signature recency: a filtered request only queues when its
+        # signature was seen within the TTL (someone to merge with is
+        # plausible); a cold signature bypasses so one-off filters never
+        # pay the window for an inevitable singleton lane
+        self._sig_ttl = max(1.0, self.window_s * 100.0)
+        self._recent_sigs: dict[str, float] = {}
+        # cheap python-side counters (bench/tests read these without a
+        # prometheus round trip; the histograms carry the same data)
+        self._dispatches = 0
+        self._dispatched_requests = 0
+        self._dispatched_rows = 0
+        self._bypass: dict[str, int] = {}
+        # blocking per-lane work (finalize+hydration, sync filtered search)
+        # runs on this pool; the flush thread only admits/enqueues, capped
+        # at `pipeline_depth` lanes in flight. While every slot is busy the
+        # flusher BLOCKS — that stall is the backpressure that lets the
+        # next window's lanes accumulate to full width. Measured on the
+        # CPU-JAX acceptance workload (64 clients, n=50k): depth 1 = 4.7x
+        # the uncoalesced QPS at ~30 requests/dispatch; depth 2 = 2.7x at
+        # ~13 (two in-flight scans contend for the same host cores);
+        # unbounded = 1.3x at ~5 (no backpressure, every window flushes
+        # thin). Depth 1 is therefore the default; a real TPU backend,
+        # where finalize/hydration is host work that overlaps device
+        # compute, is the case for raising it to 2.
+        self._inflight = threading.Semaphore(max(int(pipeline_depth), 1))
+        self._dispatch_pool = ThreadPoolExecutor(
+            max_workers=max(int(pipeline_depth), 1) + 2,
+            thread_name_prefix="coalescer-dispatch")
+        self._thread = threading.Thread(
+            target=self._run, name="query-coalescer", daemon=True)
+        self._thread.start()
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, shard, vectors: np.ndarray, k: int, flt=None,
+               include_vector: bool = False):
+        """Queue a request's rows for a coalesced dispatch.
+
+        -> a blocking callable() -> list[list[SearchResult]] (one list per
+        row), or None when the request must bypass to the direct path
+        (reason counted)."""
+        q = np.asarray(vectors, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if q.shape[0] > self.max_request_rows:
+            self.record_bypass("oversize")
+            return None
+        sig = filter_signature(flt)
+        if sig is None:
+            self.record_bypass("unique_allow_list")
+            return None
+        # dim is part of the key: a wrong-dim request must land in its own
+        # lane and fail ALONE, not poison the concatenate of its lane-mates
+        key = (id(shard), int(k), getattr(shard.vector_index, "metric", ""),
+               sig, bool(include_vector), int(q.shape[1]))
+        cold = False
+        with self._cv:
+            closed = self._closed
+            if not closed and sig:
+                # filtered request: queue only when this signature was seen
+                # recently (a lane-mate is plausible); cold signatures go
+                # direct — a one-off per-tenant filter must not pay the
+                # window for a singleton lane
+                now = time.monotonic()
+                last = self._recent_sigs.get(sig)
+                self._recent_sigs[sig] = now
+                if len(self._recent_sigs) > 1024:
+                    pruned = {s: t for s, t in self._recent_sigs.items()
+                              if now - t <= self._sig_ttl}
+                    # all-hot overflow (>1024 live signatures inside the
+                    # TTL): pruning can't shrink, and rebuilding O(n) under
+                    # the admission lock on EVERY submit would serialize the
+                    # fast path — reset instead; hot filters re-warm with
+                    # one direct request each, amortized O(1) per overflow
+                    self._recent_sigs = (pruned if len(pruned) <= 896
+                                         else {sig: now})
+                cold = last is None or now - last > self._sig_ttl
+            if not closed and not cold:
+                # wake the flusher only when the picture it sleeps on
+                # changes: a new lane (new earliest deadline) or a lane
+                # popped to _full (new due work). Appending to an existing
+                # lane changes neither — notifying there would wake/rescan
+                # the flusher once per REQUEST on the hot path instead of
+                # once per window.
+                wake = False
+                lane = self._lanes.get(key)
+                if lane is not None and lane.rows + q.shape[0] > self.max_batch:
+                    # this request would overflow the bucket: flush the lane
+                    # as-is and start fresh — a dispatch must never exceed
+                    # max_batch, or it pads to the NEXT bucket and compiles
+                    # a shape the direct path never uses
+                    del self._lanes[key]
+                    self._full.append(lane)
+                    lane = None
+                    wake = True
+                if lane is None:
+                    lane = _Lane(key, shard, flt, int(k),
+                                 bool(include_vector),
+                                 time.monotonic() + self.window_s)
+                    self._lanes[key] = lane
+                    wake = True
+                w = _Waiter(q)
+                lane.items.append(w)
+                lane.rows += q.shape[0]
+                self._queued_rows += q.shape[0]
+                if lane.rows >= self.max_batch:
+                    # bucket full: pop now so later arrivals start fresh
+                    del self._lanes[key]
+                    self._full.append(lane)
+                    wake = True
+                self._set_depth_gauge()
+                if wake:
+                    self._cv.notify()
+        if closed:
+            # outside the lock: record_bypass takes it again
+            self.record_bypass("shutdown")
+            return None
+        if cold:
+            self.record_bypass("cold_filter")
+            return None
+        return w.wait
+
+    def record_bypass(self, reason: str) -> None:
+        """Count a request that took the direct path instead of the queue."""
+        with self._lock:
+            self._bypass[reason] = self._bypass.get(reason, 0) + 1
+        m = self.metrics
+        if m is not None:
+            try:
+                m.coalescer_bypass.labels(reason).inc()
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+
+    # -- flush loop ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            due: list[_Lane] = []
+            with self._cv:
+                while not self._closed:
+                    now = time.monotonic()
+                    due = self._full
+                    self._full = []
+                    expired = [k for k, ln in self._lanes.items()
+                               if ln.deadline <= now]
+                    for k in expired:
+                        due.append(self._lanes.pop(k))
+                    if due:
+                        break
+                    timeout = None
+                    if self._lanes:
+                        timeout = max(
+                            min(ln.deadline for ln in self._lanes.values())
+                            - now, 0.0)
+                    self._cv.wait(timeout)
+                if self._closed:
+                    due.extend(self._full)
+                    due.extend(self._lanes.values())
+                    self._full = []
+                    self._lanes.clear()
+                for ln in due:
+                    self._queued_rows -= ln.rows
+                self._set_depth_gauge()
+                closed = self._closed
+            if closed:
+                err = CoalescerShutdownError(
+                    "query coalescer shut down with requests queued")
+                for ln in due:
+                    self._fail_lane(ln, err)
+                return
+            try:
+                self._flush(due)
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                # anything _flush itself failed to contain: no waiter may
+                # hang, and the next window must still be served
+                for ln in due:
+                    self._fail_lane(ln, e)
+
+    def _flush(self, due: list[_Lane]) -> None:
+        """Depth-2 pipelined flush: each lane takes an in-flight slot (the
+        flusher BLOCKS when both are busy — that stall is what lets the
+        next window's lanes fill to full width), has its device dispatch
+        enqueued here in order, and finalizes on the dispatch pool so
+        hydration overlaps the next lane's device compute."""
+        for i, ln in enumerate(due):
+            while not self._inflight.acquire(timeout=0.1):
+                if self._closed:
+                    # a wedged in-flight dispatch must not strand the rest
+                    err = CoalescerShutdownError(
+                        "query coalescer shut down with requests queued")
+                    for rest in due[i:]:
+                        self._fail_lane(rest, err)
+                    return
+            try:
+                if ln.flt is not None or not hasattr(
+                        ln.shard.vector_index, "search_by_vectors_async"):
+                    # filtered lanes AND indexes without true async dispatch
+                    # (hnsw, noop): the whole blocking search runs on the
+                    # pool — object_vector_search_async's sync fallback
+                    # would otherwise execute it inline in THIS thread and
+                    # head-of-line-block every other lane
+                    self._dispatch_pool.submit(self._dispatch_sync, ln)
+                    continue
+                q = (ln.items[0].vectors if len(ln.items) == 1
+                     else np.concatenate([w.vectors for w in ln.items]))
+                self._observe_wait(ln)  # queue wait ends as dispatch starts
+                done = ln.shard.object_vector_search_async(
+                    q, ln.k, include_vector=ln.include_vector)
+                self._dispatch_pool.submit(self._finalize_async, ln, done)
+            except Exception as e:  # noqa: BLE001 — propagate to all waiters
+                # covers pool.submit after shutdown too: no waiter may hang
+                self._inflight.release()
+                self._fail_lane(ln, e)
+
+    def _dispatch_sync(self, lane: _Lane) -> None:
+        try:
+            q = np.concatenate([w.vectors for w in lane.items]) \
+                if len(lane.items) > 1 else lane.items[0].vectors
+            self._observe_wait(lane)
+            res = lane.shard.object_vector_search(
+                q, lane.k, lane.flt, None, lane.include_vector)
+            self._resolve_lane(lane, res)
+        except Exception as e:  # noqa: BLE001 — propagate to all waiters
+            self._fail_lane(lane, e)
+        finally:
+            self._inflight.release()
+
+    def _finalize_async(self, lane: _Lane, done) -> None:
+        try:
+            self._resolve_lane(lane, done())
+        except Exception as e:  # noqa: BLE001 — propagate to all waiters
+            self._fail_lane(lane, e)
+        finally:
+            self._inflight.release()
+
+    def _observe_wait(self, lane: _Lane) -> None:
+        """Admission-queue wait per request, observed AT dispatch start —
+        observing at resolution would fold the search+hydration latency in
+        and make the histogram useless for tuning the window."""
+        m = self.metrics
+        if m is not None:
+            try:
+                now = time.monotonic()
+                for w in lane.items:
+                    m.coalescer_wait.observe((now - w.enqueued_at) * 1000.0)
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+
+    def _resolve_lane(self, lane: _Lane, res) -> None:
+        """Scatter [rows] result lists back to the lane's waiters. No k
+        trimming is needed: k is part of the lane key (see submit), so every
+        waiter here asked for exactly the k the dispatch ran at."""
+        pos = 0
+        try:
+            for w in lane.items:
+                r = w.vectors.shape[0]
+                w.result = res[pos: pos + r]
+                pos += r
+                w.event.set()
+        finally:
+            # a scatter bug must not leave later waiters hanging
+            for w in lane.items:
+                if not w.event.is_set():
+                    w.error = RuntimeError(
+                        "coalescer failed to scatter batch results")
+                    w.event.set()
+        with self._lock:
+            self._dispatches += 1
+            self._dispatched_requests += len(lane.items)
+            self._dispatched_rows += lane.rows
+        m = self.metrics
+        if m is not None:
+            try:
+                m.coalescer_batch_requests.observe(len(lane.items))
+                m.coalescer_batch_rows.observe(lane.rows)
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+
+    @staticmethod
+    def _fail_lane(lane: _Lane, err: BaseException) -> None:
+        # a failed lane means every waiter silently re-runs on the direct
+        # path (coalesce window + dead dispatch + duplicate search): make
+        # that degradation COUNTABLE, not invisible — the JGL004 rule
+        if not isinstance(err, CoalescerShutdownError):
+            record_device_fallback("serving.coalescer", "lane_dispatch_failed",
+                                   err)
+        for w in lane.items:
+            w.error = err
+            w.event.set()
+
+    def _set_depth_gauge(self) -> None:
+        m = self.metrics
+        if m is not None:
+            try:
+                m.coalescer_queue_depth.set(self._queued_rows)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- introspection / lifecycle -------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            d = self._dispatches
+            return {
+                "dispatches": d,
+                "requests": self._dispatched_requests,
+                "rows": self._dispatched_rows,
+                "mean_requests_per_dispatch":
+                    (self._dispatched_requests / d) if d else 0.0,
+                "mean_rows_per_dispatch":
+                    (self._dispatched_rows / d) if d else 0.0,
+                "bypass": dict(self._bypass),
+            }
+
+    def shutdown(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
+        # in-flight dispatch tasks run to completion (each wakes its own
+        # waiters, success or failure); nothing new can be submitted
+        self._dispatch_pool.shutdown(wait=False)

@@ -77,12 +77,35 @@ class Traverser:
 
 
 class Explorer:
-    def __init__(self, db, schema_manager, modules=None, query_limit: int = 25, max_results: int = 10000):
+    def __init__(self, db, schema_manager, modules=None, query_limit: int = 25,
+                 max_results: int = 10000, coalescer=None):
         self.db = db
         self.schema = schema_manager
         self.modules = modules
         self.query_limit = query_limit
         self.max_results = max_results
+        # cross-request micro-batching (serving/coalescer.py); None => every
+        # dispatch below is the direct path, untouched
+        self.coalescer = coalescer
+
+    # -- cross-request coalescing (serving/coalescer.py) ---------------------
+
+    def _coalesce_submit(self, idx, vecs: np.ndarray, k: int, flt,
+                         include_vector: bool):
+        """Admission-queue a request's rows for a coalesced device dispatch.
+        -> blocking finalize() (same contract as object_vector_search_async's
+        `done`) or None => the caller uses the direct path. Only the
+        single-local-shard layout coalesces: multi-shard/remote fan-out
+        already runs per-shard batches on the pool."""
+        co = self.coalescer
+        if co is None:
+            return None
+        shard = getattr(idx, "single_local_shard", lambda: None)()
+        if shard is None:
+            co.record_bypass("multi_shard")
+            return None
+        return co.submit(shard, vecs, k, flt=flt,
+                         include_vector=include_vector)
 
     # -- vector resolution (near_params_vector.go) ---------------------------
 
@@ -257,13 +280,19 @@ class Explorer:
                 vecs = np.stack(
                     [np.asarray(params_list[i].near_vector["vector"], np.float32) for i in idxs]
                 )
-                if hasattr(idx, "object_vector_search_async"):
-                    done = idx.object_vector_search_async(
-                        vecs, limit + offset, include_vector=inc_vec)
-                else:
-                    res = idx.object_vector_search(
-                        vecs, limit + offset, include_vector=inc_vec)
-                    done = (lambda res=res: res)
+                # coalescer first: a narrow group (the gRPC single-Search /
+                # REST shape) merges with other in-flight requests into one
+                # padded dispatch; wide groups bypass inside submit()
+                done = self._coalesce_submit(
+                    idx, vecs, limit + offset, None, inc_vec)
+                if done is None:
+                    if hasattr(idx, "object_vector_search_async"):
+                        done = idx.object_vector_search_async(
+                            vecs, limit + offset, include_vector=inc_vec)
+                    else:
+                        res = idx.object_vector_search(
+                            vecs, limit + offset, include_vector=inc_vec)
+                        done = (lambda res=res: res)
                 pending.append((idxs, offset, done))
             except Exception:
                 # ragged shapes or a bad class: isolate per query
@@ -343,13 +372,29 @@ class Explorer:
             vec = self._resolve_vector(params, idx)
             if vec is not None:
                 target = self._near_threshold(params, idx)
-                res = idx.object_vector_search(
-                    vec,
-                    limit + params.offset,
-                    flt=params.filters,
-                    target_distance=target,
-                    include_vector=inc_vec,
-                )[0][params.offset :]
+                res = None
+                if target is None:
+                    # coalesce single kNN queries cross-request; filtered
+                    # queries lane per filter SIGNATURE (a shared filter
+                    # coalesces, a one-off allowList bypasses inside
+                    # submit). target-distance queries stay direct — their
+                    # iterative widening can't share a fixed-k dispatch.
+                    wait = self._coalesce_submit(
+                        idx, np.asarray(vec, np.float32)[None, :],
+                        limit + params.offset, params.filters, inc_vec)
+                    if wait is not None:
+                        try:
+                            res = wait()[0][params.offset:]
+                        except Exception:  # noqa: BLE001 — dead batch:
+                            res = None     # re-run on the direct path
+                if res is None:
+                    res = idx.object_vector_search(
+                        vec,
+                        limit + params.offset,
+                        flt=params.filters,
+                        target_distance=target,
+                        include_vector=inc_vec,
+                    )[0][params.offset :]
             else:
                 # sort pushdown: shards order doc ids via the LSM-backed
                 # sorter and hydrate only the requested page
