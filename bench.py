@@ -1039,6 +1039,47 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _trace_phase_breakdown(tracer) -> Optional[dict]:
+    """Per-request phase percentiles from the serving run's trace ring:
+    queue-wait / device / hydrate p50+p99 (ms), summed per request across
+    its dispatch spans (a retried request counts both dispatches — that IS
+    its cost). None when tracing was off or nothing was sampled."""
+    if tracer is None:
+        return None
+    qw: list[float] = []
+    dev: list[float] = []
+    hyd: list[float] = []
+    for doc in tracer.snapshot():
+        tq = td = th = 0.0
+        found = False
+        stack = [doc["root"]]
+        while stack:
+            s = stack.pop()
+            if s.get("name") == "dispatch":
+                found = True
+                a = s.get("attrs", {})
+                tq += float(a.get("queue_wait_ms", 0.0))
+                td += float(a.get("device_ms", 0.0))
+                th += sum(float(c.get("duration_ms", 0.0))
+                          for c in s.get("children", [])
+                          if c.get("name") == "hydrate")
+            stack.extend(s.get("children", []))
+        if found:
+            qw.append(tq)
+            dev.append(td)
+            hyd.append(th)
+    if not qw:
+        return None
+
+    def pct(vals: list[float]) -> dict:
+        arr = np.asarray(vals, np.float64)
+        return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "p99_ms": round(float(np.percentile(arr, 99)), 3)}
+
+    return {"sampled_requests": len(qw), "queue_wait": pct(qw),
+            "device": pct(dev), "hydrate": pct(hyd)}
+
+
 def run_serving_bench(args, rng):
     """Closed-loop serving QPS through the real gRPC stack (satellite of the
     query-coalescer tentpole): N client threads each issue single-query kNN
@@ -1076,6 +1117,16 @@ def run_serving_bench(args, rng):
         cfg.coalescer.enabled = coalesce_on
         cfg.coalescer.window_ms = float(
             os.environ.get("BENCH_COALESCE_WINDOW_MS", 1.5))
+        # trace a sample of requests so the row carries a PHASE-LEVEL
+        # baseline (queue-wait / device / hydrate p50+p99) next to QPS —
+        # future perf PRs can see WHICH phase moved, not just the headline.
+        # Sampled (default 10%) so the tracer itself stays out of the
+        # measurement; ring sized to hold a full window of samples.
+        cfg.tracing.enabled = True
+        cfg.tracing.sample_rate = float(
+            os.environ.get("BENCH_TRACE_SAMPLE_RATE", 0.1))
+        cfg.tracing.ring_size = 4096
+        cfg.tracing.slow_query_threshold_ms = 0.0  # no slow-log noise
         data_dir = tempfile.mkdtemp(prefix="benchserve")
         app = srv = None
         try:
@@ -1136,6 +1187,8 @@ def run_serving_bench(args, rng):
                 t.start()
             time.sleep(args.serve_warmup)  # compile the padding buckets
             base = app.coalescer.stats() if app.coalescer is not None else None
+            if app.tracer is not None:
+                app.tracer.clear()  # phase stats cover the counted window only
             counting.set()
             t0 = time.perf_counter()
             time.sleep(args.serve_seconds)
@@ -1184,6 +1237,9 @@ def run_serving_bench(args, rng):
                     k: v - base["bypass"].get(k, 0)
                     for k, v in st["bypass"].items()
                     if v - base["bypass"].get(k, 0)}
+            phases = _trace_phase_breakdown(app.tracer)
+            if phases is not None:
+                row["trace_phases"] = phases
             log(f"  coalesce={'on' if coalesce_on else 'off'}: {row}")
             return row
         finally:

@@ -293,6 +293,124 @@ def test_jgl006_scoped_to_hot_modules_and_f32_ok():
     assert codes(ok) == []
 
 
+# -- JGL007: span leak --------------------------------------------------------
+
+SERVING = "weaviate_tpu/serving/fake_lane.py"   # inside the span scope
+DBMOD = "weaviate_tpu/db/fake_shard.py"         # also inside
+
+
+def test_jgl007_bare_span_open_fires_in_serving_and_db():
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(parent, rows):\n"
+        "    s = parent.child_start('dispatch')\n"
+        "    rec = tracing.dispatch_record(rows)\n"
+        "    return s, rec\n"
+    )
+    assert codes(src, SERVING).count("JGL007") == 2
+    assert codes(src, DBMOD).count("JGL007") == 2
+
+
+def test_jgl007_with_statement_is_structurally_closed():
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(q):\n"
+        "    with tracing.start_span('x') as s:\n"
+        "        s.annotate('k', 1)\n"
+        "    return q\n"
+    )
+    assert codes(src, SERVING) == []
+
+
+def test_jgl007_open_inside_try_with_closing_finally_passes():
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(rows):\n"
+        "    rec = None\n"
+        "    try:\n"
+        "        rec = tracing.dispatch_record(rows)\n"
+        "        return rec\n"
+        "    finally:\n"
+        "        if rec is not None:\n"
+        "            rec.finish()\n"
+    )
+    assert codes(src, SERVING) == []
+
+
+def test_jgl007_open_before_the_guarding_try_still_fires():
+    # the open sits OUTSIDE the try: an exception between the two lines
+    # leaks the span even though a closing finally exists below
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(rows):\n"
+        "    rec = tracing.dispatch_record(rows)\n"
+        "    try:\n"
+        "        return rec\n"
+        "    finally:\n"
+        "        rec.finish()\n"
+    )
+    assert codes(src, SERVING).count("JGL007") == 1
+
+
+def test_jgl007_unrelated_close_in_finally_does_not_waive():
+    # fh.close() is a close-named call, but not on a name the try body
+    # assigned from a span open — the leaked span must still fire
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(p):\n"
+        "    try:\n"
+        "        s = tracing.start_span('x')\n"
+        "        fh = open(p)\n"
+        "        return s, fh\n"
+        "    finally:\n"
+        "        fh.close()\n"
+    )
+    assert codes(src, SERVING).count("JGL007") == 1
+
+
+def test_jgl007_nested_def_inside_covered_try_still_fires():
+    # the nested function's body runs LATER, outside the enclosing
+    # try/finally — its span open is not covered by rec.finish()
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(rows, register):\n"
+        "    try:\n"
+        "        rec = tracing.dispatch_record(rows)\n"
+        "        def cb():\n"
+        "            return tracing.start_span('late')\n"
+        "        register(cb)\n"
+        "    finally:\n"
+        "        rec.finish()\n"
+    )
+    assert codes(src, SERVING).count("JGL007") == 1
+
+
+def test_jgl007_open_in_finally_itself_is_uncovered():
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(rows):\n"
+        "    try:\n"
+        "        return rows\n"
+        "    finally:\n"
+        "        s = tracing.start_span('late')\n"
+    )
+    assert codes(src, SERVING).count("JGL007") == 1
+
+
+def test_jgl007_scoped_to_serving_and_db_only():
+    src = (
+        "from weaviate_tpu.monitoring import tracing\n"
+        "def f(rows):\n"
+        "    return tracing.dispatch_record(rows)\n"
+    )
+    assert codes(src, COLD) == []      # usecases/: out of scope
+    assert codes(src, HOT) == []       # ops/: out of scope too
+    # module-level (import-time) calls are not serving-path leaks
+    top = "from weaviate_tpu.monitoring import tracing\n" \
+          "REC = tracing.dispatch_record(1)\n"
+    assert codes(top, SERVING) == []
+
+
 # -- suppressions (JGL000) ----------------------------------------------------
 
 def test_suppression_with_reason_silences_finding():

@@ -1,0 +1,559 @@
+"""End-to-end request tracing (monitoring/tracing.py) + its wiring.
+
+The two acceptance-critical properties pinned here:
+
+  1. ATTRIBUTION IDENTITY — a coalesced multi-request run yields traces
+     where each rider's attributed device time sums exactly to the
+     dispatch's device span (shares are rows_i/actual_rows over the REAL
+     rows; padding overhead is reported separately as padding_waste, never
+     smeared into shares).
+
+  2. DISABLED = ZERO TRACING WORK — with TRACING_ENABLED unset, the
+     serving hot path creates no Span, no Trace, no DispatchRecord, and
+     never consults the Tracer (spied by replacing the classes on the
+     module; serving code reaches them through module-global lookups, so a
+     single construction would trip the spy).
+
+Plus trace propagation across every coalescer edge: bypass lanes,
+wrong-dim isolation, dispatch error, shutdown — each must CLOSE or
+annotate the rider traces, never leak an open span.
+"""
+
+import json
+import logging
+import threading
+import time
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.serving.coalescer import (
+    CoalescerShutdownError,
+    QueryCoalescer,
+)
+from weaviate_tpu.usecases.traverser import GetParams
+
+N, DIM, K = 400, 16, 5
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_tracer():
+    """Tests install process-global tracers; never let one leak across."""
+    yield
+    tracing.configure(None)
+
+
+def _mk_app(tmp_path, tracing_on=True, coalesce=True, window_ms=200.0,
+            sample_rate=1.0, ring_size=256, slow_ms=0.0):
+    from weaviate_tpu.server import App
+
+    cfg = Config()
+    cfg.coalescer.enabled = coalesce
+    cfg.coalescer.window_ms = window_ms
+    cfg.tracing.enabled = tracing_on
+    cfg.tracing.sample_rate = sample_rate
+    cfg.tracing.ring_size = ring_size
+    cfg.tracing.slow_query_threshold_ms = slow_ms
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    app.schema.add_class({
+        "class": "Tr", "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "tag", "dataType": ["text"]}],
+    })
+    rng = np.random.default_rng(11)
+    vecs = rng.integers(-8, 8, (N, DIM)).astype(np.float32)
+    idx = app.db.get_index("Tr")
+    idx.put_batch([
+        StorObj(class_name="Tr", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"tag": "even" if i % 2 == 0 else "odd"},
+                vector=vecs[i])
+        for i in range(N)])
+    return app, idx, vecs
+
+
+def _walk_spans(span_dict):
+    yield span_dict
+    for c in span_dict.get("children", []):
+        yield from _walk_spans(c)
+
+
+def _dispatch_spans(trace_dicts):
+    """All 'dispatch' attribution spans across a list of trace dicts."""
+    out = []
+    for tr in trace_dicts:
+        for s in _walk_spans(tr["root"]):
+            if s["name"] == "dispatch":
+                out.append(s)
+    return out
+
+
+def _get(app, vec, flt=None, limit=K):
+    return app.traverser.get_class(GetParams(
+        class_name="Tr", near_vector={"vector": vec.tolist()},
+        filters=flt, limit=limit))
+
+
+# -- the attribution identity (acceptance criterion) --------------------------
+
+def test_coalesced_attribution_identity(tmp_path):
+    """Concurrent single-query requests coalesce into shared dispatches;
+    every rider's trace carries a dispatch span whose device_ms share sums
+    (across the dispatch's riders) to the dispatch's device span."""
+    app, idx, vecs = _mk_app(tmp_path)
+    try:
+        n_req = 10
+        barrier = threading.Barrier(n_req)
+
+        def run(i):
+            with tracing.request("test", f"q{i}"):
+                barrier.wait()
+                _get(app, vecs[i] + 0.5)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        snap = app.tracer.snapshot()
+        assert len(snap) == n_req
+        by_dispatch: dict = {}
+        for d in _dispatch_spans(snap):
+            by_dispatch.setdefault(d["attrs"]["dispatch_id"], []).append(
+                d["attrs"])
+        assert by_dispatch, "no dispatch spans attributed"
+        coalesced = [v for v in by_dispatch.values() if len(v) > 1]
+        assert coalesced, "requests never shared a dispatch"
+        total_riders = 0
+        for riders in by_dispatch.values():
+            total_riders += len(riders)
+            device_total = riders[0]["dispatch_device_ms"]
+            # the identity: rider device shares sum to the dispatch span
+            assert sum(a["device_ms"] for a in riders) == pytest.approx(
+                device_total, rel=1e-9)
+            # shares over ACTUAL rows (each request here is one row)
+            assert len(riders) == riders[0]["actual_rows"]
+            assert sum(a["share"] for a in riders) == pytest.approx(
+                1.0, rel=1e-6)
+            # padding slack is reported, not smeared into the shares
+            assert riders[0]["padded_rows"] >= riders[0]["actual_rows"]
+            waste = riders[0]["padding_waste"]
+            assert waste == pytest.approx(
+                1.0 - riders[0]["actual_rows"] / riders[0]["padded_rows"],
+                abs=1e-4)
+        assert total_riders == n_req  # every request attributed exactly once
+    finally:
+        app.shutdown()
+
+
+def test_dispatch_facts_padded_jit_and_queue_wait(tmp_path):
+    """A traced request records the dispatch facts: padded width from the
+    index's bucket, the first-sighting-of-this-jit-shape bit (True once,
+    False after), occupancy, and the lane queue wait."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=30.0)
+    try:
+        for i in range(2):
+            with tracing.request("test", f"q{i}"):
+                _get(app, vecs[i] + 0.5)
+        d1, d2 = _dispatch_spans(app.tracer.snapshot())
+        a1, a2 = d1["attrs"], d2["attrs"]
+        assert a1["padded_rows"] == idx.single_local_shard() \
+            .vector_index.padded_width(1)
+        assert a1["jit_shape_first_seen"] is True
+        assert a2["jit_shape_first_seen"] is False  # same (padded, k) shape
+        assert a1["coalesced"] is True and a1["lane_requests"] == 1
+        # the deadline flush means the lone request waited ~the window
+        assert a1["queue_wait_ms"] >= 10.0
+        assert {"device_search", "hydrate"} <= {
+            c["name"] for c in d1["children"]}
+    finally:
+        app.shutdown()
+
+
+def test_jit_shape_registered_even_for_untraced_dispatches(tmp_path):
+    """Shape registration must see EVERY dispatch while the tracer is up:
+    under sampling the compile-paying dispatch is usually unsampled, and
+    the next sampled dispatch of the warm shape must NOT read first-seen."""
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False)
+    try:
+        # no request context: rec is None, but the dispatch registers
+        idx.object_vector_search(vecs[0] + 0.5, K)
+        with tracing.request("test", "q"):
+            _get(app, vecs[1] + 0.5)
+        d = _dispatch_spans(app.tracer.snapshot())
+        assert len(d) == 1
+        assert d[0]["attrs"]["jit_shape_first_seen"] is False
+    finally:
+        app.shutdown()
+
+
+# -- disabled => zero tracing work on the serving path ------------------------
+
+def test_disabled_serving_path_makes_zero_tracing_calls(tmp_path, monkeypatch):
+    """TRACING_ENABLED unset: serving requests (direct AND coalesced paths,
+    gRPC end to end) must construct no Span/Trace/DispatchRecord and never
+    call Tracer.start_request — spied by replacing the module-global
+    classes every call site resolves at call time."""
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
+
+    app, idx, vecs = _mk_app(tmp_path, tracing_on=False)
+    calls = []
+
+    def spy(name):
+        def boom(*a, **kw):
+            calls.append(name)
+            raise AssertionError(f"tracing.{name} touched while disabled")
+        return boom
+
+    monkeypatch.setattr(tracing, "Span", spy("Span"))
+    monkeypatch.setattr(tracing, "Trace", spy("Trace"))
+    monkeypatch.setattr(tracing, "DispatchRecord", spy("DispatchRecord"))
+    monkeypatch.setattr(tracing.Tracer, "start_request",
+                        spy("Tracer.start_request"))
+    srv = GrpcServer(app, port=0, max_workers=8)
+    srv.start()
+    try:
+        assert app.tracer is None
+        assert tracing.get_tracer() is None
+        # coalesced lane
+        res = _get(app, vecs[0] + 0.5)
+        assert len(res) == K
+        # direct path (coalescer bypass via oversize batched group)
+        out = app.traverser.get_class_batched([
+            GetParams(class_name="Tr",
+                      near_vector={"vector": (vecs[i] + 0.5).tolist()},
+                      limit=K)
+            for i in range(20)])
+        assert not any(isinstance(r, Exception) for r in out)
+        # gRPC end to end (the handler wrap + request-id metadata path)
+        cl = SearchClient(f"127.0.0.1:{srv.port}")
+        try:
+            rep = cl.search(pb.SearchRequest(
+                class_name="Tr", limit=K,
+                near_vector=pb.NearVectorParams(
+                    vector=(vecs[1] + 0.5).tolist())))
+            assert len(rep.results) == K
+        finally:
+            cl.close()
+        assert calls == []
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_unsampled_request_serves_with_no_trace(tmp_path):
+    """sample_rate=0: the tracer exists but every request is sampled out —
+    serving still works, the ring stays empty, no span context leaks."""
+    app, idx, vecs = _mk_app(tmp_path, sample_rate=0.0)
+    try:
+        with tracing.request("test", "q") as tr:
+            assert tr is None
+            assert tracing.current_span() is None
+            res = _get(app, vecs[0] + 0.5)
+        assert len(res) == K
+        assert app.tracer.snapshot() == []
+    finally:
+        app.shutdown()
+
+
+# -- propagation across every coalescer edge ----------------------------------
+
+def test_bypass_lane_annotates_trace_and_records_direct_dispatch(tmp_path):
+    """A cold-filter bypass annotates the trace with the reason AND the
+    direct-path dispatch that serves it still records its phase spans
+    (including the filter phase)."""
+    app, idx, vecs = _mk_app(tmp_path)
+    try:
+        flt = LocalFilter.from_dict(
+            {"operator": "Equal", "path": ["tag"], "valueText": "even"})
+        with tracing.request("test", "cold") as tr:
+            res = _get(app, vecs[0] + 0.5, flt=flt)
+        assert len(res) == K
+        doc = app.tracer.snapshot()[0]
+        spans = list(_walk_spans(doc["root"]))
+        tv = [s for s in spans if s["name"] == "traverser.get_class"][0]
+        assert tv["attrs"]["coalescer_bypass"] == "cold_filter"
+        d = [s for s in spans if s["name"] == "dispatch"]
+        assert len(d) == 1 and d[0]["attrs"].get("coalesced") is not True
+        assert {"filter", "device_search", "hydrate"} <= {
+            c["name"] for c in d[0]["children"]}
+        assert doc["duration_ms"] is not None  # root closed
+    finally:
+        app.shutdown()
+
+
+def test_wrong_dim_fails_alone_and_lane_mates_attribute(tmp_path):
+    """Dim isolation: the malformed request's trace gets the coalescer
+    error annotation; its would-be lane-mates still get clean attribution."""
+    app, idx, vecs = _mk_app(tmp_path)
+    try:
+        shard = idx.single_local_shard()
+        co = QueryCoalescer(window_s=0.05, max_batch=64, max_request_rows=4)
+        try:
+            waits, traces = [], []
+
+            def submit(vec, name):
+                with tracing.request("test", name) as tr:
+                    traces.append(tr)
+                    return co.submit(shard, vec, K)
+
+            for i in range(3):
+                waits.append(submit(vecs[i], f"good{i}"))
+            bad_wait = submit(np.zeros(DIM * 2, np.float32), "bad")
+            for w in waits:
+                assert len(w()) == 1
+            with pytest.raises(Exception):
+                bad_wait()
+            time.sleep(0.1)  # annotation lands before the waiter wakes,
+            # but the good lanes' finish() may still be in flight
+            docs = {t.name: t.to_dict() for t in traces}
+            assert "coalescer_error" in docs["bad"]["root"]["attrs"]
+            for i in range(3):
+                d = _dispatch_spans([docs[f"good{i}"]])
+                assert len(d) == 1
+                assert "coalescer_error" not in \
+                    docs[f"good{i}"]["root"].get("attrs", {})
+        finally:
+            co.shutdown()
+    finally:
+        app.shutdown()
+
+
+def test_dispatch_error_annotates_and_direct_retry_traces(tmp_path):
+    """An injected dispatch failure: the rider trace carries the coalescer
+    error AND the retry marker AND the direct dispatch that re-served it —
+    the doubled device work is visible, not silent."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=30.0)
+    try:
+        shard = idx.single_local_shard()
+        boom = RuntimeError("injected dispatch failure")
+
+        def exploding(*a, **kw):
+            raise boom
+
+        shard.object_vector_search_async = exploding
+        try:
+            with tracing.request("test", "q") as tr:
+                res = _get(app, vecs[0] + 0.5)
+            assert len(res) == K  # served by the direct retry
+        finally:
+            del shard.object_vector_search_async
+        doc = app.tracer.snapshot()[0]
+        spans = list(_walk_spans(doc["root"]))
+        tv = [s for s in spans if s["name"] == "traverser.get_class"][0]
+        assert "coalescer_error" in tv["attrs"]
+        assert "coalescer_retry_direct" in tv["attrs"]
+        d = _dispatch_spans([doc])
+        assert len(d) == 1 and d[0]["attrs"].get("coalesced") is not True
+    finally:
+        app.shutdown()
+
+
+def test_shutdown_annotates_queued_waiters(tmp_path):
+    """Waiters queued at shutdown: the trace records the shutdown, the
+    waiter raises, and the request trace still closes."""
+    app, idx, vecs = _mk_app(tmp_path)
+    try:
+        shard = idx.single_local_shard()
+        co = QueryCoalescer(window_s=60.0, max_batch=64, max_request_rows=4)
+        with tracing.request("test", "q") as tr:
+            w = co.submit(shard, vecs[0], K)
+            assert w is not None
+            co.shutdown()
+            with pytest.raises(CoalescerShutdownError):
+                w()
+        doc = app.tracer.snapshot()[0]
+        assert "coalescer_shutdown" in doc["root"]["attrs"]
+        assert doc["duration_ms"] is not None
+    finally:
+        app.shutdown()
+
+
+# -- exposure surfaces --------------------------------------------------------
+
+def test_debug_traces_endpoint_and_request_id_headers(tmp_path):
+    """REST: traceparent honored (trace joins the caller's trace id),
+    X-Request-Id echoed on success AND error replies, /debug/traces serves
+    the ring behind the data-plane authorizer."""
+    import urllib.error
+    import urllib.request
+
+    from weaviate_tpu.server.rest import RestServer
+
+    app, idx, vecs = _mk_app(tmp_path)
+    srv = RestServer(app, port=0)
+    srv.start()
+    try:
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        gq = ("{ Get { Tr(nearVector: {vector: %s}, limit: 3) "
+              "{ _additional { id } } } }" % (vecs[0] + 0.5).tolist())
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/graphql",
+            data=json.dumps({"query": gq}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": tp, "X-Request-Id": "rid-42"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        assert resp.headers.get("X-Request-Id") == "rid-42"
+        assert "errors" not in json.loads(resp.read())
+        # error envelope carries a (generated) request id too
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/not-a-route", timeout=10)
+        assert ei.value.headers.get("X-Request-Id")
+        # a traced response EMITS the server's traceparent: same trace id
+        # as the inbound header, this server's own (fresh) span id
+        resp_tp = tracing.parse_traceparent(resp.headers.get("traceparent"))
+        assert resp_tp is not None
+        assert resp_tp[0] == "ab" * 16 and resp_tp[1] != "cd" * 8
+        dbg = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/traces?limit=5",
+            timeout=10).read())
+        assert dbg["enabled"] is True and dbg["count"] >= 1
+        tr = dbg["traces"][-1]
+        assert tr["trace_id"] == "ab" * 16
+        assert tr["parent_span_id"] == "cd" * 8
+        assert tr["request_id"] == "rid-42"
+        assert tr["kind"] == "rest"
+        # the graphql span nests under the rest root
+        names = {s["name"] for s in _walk_spans(tr["root"])}
+        assert {"request", "graphql.get", "traverser.get_class",
+                "dispatch"} <= names
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_grpc_trailing_request_id_and_trace(tmp_path):
+    """gRPC: x-request-id honored and echoed as trailing metadata; the
+    trace records kind=grpc with the inbound traceparent's trace id."""
+    import grpc
+
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server.grpc_server import GrpcServer
+
+    app, idx, vecs = _mk_app(tmp_path)
+    srv = GrpcServer(app, port=0, max_workers=8)
+    srv.start()
+    try:
+        tp = "00-" + "12" * 16 + "-" + "34" * 8 + "-01"
+        ch = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        call = ch.unary_unary(
+            "/weaviatetpu.v1.Weaviate/Search",
+            request_serializer=pb.SearchRequest.SerializeToString,
+            response_deserializer=pb.SearchReply.FromString)
+        rep, info = call.with_call(
+            pb.SearchRequest(class_name="Tr", limit=K,
+                             near_vector=pb.NearVectorParams(
+                                 vector=(vecs[0] + 0.5).tolist())),
+            metadata=(("x-request-id", "grid-9"), ("traceparent", tp)))
+        ch.close()
+        assert len(rep.results) == K
+        md = dict(info.trailing_metadata() or ())
+        assert md.get("x-request-id") == "grid-9"
+        out_tp = tracing.parse_traceparent(md.get("traceparent"))
+        assert out_tp is not None and out_tp[0] == "12" * 16
+        doc = app.tracer.snapshot()[-1]
+        assert doc["kind"] == "grpc"
+        assert doc["trace_id"] == "12" * 16
+        assert doc["request_id"] == "grid-9"
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_slow_query_log_emits_full_span_tree(tmp_path, caplog):
+    """A trace over the threshold logs ONE structured JSON line with the
+    whole span tree on the weaviate_tpu.slowquery logger."""
+    app, idx, vecs = _mk_app(tmp_path, slow_ms=0.0001)
+    try:
+        with caplog.at_level(logging.WARNING, logger="weaviate_tpu.slowquery"):
+            with tracing.request("test", "slow-one"):
+                _get(app, vecs[0] + 0.5)
+        lines = [r.getMessage() for r in caplog.records
+                 if r.name == "weaviate_tpu.slowquery"]
+        assert len(lines) == 1
+        doc = json.loads(lines[0])
+        assert doc["slow_query"] is True and doc["name"] == "slow-one"
+        assert any(s["name"] == "dispatch"
+                   for s in _walk_spans(doc["root"]))
+    finally:
+        app.shutdown()
+
+
+def test_ring_buffer_is_bounded(tmp_path):
+    app, idx, vecs = _mk_app(tmp_path, ring_size=4, window_ms=10.0)
+    try:
+        for i in range(9):
+            with tracing.request("test", f"q{i}"):
+                _get(app, vecs[i] + 0.5)
+        snap = app.tracer.snapshot()
+        assert len(snap) == 4
+        assert [t["name"] for t in snap] == ["q5", "q6", "q7", "q8"]
+    finally:
+        app.shutdown()
+
+
+def test_trace_metrics_exposed(tmp_path):
+    """Exemplar counters land in the app's Metrics registry."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=10.0)
+    try:
+        with tracing.request("test", "q"):
+            _get(app, vecs[0] + 0.5)
+        text = app.metrics.expose().decode()
+        assert 'weaviate_traces_total{kind="test",outcome="ok"} 1.0' in text
+        assert 'weaviate_trace_phase_ms_count{phase="device_search"} 1.0' \
+            in text
+        assert 'weaviate_trace_phase_ms_count{phase="queue_wait"} 1.0' in text
+        assert 'weaviate_trace_dispatch_rows_total{kind="actual"} 1.0' in text
+        assert 'weaviate_trace_dispatch_rows_total{kind="padded"} 1.0' in text
+    finally:
+        app.shutdown()
+
+
+def test_tracing_config_env_parsing():
+    from weaviate_tpu.config import ConfigError, load_config
+
+    cfg = load_config({
+        "TRACING_ENABLED": "true",
+        "TRACING_SAMPLE_RATE": "0.25",
+        "TRACING_RING_SIZE": "64",
+        "SLOW_QUERY_THRESHOLD_MS": "250",
+    })
+    assert cfg.tracing.enabled is True
+    assert cfg.tracing.sample_rate == 0.25
+    assert cfg.tracing.ring_size == 64
+    assert cfg.tracing.slow_query_threshold_ms == 250.0
+    assert load_config({}).tracing.enabled is False
+    with pytest.raises(ConfigError):
+        load_config({"TRACING_SAMPLE_RATE": "1.5"})
+    with pytest.raises(ConfigError):
+        load_config({"TRACING_RING_SIZE": "0"})
+
+
+def test_request_id_cleaning_blocks_header_injection():
+    """An inbound X-Request-Id is echoed into a response header: CR/LF and
+    non-printables must never survive, and an empty/garbage id is replaced
+    with a generated one."""
+    assert tracing.clean_request_id("abc-123") == "abc-123"
+    assert tracing.clean_request_id(
+        "evil\r\nSet-Cookie: x=1") == "evilSet-Cookie:x=1"
+    assert len(tracing.clean_request_id("x" * 500)) == 128
+    for empty in (None, "", "   ", "\r\n"):
+        rid = tracing.clean_request_id(empty)
+        assert rid and len(rid) == 32  # generated
+
+
+def test_traceparent_parsing_rejects_malformed():
+    good = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    assert tracing.parse_traceparent(good) == ("ab" * 16, "cd" * 8, "01")
+    for bad in (None, "", "garbage", "00-xyz-abc-01",
+                "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # wrong version
+                "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # zero trace id
+                "00-" + "ab" * 16 + "-" + "0" * 16 + "-01"):  # zero parent
+        assert tracing.parse_traceparent(bad) is None

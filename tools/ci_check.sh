@@ -2,17 +2,32 @@
 # Pre-PR gate: graftlint + ruff + tier-1 tests. Run from the repo root:
 #   bash tools/ci_check.sh
 # Exits nonzero on the first failing stage. Documented in README.md.
+#
+# CI_ARTIFACT_DIR (optional): when set, the graftlint report and the tier-1
+# log are written there under stable names (graftlint-report.txt, _t1.log)
+# and kept — the workflow uploads them as artifacts on failure so a red run
+# is debuggable without a rerun. Unset (local use) => per-run mktemp logs,
+# cleaned up as before.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 fail=0
 
+art="${CI_ARTIFACT_DIR:-}"
+if [ -n "$art" ]; then
+    mkdir -p "$art"
+fi
+
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="
-if ! python -m tools.graftlint weaviate_tpu --strict-baseline; then
+gl_log="${art:+$art/graftlint-report.txt}"
+gl_log="${gl_log:-$(mktemp)}"
+if ! python -m tools.graftlint weaviate_tpu --strict-baseline 2>&1 \
+        | tee "$gl_log"; then
     echo "ci_check: graftlint FAILED — fix the findings or suppress inline" \
          "with a reason; the baseline may only shrink" >&2
     fail=1
 fi
+[ -z "$art" ] && rm -f "$gl_log"
 
 echo "== ruff (pycodestyle/pyflakes/bugbear subset from pyproject.toml) =="
 if command -v ruff >/dev/null 2>&1; then
@@ -47,13 +62,16 @@ if [ "$fail" -ne 0 ]; then
 fi
 
 echo "== tier-1 tests (ROADMAP.md verify command) =="
-t1_log="$(mktemp)"  # per-run log: no clashes between users / concurrent runs
+# per-run mktemp log locally (no clashes between users / concurrent runs);
+# a stable, kept path under CI_ARTIFACT_DIR in CI (uploaded on failure)
+t1_log="${art:+$art/_t1.log}"
+t1_log="${t1_log:-$(mktemp)}"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
     2>&1 | tee "$t1_log"
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" | tr -cd . | wc -c)"
-rm -f "$t1_log"
+[ -z "$art" ] && rm -f "$t1_log"
 if [ "$rc" -ne 0 ]; then
     echo "ci_check: tier-1 tests FAILED (rc=$rc)" >&2
     exit "$rc"

@@ -1,4 +1,4 @@
-"""graftlint rules: the six project-specific TPU-hot-path checks.
+"""graftlint rules: the seven project-specific TPU-hot-path checks.
 
 Every rule has a code, a one-line fix-it in its message, and a scope:
 
@@ -11,10 +11,15 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
           log/metric and no re-raise)
   JGL005  module-level mutable state mutated without a lock
   JGL006  dtype drift (float64 spellings in kernel-adjacent code)
+  JGL007  span leak (a trace span opened in serving/db code without a
+          structural close: neither a `with` nor a close in `finally`)
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
-JGL001/JGL004/JGL006; JGL002/JGL003/JGL005 apply package-wide. JGL001
+JGL001/JGL004/JGL006; JGL002/JGL003/JGL005 apply package-wide; JGL007
+gates the request-tracing scope (weaviate_tpu/serving/, weaviate_tpu/db/ —
+where spans cross the coalescer's thread handoffs and a leaked one
+corrupts every rider's trace tree). JGL001
 additionally skips boundary functions whose JOB is host materialization —
 that allowlist lives here, in one place, so reviewers see every waiver.
 
@@ -65,6 +70,25 @@ MUTATING_METHODS = frozenset({
     "extend", "remove", "insert", "move_to_end", "discard",
 })
 
+# JGL007 scope: the serving/trace path, where an unclosed span survives the
+# request and corrupts the trace tree of every later rider in its lane
+JGL007_PREFIXES = (
+    "weaviate_tpu/serving/",
+    "weaviate_tpu/db/",
+)
+
+# span-opening call names: the tracing API's open-ended constructors. The
+# safe forms are `with tracing.span(...)` / `with tracing.request(...)`
+# (structurally closed) — these names are the escape hatches that return an
+# open object the caller must close.
+SPAN_OPEN_NAMES = frozenset({
+    "span_start", "start_span", "child_start", "dispatch_record",
+    "start_request",
+})
+
+# calls that close a span-like object when they appear in a finally block
+SPAN_CLOSE_NAMES = frozenset({"end", "finish", "close"})
+
 RULE_DOCS = {
     "JGL000": "suppression hygiene: every inline disable needs a reason and "
               "must still match a finding",
@@ -82,8 +106,18 @@ RULE_DOCS = {
               "serving threads share module globals",
     "JGL006": "dtype drift — float64 in kernel-adjacent code silently "
               "doubles bandwidth and falls off the MXU fast path",
+    "JGL007": "span leak — a trace span opened in serving/db code must "
+              "close structurally: `with tracing.span(...)`, or open "
+              "inside a `try:` whose `finally:` calls .end()/.finish()",
     "JGL999": "file does not parse",
 }
+
+
+def in_span_scope(rel_path: str) -> bool:
+    """JGL007 scope check (same interior-boundary matching as is_hot)."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL007_PREFIXES)
 
 
 def is_hot(rel_path: str) -> bool:
@@ -185,6 +219,7 @@ class RuleWalker(ast.NodeVisitor):
     def __init__(self, rel_path: str, mod: ModuleIndex):
         self.rel = rel_path
         self.hot = is_hot(rel_path)
+        self.span_scope = in_span_scope(rel_path)
         self.mod = mod
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
@@ -194,6 +229,11 @@ class RuleWalker(ast.NodeVisitor):
         self.with_locks = 0                   # enclosing `with <lock>:` blocks
         self.device_vars: list[set[str]] = []  # per-function device names
         self.global_names: list[set[str]] = []
+        # JGL007 state: span-open calls that ARE a with-statement's context
+        # expression (structurally closed), and the depth of enclosing
+        # try-blocks whose finally calls a span close
+        self._span_with_ctx: set[int] = set()
+        self._span_finally_depth = 0
 
     # -- plumbing --
 
@@ -253,8 +293,13 @@ class RuleWalker(ast.NodeVisitor):
         self.device_vars.append(set())
         self.global_names.append(set())
         outer_loops, self.loop_depth = self.loop_depth, 0
+        # a nested def's body runs LATER, outside any enclosing try/finally
+        # — an enclosing close must not waive its span opens (JGL007)
+        outer_span_depth, self._span_finally_depth = \
+            self._span_finally_depth, 0
         for stmt in node.body:  # decorators/defaults already visited above
             self.visit(stmt)
+        self._span_finally_depth = outer_span_depth
         self.loop_depth = outer_loops
         self.global_names.pop()
         self.device_vars.pop()
@@ -284,9 +329,69 @@ class RuleWalker(ast.NodeVisitor):
                      for item in node.items)
         if locked:
             self.with_locks += 1
+        # a span-open call used AS the context expression is structurally
+        # closed — mark it before visit_Call sees it (JGL007)
+        marked = []
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call) \
+                    and self._span_open_name(item.context_expr):
+                marked.append(id(item.context_expr))
+                self._span_with_ctx.add(id(item.context_expr))
         self.generic_visit(node)
+        for i in marked:
+            self._span_with_ctx.discard(i)
         if locked:
             self.with_locks -= 1
+
+    def visit_Try(self, node: ast.Try) -> None:
+        """A try whose finally closes a span opened IN its body covers the
+        opens in that body (and handlers/else) — the
+        `rec = tracing.dispatch_record(...)` + `finally: rec.finish()`
+        idiom (JGL007). The close must be called ON a name the try body
+        assigned from a span-open call: an unrelated `fh.close()` in the
+        finally must not waive a genuinely leaked span."""
+        opened: set[str] = set()
+        for stmt in node.body + node.handlers + node.orelse:
+            for sub in ast.walk(stmt):
+                targets: list[ast.expr] = []
+                value = None
+                if isinstance(sub, ast.Assign):
+                    targets, value = sub.targets, sub.value
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    targets, value = [sub.target], sub.value
+                if isinstance(value, ast.Call) and self._span_open_name(value):
+                    for t in targets:
+                        d = dotted(t)
+                        if d:
+                            opened.add(d)
+        closes = False
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in SPAN_CLOSE_NAMES \
+                        and (dotted(sub.func.value) or "") in opened:
+                    closes = True
+        if closes:
+            self._span_finally_depth += 1
+        for stmt in node.body + node.handlers + node.orelse:
+            self.visit(stmt)
+        if closes:
+            self._span_finally_depth -= 1
+        for stmt in node.finalbody:  # opens in the finally itself: uncovered
+            self.visit(stmt)
+
+    @staticmethod
+    def _call_last_name(node: ast.Call) -> str:
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr
+        return (dotted(node.func) or "").split(".")[-1]
+
+    def _span_open_name(self, node: ast.Call) -> bool:
+        return self._call_last_name(node) in SPAN_OPEN_NAMES
+
+    def _span_close_name(self, node: ast.Call) -> bool:
+        return self._call_last_name(node) in SPAN_CLOSE_NAMES
 
     def _looks_like_lock(self, expr: ast.expr) -> bool:
         d = dotted(expr) or ""
@@ -300,7 +405,24 @@ class RuleWalker(ast.NodeVisitor):
         self._check_sync(node)
         self._check_jit_churn(node)
         self._check_mutation_call(node)
+        self._check_span_leak(node)
         self.generic_visit(node)
+
+    # -- JGL007: span leak --
+
+    def _check_span_leak(self, node: ast.Call) -> None:
+        if not self.span_scope or self.fn_depth == 0:
+            return
+        if not self._span_open_name(node):
+            return
+        if id(node) in self._span_with_ctx or self._span_finally_depth > 0:
+            return
+        self.emit("JGL007", node,
+                  f"`{self._call_last_name(node)}(...)` returns an OPEN "
+                  "span/dispatch record with no structural close: use "
+                  "`with tracing.span(...)`, or open it inside a `try:` "
+                  "whose `finally:` calls .end()/.finish() — a leaked span "
+                  "corrupts every rider's trace tree")
 
     def _check_sync(self, node: ast.Call) -> None:
         if not self.hot or (self.rel, self.qualname()) in JGL001_BOUNDARY:

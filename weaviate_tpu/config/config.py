@@ -150,6 +150,21 @@ class CoalescerConfig:
 
 
 @dataclass
+class TracingConfig:
+    """End-to-end request tracing (monitoring/tracing.py). TPU extension:
+    per-request span trees with device-time attribution across coalesced
+    dispatches, a /debug/traces ring buffer, and a slow-query JSON log.
+    Disabled => no tracer object anywhere on the serving path (the module
+    global stays None; every tracing entry point is a one-comparison
+    no-op)."""
+
+    enabled: bool = False
+    sample_rate: float = 1.0      # fraction of requests traced (0..1)
+    ring_size: int = 256          # completed traces kept for /debug/traces
+    slow_query_threshold_ms: float = 1000.0  # <=0 disables the slow log
+
+
+@dataclass
 class AutoSchemaConfig:
     enabled: bool = True
     default_string: str = "text"
@@ -189,6 +204,7 @@ class Config:
     device_mesh_shards: int = 0  # 0 = one shard per local device
     store_dtype: str = "float32"
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -211,6 +227,10 @@ class Config:
             raise ConfigError(
                 "QUERY_COALESCER_MAX_REQUEST_ROWS must be in "
                 "[1, QUERY_COALESCER_MAX_BATCH]")
+        if not (0.0 <= self.tracing.sample_rate <= 1.0):
+            raise ConfigError("TRACING_SAMPLE_RATE must be in [0, 1]")
+        if self.tracing.ring_size < 1:
+            raise ConfigError("TRACING_RING_SIZE must be >= 1")
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -292,6 +312,12 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.coalescer.max_batch = _int(e, "QUERY_COALESCER_MAX_BATCH", 256)
     cfg.coalescer.max_request_rows = _int(
         e, "QUERY_COALESCER_MAX_REQUEST_ROWS", 16)
+
+    cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
+    cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)
+    cfg.tracing.ring_size = _int(e, "TRACING_RING_SIZE", 256)
+    cfg.tracing.slow_query_threshold_ms = _float(
+        e, "SLOW_QUERY_THRESHOLD_MS", 1000.0)
 
     cfg.validate()
     return cfg

@@ -32,6 +32,7 @@ from weaviate_tpu.entities.filters import GeoRange, LocalFilter
 from weaviate_tpu.entities.schema import ClassDef, DataType
 from weaviate_tpu.entities.storobj import StorObj
 from weaviate_tpu.index import new_vector_index
+from weaviate_tpu.monitoring import tracing
 from weaviate_tpu.inverted.bm25 import BM25Searcher
 from weaviate_tpu.inverted.index import InvertedIndex
 from weaviate_tpu.inverted.searcher import FilterSearcher
@@ -510,21 +511,41 @@ class Shard:
         """Batched vector search (shard_read.go:223 objectVectorSearch),
         [B, D] queries in one device dispatch -> per-query hydrated results.
         Phase timings land in the filtered-vector breakdown histograms
-        (shard_read.go:236-287 instrumentation parity): filter build,
-        device search, hydration."""
+        (shard_read.go:236-287 instrumentation parity) AND, when a trace is
+        active, in the dispatch record (monitoring/tracing.py): the
+        coalescer's record when this call is a coalesced lane flush, else a
+        single-rider record on the current request's trace."""
+        q = np.asarray(vectors, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        rec = None
+        try:
+            rec = tracing.dispatch_record(q.shape[0])
+            return self._vector_search_impl(
+                q, k, flt, target_distance, include_vector, rec)
+        finally:
+            # the direct path owns its record; a coalesced record is
+            # finished by the coalescer after scatter (it knows the riders)
+            if rec is not None and rec.owned:
+                rec.finish()
+
+    def _vector_search_impl(
+        self, q: np.ndarray, k: int, flt, target_distance,
+        include_vector: bool, rec,
+    ) -> list[list[SearchResult]]:
         m = self.metrics
         cls = self.class_def.name
         t0 = time.perf_counter()
         allow = self.build_allow_list(flt)
-        if m is not None and flt is not None:
-            m.filtered_vector_filter.labels(cls, self.name).observe(
-                (time.perf_counter() - t0) * 1000.0)
+        t1 = time.perf_counter()
+        if flt is not None:
+            if rec is not None:
+                rec.phase("filter", (t1 - t0) * 1000.0)
+            if m is not None:
+                m.filtered_vector_filter.labels(cls, self.name).observe(
+                    (t1 - t0) * 1000.0)
         if allow is not None and len(allow) == 0:
-            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
-            return [[] for _ in range(b)]
-        q = np.asarray(vectors, dtype=np.float32)
-        if q.ndim == 1:
-            q = q[None, :]
+            return [[] for _ in range(q.shape[0])]
         t1 = time.perf_counter()
         if target_distance is not None:
             row_ids, row_dists = self._search_by_vectors_distance(
@@ -540,11 +561,16 @@ class Shard:
                 ids[i, : len(ri)] = ri
                 dists[i, : len(ri)] = rd
             hydrated = self._hydrate_batch(ids, dists, include_vector)
+            t3 = time.perf_counter()
+            if rec is not None:
+                rec.phase("device_search", (t2 - t1) * 1000.0)
+                rec.phase("hydrate", (t3 - t2) * 1000.0)
+            self._trace_dispatch_facts(rec, q.shape[0], k)
             if m is not None:
                 m.filtered_vector_search.labels(cls, self.name).observe(
                     (t2 - t1) * 1000.0)
                 m.filtered_vector_objects.labels(cls, self.name).observe(
-                    (time.perf_counter() - t2) * 1000.0)
+                    (t3 - t2) * 1000.0)
                 m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
                 m.query_dimensions.labels("nearVector", "search", cls).inc(
                     int(q.shape[0] * q.shape[1]))
@@ -552,14 +578,41 @@ class Shard:
         ids, dists = self.vector_index.search_by_vectors(q, k, allow)
         t2 = time.perf_counter()
         hydrated = self._hydrate_batch(ids, dists, include_vector)
+        t3 = time.perf_counter()
+        if rec is not None:
+            rec.phase("device_search", (t2 - t1) * 1000.0)
+            rec.phase("hydrate", (t3 - t2) * 1000.0)
+        self._trace_dispatch_facts(rec, q.shape[0], k)
         if m is not None:
             m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
             m.filtered_vector_objects.labels(cls, self.name).observe(
-                (time.perf_counter() - t2) * 1000.0)
+                (t3 - t2) * 1000.0)
             m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
             m.query_dimensions.labels("nearVector", "search", cls).inc(
                 int(q.shape[0] * q.shape[1]))
         return hydrated
+
+    def _trace_dispatch_facts(self, rec, rows: int, k: int) -> None:
+        """Dispatch-level facts for the trace: the padded width (what the
+        jit cache is keyed on — padding waste = 1 - rows/padded), and
+        whether this (index, padded, k) shape is the first sighting since
+        tracing began (a proxy for "this dispatch paid the compile").
+
+        Called for EVERY dispatch while the tracer is up — even when this
+        one carries no sampled rider (rec None): under sampling, the
+        dispatch that actually pays a shape's compile is usually an
+        unsampled one, and skipping registration would make the NEXT
+        sampled dispatch of the warm shape falsely read first-seen."""
+        if tracing.get_tracer() is None:
+            return
+        vidx = self.vector_index
+        pw = getattr(vidx, "padded_width", None)
+        padded = pw(rows) if pw is not None else rows
+        first = tracing.note_shape((id(vidx), int(padded), int(k)))
+        if rec is not None:
+            rec.fact(padded_rows=int(padded), shard=self.name,
+                     class_name=self.class_def.name,
+                     jit_shape_first_seen=bool(first))
 
     def _search_by_vectors_distance(
         self, q: np.ndarray, target: float, max_limit: int, allow
@@ -628,20 +681,33 @@ class Shard:
             # observe only the time BLOCKED on the device result — wall time
             # since dispatch includes deliberate deferral (the two-phase
             # traverser enqueues every group before finalizing any) and
-            # would pollute the same histogram the sync path feeds
-            t0 = time.perf_counter()
-            ids, dists = finalize()
-            t1 = time.perf_counter()
-            hydrated = self._hydrate_batch(ids, dists, include_vector)
-            if m is not None:
-                m.filtered_vector_search.labels(cls, self.name).observe(
-                    (t1 - t0) * 1000.0)
-                m.filtered_vector_objects.labels(cls, self.name).observe(
-                    (time.perf_counter() - t1) * 1000.0)
-                m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
-                m.query_dimensions.labels("nearVector", "search", cls).inc(
-                    int(q.shape[0] * q.shape[1]))
-            return hydrated
+            # would pollute the same histogram the sync path feeds. The
+            # trace phases use the same convention (device_search = blocked
+            # time), so sync and async dispatches compare on one scale.
+            rec = None
+            try:
+                rec = tracing.dispatch_record(q.shape[0])
+                t0 = time.perf_counter()
+                ids, dists = finalize()
+                t1 = time.perf_counter()
+                hydrated = self._hydrate_batch(ids, dists, include_vector)
+                t2 = time.perf_counter()
+                if rec is not None:
+                    rec.phase("device_search", (t1 - t0) * 1000.0)
+                    rec.phase("hydrate", (t2 - t1) * 1000.0)
+                self._trace_dispatch_facts(rec, q.shape[0], k)
+                if m is not None:
+                    m.filtered_vector_search.labels(cls, self.name).observe(
+                        (t1 - t0) * 1000.0)
+                    m.filtered_vector_objects.labels(cls, self.name).observe(
+                        (t2 - t1) * 1000.0)
+                    m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
+                    m.query_dimensions.labels("nearVector", "search", cls).inc(
+                        int(q.shape[0] * q.shape[1]))
+                return hydrated
+            finally:
+                if rec is not None and rec.owned:
+                    rec.finish()
 
         return done
 
@@ -671,18 +737,29 @@ class Shard:
         gate on raw_plane_ready() first to avoid duplicate device work."""
         m = self.metrics
         cls = self.class_def.name
-        t1 = time.perf_counter()
-        ids, dists = self.vector_index.search_by_vectors(q, k)
-        t2 = time.perf_counter()
-        out = self.hydrate_raw_packed(ids, dists)
-        if m is not None:
-            m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
-            m.filtered_vector_objects.labels(cls, self.name).observe(
-                (time.perf_counter() - t2) * 1000.0)
-            m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
-            m.query_dimensions.labels("nearVector", "search", cls).inc(
-                int(q.shape[0] * q.shape[1]))
-        return out
+        rec = None
+        try:
+            rec = tracing.dispatch_record(q.shape[0])
+            t1 = time.perf_counter()
+            ids, dists = self.vector_index.search_by_vectors(q, k)
+            t2 = time.perf_counter()
+            out = self.hydrate_raw_packed(ids, dists)
+            t3 = time.perf_counter()
+            if rec is not None:
+                rec.phase("device_search", (t2 - t1) * 1000.0)
+                rec.phase("hydrate", (t3 - t2) * 1000.0)
+            self._trace_dispatch_facts(rec, q.shape[0], k)
+            if m is not None:
+                m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
+                m.filtered_vector_objects.labels(cls, self.name).observe(
+                    (t3 - t2) * 1000.0)
+                m.vector_index_ops.labels("search", cls, self.name).inc(q.shape[0])
+                m.query_dimensions.labels("nearVector", "search", cls).inc(
+                    int(q.shape[0] * q.shape[1]))
+            return out
+        finally:
+            if rec is not None and rec.owned:
+                rec.finish()
 
     def hydrate_raw_packed(self, ids, dists):
         """Packed twin of _hydrate_batch: docid -> uuid -> image entirely in

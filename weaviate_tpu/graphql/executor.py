@@ -19,6 +19,7 @@ from weaviate_tpu.graphql.parser import (
     InlineFragment,
     parse_query,
 )
+from weaviate_tpu.monitoring import tracing
 from weaviate_tpu.usecases.aggregator import AggregateParams
 from weaviate_tpu.usecases.traverser import GetParams
 
@@ -140,18 +141,23 @@ class GraphQLExecutor:
         for class_field in root.selections:
             if not isinstance(class_field, Field):
                 raise GraphQLParseError("expected class field under Get")
-            self._validate_get_class(class_field)
-            params = self._get_params(class_field)
-            results = self.traverser.get_class(params)
-            self._resolve_module_additionals(class_field, params, results)
-            self._resolve_is_consistent(class_field, params, results)
-            # per-query ref cache (refcache/ role): N results pointing at the
-            # same referenced object hit storage once, not N times
-            ref_cache: dict[str, object] = {}
-            out[class_field.out_name] = [
-                self._project(r, class_field.selections, params, ref_cache)
-                for r in results
-            ]
+            # one span per Get class: a multi-class query's trace shows
+            # which class the time went to, not one opaque "graphql" blob
+            with tracing.span("graphql.get", class_name=class_field.name):
+                self._validate_get_class(class_field)
+                params = self._get_params(class_field)
+                results = self.traverser.get_class(params)
+                self._resolve_module_additionals(class_field, params, results)
+                self._resolve_is_consistent(class_field, params, results)
+                # per-query ref cache (refcache/ role): N results pointing
+                # at the same referenced object hit storage once, not N
+                # times
+                ref_cache: dict[str, object] = {}
+                out[class_field.out_name] = [
+                    self._project(r, class_field.selections, params,
+                                  ref_cache)
+                    for r in results
+                ]
         return out
 
     def _module_provider(self):

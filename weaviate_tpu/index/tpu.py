@@ -1539,6 +1539,12 @@ class TpuVectorIndex(VectorIndex):
             pass  # foreign AllowList impls without the cache slot
         return words
 
+    def padded_width(self, b: int) -> int:
+        """Query rows after bucket padding (`_bucket_b`) — the dispatch
+        width the jit cache is keyed on. Serving traces use it to report
+        per-request padding waste (monitoring/tracing.py dispatch facts)."""
+        return _bucket_b(max(int(b), 1))
+
     def search_by_vectors(
         self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
     ) -> tuple[np.ndarray, np.ndarray]:

@@ -175,6 +175,24 @@ class Metrics:
             "requests that bypassed the coalescer queue to the direct path",
             ("reason",))
 
+        # request tracing (monitoring/tracing.py): exemplar counters so a
+        # dashboard sees trace volume/outcomes and the attributed phase
+        # shape without scraping /debug/traces. Same registration-once
+        # pattern as the coalescer vecs: the tracer only touches
+        # already-registered metrics, inside try/except.
+        self.traces = c(
+            "weaviate_traces_total", "completed request traces",
+            ("kind", "outcome"))
+        self.trace_phase = h(
+            "weaviate_trace_phase_ms",
+            "per-request attributed dispatch-phase durations "
+            "(device time split across coalesced riders by rows)",
+            ("phase",))
+        self.trace_dispatch_rows = c(
+            "weaviate_trace_dispatch_rows_total",
+            "rows in traced device dispatches (actual vs padded — the "
+            "fleet-wide padding-waste ratio)", ("kind",))
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

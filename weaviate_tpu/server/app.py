@@ -46,6 +46,23 @@ class App:
 
         self.stack_sampler = StackSampler()
 
+        # end-to-end request tracing (monitoring/tracing.py): the tracer is
+        # a process-wide module global — shards and the coalescer reach it
+        # without plumbing — installed here and cleared on shutdown.
+        # Disabled => the global stays None and every tracing entry point
+        # on the serving path is a one-comparison no-op.
+        tc = self.config.tracing
+        if tc.enabled:
+            from weaviate_tpu.monitoring import tracing
+
+            self.tracer = tracing.configure(tracing.Tracer(
+                sample_rate=tc.sample_rate,
+                ring_size=tc.ring_size,
+                slow_ms=tc.slow_query_threshold_ms,
+                metrics=self.metrics))
+        else:
+            self.tracer = None
+
         # distributed deployments (CLUSTER_HOSTNAME/CLUSTER_JOIN set) build
         # the full cluster graph: membership, cluster-API listener, schema
         # 2PC, replication, scaler (configure_api.go startupRoutine's
@@ -229,6 +246,11 @@ class App:
         # shards they would dispatch to go away
         if self.coalescer is not None:
             self.coalescer.shutdown()
+        if self.tracer is not None:
+            from weaviate_tpu.monitoring import tracing
+
+            # clear only if still ours: a newer App's tracer survives
+            tracing.unconfigure(self.tracer)
         if self.serving_pool is not None:
             self.serving_pool.shutdown(wait=False)
         self.disk_monitor.shutdown()

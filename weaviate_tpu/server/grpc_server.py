@@ -21,10 +21,37 @@ import numpy as np
 
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+from weaviate_tpu.monitoring import tracing
 from weaviate_tpu.server import reply_native
 from weaviate_tpu.usecases.traverser import GetParams
 
 _SERVICE = "weaviatetpu.v1.Weaviate"
+
+
+def _request_meta(context) -> tuple[str, Optional[str]]:
+    """(request_id, traceparent) from invocation metadata. The request id
+    (inbound ``x-request-id`` honored, else generated) is the gRPC twin of
+    the REST X-Request-Id header; `_set_reply_meta` echoes it back."""
+    md = {}
+    try:
+        md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+    except Exception:  # noqa: BLE001 — metadata is best-effort plumbing
+        pass
+    return tracing.clean_request_id(md.get("x-request-id")), \
+        md.get("traceparent")
+
+
+def _set_reply_meta(context, rid: str, trace) -> None:
+    """Trailing metadata on EVERY reply, tracing on or off: the request id
+    for log joining, plus — when this request was traced — the server's
+    W3C traceparent so the caller can join its own trace to ours."""
+    md = [("x-request-id", rid)]
+    if trace is not None:
+        md.append(("traceparent", trace.traceparent()))
+    try:
+        context.set_trailing_metadata(tuple(md))
+    except Exception:  # noqa: BLE001 — metadata is best-effort plumbing
+        pass
 
 
 def _collect_fast(results, req: pb.SearchRequest):
@@ -146,26 +173,32 @@ class SearchServicer:
 
     def Search(self, request: pb.SearchRequest, context) -> pb.SearchReply:
         start = time.perf_counter()
-        try:
-            params = params_from_proto(request)
-        except Exception as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            return
-        try:
-            results = self.app.traverser.get_class(params)
-        except ValueError as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            return
-        except Exception as e:
-            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
-            return
-        took = time.perf_counter() - start
-        fast = fast_reply_bytes(results, request, took)
-        if fast is not None:
-            return fast  # pre-serialized; the passthrough serializer ships it
-        reply = pb.SearchReply(took_seconds=took)
-        reply.results.extend(result_to_proto(r, request) for r in results)
-        return reply
+        rid, traceparent = _request_meta(context)
+        with tracing.request("grpc", "Search", traceparent=traceparent,
+                             request_id=rid,
+                             class_name=request.class_name) as tr:
+            _set_reply_meta(context, rid, tr)
+            try:
+                params = params_from_proto(request)
+            except Exception as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                return
+            try:
+                results = self.app.traverser.get_class(params)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                return
+            except Exception as e:
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+                return
+            took = time.perf_counter() - start
+            fast = fast_reply_bytes(results, request, took)
+            if fast is not None:
+                return fast  # pre-serialized; the passthrough serializer ships it
+            reply = pb.SearchReply(took_seconds=took)
+            reply.results.extend(result_to_proto(r, request) for r in results)
+            return reply
 
     def _raw_batch_lane(self, request: pb.BatchSearchRequest,
                         start: float) -> Optional[bytes]:
@@ -225,6 +258,14 @@ class SearchServicer:
         query yields a reply with error_message; the other slots still ride
         the shared device dispatch."""
         start = time.perf_counter()
+        rid, traceparent = _request_meta(context)
+        with tracing.request("grpc", "BatchSearch", traceparent=traceparent,
+                             request_id=rid,
+                             slots=len(request.requests)) as tr:
+            _set_reply_meta(context, rid, tr)
+            return self._batch_search(request, start)
+
+    def _batch_search(self, request: pb.BatchSearchRequest, start: float):
         # with the coalescer on, a NARROW batch (up to max_request_rows —
         # the widest request the coalescer admits) skips the raw lane: its
         # own dispatch would run underfilled, while the general path merges

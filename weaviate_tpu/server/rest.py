@@ -23,6 +23,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from weaviate_tpu.auth import ForbiddenError, UnauthorizedError
+from weaviate_tpu.monitoring import tracing
 from weaviate_tpu.schema.manager import SchemaError
 from weaviate_tpu.usecases.objects import NotFoundError, ObjectsError
 from weaviate_tpu.version import __version__ as VERSION
@@ -98,6 +99,10 @@ for _m, _p, _n in [
     ("POST", r"/v1/graphql/batch", "graphql_batch"),
     ("GET", r"/v1/nodes", "nodes"),
     ("GET", r"/metrics", "metrics"),
+    # completed-request trace ring (monitoring/tracing.py) — same
+    # authorizer as the pprof surface below: span trees name classes and
+    # filters and are not for anonymous remote clients
+    ("GET", r"/debug/traces", "debug_traces"),
     # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
     ("GET", r"/debug/pprof/?", "pprof_index"),
     ("GET", r"/debug/pprof/profile", "pprof_profile"),
@@ -170,6 +175,18 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        # every response (success AND error) carries the request id —
+        # inbound X-Request-Id honored, else generated — so client logs
+        # join to server traces/slow-query lines without tracing enabled
+        rid = getattr(self, "_request_id", None)
+        if rid:
+            self.send_header("X-Request-Id", rid)
+        # ...and a traced request emits its W3C traceparent (this server's
+        # root span id), so a caller can join its own outbound trace to
+        # the /debug/traces entry this request produced
+        tp = getattr(self, "_traceparent", None)
+        if tp:
+            self.send_header("traceparent", tp)
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)
@@ -179,8 +196,24 @@ class Handler(BaseHTTPRequestHandler):
         token = auth[7:] if auth.startswith("Bearer ") else None
         return self.app.authenticator.principal_from_bearer(token)
 
+    # plumbing/introspection routes never open a trace: they are not the
+    # serving path, and tracing /debug/traces would feed the ring with
+    # reads of itself
+    _UNTRACED = frozenset({
+        "live", "ready", "openid", "metrics", "debug_traces", "pprof_index",
+        "pprof_profile", "pprof_trace", "pprof_goroutine", "pprof_heap",
+        "pprof_cmdline",
+    })
+
     def _dispatch(self):
         self._body_consumed = False
+        # request id before anything can fail: the error envelope carries
+        # the header too (satellite contract: EVERY response has one);
+        # cleaned — an inbound id is echoed into a response header and must
+        # not be able to smuggle CR/LF
+        self._request_id = tracing.clean_request_id(
+            self.headers.get("X-Request-Id"))
+        self._traceparent = None
         try:
             parsed = urlparse(self.path)
             self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
@@ -194,7 +227,16 @@ class Handler(BaseHTTPRequestHandler):
                 verb = _WRITE_METHODS.get(self.command, "get")
                 self.app.authorizer.authorize(principal, verb, parsed.path)
             handler = getattr(self, "h_" + name)
-            handler(**mt.groupdict())
+            if tracing.get_tracer() is None or name in self._UNTRACED:
+                handler(**mt.groupdict())
+            else:
+                with tracing.request(
+                        "rest", f"{self.command} {parsed.path}",
+                        traceparent=self.headers.get("traceparent"),
+                        request_id=self._request_id, route=name) as tr:
+                    if tr is not None:
+                        self._traceparent = tr.traceparent()
+                    handler(**mt.groupdict())
         except HTTPError as e:
             self._reply(e.status, _err_body(e.message))
         except UnauthorizedError as e:
@@ -234,6 +276,23 @@ class Handler(BaseHTTPRequestHandler):
     def h_metrics(self):
         self._reply(200, raw=self.app.metrics.expose(),
                     content_type="text/plain; version=0.0.4")
+
+    # -- tracing (monitoring/tracing.py) -------------------------------------
+
+    def h_debug_traces(self):
+        t = tracing.get_tracer()
+        if t is None:
+            self._reply(200, {"enabled": False, "traces": []})
+            return
+        traces = t.snapshot()
+        try:
+            limit = int(self.query.get("limit", 0) or 0)
+        except ValueError:
+            limit = 0
+        if limit > 0:
+            traces = traces[-limit:]
+        self._reply(200, {"enabled": True, "count": len(traces),
+                          "traces": traces})
 
     # -- profiling (monitoring/profiling.py; pprof surface) ------------------
 
@@ -457,10 +516,18 @@ class Handler(BaseHTTPRequestHandler):
             # REST twin of gRPC BatchSearch) instead of serializing one
             # one-wide dispatch per slot. graphql.execute returns per-query
             # error envelopes, so slot isolation matches the serial path.
+            # Each slot runs under a COPY of this handler's context (one
+            # copy per slot — a shared Context cannot be entered twice
+            # concurrently), so the request's trace span reaches the pool
+            # threads and the coalescer lanes they submit into.
+            import contextvars
+
+            ctxs = [contextvars.copy_context() for _ in body]
             out = list(pool.map(
-                lambda q: self.app.graphql.execute(
-                    q.get("query") or "", q.get("variables")),
-                body))
+                lambda qc: qc[1].run(
+                    self.app.graphql.execute,
+                    qc[0].get("query") or "", qc[0].get("variables")),
+                zip(body, ctxs)))
             self._reply(200, out)
             return
         self._reply(200, [

@@ -66,6 +66,7 @@ import numpy as np
 # to module scope (PR 1 pattern): failure paths must not die on an import.
 from weaviate_tpu.db.shard import filter_signature
 from weaviate_tpu.index.tpu import _B_BUCKETS
+from weaviate_tpu.monitoring import tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 
 
@@ -90,9 +91,13 @@ def _bucket_floor(n: int) -> int:
 
 class _Waiter:
     """One queued request: its rows plus the rendezvous the serving thread
-    blocks on."""
+    blocks on. `trace_span` is the submitter's active span, captured on the
+    serving thread at admission — the explicit handoff that carries trace
+    context across the flush-thread / dispatch-pool boundary (contextvars
+    do not follow the lane)."""
 
-    __slots__ = ("vectors", "event", "result", "error", "enqueued_at")
+    __slots__ = ("vectors", "event", "result", "error", "enqueued_at",
+                 "trace_span")
 
     def __init__(self, vectors: np.ndarray):
         self.vectors = vectors
@@ -100,6 +105,7 @@ class _Waiter:
         self.result = None
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.monotonic()
+        self.trace_span = tracing.current_span()
 
     def wait(self):
         """Block until the lane resolves -> per-row result lists."""
@@ -282,6 +288,10 @@ class QueryCoalescer:
 
     def record_bypass(self, reason: str) -> None:
         """Count a request that took the direct path instead of the queue."""
+        # always called on the bypassing request's own serving thread, so
+        # the reason lands on ITS trace (the direct dispatch that follows
+        # records its own spans there too)
+        tracing.annotate_current("coalescer_bypass", reason)
         with self._lock:
             self._bypass[reason] = self._bypass.get(reason, 0) + 1
         m = self.metrics
@@ -364,9 +374,11 @@ class QueryCoalescer:
                 q = (ln.items[0].vectors if len(ln.items) == 1
                      else np.concatenate([w.vectors for w in ln.items]))
                 self._observe_wait(ln)  # queue wait ends as dispatch starts
+                rec = self._trace_record(ln)
                 done = ln.shard.object_vector_search_async(
                     q, ln.k, include_vector=ln.include_vector)
-                self._dispatch_pool.submit(self._finalize_async, ln, done)
+                self._dispatch_pool.submit(self._finalize_async, ln, done,
+                                           rec)
             except Exception as e:  # noqa: BLE001 — propagate to all waiters
                 # covers pool.submit after shutdown too: no waiter may hang
                 self._inflight.release()
@@ -377,21 +389,57 @@ class QueryCoalescer:
             q = np.concatenate([w.vectors for w in lane.items]) \
                 if len(lane.items) > 1 else lane.items[0].vectors
             self._observe_wait(lane)
-            res = lane.shard.object_vector_search(
-                q, lane.k, lane.flt, None, lane.include_vector)
+            rec = self._trace_record(lane)
+            tok = tracing.push_dispatch(rec)
+            try:
+                # the shard's phase recording lands in `rec` via the
+                # dispatch contextvar set for THIS pool thread
+                res = lane.shard.object_vector_search(
+                    q, lane.k, lane.flt, None, lane.include_vector)
+            finally:
+                tracing.pop_dispatch(tok)
+            if rec is not None:
+                # attribution completes BEFORE waiters wake: a request
+                # thread reading its own trace after wait() must see its
+                # dispatch span already attached
+                rec.finish()
             self._resolve_lane(lane, res)
         except Exception as e:  # noqa: BLE001 — propagate to all waiters
             self._fail_lane(lane, e)
         finally:
             self._inflight.release()
 
-    def _finalize_async(self, lane: _Lane, done) -> None:
+    def _finalize_async(self, lane: _Lane, done, rec=None) -> None:
         try:
-            self._resolve_lane(lane, done())
+            tok = tracing.push_dispatch(rec)
+            try:
+                res = done()
+            finally:
+                tracing.pop_dispatch(tok)
+            if rec is not None:
+                rec.finish()  # before waiters wake — see _dispatch_sync
+            self._resolve_lane(lane, res)
         except Exception as e:  # noqa: BLE001 — propagate to all waiters
             self._fail_lane(lane, e)
         finally:
             self._inflight.release()
+
+    def _trace_record(self, lane: _Lane):
+        """DispatchRecord for this lane's traced riders (span + rows +
+        queue wait per rider), or None when tracing is off or no rider was
+        sampled. Unowned: finish() runs here in the coalescer, after the
+        device work and before the waiters wake."""
+        if tracing.get_tracer() is None:
+            return None
+        now = time.monotonic()
+        riders = [(w.trace_span, int(w.vectors.shape[0]),
+                   (now - w.enqueued_at) * 1000.0)
+                  for w in lane.items if w.trace_span is not None]
+        if not riders:
+            return None
+        return tracing.DispatchRecord(
+            riders, owned=False, actual_rows=lane.rows, coalesced=True,
+            lane_requests=len(lane.items), k=lane.k)
 
     def _observe_wait(self, lane: _Lane) -> None:
         """Admission-queue wait per request, observed AT dispatch start —
@@ -444,7 +492,16 @@ class QueryCoalescer:
         if not isinstance(err, CoalescerShutdownError):
             record_device_fallback("serving.coalescer", "lane_dispatch_failed",
                                    err)
+        key = ("coalescer_shutdown"
+               if isinstance(err, CoalescerShutdownError)
+               else "coalescer_error")
         for w in lane.items:
+            # error/shutdown paths close out the trace side too: the rider
+            # trace gets the failure reason (annotation, not an open span —
+            # nothing to leak), BEFORE the waiter wakes and possibly
+            # re-runs direct
+            tracing.annotate_span(w.trace_span, key,
+                                  f"{type(err).__name__}: {err}")
             w.error = err
             w.event.set()
 

@@ -19,6 +19,7 @@ import numpy as np
 from weaviate_tpu.db.shard import SearchResult
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.entities.vectorindex import DISTANCE_COSINE
+from weaviate_tpu.monitoring import tracing
 from weaviate_tpu.usecases import hybrid as hybrid_mod
 
 
@@ -59,10 +60,15 @@ class Traverser:
         self._gate = threading.Semaphore(max_concurrent) if max_concurrent > 0 else None
 
     def get_class(self, params: GetParams) -> list[SearchResult]:
-        if self._gate is not None:
-            with self._gate:
-                return self.explorer.get_class(params)
-        return self.explorer.get_class(params)
+        # the span context propagates from here via contextvars into the
+        # coalescer lane (submit captures the active span) and into the
+        # shard's dispatch record on the direct path
+        with tracing.span("traverser.get_class",
+                          class_name=params.class_name):
+            if self._gate is not None:
+                with self._gate:
+                    return self.explorer.get_class(params)
+            return self.explorer.get_class(params)
 
     def get_class_batched(
         self, params_list: Sequence[GetParams]
@@ -73,7 +79,9 @@ class Traverser:
         Per-slot error isolation: a slot whose query failed holds the
         Exception instead of a result list (callers check isinstance) — one
         bad query must not fail the whole device batch."""
-        return self.explorer.get_class_batched(params_list)
+        with tracing.span("traverser.get_class_batched",
+                          slots=len(params_list)):
+            return self.explorer.get_class_batched(params_list)
 
 
 class Explorer:
@@ -385,8 +393,15 @@ class Explorer:
                     if wait is not None:
                         try:
                             res = wait()[0][params.offset:]
-                        except Exception:  # noqa: BLE001 — dead batch:
+                        except Exception as ce:  # noqa: BLE001 — dead batch:
                             res = None     # re-run on the direct path
+                            # the retry is invisible in aggregate metrics
+                            # (the direct dispatch records its own spans);
+                            # mark the trace so a slow query explains the
+                            # doubled device work
+                            tracing.annotate_current(
+                                "coalescer_retry_direct",
+                                f"{type(ce).__name__}: {ce}")
                 if res is None:
                     res = idx.object_vector_search(
                         vec,
