@@ -206,6 +206,90 @@ def test_unique_allowlist_filter_bypasses(tmp_path):
         app.shutdown()
 
 
+class _SpyLock:
+    """Counts every acquisition of the wrapped index lock while delegating,
+    so a test can pin that a code path is genuinely lock-free."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.count = 0
+
+    def acquire(self, *a, **kw):
+        self.count += 1
+        return self.inner.acquire(*a, **kw)
+
+    def release(self):
+        return self.inner.release()
+
+    def __enter__(self):
+        self.count += 1
+        return self.inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self.inner.__exit__(*exc)
+
+
+def test_filtered_coalesced_dispatch_never_takes_index_lock(tmp_path):
+    """The acceptance spy for the snapshot read plane: a FILTERED coalesced
+    dispatch rides the async two-phase path end to end — enqueue, device
+    work, finalize, hydration — without a single acquisition of the
+    per-index lock (pre-PR, filtered lanes fell back to the sync path that
+    held it across the whole dispatch)."""
+    app, idx, vecs = _mk_app(tmp_path)
+    try:
+        shard = idx.single_local_shard()
+        vidx = shard.vector_index
+
+        def mk_flt():
+            return LocalFilter.from_dict(
+                {"operator": "Equal", "path": ["tag"], "valueText": "even"})
+
+        queries = _tie_free_queries(vecs, 6, mask=np.arange(N) % 2 == 0)
+        expected = [
+            _rows(idx.object_vector_search(q, K, flt=mk_flt())[0])
+            for q in queries]
+        # warm: publishes the snapshot, seeds the filter-signature recency
+        # (a cold signature would bypass) and the allowList cache
+        app.traverser.get_class(GetParams(
+            class_name="Co", near_vector={"vector": queries[0].tolist()},
+            filters=mk_flt(), limit=K))
+        base = app.coalescer.stats()
+        spy = _SpyLock(vidx._lock)
+        vidx._lock = spy
+        try:
+            got = [None] * len(queries)
+            barrier = threading.Barrier(len(queries))
+
+            def run(i):
+                barrier.wait()
+                got[i] = _rows(app.traverser.get_class(GetParams(
+                    class_name="Co",
+                    near_vector={"vector": queries[i].tolist()},
+                    filters=mk_flt(), limit=K)))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(len(queries))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        finally:
+            vidx._lock = spy.inner
+        assert got == expected  # bit-identical through the lock-free path
+        st = app.coalescer.stats()
+        # the filtered requests went THROUGH the queue (no sync fallback,
+        # no new bypasses) and actually merged into coalesced dispatches
+        assert st["requests"] - base["requests"] == len(queries)
+        assert st["bypass"] == base["bypass"]
+        assert st["dispatches"] - base["dispatches"] < len(queries)
+        assert spy.count == 0, (
+            f"filtered coalesced dispatch acquired the index lock "
+            f"{spy.count} time(s) — the snapshot read plane must be "
+            "lock-free")
+    finally:
+        app.shutdown()
+
+
 def test_shared_filter_lane_coalesces_and_matches_direct(tmp_path):
     """Filtered queries with the SAME filter signature share a lane once the
     signature is warm (a COLD first sighting goes direct — a one-off filter

@@ -37,7 +37,7 @@ def _exact(vecs, q, k, metric):
 def test_gmin_matches_exact(tmp_path, metric):
     idx, vecs, rng = _mk_index(tmp_path, metric)
     q = rng.standard_normal((16, vecs.shape[1])).astype(np.float32)
-    assert idx._use_gmin(16, 10)
+    assert idx._use_gmin(idx._read_snapshot(), 16, 10)
     ids, dists = idx.search_by_vectors(q, 10)
     assert not idx._gmin_broken  # the fused path actually ran
     gt_ids, gt_d = _exact(vecs, q, 10, metric)
@@ -74,7 +74,7 @@ def test_gmin_tombstones_and_filter(tmp_path):
 
 def test_gmin_small_batch_uses_legacy(tmp_path):
     idx, vecs, _ = _mk_index(tmp_path, vi.DISTANCE_L2, n=50)
-    assert not idx._use_gmin(4, 10)  # b < 8 -> legacy scan
+    assert not idx._use_gmin(idx._read_snapshot(), 4, 10)  # b < 8 -> legacy
     ids, _ = idx.search_by_vectors(vecs[:2], 3)
     assert ids.shape == (2, 3)
 
@@ -96,10 +96,10 @@ def test_gmin_per_shape_fallback(tmp_path, monkeypatch):
     idx, vecs, rng = _mk_index(tmp_path, vi.DISTANCE_L2)
     real = idx._search_full_gmin
 
-    def failing(q, kk, allow_words, *a, **k):
+    def failing(snap, q, kk, allow_words, *a, **k):
         if q.shape[0] >= 64:  # "over VMEM budget" for big batches
             raise RuntimeError("Mosaic: scoped vmem limit exceeded")
-        return real(q, kk, allow_words, *a, **k)
+        return real(snap, q, kk, allow_words, *a, **k)
 
     monkeypatch.setattr(idx, "_search_full_gmin", failing)
     big = rng.standard_normal((64, vecs.shape[1])).astype(np.float32)

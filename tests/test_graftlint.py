@@ -411,6 +411,116 @@ def test_jgl007_scoped_to_serving_and_db_only():
     assert codes(top, SERVING) == []
 
 
+# -- JGL008: blocking device fetch under a held lock --------------------------
+
+IDXMOD = "weaviate_tpu/index/fake_index.py"    # inside the lock-fetch scope
+
+
+def test_jgl008_asarray_on_device_attr_under_lock_fires():
+    src = (
+        "import numpy as np\n"
+        "def f(self, k):\n"
+        "    with self._lock:\n"
+        "        return np.asarray(self._store)\n"
+    )
+    assert codes(src, IDXMOD).count("JGL008") == 1
+    assert codes(src, DBMOD).count("JGL008") == 1
+
+
+def test_jgl008_block_until_ready_and_jitted_result_under_lock():
+    src = (
+        "import jax, numpy as np\n"
+        "@jax.jit\n"
+        "def _kern(x):\n"
+        "    return x\n"
+        "def f(self, q):\n"
+        "    with self._lock:\n"
+        "        out = _kern(q)\n"
+        "        out.block_until_ready()\n"
+        "        return np.asarray(out)\n"
+    )
+    assert codes(src, IDXMOD).count("JGL008") == 2
+
+
+def test_jgl008_fetch_outside_the_lock_passes():
+    # the snapshot two-phase shape: dispatch under the lock, fetch outside
+    src = (
+        "import jax, numpy as np\n"
+        "@jax.jit\n"
+        "def _kern(x):\n"
+        "    return x\n"
+        "def f(self, q):\n"
+        "    with self._lock:\n"
+        "        out = _kern(q)\n"
+        "    return np.asarray(out)\n"
+    )
+    assert "JGL008" not in codes(src, IDXMOD)
+
+
+def test_jgl008_host_value_under_lock_passes():
+    # np.asarray on a plain host value holds no device round trip
+    src = (
+        "import numpy as np\n"
+        "def f(self, rows):\n"
+        "    with self._lock:\n"
+        "        return np.asarray(rows)\n"
+    )
+    assert "JGL008" not in codes(src, IDXMOD)
+
+
+def test_jgl008_non_lock_with_block_passes():
+    src = (
+        "import numpy as np\n"
+        "def f(self, path):\n"
+        "    with open(path) as fh:\n"
+        "        return np.asarray(self._store)\n"
+    )
+    assert "JGL008" not in codes(src, IDXMOD)
+
+
+def test_jgl008_fetch_in_closure_defined_under_lock_passes():
+    # the two-phase idiom itself: the finalize closure is DEFINED inside
+    # the `with lock:` block but RUNS after release — no finding
+    src = (
+        "import numpy as np\n"
+        "def f(self, q):\n"
+        "    with self._lock:\n"
+        "        def finalize():\n"
+        "            return np.asarray(self._store)\n"
+        "    return finalize\n"
+    )
+    assert "JGL008" not in codes(src, IDXMOD)
+
+
+def test_jgl008_scoped_to_index_and_db_only():
+    src = (
+        "import numpy as np\n"
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        return np.asarray(self._store)\n"
+    )
+    assert "JGL008" not in codes(src, SERVING)  # serving/: JGL007 scope only
+    assert "JGL008" not in codes(src, COLD)     # usecases/: out of scope
+
+
+def test_jgl008_baseline_shrink_only_contract():
+    """JGL008 entries ride the same ratchet as every other rule: growth
+    surfaces the overflow, shrinkage reports the entry stale (the
+    strict-baseline CI gate then demands the prune)."""
+    f = Finding("JGL008", "weaviate_tpu/index/mesh.py", 10, 0,
+                "MeshVectorIndex.compact", "m")
+    base = build_baseline([f])
+    # same count: waived, nothing stale
+    new, waived, stale = apply_baseline([f], base)
+    assert new == [] and waived == 1 and stale == []
+    # growth: the overflow surfaces
+    new, waived, stale = apply_baseline([f, f], base)
+    assert len(new) == 1 and waived == 1
+    # shrinkage: the entry reports stale (shrink-only policy)
+    new, waived, stale = apply_baseline([], base)
+    assert new == [] and stale and stale[0]["code"] == "JGL008"
+
+
 # -- suppressions (JGL000) ----------------------------------------------------
 
 def test_suppression_with_reason_silences_finding():

@@ -171,6 +171,12 @@ def test_dispatch_facts_padded_jit_and_queue_wait(tmp_path):
         assert a1["queue_wait_ms"] >= 10.0
         assert {"device_search", "hydrate"} <= {
             c["name"] for c in d1["children"]}
+        # snapshot read-plane facts: the generation the dispatch read and
+        # its lock wait (0.0 = the lock-free fast path; the import already
+        # published, so neither dispatch pays the read-your-writes flush)
+        vidx = idx.single_local_shard().vector_index
+        assert a1["snapshot_gen"] == vidx.snapshot_gen
+        assert a1["lock_wait_ms"] == 0.0 and a2["lock_wait_ms"] == 0.0
     finally:
         app.shutdown()
 

@@ -19,9 +19,14 @@ if [ -n "$art" ]; then
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="
+# GRAFTLINT_STRICT (default 1): the shrink-only contract — every rule's
+# baseline (JGL001..JGL008) may lose entries but never gain; stale entries
+# fail the gate until pruned. 0 relaxes to report-only for local triage.
+strict_flag="--strict-baseline"
+[ "${GRAFTLINT_STRICT:-1}" = "0" ] && strict_flag=""
 gl_log="${art:+$art/graftlint-report.txt}"
 gl_log="${gl_log:-$(mktemp)}"
-if ! python -m tools.graftlint weaviate_tpu --strict-baseline 2>&1 \
+if ! python -m tools.graftlint weaviate_tpu $strict_flag 2>&1 \
         | tee "$gl_log"; then
     echo "ci_check: graftlint FAILED — fix the findings or suppress inline" \
          "with a reason; the baseline may only shrink" >&2

@@ -13,13 +13,19 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
   JGL006  dtype drift (float64 spellings in kernel-adjacent code)
   JGL007  span leak (a trace span opened in serving/db code without a
           structural close: neither a `with` nor a close in `finally`)
+  JGL008  blocking device fetch under a held lock (np.asarray /
+          .block_until_ready() on a device value lexically inside a
+          `with <lock>:` block) — the read-path serialization the
+          snapshot-isolated dispatch plane removed
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
 JGL001/JGL004/JGL006; JGL002/JGL003/JGL005 apply package-wide; JGL007
 gates the request-tracing scope (weaviate_tpu/serving/, weaviate_tpu/db/ —
 where spans cross the coalescer's thread handoffs and a leaked one
-corrupts every rider's trace tree). JGL001
+corrupts every rider's trace tree); JGL008 gates weaviate_tpu/index/ +
+weaviate_tpu/db/ (where a fetch inside a lock convoys every concurrent
+reader AND writer on one mutex for a whole device round trip). JGL001
 additionally skips boundary functions whose JOB is host materialization —
 that allowlist lives here, in one place, so reviewers see every waiver.
 
@@ -89,6 +95,15 @@ SPAN_OPEN_NAMES = frozenset({
 # calls that close a span-like object when they appear in a finally block
 SPAN_CLOSE_NAMES = frozenset({"end", "finish", "close"})
 
+# JGL008 scope: the index + db layers, where the snapshot-isolated read
+# plane (index/tpu.py IndexSnapshot) guarantees device fetches happen
+# OUTSIDE any lock — a fetch that creeps back under one convoys every
+# reader and stalls every writer for a device round trip
+JGL008_PREFIXES = (
+    "weaviate_tpu/index/",
+    "weaviate_tpu/db/",
+)
+
 RULE_DOCS = {
     "JGL000": "suppression hygiene: every inline disable needs a reason and "
               "must still match a finding",
@@ -109,6 +124,9 @@ RULE_DOCS = {
     "JGL007": "span leak — a trace span opened in serving/db code must "
               "close structurally: `with tracing.span(...)`, or open "
               "inside a `try:` whose `finally:` calls .end()/.finish()",
+    "JGL008": "blocking device fetch under a held lock — dispatch inside, "
+              "fetch OUTSIDE the critical section (snapshot two-phase "
+              "pattern, index/tpu.py _dispatch_search)",
     "JGL999": "file does not parse",
 }
 
@@ -118,6 +136,13 @@ def in_span_scope(rel_path: str) -> bool:
     rp = rel_path.replace("\\", "/")
     return any(rp == p or rp.startswith(p) or f"/{p}" in rp
                for p in JGL007_PREFIXES)
+
+
+def in_lock_fetch_scope(rel_path: str) -> bool:
+    """JGL008 scope check (same interior-boundary matching as is_hot)."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL008_PREFIXES)
 
 
 def is_hot(rel_path: str) -> bool:
@@ -220,6 +245,7 @@ class RuleWalker(ast.NodeVisitor):
         self.rel = rel_path
         self.hot = is_hot(rel_path)
         self.span_scope = in_span_scope(rel_path)
+        self.lock_fetch_scope = in_lock_fetch_scope(rel_path)
         self.mod = mod
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
@@ -294,11 +320,17 @@ class RuleWalker(ast.NodeVisitor):
         self.global_names.append(set())
         outer_loops, self.loop_depth = self.loop_depth, 0
         # a nested def's body runs LATER, outside any enclosing try/finally
-        # — an enclosing close must not waive its span opens (JGL007)
+        # — an enclosing close must not waive its span opens (JGL007) —
+        # and outside any enclosing `with <lock>:` — the two-phase pattern
+        # (dispatch under the lock, finalize-closure fetches after release)
+        # must not read as a lock-held fetch (JGL008), nor may an
+        # enclosing lock waive a closure's registry mutation (JGL005)
         outer_span_depth, self._span_finally_depth = \
             self._span_finally_depth, 0
+        outer_locks, self.with_locks = self.with_locks, 0
         for stmt in node.body:  # decorators/defaults already visited above
             self.visit(stmt)
+        self.with_locks = outer_locks
         self._span_finally_depth = outer_span_depth
         self.loop_depth = outer_loops
         self.global_names.pop()
@@ -406,7 +438,34 @@ class RuleWalker(ast.NodeVisitor):
         self._check_jit_churn(node)
         self._check_mutation_call(node)
         self._check_span_leak(node)
+        self._check_lock_fetch(node)
         self.generic_visit(node)
+
+    # -- JGL008: blocking device fetch under a held lock --
+
+    def _check_lock_fetch(self, node: ast.Call) -> None:
+        if not self.lock_fetch_scope or self.fn_depth == 0 \
+                or self.with_locks == 0:
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+            self.emit("JGL008", node,
+                      "`block_until_ready()` inside a `with <lock>:` block "
+                      "serializes every concurrent reader on this mutex for "
+                      "a device round trip; dispatch under the lock, block "
+                      "outside it (snapshot two-phase pattern)")
+            return
+        fd = dotted(f) or ""
+        arg = node.args[0] if node.args else None
+        if fd in ("np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "jax.device_get") and arg is not None \
+                and self._is_device_value(arg):
+            self.emit("JGL008", node,
+                      f"`{fd}(...)` on a device value inside a "
+                      "`with <lock>:` block holds the mutex across a "
+                      "blocking device->host transfer — every reader and "
+                      "writer convoys on it; pin the state in a snapshot "
+                      "and fetch outside the critical section")
 
     # -- JGL007: span leak --
 

@@ -147,6 +147,13 @@ class CoalescerConfig:
     window_ms: float = 1.5        # deadline flush window per lane
     max_batch: int = 256          # rows that force an immediate flush
     max_request_rows: int = 16    # wider requests bypass to the direct path
+    # lanes in flight between async enqueue and finalize. With the
+    # snapshot-isolated read path (PR 4) finalize no longer contends with
+    # the next lane's enqueue on an index lock, but on a CPU backend two
+    # in-flight scans still contend for host cores — depth 1 (the flusher's
+    # stall IS the backpressure that fills lanes) remains the measured
+    # default; a real TPU backend is the case for 2.
+    pipeline_depth: int = 1
 
 
 @dataclass
@@ -227,6 +234,8 @@ class Config:
             raise ConfigError(
                 "QUERY_COALESCER_MAX_REQUEST_ROWS must be in "
                 "[1, QUERY_COALESCER_MAX_BATCH]")
+        if self.coalescer.pipeline_depth < 1:
+            raise ConfigError("QUERY_COALESCER_PIPELINE_DEPTH must be >= 1")
         if not (0.0 <= self.tracing.sample_rate <= 1.0):
             raise ConfigError("TRACING_SAMPLE_RATE must be in [0, 1]")
         if self.tracing.ring_size < 1:
@@ -312,6 +321,8 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.coalescer.max_batch = _int(e, "QUERY_COALESCER_MAX_BATCH", 256)
     cfg.coalescer.max_request_rows = _int(
         e, "QUERY_COALESCER_MAX_REQUEST_ROWS", 16)
+    cfg.coalescer.pipeline_depth = _int(
+        e, "QUERY_COALESCER_PIPELINE_DEPTH", 1)
 
     cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
     cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)

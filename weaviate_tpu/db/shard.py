@@ -492,11 +492,21 @@ class Shard:
         gen = self._locked_gen()
         hit = self._allow_cache.get(key)
         if hit is not None and hit[0] == gen:
+            # LRU move-to-end on hit (dict preserves insertion order): a hot
+            # filter inserted FIRST must outlive cold one-offs — plain FIFO
+            # evicted exactly the entries worth keeping. pop+reinsert races
+            # benignly between reader threads (both re-insert the same hit).
+            self._allow_cache.pop(key, None)
+            self._allow_cache[key] = hit
             return hit[1]
         allow = self.searcher.doc_ids(flt)
         if self._locked_gen() == gen:
-            if len(self._allow_cache) >= 16:  # small FIFO: hot filters are few
-                self._allow_cache.pop(next(iter(self._allow_cache)))
+            if len(self._allow_cache) >= 16:  # small LRU: hot filters are few
+                try:
+                    # oldest = least recently used under move-to-end
+                    self._allow_cache.pop(next(iter(self._allow_cache)))
+                except (StopIteration, KeyError, RuntimeError):
+                    pass  # concurrent readers emptied/mutated it first
             self._allow_cache[key] = (gen, allow)
         return allow
 
@@ -550,6 +560,7 @@ class Shard:
         if target_distance is not None:
             row_ids, row_dists = self._search_by_vectors_distance(
                 q, target_distance, k, allow)
+            lock_wait = self._pop_lock_wait()
             t2 = time.perf_counter()
             # pad the ragged per-row results back to one rectangle so the
             # winners hydrate in ONE batched pass (inf marks absent slots,
@@ -565,7 +576,7 @@ class Shard:
             if rec is not None:
                 rec.phase("device_search", (t2 - t1) * 1000.0)
                 rec.phase("hydrate", (t3 - t2) * 1000.0)
-            self._trace_dispatch_facts(rec, q.shape[0], k)
+            self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
             if m is not None:
                 m.filtered_vector_search.labels(cls, self.name).observe(
                     (t2 - t1) * 1000.0)
@@ -576,13 +587,14 @@ class Shard:
                     int(q.shape[0] * q.shape[1]))
             return hydrated
         ids, dists = self.vector_index.search_by_vectors(q, k, allow)
+        lock_wait = self._pop_lock_wait()
         t2 = time.perf_counter()
         hydrated = self._hydrate_batch(ids, dists, include_vector)
         t3 = time.perf_counter()
         if rec is not None:
             rec.phase("device_search", (t2 - t1) * 1000.0)
             rec.phase("hydrate", (t3 - t2) * 1000.0)
-        self._trace_dispatch_facts(rec, q.shape[0], k)
+        self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
         if m is not None:
             m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
             m.filtered_vector_objects.labels(cls, self.name).observe(
@@ -592,11 +604,23 @@ class Shard:
                 int(q.shape[0] * q.shape[1]))
         return hydrated
 
-    def _trace_dispatch_facts(self, rec, rows: int, k: int) -> None:
+    def _pop_lock_wait(self) -> Optional[float]:
+        """ms this thread's last snapshot read waited on the index write
+        lock (0.0 = the lock-free fast path), or None when the index has no
+        snapshot plane (hnsw, mesh)."""
+        pop = getattr(self.vector_index, "pop_read_lock_wait", None)
+        return pop() if pop is not None else None
+
+    def _trace_dispatch_facts(self, rec, rows: int, k: int,
+                              lock_wait_ms: Optional[float] = None) -> None:
         """Dispatch-level facts for the trace: the padded width (what the
-        jit cache is keyed on — padding waste = 1 - rows/padded), and
-        whether this (index, padded, k) shape is the first sighting since
-        tracing began (a proxy for "this dispatch paid the compile").
+        jit cache is keyed on — padding waste = 1 - rows/padded), whether
+        this (index, padded, k) shape is the first sighting since tracing
+        began (a proxy for "this dispatch paid the compile"), the index
+        snapshot generation the dispatch read (`snapshot_gen` — correlates
+        a slow query with a concurrent write burst), and the ms the
+        snapshot read waited on the writer lock (`lock_wait_ms`, 0.0 on the
+        lock-free fast path).
 
         Called for EVERY dispatch while the tracer is up — even when this
         one carries no sampled rider (rec None): under sampling, the
@@ -613,6 +637,11 @@ class Shard:
             rec.fact(padded_rows=int(padded), shard=self.name,
                      class_name=self.class_def.name,
                      jit_shape_first_seen=bool(first))
+            sg = getattr(vidx, "snapshot_gen", None)
+            if sg is not None:
+                rec.fact(snapshot_gen=int(sg))
+            if lock_wait_ms is not None:
+                rec.fact(lock_wait_ms=round(float(lock_wait_ms), 3))
 
     def _search_by_vectors_distance(
         self, q: np.ndarray, target: float, max_limit: int, allow
@@ -658,24 +687,49 @@ class Shard:
         return out_ids, out_dists
 
     def object_vector_search_async(
-        self, vectors: np.ndarray, k: int, include_vector: bool = False
+        self, vectors: np.ndarray, k: int, include_vector: bool = False,
+        flt: Optional[LocalFilter] = None,
     ):
-        """Unfiltered batched kNN with deferred hydration: the device
-        dispatch is enqueued immediately and `finalize() -> hydrated
-        results` materializes later, so concurrent requests overlap device
-        compute with another request's hydration instead of serializing
-        both under the index lock (the depth-2 pipeline the index bench
-        uses, extended to the serving stack)."""
+        """Batched kNN with deferred hydration: the device dispatch is
+        enqueued immediately against the index's published snapshot and
+        `finalize() -> hydrated results` materializes later, so concurrent
+        requests overlap device compute with another request's hydration
+        instead of serializing both under the index lock (the depth-2
+        pipeline the index bench uses, extended to the serving stack).
+
+        Filtered searches ride the same two-phase pipeline when the index
+        supports snapshot dispatch (`async_supports_filters`): the
+        allowList builds HERE, on the submitting thread — its cost lands
+        in the `filter` phase, never inside a lock a reader could convoy
+        on. Indexes without it (hnsw, mesh) fall back to the sync path."""
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
-        dispatch = getattr(self.vector_index, "search_by_vectors_async", None)
-        if dispatch is None:
-            res = self.object_vector_search(q, k, None, None, include_vector)
+        vidx = self.vector_index
+        dispatch = getattr(vidx, "search_by_vectors_async", None)
+        if dispatch is None or (
+                flt is not None
+                and not getattr(vidx, "async_supports_filters", False)):
+            res = self.object_vector_search(q, k, flt, None, include_vector)
             return lambda: res
         m = self.metrics
         cls = self.class_def.name
-        finalize = dispatch(q, k)
+        filter_ms = None
+        allow = None
+        if flt is not None:
+            t0 = time.perf_counter()
+            allow = self.build_allow_list(flt)
+            filter_ms = (time.perf_counter() - t0) * 1000.0
+            if m is not None:
+                m.filtered_vector_filter.labels(cls, self.name).observe(
+                    filter_ms)
+            if allow is not None and len(allow) == 0:
+                empty: list[list[SearchResult]] = [
+                    [] for _ in range(q.shape[0])]
+                return lambda: empty
+        finalize = (dispatch(q, k, allow) if allow is not None
+                    else dispatch(q, k))
+        lock_wait = self._pop_lock_wait()
 
         def done() -> list[list[SearchResult]]:
             # observe only the time BLOCKED on the device result — wall time
@@ -687,6 +741,8 @@ class Shard:
             rec = None
             try:
                 rec = tracing.dispatch_record(q.shape[0])
+                if rec is not None and filter_ms is not None:
+                    rec.phase("filter", filter_ms)
                 t0 = time.perf_counter()
                 ids, dists = finalize()
                 t1 = time.perf_counter()
@@ -695,7 +751,7 @@ class Shard:
                 if rec is not None:
                     rec.phase("device_search", (t1 - t0) * 1000.0)
                     rec.phase("hydrate", (t2 - t1) * 1000.0)
-                self._trace_dispatch_facts(rec, q.shape[0], k)
+                self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
                 if m is not None:
                     m.filtered_vector_search.labels(cls, self.name).observe(
                         (t1 - t0) * 1000.0)
@@ -742,13 +798,14 @@ class Shard:
             rec = tracing.dispatch_record(q.shape[0])
             t1 = time.perf_counter()
             ids, dists = self.vector_index.search_by_vectors(q, k)
+            lock_wait = self._pop_lock_wait()
             t2 = time.perf_counter()
             out = self.hydrate_raw_packed(ids, dists)
             t3 = time.perf_counter()
             if rec is not None:
                 rec.phase("device_search", (t2 - t1) * 1000.0)
                 rec.phase("hydrate", (t3 - t2) * 1000.0)
-            self._trace_dispatch_facts(rec, q.shape[0], k)
+            self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
             if m is not None:
                 m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
                 m.filtered_vector_objects.labels(cls, self.name).observe(

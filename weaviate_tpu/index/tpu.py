@@ -24,8 +24,14 @@ device do what it is good at:
   allowed rows are gathered and scored (flat_search.go:19 semantics,
   vectorized);
 - mutation is staged host-side and flushed to the device in fixed-size
-  chunks via donated dynamic_update_slice (no reallocation until capacity
-  doubles — maintainance.go:31 geometric growth parity).
+  chunks via dynamic_update_slice (no reallocation until capacity
+  doubles — maintainance.go:31 geometric growth parity);
+- reads are SNAPSHOT-ISOLATED (docs/concurrency.md): writers publish an
+  immutable IndexSnapshot with one atomic reference swap, readers grab it
+  lock-free and run the whole two-phase dispatch (enqueue on the snapshot,
+  fetch outside any lock) — concurrent searches never convoy on the index
+  mutex, and deletes/compression/compaction can't tear an in-flight
+  dispatch because the snapshot pins its arrays.
 
 Durability: an append-only binary vector log per shard (add/delete records),
 replayed at startup — the analog of the HNSW commit log
@@ -78,17 +84,22 @@ def _bucket_rows(n: int) -> int:
     return b
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+# the write kernels deliberately do NOT donate their input buffers:
+# snapshot-isolated readers (IndexSnapshot) may still be dispatching on the
+# previous array generation, and donation would invalidate the buffer under
+# an in-flight search. Copy-on-write costs one transient extra copy per
+# flush on the WRITE path — the trade that makes the read path lock-free.
+@jax.jit
 def _write_rows(store, chunk, offset):
     return jax.lax.dynamic_update_slice(store, chunk, (offset, 0))
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@jax.jit
 def _write_norms(norms, chunk, offset):
     return jax.lax.dynamic_update_slice(norms, chunk, (offset,))
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@jax.jit
 def _set_tombstones(tombs, idx):
     # idx padded with an out-of-range sentinel; mode="drop" ignores those
     return tombs.at[idx].set(True, mode="drop")
@@ -843,7 +854,78 @@ class VectorLog:
         self._f = open(self.path, "ab")
 
 
+class IndexSnapshot:
+    """One immutable published generation of the device state a search
+    dispatch reads.
+
+    Writers stage under the index lock and publish a NEW snapshot with one
+    atomic reference swap (`TpuVectorIndex._publish_snapshot`); readers grab
+    the current reference lock-free and dispatch on it. The snapshot's
+    references pin its arrays: a concurrent delete/compress/compact swaps
+    the index's attributes to new arrays but can never tear an in-flight
+    dispatch, because
+
+      - the device write kernels do not donate (every update REPLACES the
+        array object, the old buffer stays valid until the last snapshot
+        holding it drops), and
+      - the host-side arrays (`slot_to_doc`, `host_tombs`) are
+        copy-on-written by any writer that would mutate an array a
+        published snapshot still references.
+
+    Everything here is frozen at publish except `_sorted_map`, a lazily
+    computed pure function of the frozen arrays (two racing readers compute
+    identical tuples; the reference assignment is atomic under the GIL).
+    """
+
+    __slots__ = ("gen", "dim", "capacity", "n", "live", "store", "sq_norms",
+                 "tombs", "slot_to_doc", "host_tombs", "allow_token",
+                 "compressed", "pq", "codes", "recon_norms", "rescore_dev",
+                 "rescore_sq_norms", "host_vecs", "_sorted_map")
+
+    def __init__(self, gen: int, idx: "TpuVectorIndex"):
+        self.gen = gen
+        self.dim = idx.dim
+        self.capacity = idx.capacity
+        self.n = idx.n
+        self.live = idx.live
+        self.store = idx._store
+        self.sq_norms = idx._sq_norms
+        self.tombs = idx._tombs
+        self.slot_to_doc = idx._slot_to_doc
+        self.host_tombs = idx._host_tombs
+        self.allow_token = idx._allow_token
+        self.compressed = idx.compressed
+        self.pq = idx._pq
+        self.codes = idx._codes
+        self.recon_norms = idx._recon_norms
+        self.rescore_dev = idx._rescore_dev
+        self.rescore_sq_norms = idx._rescore_sq_norms
+        self.host_vecs = idx._host_vecs
+        self._sorted_map: Optional[tuple[np.ndarray, np.ndarray]] = None
+
+    def sorted_doc_slots(self) -> tuple[np.ndarray, np.ndarray]:
+        """Sorted (docs, slots) of the LIVE rows in this snapshot (the
+        vectorized doc->slot map the small-allowList gather path binary-
+        searches). Derived from the frozen arrays only — tombstoned slots
+        are excluded via `host_tombs`, so a re-added doc maps to exactly
+        its newest slot."""
+        sm = self._sorted_map
+        if sm is None:
+            live = np.flatnonzero(
+                ~self.host_tombs[: self.n]).astype(np.int32)
+            docs = self.slot_to_doc[live].astype(np.uint64)
+            order = np.argsort(docs)
+            sm = (docs[order], live[order])
+            self._sorted_map = sm
+        return sm
+
+
 class TpuVectorIndex(VectorIndex):
+    # the async dispatch path handles filtered searches, the PQ codes-only
+    # tier, and the small-allowList gather (everything rides the snapshot
+    # two-phase enqueue/finalize pipeline) — serving layers key off this
+    async_supports_filters = True
+
     def __init__(
         self,
         config: vi.HnswUserConfig,
@@ -873,12 +955,26 @@ class TpuVectorIndex(VectorIndex):
         self._sq_norms = None    # device [capacity] float32 (l2 only)
         self._tombs = None       # device [capacity] bool
         self._slot_to_doc = np.zeros(0, dtype=np.int64)
+        # host mirror of the device tombstone mask: snapshots derive the
+        # live doc->slot map from it without a device fetch
+        self._host_tombs = np.zeros(0, dtype=bool)
         self._doc_to_slot: dict[int, int] = {}
+        # snapshot-isolated read plane: readers dispatch on the published
+        # IndexSnapshot lock-free; writers republish under self._lock.
+        # _staged_gen/_published_gen is the read-your-writes handshake: any
+        # staging bumps _staged_gen (under the lock), publication copies it
+        # — a reader that sees them equal may use the snapshot as-is.
+        self._snap: Optional[IndexSnapshot] = None
+        self._snap_gen = 0
+        self._staged_gen = 0
+        self._published_gen = -1
+        self._read_local = threading.local()  # per-thread last lock wait
+        self._inflight = 0                    # dispatches between enqueue
+        self._inflight_lock = threading.Lock()  # ...and finalize
+        self._inflight_gauge = None  # resolved lazily (None) / broken (False)
         # staging buffer keyed by doc_id: a re-add of a staged doc replaces it
         self._pending: dict[int, np.ndarray] = {}
         self._pending_tombs: list[int] = []
-        # lazily-rebuilt sorted (docs, slots) pair for vectorized doc->slot
-        self._map_cache: Optional[tuple[np.ndarray, np.ndarray]] = None
         # PQ state (compress.go analog): when compressed, the device holds
         # [cap, M] uint8/16 codes instead of floats; full-precision rows move
         # to host RAM for the rescoring pass
@@ -905,8 +1001,10 @@ class TpuVectorIndex(VectorIndex):
         self._pqg_cb = None  # (pq identity, cb_chunks dev, flat_cb dev)
         # per-store-generation [ncols, G*D] rescore-block layouts (see
         # gmin_scan.build_rescore_blocks): keyed by the exact device array
-        # object — every write replaces the donated store array, so object
-        # identity IS the write generation. Strong refs keep ids stable.
+        # object — every write replaces the store array with a fresh copy
+        # (copy-on-write, nothing donated: snapshots may still pin the old
+        # generation), so object identity IS the write generation. Strong
+        # refs keep ids stable.
         self._blk_cache: dict = {}
         # compiled-shape keys (b, k, rg, active_g, use_allow) that completed a
         # materialized search — each key is its own Mosaic compilation, so one
@@ -975,6 +1073,7 @@ class TpuVectorIndex(VectorIndex):
         self._sq_norms = jax.device_put(jnp.zeros((self.capacity,), jnp.float32), dev)
         self._tombs = jax.device_put(jnp.zeros((self.capacity,), jnp.bool_), dev)
         self._slot_to_doc = np.full(self.capacity, -1, dtype=np.int64)
+        self._host_tombs = np.zeros(self.capacity, dtype=bool)
 
     def _ensure_capacity(self, needed: int) -> None:
         if self._store is None and self._codes is None:
@@ -1001,6 +1100,9 @@ class TpuVectorIndex(VectorIndex):
             s2d = np.full(cap, -1, dtype=np.int64)
             s2d[: self.capacity] = self._slot_to_doc
             self._slot_to_doc = s2d
+            ht = np.zeros(cap, dtype=bool)
+            ht[: self.capacity] = self._host_tombs
+            self._host_tombs = ht
             self.capacity = cap
 
     def _write_block(self, rows: np.ndarray, start: int) -> None:
@@ -1054,11 +1156,13 @@ class TpuVectorIndex(VectorIndex):
             self._init_device(int(vector.shape[0]))
         elif vector.shape[0] != self.dim:
             raise ValueError(f"dim mismatch: index has {self.dim}, got {vector.shape[0]}")
+        # gen bump AFTER validation: a rejected add must not dirty the
+        # published snapshot and push the next reader onto the locked path
+        self._staged_gen += 1
         old = self._doc_to_slot.pop(doc_id, None)
         if old is not None:
             self._pending_tombs.append(old)
             self.live -= 1
-            self._map_cache = None
         if doc_id in self._pending:
             self.live -= 1
         self._pending[doc_id] = vector
@@ -1105,33 +1209,48 @@ class TpuVectorIndex(VectorIndex):
             return
         self._flush_pending()  # earlier staged singles keep their slots
         count = len(ids64)
+        self._staged_gen += 1
         self._ensure_capacity(self.n + count)
+        self._cow_host_state()
         self._write_block(np.ascontiguousarray(vecs), self.n)
         self._slot_to_doc[self.n : self.n + count] = ids64
         d2s.update(zip(ids64.tolist(), range(self.n, self.n + count)))
         self.n += count
         self.live += count
-        self._map_cache = None
 
     def _stage_delete(self, doc_id: int, log: bool = True) -> None:
         slot = self._doc_to_slot.pop(doc_id, None)
-        if slot is not None:
-            self._map_cache = None
         if slot is None:
-            # may still be in the staging buffer
+            # may still be in the staging buffer; an unknown doc changes
+            # nothing and must not dirty the published snapshot
             if doc_id in self._pending:
                 del self._pending[doc_id]
                 self.live -= 1
+                self._staged_gen += 1
                 if log and self._log is not None:
                     self._log.append_delete(doc_id)
             return
         self._pending_tombs.append(slot)
         self.live -= 1
+        self._staged_gen += 1
         if log and self._log is not None:
             self._log.append_delete(doc_id)
 
+    def _cow_host_state(self) -> None:
+        """Copy-on-write the host arrays a published snapshot still pins,
+        so in-place writer mutation can never tear a lock-free reader."""
+        snap = self._snap
+        if snap is None:
+            return
+        if snap.slot_to_doc is self._slot_to_doc:
+            self._slot_to_doc = self._slot_to_doc.copy()
+        if snap.host_tombs is self._host_tombs:
+            self._host_tombs = self._host_tombs.copy()
+
     def _flush_pending(self) -> None:
         flushed = bool(self._pending or self._pending_tombs)
+        if flushed:
+            self._cow_host_state()
         if self._pending:
             t0 = time.perf_counter()
             rows = np.stack(list(self._pending.values()))
@@ -1146,7 +1265,6 @@ class TpuVectorIndex(VectorIndex):
                 self._doc_to_slot[int(d)] = self.n + i
             self.n += count
             self._pending.clear()
-            self._map_cache = None
             self._obs_index("add", "flush", t0, ops=count)
         if self._pending_tombs:
             t0 = time.perf_counter()
@@ -1155,6 +1273,7 @@ class TpuVectorIndex(VectorIndex):
             padded = np.full(pad, self.capacity + 1, dtype=np.int32)
             padded[: len(idx)] = idx
             self._tombs = _set_tombstones(self._tombs, jnp.asarray(padded))
+            self._host_tombs[idx] = True
             self._obs_index("delete", "apply_tombstones", t0,
                             ops=len(self._pending_tombs))
             self._pending_tombs.clear()
@@ -1162,9 +1281,18 @@ class TpuVectorIndex(VectorIndex):
             # gauges refresh only when state changed: _flush_pending runs at
             # the top of every search and must stay free on the hot path
             self._update_index_gauges()
+        self._maybe_declared_compress()
+        if flushed or self._published_gen != self._staged_gen:
+            # publication is the LAST step: readers grabbing the new
+            # reference must see every staged mutation already applied
+            self._publish_snapshot()
+
+    def _maybe_declared_compress(self) -> None:
         # pq.enabled set at class creation: compress once enough data exists
         # to fit codebooks (the reference requires an explicit post-import
-        # config update; we also honor the declarative form)
+        # config update; we also honor the declarative form). Evaluated on
+        # every flush AND every direct batch write — the snapshot read path
+        # no longer flushes on each search, so writes must carry the trigger
         if (
             self.config.pq.enabled
             and not self.compressed
@@ -1184,6 +1312,76 @@ class TpuVectorIndex(VectorIndex):
                 logging.getLogger(__name__).warning(
                     "declared pq config is invalid (%s); auto-disabling "
                     "compression for this index", e)
+
+    # -- snapshot publication / lock-free reads ------------------------------
+
+    def _publish_snapshot(self) -> None:
+        """Publish the current device state as a new immutable snapshot
+        (one reference swap — callers hold self._lock). Always the LAST
+        step of a mutation: a reader that grabs the new reference sees a
+        fully applied write."""
+        self._snap_gen += 1
+        self._snap = IndexSnapshot(self._snap_gen, self)
+        self._published_gen = self._staged_gen
+        m = self.metrics
+        if m is not None:
+            cls, shard = self._metric_labels()
+            m.index_snapshot_gen.labels(cls, shard).set(self._snap_gen)
+
+    def _read_snapshot(self) -> IndexSnapshot:
+        """The snapshot a search dispatches on. Fast path: one reference
+        read and one generation compare, NO lock — concurrent writers
+        cannot block it. Slow path (staged writes not yet published, or
+        never published): take the write lock once, flush + publish, and
+        observe the wait — this is the read-your-writes pre-read check,
+        paid only by the first read after a write."""
+        snap = self._snap
+        if snap is not None and self._published_gen == self._staged_gen:
+            self._read_local.lock_wait_ms = 0.0
+            return snap
+        t0 = time.perf_counter()
+        with self._lock:
+            wait_ms = (time.perf_counter() - t0) * 1000.0
+            self._flush_pending()
+            if self._snap is None or self._published_gen != self._staged_gen:
+                self._publish_snapshot()
+            snap = self._snap
+        self._read_local.lock_wait_ms = wait_ms
+        m = self.metrics
+        if m is not None:
+            cls, shard = self._metric_labels()
+            m.index_lock_wait.labels(cls, shard).observe(wait_ms)
+        return snap
+
+    def pop_read_lock_wait(self) -> float:
+        """ms the CALLING thread's last snapshot read waited on the write
+        lock (0.0 on the lock-free fast path); reading clears it. The shard
+        layer attaches it as a dispatch trace fact."""
+        w = getattr(self._read_local, "lock_wait_ms", 0.0)
+        self._read_local.lock_wait_ms = 0.0
+        return w
+
+    @property
+    def snapshot_gen(self) -> int:
+        """Published snapshot generation (0 = never published)."""
+        snap = self._snap
+        return snap.gen if snap is not None else 0
+
+    def _track_inflight(self, delta: int) -> None:
+        """Enqueued-but-not-finalized dispatch count (the read pipeline's
+        depth). The labeled gauge child resolves ONCE — per-dispatch cost
+        is one small lock and one gauge set."""
+        with self._inflight_lock:
+            self._inflight += delta
+            val = self._inflight
+        g = self._inflight_gauge
+        if g is None:
+            if self.metrics is None:
+                return
+            cls, shard = self._metric_labels()
+            g = self.metrics.index_inflight_dispatches.labels(cls, shard)
+            self._inflight_gauge = g
+        g.set(val)
 
     # -- product quantization (compress.go analog) ---------------------------
 
@@ -1262,6 +1460,8 @@ class TpuVectorIndex(VectorIndex):
             self.config.pq.enabled = True
         if save and self._log is not None:
             pq.save(self._pq_path)
+        self._staged_gen += 1
+        self._publish_snapshot()
 
     # -- VectorIndex ---------------------------------------------------------
 
@@ -1302,16 +1502,19 @@ class TpuVectorIndex(VectorIndex):
                 self._log.append_add_batch(doc_arr, vectors)
             t0 = time.perf_counter()
             count = vectors.shape[0]
+            self._staged_gen += 1
             self._ensure_capacity(self.n + count + _CHUNK)
+            self._cow_host_state()
             self._write_block(vectors, self.n)
             self._slot_to_doc[self.n : self.n + count] = doc_arr
             new_slots = dict(zip(doc_arr.tolist(), range(self.n, self.n + count)))
             self._doc_to_slot.update(new_slots)
             self.n += count
             self.live += count
-            self._map_cache = None
             self._obs_index("add", "device_write", t0, ops=count)
             self._update_index_gauges()
+            self._maybe_declared_compress()
+            self._publish_snapshot()
 
     def delete(self, *doc_ids: int) -> None:
         with self._lock:
@@ -1345,18 +1548,18 @@ class TpuVectorIndex(VectorIndex):
 
     # -- fused group-min fast scan (ops/gmin_scan.py) ------------------------
 
-    def _gmin_rg(self, k: int) -> int:
+    def _gmin_rg(self, k: int, capacity: int) -> int:
         """Groups kept by the fused scan: >= k guarantees exact selection
         under exact arithmetic (at most k groups hold the true top-k);
         2k..128 adds slack for bf16 fast-scan ranking error. 0 = shape
         unsupported, use the legacy scan."""
         from weaviate_tpu.ops import gmin_scan
 
-        ncols = self.capacity // gmin_scan.G
+        ncols = capacity // gmin_scan.G
         rg = min(max(32, 2 * k), 128, ncols)
         return rg if rg >= k else 0
 
-    def _use_gmin(self, b: int, k: int) -> bool:
+    def _use_gmin(self, snap: IndexSnapshot, b: int, k: int) -> bool:
         if getattr(self.config, "exact_topk", False):
             return False  # config opt-out, not degradation
         if self._gmin_broken:
@@ -1366,55 +1569,57 @@ class TpuVectorIndex(VectorIndex):
             return False
         # pallas tiling wants >= 8 query sublanes; tiny batches stay on the
         # legacy scan (they're dispatch-latency-bound anyway)
-        if self.capacity < _MIN_CAPACITY or b < 8:
+        if snap.capacity < _MIN_CAPACITY or b < 8:
             return False
-        return self._gmin_rg(k) > 0
+        return self._gmin_rg(k, snap.capacity) > 0
 
     def _gen_blocks(self, arr, build_fn):
         """Generation-cached block layout for `arr` (the store, the bf16
         rescore store, or the PQ codes): rebuilt only when the underlying
-        array object changes (donated updates replace it). On every miss,
-        entries whose source array is no longer a live index member are
-        dropped FIRST — a replaced store generation plus its block layout
-        (~1 GB HBM at 1M x 128 f32) must free before the new one builds,
-        and still-valid entries for the other arrays stay cached."""
+        array object changes (copy-on-write updates replace it). On every
+        miss, entries whose source array is no longer a live index member
+        are dropped FIRST — a replaced store generation plus its block
+        layout (~1 GB HBM at 1M x 128 f32) must free before the new one
+        builds, and still-valid entries for the other arrays stay cached.
+        Concurrent snapshot readers may race here: dict get/set/pop are
+        atomic under the GIL and a lost race only recomputes a layout."""
         hit = self._blk_cache.get(id(arr))
         if hit is not None and hit[0] is arr:
             return hit[1]
         live = {id(x) for x in (self._store, self._rescore_dev, self._codes)
                 if x is not None}
-        for k in [k for k in self._blk_cache if k not in live]:
-            del self._blk_cache[k]
+        for k in [k for k in list(self._blk_cache) if k not in live]:
+            self._blk_cache.pop(k, None)
         blk = build_fn(arr)
         self._blk_cache[id(arr)] = (arr, blk)
         return blk
 
-    def _search_full_gmin(self, q: np.ndarray, kk: int, allow_words,
-                          store=None, sq_norms=None):
+    def _search_full_gmin(self, snap: IndexSnapshot, q: np.ndarray, kk: int,
+                          allow_words, store=None, sq_norms=None):
         from weaviate_tpu.ops import gmin_scan
 
         interpret = jax.default_backend() not in ("tpu", "axon")
-        ncols = self.capacity // gmin_scan.G
-        s = self._store if store is None else store
+        ncols = snap.capacity // gmin_scan.G
+        s = snap.store if store is None else store
         return gmin_scan.search_gmin(
             s,
-            self._sq_norms if sq_norms is None else sq_norms,
-            self._tombs,
-            self.n,
+            snap.sq_norms if sq_norms is None else sq_norms,
+            snap.tombs,
+            snap.n,
             jnp.asarray(q),
             allow_words if allow_words is not None
-            else jnp.zeros((self.capacity // 32,), jnp.uint32),
+            else jnp.zeros((snap.capacity // 32,), jnp.uint32),
             allow_words is not None,
             kk,
             self.metric,
-            self._gmin_rg(kk),
-            -(-self.n // ncols),  # live store slices only
+            self._gmin_rg(kk, snap.capacity),
+            -(-snap.n // ncols),  # live store slices only
             interpret,
             self._gen_blocks(s, gmin_scan.build_rescore_blocks),
         )
 
-    def _gmin_packed_or_none(self, q: np.ndarray, kk: int, allow_words,
-                             store=None, sq_norms=None):
+    def _gmin_packed_or_none(self, snap: IndexSnapshot, q: np.ndarray,
+                             kk: int, allow_words, store=None, sq_norms=None):
         """Run the fused scan, or None to use the legacy kernel. Validation
         is per compiled shape: each distinct (b, k, rg, active_g, use_allow)
         is a separate Mosaic compilation with its own VMEM footprint
@@ -1422,57 +1627,58 @@ class TpuVectorIndex(VectorIndex):
         back for that shape only, while a failure on a shape that already
         completed a materialized search is a real runtime fault and
         propagates instead of silently halving throughput."""
-        if not self._use_gmin(q.shape[0], kk):
+        if not self._use_gmin(snap, q.shape[0], kk):
             return None
         from weaviate_tpu.ops import gmin_scan
 
-        ncols = self.capacity // gmin_scan.G
-        active_g = -(-self.n // ncols)
-        sb = (store if store is not None else self._store).dtype.itemsize
-        if not gmin_scan.fits_vmem(q.shape[0], self.dim, ncols, active_g, sb):
+        ncols = snap.capacity // gmin_scan.G
+        active_g = -(-snap.n // ncols)
+        sb = (store if store is not None else snap.store).dtype.itemsize
+        if not gmin_scan.fits_vmem(q.shape[0], snap.dim, ncols, active_g, sb):
             # even the smallest tiling exceeds the VMEM budget (very wide
             # vectors): never hand Mosaic a kernel that can wedge the chip
             return None
         # capacity is part of the key: the compilation is parameterized by
         # the [capacity, D] store, so growth invalidates prior validation
-        key = (q.shape[0], kk, self._gmin_rg(kk), active_g,
-               self.capacity, allow_words is not None, store is not None)
+        key = (q.shape[0], kk, self._gmin_rg(kk, snap.capacity), active_g,
+               snap.capacity, allow_words is not None, store is not None)
         return gmin_scan.guarded_kernel_call(
             self, key,
-            lambda: self._search_full_gmin(q, kk, allow_words, store, sq_norms),
+            lambda: self._search_full_gmin(snap, q, kk, allow_words, store,
+                                           sq_norms),
             "fused gmin kernel", component="index.tpu.gmin")
 
-    def _pq_gmin_packed_or_none(self, q: np.ndarray, b: int, k: int,
-                                allow_list):
+    def _pq_gmin_packed_or_none(self, snap: IndexSnapshot, q: np.ndarray,
+                                b: int, k: int, allow_list):
         """Run the fused PQ codes kernel, or None for the legacy recon
         scan. Same per-shape validation contract as the dense kernel, on a
         SEPARATE failure domain (self._pqg_state); gating and codebook
         constants are the shared helpers in ops/pq_gmin.py."""
         from weaviate_tpu.ops import gmin_scan, pq_gmin
 
-        ncols = self.capacity // gmin_scan.G
-        kk = min(k, self.live)
-        active_g = max(1, -(-self.n // ncols))
+        ncols = snap.capacity // gmin_scan.G
+        kk = min(k, snap.live)
+        active_g = max(1, -(-snap.n // ncols))
         rg = pq_gmin.eligible_rg(
             self._pqg_state, getattr(self.config, "exact_topk", False),
-            self.metric, self._pq, q.shape[0], ncols, kk, self.dim, active_g,
+            self.metric, snap.pq, q.shape[0], ncols, kk, snap.dim, active_g,
             component="index.tpu.pq_gmin")
         if rg is None:
             return None
-        m, c = self._pq.segments, self._pq.centroids
+        m, c = snap.pq.segments, snap.pq.centroids
         interpret = jax.default_backend() not in ("tpu", "axon")
         use_allow = allow_list is not None
-        words = (self._allow_words(allow_list) if use_allow
-                 else jnp.zeros((self.capacity // 32,), jnp.uint32))
-        cb_chunks, flat_cb = pq_gmin.cached_cb_constants(self)
-        key = (q.shape[0], kk, rg, active_g, self.capacity, m, c, use_allow)
+        words = (self._allow_words(snap, allow_list) if use_allow
+                 else jnp.zeros((snap.capacity // 32,), jnp.uint32))
+        cb_chunks, flat_cb = pq_gmin.cached_cb_constants(self, snap.pq)
+        key = (q.shape[0], kk, rg, active_g, snap.capacity, m, c, use_allow)
         return gmin_scan.guarded_kernel_call(
             self._pqg_state, key,
             lambda: pq_gmin.search_pq_gmin(
-                self._codes,
-                self._recon_norms,
-                self._tombs,
-                self.n,
+                snap.codes,
+                snap.recon_norms,
+                snap.tombs,
+                snap.n,
                 jnp.asarray(q),
                 cb_chunks,
                 flat_cb,
@@ -1483,12 +1689,12 @@ class TpuVectorIndex(VectorIndex):
                 rg,
                 active_g,
                 interpret,
-                self._pq.rotation_dev(),
-                self._gen_blocks(self._codes, pq_gmin.build_codes_blocks),
+                snap.pq.rotation_dev(),
+                self._gen_blocks(snap.codes, pq_gmin.build_codes_blocks),
             ),
             "fused pq codes kernel", component="index.tpu.pq_gmin")
 
-    def _rescore_r(self, k: int) -> int:
+    def _rescore_r(self, k: int, n: int) -> int:
         """Fast-scan candidate depth: 0 disables (exactTopK config or
         non-matmul metrics); otherwise 4k clamped to [32, 128] — selection
         errors of the single-pass scan sit well within 4k candidates."""
@@ -1496,7 +1702,7 @@ class TpuVectorIndex(VectorIndex):
             return 0
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return 0
-        r = int(min(max(4 * k, 32), 128, max(self.n, 1)))
+        r = int(min(max(4 * k, 32), 128, max(n, 1)))
         # no candidate slack over k => the fast pass would pick the FINAL set
         # at reduced precision; fall back to the HIGHEST-precision scan
         return r if r >= 2 * k else 0
@@ -1515,24 +1721,27 @@ class TpuVectorIndex(VectorIndex):
             q = np.concatenate([q, np.zeros((bb - b, q.shape[1]), np.float32)])
         return q, b
 
-    def _allow_words(self, allow_list: AllowList) -> jax.Array:
-        """Packed device filter words for this index state, cached ON the
-        (immutable) allowList: repeated queries with the same filter skip
-        the host-side pack entirely. The cache key holds a strong ref to
-        this index's token object, so identity can never be recycled."""
+    def _allow_words(self, snap: IndexSnapshot, allow_list: AllowList) -> jax.Array:
+        """Packed device filter words for a snapshot's slot layout, cached
+        ON the (immutable) allowList: repeated queries with the same filter
+        skip the host-side pack entirely. The cache key holds a strong ref
+        to the allow token object, so identity can never be recycled; the
+        (token, n, capacity) triple still uniquely identifies the layout
+        under snapshots because slot assignment is append-only between
+        token refreshes (compact issues a fresh token)."""
         from weaviate_tpu.storage.bitmap import (
             Bitmap, allowed_mask, pack_allow_words)
 
-        key = (self._allow_token, self.n, self.capacity)
+        key = (snap.allow_token, snap.n, snap.capacity)
         cached = getattr(allow_list, "_words_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
-        live_docs = self._slot_to_doc[: self.n]
+        live_docs = snap.slot_to_doc[: snap.n]
         if isinstance(allow_list, Bitmap):
             allowed = allowed_mask(allow_list, live_docs)
         else:
             allowed = allow_list.contains_array(live_docs.astype(np.uint64))
-        words = jnp.asarray(pack_allow_words(allowed, self.capacity))
+        words = jnp.asarray(pack_allow_words(allowed, snap.capacity))
         try:
             allow_list._words_cache = (key, words)
         except AttributeError:
@@ -1548,61 +1757,92 @@ class TpuVectorIndex(VectorIndex):
     def search_by_vectors(
         self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
     ) -> tuple[np.ndarray, np.ndarray]:
-        with self._lock:
-            self._flush_pending()
-            if self.n == 0 or self.live == 0:
-                b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
-                return (
-                    np.zeros((b, 0), dtype=np.uint64),
-                    np.zeros((b, 0), dtype=np.float32),
-                )
-            q, b = self._prep_queries(vectors)
-            k_eff = min(k, self.live)
+        """Batched kNN on the current published snapshot: grab the
+        reference (lock-free unless writes are pending), dispatch, fetch.
+        Concurrent writers republish new snapshots but can never tear or
+        block this dispatch — the snapshot pins its arrays."""
+        snap = self._read_snapshot()
+        return self._dispatch_search(snap, vectors, k, allow_list)()
 
-            if allow_list is not None and len(allow_list) < self.config.flat_search_cutoff:
-                ids, dists = self._search_small_allow(q, b, k_eff, allow_list)
-            elif self.compressed:
-                ids, dists = self._search_full_pq(q, b, k_eff, allow_list)
-            else:
-                allow_words = self._allow_words(allow_list) if allow_list is not None else None
-                ids, dists = self._scan_store(q, b, k_eff, allow_words)
-            return ids.astype(np.uint64), dists.astype(np.float32)
+    def _dispatch_search(self, snap: IndexSnapshot, vectors: np.ndarray,
+                         k: int, allow_list: Optional[AllowList] = None):
+        """Two-phase search on `snap`: enqueue the device work NOW (query
+        upload + kernels — nothing blocks), return finalize() -> (ids,
+        dists) whose ONE blocking device->host fetch runs outside any
+        lock. Every read-path case — full scan, both PQ tiers, filtered
+        scans, the small-allowList gather — dispatches through here, so
+        sync and async searches run the same kernels with the same
+        arguments (the bit-identical contract)."""
+        if snap.n == 0 or snap.live == 0:
+            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
+            empty = (np.zeros((b, 0), dtype=np.uint64),
+                     np.zeros((b, 0), dtype=np.float32))
+            return lambda: empty
+        q, b = self._prep_queries(vectors)
+        k_eff = min(k, snap.live)
+        if allow_list is not None and len(allow_list) < self.config.flat_search_cutoff:
+            fin = self._dispatch_small_allow(snap, q, b, k_eff, allow_list)
+        elif snap.compressed:
+            fin = self._dispatch_full_pq(snap, q, b, k_eff, allow_list)
+        else:
+            allow_words = (self._allow_words(snap, allow_list)
+                           if allow_list is not None else None)
+            fin = self._dispatch_scan(snap, q, b, k_eff, allow_words)
+        self._track_inflight(1)
+        done = [False]
 
-    def _scan_store(self, q: np.ndarray, b: int, k_eff: int, allow_words,
-                    store=None, sq_norms=None):
+        def finalize():
+            try:
+                return fin()
+            finally:
+                if not done[0]:  # idempotent: finalize may be retried
+                    done[0] = True
+                    self._track_inflight(-1)
+
+        return finalize
+
+    def _dispatch_scan(self, snap: IndexSnapshot, q: np.ndarray, b: int,
+                       k_eff: int, allow_words, store=None, sq_norms=None):
         """Full-store scan (fused gmin when eligible, legacy lax.scan kernel
         otherwise) over `store` — the f32 store uncompressed, or the bf16
         rescore copy under PQ-with-rescore (scanning codes first would read
         MORE HBM than the copy the rescore pass consults anyway)."""
-        kk = min(max(k_eff, 1), self.n)
-        packed = self._gmin_packed_or_none(q, kk, allow_words, store, sq_norms)
-        if packed is not None:
-            packed = np.asarray(packed)
-        else:
-            sq = self._sq_norms if sq_norms is None else sq_norms
-            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch per search dispatch (results packed [B,2k] so it is a single transfer)
-                _search_full(
-                    self._store if store is None else store,
-                    sq if self.metric == vi.DISTANCE_L2 else None,
-                    self._tombs,
-                    self.n,
-                    jnp.asarray(q),
-                    allow_words if allow_words is not None else jnp.zeros((self.capacity // 32,), jnp.uint32),
-                    kk,
-                    self.metric,
-                    allow_words is not None,
-                    getattr(self.config, "exact_topk", False),
-                    -(-self.n // _SCAN_CHUNK),
-                    self._rescore_r(kk),
-                )
+        kk = min(max(k_eff, 1), snap.n)
+        packed_dev = self._gmin_packed_or_none(snap, q, kk, allow_words,
+                                               store, sq_norms)
+        if packed_dev is None:
+            sq = snap.sq_norms if sq_norms is None else sq_norms
+            packed_dev = _search_full(
+                snap.store if store is None else store,
+                sq if self.metric == vi.DISTANCE_L2 else None,
+                snap.tombs,
+                snap.n,
+                jnp.asarray(q),
+                allow_words if allow_words is not None
+                else jnp.zeros((snap.capacity // 32,), jnp.uint32),
+                kk,
+                self.metric,
+                allow_words is not None,
+                getattr(self.config, "exact_topk", False),
+                -(-snap.n // _SCAN_CHUNK),
+                self._rescore_r(kk, snap.n),
             )
-        top, idx = _unpack(packed)
-        top = top[:b]
-        idx = idx[:b]
-        ids = np.where(idx >= 0, self._slot_to_doc[np.clip(idx, 0, None)], -1)
-        return ids, top
+        slot_to_doc = snap.slot_to_doc
 
-    def _search_full_pq(self, q: np.ndarray, b: int, k: int, allow_list):
+        def finalize():
+            # the ONE deliberate blocking fetch per search dispatch
+            # (results packed [B,2k] = a single transfer), outside any lock
+            packed = np.asarray(packed_dev)
+            top, idx = _unpack(packed)
+            top = top[:b]
+            idx = idx[:b]
+            ids = np.where(idx >= 0, slot_to_doc[np.clip(idx, 0, None)], -1)
+            return ids.astype(np.uint64), top.astype(np.float32)
+
+        return finalize
+
+    def _dispatch_full_pq(self, snap: IndexSnapshot, q: np.ndarray, b: int,
+                          k: int, allow_list):
         """Compressed full-store search.
 
         With rescore enabled a full bf16 copy of the rows already lives in
@@ -1619,131 +1859,131 @@ class TpuVectorIndex(VectorIndex):
         from weaviate_tpu.compress.pq import build_lut
 
         pqc = self.config.pq
-        rescore = pqc.rescore and self._rescore_dev is not None
+        rescore = pqc.rescore and snap.rescore_dev is not None
         if rescore:
-            allow_words = (self._allow_words(allow_list)
+            allow_words = (self._allow_words(snap, allow_list)
                            if allow_list is not None else None)
-            ids, dists = self._scan_store(
-                q, b, k, allow_words,
-                store=self._rescore_dev, sq_norms=self._rescore_sq_norms)
-            return ids, dists
+            return self._dispatch_scan(
+                snap, q, b, k, allow_words,
+                store=snap.rescore_dev, sq_norms=snap.rescore_sq_norms)
+        slot_to_doc = snap.slot_to_doc
         # codes-only tier from here: raw ADC distances, no rescoring pass.
         # Fast path: the fused PQ-ADC group-min kernel (ops/pq_gmin.py) —
         # reconstruction-as-matmul in VMEM, codes never expand in HBM
-        packed = self._pq_gmin_packed_or_none(q, b, k, allow_list)
-        if packed is not None:
-            top, slots = _unpack(np.asarray(packed))
-            top, slots = top[:b], slots[:b]
-            ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
-            return ids[:, :k], top[:, :k]
-        # legacy reconstruction-scan path:
-        # per-chunk candidate depth: selection cost on TPU grows sharply
-        # with k, so each chunk contributes a SMALL top-r and the candidate
-        # pool is nchunks * r_chunk deep. Sized so the pool stays >= 512
-        # regardless of chunk count (64/chunk over a 1M store; deeper per
-        # chunk when the store fits fewer chunks).
-        nchunks_eff = max(1, -(-self.n // _SCAN_CHUNK))
-        pool_target = pqc.rescore_limit or 1024
-        r_chunk = min(
-            max(2 * k, -(-pool_target // nchunks_eff), 64), 256, self.n
-        )
-        # the concatenated pool must cover k (final top_k rejects k > pool)
-        r_chunk = max(r_chunk, min(-(-k // nchunks_eff), self.n))
-        allow_words = self._allow_words(allow_list) if allow_list is not None else None
-        words = (allow_words if allow_words is not None
-                 else jnp.zeros((self.capacity // 32,), jnp.uint32))
-        if self.metric in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
-            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch per PQ search dispatch
-                _search_pq_recon(
-                    self._codes,
-                    self._recon_norms,
-                    self._tombs,
-                    self.n,
-                    self._pq._dev_codebook(),
-                    jnp.zeros((1, self.dim), jnp.bfloat16),
+        packed_dev = self._pq_gmin_packed_or_none(snap, q, b, k, allow_list)
+        if packed_dev is None:
+            # legacy reconstruction-scan path:
+            # per-chunk candidate depth: selection cost on TPU grows sharply
+            # with k, so each chunk contributes a SMALL top-r and the
+            # candidate pool is nchunks * r_chunk deep. Sized so the pool
+            # stays >= 512 regardless of chunk count (64/chunk over a 1M
+            # store; deeper per chunk when the store fits fewer chunks).
+            nchunks_eff = max(1, -(-snap.n // _SCAN_CHUNK))
+            pool_target = pqc.rescore_limit or 1024
+            r_chunk = min(
+                max(2 * k, -(-pool_target // nchunks_eff), 64), 256, snap.n
+            )
+            # the concatenated pool must cover k (final top_k rejects k > pool)
+            r_chunk = max(r_chunk, min(-(-k // nchunks_eff), snap.n))
+            allow_words = (self._allow_words(snap, allow_list)
+                           if allow_list is not None else None)
+            words = (allow_words if allow_words is not None
+                     else jnp.zeros((snap.capacity // 32,), jnp.uint32))
+            if self.metric in (vi.DISTANCE_L2, vi.DISTANCE_DOT,
+                               vi.DISTANCE_COSINE):
+                packed_dev = _search_pq_recon(
+                    snap.codes,
+                    snap.recon_norms,
+                    snap.tombs,
+                    snap.n,
+                    snap.pq._dev_codebook(),
+                    jnp.zeros((1, snap.dim), jnp.bfloat16),
                     jnp.asarray(q),
                     words,
-                    min(k, self.live),
+                    min(k, snap.live),
                     r_chunk,
                     self.metric,
                     allow_words is not None,
                     getattr(self.config, "exact_topk", False),
-                    -(-self.n // _SCAN_CHUNK),
+                    -(-snap.n // _SCAN_CHUNK),
                     False,
-                    self._pq.rotation_dev(),
+                    snap.pq.rotation_dev(),
                 )
-            )
+            else:
+                lut = build_lut(jnp.asarray(q), snap.pq._dev_codebook(),
+                                self.metric)
+                packed_dev = _search_pq(
+                    snap.codes,
+                    snap.tombs,
+                    snap.n,
+                    lut,
+                    words,
+                    min(k, snap.n, _PQ_SCAN_CHUNK),
+                    allow_words is not None,
+                    getattr(self.config, "exact_topk", False),
+                    -(-snap.n // _PQ_SCAN_CHUNK),
+                )
+
+        def finalize():
+            # the ONE deliberate blocking fetch per PQ search dispatch,
+            # outside any lock
+            packed = np.asarray(packed_dev)
             top, slots = _unpack(packed)
             top, slots = top[:b], slots[:b]
             # (cosine: the recon path already emits 1 - dot directly)
-            ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
-            return ids[:, :k], top[:, :k]
-        lut = build_lut(jnp.asarray(q), self._pq._dev_codebook(), self.metric)
-        packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch of the LUT-scan dispatch
-            _search_pq(
-                self._codes,
-                self._tombs,
-                self.n,
-                lut,
-                words,
-                min(k, self.n, _PQ_SCAN_CHUNK),
-                allow_words is not None,
-                getattr(self.config, "exact_topk", False),
-                -(-self.n // _PQ_SCAN_CHUNK),
-            )
-        )
-        top, slots = _unpack(packed)
-        top, slots = top[:b], slots[:b]
-        ids = np.where(slots >= 0, self._slot_to_doc[np.clip(slots, 0, None)], -1)
-        return ids[:, :k], top[:, :k]
+            ids = np.where(slots >= 0, slot_to_doc[np.clip(slots, 0, None)], -1)
+            return (ids[:, :k].astype(np.uint64),
+                    top[:, :k].astype(np.float32))
 
-    def _sorted_doc_slots(self) -> tuple[np.ndarray, np.ndarray]:
-        if self._map_cache is None:
-            count = len(self._doc_to_slot)
-            docs = np.fromiter(self._doc_to_slot.keys(), dtype=np.uint64, count=count)
-            slots = np.fromiter(self._doc_to_slot.values(), dtype=np.int32, count=count)
-            order = np.argsort(docs)
-            self._map_cache = (docs[order], slots[order])
-        return self._map_cache
+        return finalize
 
-    def _search_small_allow(self, q: np.ndarray, b: int, k: int, allow_list: AllowList):
-        """Gather path (flatSearch over allowList, flat_search.go:19)."""
+    def _dispatch_small_allow(self, snap: IndexSnapshot, q: np.ndarray,
+                              b: int, k: int, allow_list: AllowList):
+        """Gather path (flatSearch over allowList, flat_search.go:19): the
+        host-side doc->slot resolution binary-searches the snapshot's
+        frozen sorted map; the row scoring is one enqueued device call."""
         allowed_docs = allow_list.to_array()
         # vectorized doc->slot: keep only docs present in this shard
-        docs_sorted, slots_sorted = self._sorted_doc_slots()
+        docs_sorted, slots_sorted = snap.sorted_doc_slots()
+        empty = (np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32))
         if docs_sorted.size == 0:
-            return np.zeros((b, 0), np.int64), np.zeros((b, 0), np.float32)
+            return lambda: empty
         pos = np.searchsorted(docs_sorted, allowed_docs)
         pos_c = np.clip(pos, 0, docs_sorted.size - 1)
         hit = docs_sorted[pos_c] == allowed_docs
         slots = slots_sorted[pos_c[hit]].astype(np.int32)
         if slots.size == 0:
-            return np.zeros((b, 0), np.int64), np.zeros((b, 0), np.float32)
+            return lambda: empty
         r = _bucket_rows(slots.size)
         rows = np.full(r, 0, dtype=np.int32)
         rows[: slots.size] = slots
         row_valid = np.zeros(r, dtype=bool)
         row_valid[: slots.size] = True
         kk = min(k, slots.size)
-        if self.compressed:
+        if snap.compressed:
             # float rows live host-side under PQ: upload the gathered block
-            sub = np.zeros((r, self.dim), np.float32)
-            sub[: slots.size] = self._host_vecs[slots]
-            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch of the gather-path dispatch
-                _score_rows(jnp.asarray(sub), jnp.asarray(q), jnp.asarray(row_valid), kk, self.metric)
-            )
+            sub = np.zeros((r, snap.dim), np.float32)
+            sub[: slots.size] = snap.host_vecs[slots]
+            packed_dev = _score_rows(jnp.asarray(sub), jnp.asarray(q),
+                                     jnp.asarray(row_valid), kk, self.metric)
         else:
-            packed = np.asarray(  # graftlint: disable=JGL001 the ONE deliberate blocking fetch of the gather-path dispatch
-                _search_gathered(
-                    self._store, jnp.asarray(q), jnp.asarray(rows), jnp.asarray(row_valid), kk, self.metric
-                )
-            )
-        top, idx = _unpack(packed)
-        top = top[:b]
-        idx = idx[:b]
-        safe = np.clip(idx, 0, r - 1)
-        ids = np.where(idx >= 0, self._slot_to_doc[rows[safe]], -1)
-        return ids, top
+            packed_dev = _search_gathered(
+                snap.store, jnp.asarray(q), jnp.asarray(rows),
+                jnp.asarray(row_valid), kk, self.metric)
+        slot_to_doc = snap.slot_to_doc
+
+        def finalize():
+            # the ONE deliberate blocking fetch of the gather-path
+            # dispatch, outside any lock
+            packed = np.asarray(packed_dev)
+            top, idx = _unpack(packed)
+            top = top[:b]
+            idx = idx[:b]
+            safe = np.clip(idx, 0, r - 1)
+            ids = np.where(idx >= 0, slot_to_doc[rows[safe]], -1)
+            return ids.astype(np.uint64), top.astype(np.float32)
+
+        return finalize
 
     def search_by_vector(
         self, vector: np.ndarray, k: int, allow_list: Optional[AllowList] = None
@@ -1752,58 +1992,21 @@ class TpuVectorIndex(VectorIndex):
         keep = dists[0] != np.inf
         return ids[0][keep], dists[0][keep]
 
-    def search_by_vectors_async(self, vectors: np.ndarray, k: int):
-        """Dispatch an unfiltered batched kNN without blocking on the result.
+    def search_by_vectors_async(self, vectors: np.ndarray, k: int,
+                                allow_list: Optional[AllowList] = None):
+        """Dispatch a batched kNN without blocking on the result.
 
-        Returns finalize() -> (ids, dists). Dispatch (query upload + compute)
-        overlaps with other in-flight batches — the serving loop and bench use
-        a depth-2 pipeline so the PCIe/relay upload of batch i+1 hides behind
-        the compute of batch i.
+        Returns finalize() -> (ids, dists). Covers EVERY read-path case —
+        filtered searches, both PQ tiers, and the small-allowList gather —
+        because dispatch runs on an immutable snapshot: there is no
+        fully-locked sync fallback left. Dispatch (query upload + compute)
+        overlaps with other in-flight batches — the serving loop and bench
+        use a depth-2 pipeline so the PCIe/relay upload of batch i+1 hides
+        behind the compute of batch i, and the coalescer's finalize runs on
+        its dispatch pool without contending with the next enqueue.
         """
-        with self._lock:
-            self._flush_pending()
-            if self.n == 0 or self.live == 0:
-                b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
-                return lambda: (np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32))
-            # PQ-with-rescore serves from the bf16 rescore store through the
-            # same scan kernels, so it pipelines like the uncompressed path;
-            # only the codes-only tier stays synchronous
-            store = sq = None
-            if self.compressed:
-                if (self._rescore_dev is None
-                        or self.metric == vi.DISTANCE_HAMMING):
-                    ids, dists = self.search_by_vectors(vectors, k)
-                    return lambda: (ids, dists)
-                store, sq = self._rescore_dev, self._rescore_sq_norms
-            q, b = self._prep_queries(vectors)
-            kk = min(max(min(k, self.live), 1), self.n)
-            packed_dev = self._gmin_packed_or_none(q, kk, None, store, sq)
-            if packed_dev is None:
-                packed_dev = _search_full(
-                    self._store if store is None else store,
-                    (self._sq_norms if sq is None else sq)
-                    if self.metric == vi.DISTANCE_L2 else None,
-                    self._tombs,
-                    self.n,
-                    jnp.asarray(q),
-                    jnp.zeros((self.capacity // 32,), jnp.uint32),
-                    kk,
-                    self.metric,
-                    False,
-                    getattr(self.config, "exact_topk", False),
-                    -(-self.n // _SCAN_CHUNK),
-                    self._rescore_r(kk),
-                )
-            slot_to_doc = self._slot_to_doc
-
-        def finalize():
-            top, idx = _unpack(np.asarray(packed_dev))
-            top = top[:b]
-            idx = idx[:b]
-            ids = np.where(idx >= 0, slot_to_doc[np.clip(idx, 0, None)], -1)
-            return ids.astype(np.uint64), top.astype(np.float32)
-
-        return finalize
+        snap = self._read_snapshot()
+        return self._dispatch_search(snap, vectors, k, allow_list)
 
     def search_by_vector_distance(
         self,
@@ -1875,7 +2078,7 @@ class TpuVectorIndex(VectorIndex):
             if self.compressed:
                 store_host = self._host_vecs[: self.n]
             else:
-                store_host = np.asarray(self._store[: self.n]).astype(np.float32)
+                store_host = np.asarray(self._store[: self.n]).astype(np.float32)  # graftlint: disable=JGL008 compact is a stop-the-world rebuild: the lock must cover it and the materialized store IS the rebuild's input
             docs = self._slot_to_doc[live_slots]
             vecs = store_host[live_slots]
             if self._log is not None:
@@ -1898,13 +2101,14 @@ class TpuVectorIndex(VectorIndex):
             self.n = 0
             self.live = 0
             self._doc_to_slot.clear()
-            self._map_cache = None
             self._store = self._sq_norms = self._tombs = None
+            self._slot_to_doc = np.zeros(0, dtype=np.int64)
+            self._host_tombs = np.zeros(0, dtype=bool)
             for d, v in zip(docs.tolist(), vecs):
                 self._stage_add(int(d), v, log=False)
             self._flush_pending()
             if was_compressed and self.n > 0:
-                fresh = np.asarray(self._store[: self.n], dtype=np.float32)
+                fresh = np.asarray(self._store[: self.n], dtype=np.float32)  # graftlint: disable=JGL008 compact is a stop-the-world rebuild: the lock must cover it and the materialized store IS the rebuild's input
                 self._enable_pq(pq, fresh, save=False)
 
     def drop(self) -> None:
@@ -1922,8 +2126,8 @@ class TpuVectorIndex(VectorIndex):
             self.n = 0
             self.live = 0
             self._slot_to_doc = np.zeros(0, dtype=np.int64)
+            self._host_tombs = np.zeros(0, dtype=bool)
             self._doc_to_slot.clear()
-            self._map_cache = None
             self._pending.clear()
             self._pending_tombs.clear()
             self.compressed = False
@@ -1933,6 +2137,8 @@ class TpuVectorIndex(VectorIndex):
             self._rescore_sq_norms = None
             self._recon_norms = None
             self._host_vecs = None
+            self._staged_gen += 1
+            self._publish_snapshot()
             try:
                 os.remove(self._pq_path)
             except FileNotFoundError:

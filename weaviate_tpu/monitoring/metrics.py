@@ -193,6 +193,27 @@ class Metrics:
             "rows in traced device dispatches (actual vs padded — the "
             "fleet-wide padding-waste ratio)", ("kind",))
 
+        # snapshot-isolated read plane (index/tpu.py IndexSnapshot):
+        # contention observability for the lock-free search path.
+        # Registered once here; the index sets them unguarded, in the same
+        # style as its existing gauge updates (_update_index_gauges) —
+        # metrics is either None or this working registry.
+        self.index_snapshot_gen = g(
+            "weaviate_index_snapshot_generation",
+            "published device-state snapshot generation (one bump per "
+            "writer publication; readers dispatch on it lock-free)",
+            ("class_name", "shard_name"))
+        self.index_lock_wait = h(
+            "weaviate_index_lock_wait_ms",
+            "time a snapshot read waited on the index write lock (0 on "
+            "the lock-free fast path; nonzero = read-your-writes flush)",
+            ("class_name", "shard_name"))
+        self.index_inflight_dispatches = g(
+            "weaviate_index_inflight_dispatches",
+            "search dispatches enqueued on a snapshot but not yet "
+            "finalized (the read pipeline's depth)",
+            ("class_name", "shard_name"))
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

@@ -106,20 +106,26 @@ def eligible_rg(state, exact_topk: bool, metric: str, pq, b: int, ncols: int,
     return rg
 
 
-def cached_cb_constants(index):
+def cached_cb_constants(index, pq=None):
     """Device codebook constants for the fused codes kernel, cached on the
-    index per ProductQuantizer instance (index carries `_pqg_cb` and
-    `_pq`): (bf16 block-diagonal chunks — what the kernel holds in VMEM,
-    counted at 2 bytes by the planner — and the f32 flat codebook for the
-    exact-ADC candidate rescore)."""
-    if index._pqg_cb is None or index._pqg_cb[0] is not index._pq:
-        cb = index._pq.codebook  # [M, C, ds] f32
+    index per ProductQuantizer instance (index carries `_pqg_cb`): (bf16
+    block-diagonal chunks — what the kernel holds in VMEM, counted at 2
+    bytes by the planner — and the f32 flat codebook for the exact-ADC
+    candidate rescore). `pq` defaults to the index's live quantizer;
+    snapshot-isolated readers pass their snapshot's pq so constants always
+    match the codes they dispatch against."""
+    if pq is None:
+        pq = index._pq
+    cached = index._pqg_cb
+    if cached is None or cached[0] is not pq:
+        cb = pq.codebook  # [M, C, ds] f32
         m = cb.shape[0]
         chunks = jnp.asarray(build_cb_chunks(cb, min(_MSEG, m)),
                              dtype=jnp.bfloat16)
         flat = jnp.asarray(cb.reshape(-1, cb.shape[2]))
-        index._pqg_cb = (index._pq, chunks, flat)
-    return index._pqg_cb[1], index._pqg_cb[2]
+        cached = (pq, chunks, flat)
+        index._pqg_cb = cached
+    return cached[1], cached[2]
 
 
 def build_cb_chunks(codebook: np.ndarray, mseg: int) -> np.ndarray:

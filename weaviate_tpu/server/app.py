@@ -156,7 +156,8 @@ class App:
                 window_s=cc.window_ms / 1000.0,
                 max_batch=cc.max_batch,
                 max_request_rows=cc.max_request_rows,
-                metrics=self.metrics)
+                metrics=self.metrics,
+                pipeline_depth=cc.pipeline_depth)
             # persistent slot pool for concurrent batch fan-out (REST
             # /v1/graphql/batch): per-request executors would pay thread
             # churn on the exact hot path the coalescer optimizes

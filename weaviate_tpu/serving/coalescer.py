@@ -23,8 +23,12 @@ dispatch when either
 
 Dispatch rides the existing two-phase path (`object_vector_search_async`):
 the flush thread enqueues device work in dispatch order, while finalize +
-hydration (and the sync filtered-lane searches) run on a small dispatch
-pool so lanes overlap device compute with hydration and with each other.
+hydration runs on a small dispatch pool so lanes overlap device compute
+with hydration and with each other. FILTERED lanes ride the same two-phase
+pipeline (snapshot-isolated indexes dispatch filtered searches, both PQ
+tiers, and the small-allowList gather without a lock — index/tpu.py
+IndexSnapshot); only index types without snapshot dispatch (hnsw, noop,
+mesh) still run their whole blocking search on the pool.
 Results scatter back to per-request waiters. k is deliberately part of the
 lane key — requests only share a dispatch at IDENTICAL k — because the
 bit-identical contract (coalesced == direct, pinned by the tests) would
@@ -361,15 +365,26 @@ class QueryCoalescer:
                     for rest in due[i:]:
                         self._fail_lane(rest, err)
                     return
+            done = None
             try:
-                if ln.flt is not None or not hasattr(
-                        ln.shard.vector_index, "search_by_vectors_async"):
-                    # filtered lanes AND indexes without true async dispatch
-                    # (hnsw, noop): the whole blocking search runs on the
-                    # pool — object_vector_search_async's sync fallback
-                    # would otherwise execute it inline in THIS thread and
+                vidx = ln.shard.vector_index
+                if not hasattr(vidx, "search_by_vectors_async"):
+                    # indexes without true async dispatch (hnsw, noop,
+                    # mesh): the whole blocking search runs on the pool —
+                    # object_vector_search_async's sync fallback would
+                    # otherwise execute it inline in THIS thread and
                     # head-of-line-block every other lane
                     self._dispatch_pool.submit(self._dispatch_sync, ln)
+                    continue
+                if ln.flt is not None:
+                    # filtered lanes: the allowList resolution (an
+                    # inverted-index scan on a cache miss) must not
+                    # head-of-line block the flusher either — resolve,
+                    # enqueue AND finalize on the pool. The search itself
+                    # still rides the lock-free two-phase snapshot path
+                    # inside object_vector_search_async (or the sync
+                    # fallback for index types without filtered async).
+                    self._dispatch_pool.submit(self._dispatch_filtered, ln)
                     continue
                 q = (ln.items[0].vectors if len(ln.items) == 1
                      else np.concatenate([w.vectors for w in ln.items]))
@@ -383,6 +398,41 @@ class QueryCoalescer:
                 # covers pool.submit after shutdown too: no waiter may hang
                 self._inflight.release()
                 self._fail_lane(ln, e)
+                if done is not None:
+                    # the dispatch WAS enqueued (submit itself failed):
+                    # settle it so the index's in-flight gauge and any
+                    # device work don't leak; results are discarded
+                    try:
+                        done()
+                    except Exception:  # noqa: BLE001 — already failed lane
+                        pass
+
+    def _dispatch_filtered(self, lane: _Lane) -> None:
+        """Pool-side twin of the flusher's async enqueue for FILTERED
+        lanes: allowList build + two-phase enqueue + finalize, all off the
+        flusher thread. Enqueue ordering across filtered lanes is pool
+        order (exactly the pre-snapshot behavior); the win vs the old
+        sync path is that the search holds no index lock."""
+        try:
+            q = (lane.items[0].vectors if len(lane.items) == 1
+                 else np.concatenate([w.vectors for w in lane.items]))
+            self._observe_wait(lane)
+            rec = self._trace_record(lane)
+            # record pushed around the enqueue too: an index without
+            # filtered async runs the WHOLE sync search eagerly inside
+            # this call, and its phases must land on the lane's record
+            tok = tracing.push_dispatch(rec)
+            try:
+                done = lane.shard.object_vector_search_async(
+                    q, lane.k, include_vector=lane.include_vector,
+                    flt=lane.flt)
+            finally:
+                tracing.pop_dispatch(tok)
+        except Exception as e:  # noqa: BLE001 — propagate to all waiters
+            self._fail_lane(lane, e)
+            self._inflight.release()
+            return
+        self._finalize_async(lane, done, rec)
 
     def _dispatch_sync(self, lane: _Lane) -> None:
         try:
