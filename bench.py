@@ -1032,6 +1032,18 @@ def _parse_args(argv=None):
     p.add_argument("--coalesce", choices=("on", "off", "both"),
                    default="both",
                    help="query coalescer state for the serving run")
+    p.add_argument("--overload", type=int, default=0,
+                   help="closed-loop OVERLOAD mode: N client threads, each "
+                        "request under a tight deadline "
+                        "(BENCH_OVERLOAD_DEADLINE_MS, default 75) against a "
+                        "deliberately undersized admission queue "
+                        "(BENCH_OVERLOAD_MAX_QUEUED_ROWS, default 64) — "
+                        "records goodput (successes inside the deadline), "
+                        "shed rate, and p99-within-deadline into the "
+                        "bench_matrix overload row. Optional fault storm "
+                        "via BENCH_OVERLOAD_FAULTS (a FAULT_INJECTION "
+                        "spec, e.g. "
+                        "'index.tpu.dispatch:device_error:times=inf:p=0.2')")
     p.add_argument("--serve-n", type=int,
                    default=int(os.environ.get("BENCH_SERVE_N", 50_000)),
                    help="objects imported for the serving run")
@@ -1085,6 +1097,188 @@ def _trace_phase_breakdown(tracer) -> Optional[dict]:
 
     return {"sampled_requests": len(qw), "queue_wait": pct(qw),
             "device": pct(dev), "hydrate": pct(hyd)}
+
+
+def run_overload_bench(args, rng):
+    """Closed-loop OVERLOAD mode (robustness satellite): N clients hammer
+    the gRPC stack, every request under a tight server-side deadline
+    (x-request-timeout-ms metadata), against a deliberately undersized
+    admission queue — the saturation regime where a serving stack is
+    judged on tail behavior, not steady-state QPS. Records GOODPUT
+    (successes that finished inside the deadline), the shed rate
+    (RESOURCE_EXHAUSTED + retry hint), the deadline-miss rate, and
+    p99-within-deadline into the bench_matrix `overload_{cpu,tpu}` row.
+    BENCH_OVERLOAD_FAULTS (a FAULT_INJECTION spec) adds a deterministic
+    device-fault storm on top, exercising the breaker + host fallback
+    under load."""
+    import shutil
+    import tempfile
+    import threading
+    import uuid as uuidlib
+
+    import jax
+
+    if os.environ.get("BENCH_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        _probe_device()
+    import grpc
+
+    from weaviate_tpu.config import Config
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server import App
+    from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
+
+    n, dim = args.serve_n, args.serve_dim
+    clients = args.overload
+    deadline_ms = float(os.environ.get("BENCH_OVERLOAD_DEADLINE_MS", 75.0))
+    max_rows = int(os.environ.get("BENCH_OVERLOAD_MAX_QUEUED_ROWS", 64))
+    fault_spec = os.environ.get("BENCH_OVERLOAD_FAULTS", "")
+    log(f"overload bench: n={n} dim={dim} clients={clients} "
+        f"deadline={deadline_ms}ms max_queued_rows={max_rows} "
+        f"faults={fault_spec or 'none'}")
+    vecs = make_data(n, dim, rng)
+    pool_q = vecs[rng.integers(0, n, 256)] + 0.05 * rng.standard_normal(
+        (256, dim), dtype=np.float32)
+
+    cfg = Config()
+    cfg.coalescer.enabled = True
+    cfg.coalescer.max_queued_rows = max_rows
+    cfg.coalescer.wait_timeout_s = max(deadline_ms / 1000.0 * 4, 2.0)
+    cfg.robustness.breaker_reset_ms = 250.0
+    if fault_spec:
+        cfg.robustness.fault_injection = fault_spec
+        cfg.robustness.fault_injection_seed = 17
+    data_dir = tempfile.mkdtemp(prefix="benchoverload")
+    app = srv = None
+    try:
+        app = App(config=cfg, data_path=data_dir)
+        app.schema.add_class({
+            "class": "Serve", "vectorIndexType": "hnsw_tpu",
+            "vectorIndexConfig": {"distance": "l2-squared"},
+            "properties": [{"name": "tag", "dataType": ["text"]}],
+        })
+        idx = app.db.get_index("Serve")
+        for s in range(0, n, 10_000):
+            idx.put_batch([
+                StorObj(class_name="Serve",
+                        uuid=str(uuidlib.UUID(int=i + 1)),
+                        properties={"tag": f"t{i % 16}"}, vector=vecs[i])
+                for i in range(s, min(s + 10_000, n))])
+        srv = GrpcServer(app, port=0, max_workers=max(32, clients + 8))
+        srv.start()
+        addr = f"127.0.0.1:{srv.port}"
+        reqs = [pb.SearchRequest(
+            class_name="Serve", limit=K,
+            near_vector=pb.NearVectorParams(vector=q.tolist()))
+            for q in pool_q]
+        meta = (("x-request-timeout-ms", f"{deadline_ms:.0f}"),)
+        stop = threading.Event()
+        counting = threading.Event()
+        ok_lat: list[list[float]] = [[] for _ in range(clients)]
+        counts = [dict(ok=0, shed=0, deadline=0, error=0, hung=0)
+                  for _ in range(clients)]
+
+        def loop(tid: int) -> None:
+            cl = SearchClient(addr)
+            lrng = np.random.default_rng(2000 + tid)
+            try:
+                while not stop.is_set():
+                    qi = int(lrng.integers(0, len(reqs)))
+                    t0 = time.perf_counter()
+                    outcome = "ok"
+                    try:
+                        # generous transport timeout: the SERVER must
+                        # resolve the request (shed/expire/serve); a
+                        # client-side transport timeout = a hung request
+                        cl.search(reqs[qi], timeout=30.0, metadata=meta)
+                    except grpc.RpcError as e:
+                        code = e.code()
+                        if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                            outcome = "shed"
+                        elif code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                            outcome = "deadline"
+                        else:
+                            outcome = "error"
+                    except Exception:  # noqa: BLE001 — outcome accounting
+                        outcome = "error"
+                    dt = time.perf_counter() - t0
+                    if dt > 25.0:
+                        outcome = "hung"  # the zero-hung-requests gate
+                    if counting.is_set():
+                        counts[tid][outcome] += 1
+                        if outcome == "ok":
+                            ok_lat[tid].append(dt)
+            finally:
+                cl.close()
+
+        threads = [threading.Thread(target=loop, args=(i,), daemon=True)
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        time.sleep(args.serve_warmup)
+        counting.set()
+        t0 = time.perf_counter()
+        time.sleep(args.serve_seconds)
+        counting.clear()
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        tot = {k: sum(c[k] for c in counts)
+               for k in ("ok", "shed", "deadline", "error", "hung")}
+        flat = np.array([x for per in ok_lat for x in per], np.float64)
+        within = flat[flat <= deadline_ms / 1000.0]
+        requests = int(sum(tot.values()))
+        st = app.coalescer.stats() if app.coalescer is not None else {}
+        row = {
+            "clients": clients, "n": n, "dim": dim, "k": K,
+            "deadline_ms": deadline_ms, "max_queued_rows": max_rows,
+            "faults": fault_spec or None,
+            "duration_s": round(elapsed, 2),
+            "requests": requests,
+            "goodput_qps": round(within.size / elapsed, 1),
+            "shed_rate": round(tot["shed"] / requests, 4) if requests else None,
+            "deadline_miss_rate": round(
+                (tot["deadline"] + (flat.size - within.size)) / requests, 4)
+            if requests else None,
+            "error_rate": round(tot["error"] / requests, 4) if requests else None,
+            "hung_requests": tot["hung"],
+            "p50_ok_ms": round(float(np.percentile(flat, 50)) * 1000, 2)
+            if flat.size else None,
+            "p99_within_deadline_ms": round(
+                float(np.percentile(within, 99)) * 1000, 2)
+            if within.size else None,
+            "outcomes": tot,
+            "shed": st.get("shed"),
+            "breaker_state": (app.breaker.state()
+                              if app.breaker is not None else None),
+        }
+        log(f"  overload: {row}")
+        plat = jax.devices()[0].platform
+        backend = "tpu-v5e" if plat in ("tpu", "axon") else "cpu"
+        suffix = "cpu" if backend == "cpu" else "tpu"
+        out_row = {"backend": backend, "round": 6,
+                   "date": time.strftime("%Y-%m-%d"), **row}
+        _merge_matrix({f"overload_{suffix}": out_row})
+        print(json.dumps({
+            "metric": (
+                f"closed-loop goodput under overload ({clients} clients, "
+                f"deadline {deadline_ms:.0f}ms, queue cap {max_rows} rows, "
+                f"n={n}, d={dim}, backend {backend})"),
+            "value": row["goodput_qps"],
+            "unit": "qps-within-deadline",
+            "vs_baseline": 0,
+            "row": out_row,
+        }))
+    finally:
+        if srv is not None:
+            srv.stop()
+        if app is not None:
+            app.shutdown()
+        shutil.rmtree(data_dir, ignore_errors=True)
+    _gate_exit()
 
 
 def run_serving_bench(args, rng):
@@ -1479,6 +1673,9 @@ def main():
     rng = np.random.default_rng(7)
     if args.readers:
         run_reader_scaling_bench(args, rng)
+        return
+    if args.overload:
+        run_overload_bench(args, rng)
         return
     if args.clients:
         run_serving_bench(args, rng)

@@ -521,6 +521,61 @@ def test_jgl008_baseline_shrink_only_contract():
     assert new == [] and stale and stale[0]["code"] == "JGL008"
 
 
+# -- JGL009: unbounded blocking wait ------------------------------------------
+
+
+def test_jgl009_bare_wait_get_acquire_fire_in_serving_and_db():
+    src = (
+        "def f(self):\n"
+        "    self.event.wait()\n"
+        "    item = self.queue.get()\n"
+        "    self.sem.acquire()\n"
+        "    self.thread.join()\n"
+        "    return item\n"
+    )
+    assert codes(src, SERVING).count("JGL009") == 4
+    assert codes(src, DBMOD).count("JGL009") == 4
+
+
+def test_jgl009_bounded_waits_pass():
+    src = (
+        "def f(self, timeout):\n"
+        "    self.event.wait(5.0)\n"
+        "    self.event.wait(timeout=timeout)\n"
+        "    item = self.queue.get(timeout=0.5)\n"
+        "    ok = self.sem.acquire(timeout=0.1)\n"
+        "    ok2 = self.sem.acquire(blocking=False)\n"
+        "    self.thread.join(2.0)\n"
+        "    return item, ok, ok2\n"
+    )
+    assert "JGL009" not in codes(src, SERVING)
+
+
+def test_jgl009_dict_get_and_contextvar_get_pass():
+    src = (
+        "import contextvars\n"
+        "_VAR = contextvars.ContextVar('v', default=None)\n"
+        "def f(self, d, key):\n"
+        "    a = d.get(key)\n"          # keyed lookup, not a queue wait
+        "    b = _VAR.get()\n"          # ContextVar: lookup, not blocking
+        "    return a, b\n"
+    )
+    assert "JGL009" not in codes(src, SERVING)
+
+
+def test_jgl009_out_of_scope_modules_unflagged():
+    src = "def f(self):\n    self.event.wait()\n"
+    assert "JGL009" not in codes(src, COLD)   # usecases/: out of scope
+    assert "JGL009" not in codes(src, HOT)    # ops/: JGL001 scope, not 009
+
+
+def test_jgl009_module_level_calls_unflagged():
+    # import-time waits (e.g. a module bootstrap barrier) are not the
+    # serving path; the rule scopes to function bodies like JGL005
+    src = "import e\ne.EVENT.wait()\n"
+    assert "JGL009" not in codes(src, SERVING)
+
+
 # -- suppressions (JGL000) ----------------------------------------------------
 
 def test_suppression_with_reason_silences_finding():

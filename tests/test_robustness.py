@@ -1,0 +1,944 @@
+"""Request-lifecycle robustness: deadlines, overload shedding, the device
+circuit breaker + host fallback plane, and the fault-injection harness.
+
+Failure journeys run against the REAL serving stack (App + coalescer +
+shard + index) with faults injected at the named points — deterministic
+(seeded/count-windowed schedules, integer-valued vectors so host and
+device results are bit-comparable), tier-1 fast.
+"""
+
+import http.client
+import json
+import threading
+import time
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.entities.filters import LocalFilter
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.serving import robustness
+from weaviate_tpu.serving.coalescer import CoalescerTimeoutError
+from weaviate_tpu.testing import faults
+from weaviate_tpu.usecases.traverser import GetParams
+
+N, DIM, K = 300, 16, 5
+
+
+# -- unit: deadlines ----------------------------------------------------------
+
+
+def test_deadline_scope_and_check():
+    assert robustness.current_deadline() is None
+    assert robustness.remaining_s() is None
+    robustness.check_deadline("nowhere")  # unbounded: no-op
+    with robustness.deadline_scope(50.0) as d:
+        assert d is not None and robustness.current_deadline() is d
+        assert 0.0 < robustness.remaining_s() <= 0.05
+        robustness.check_deadline("fresh")  # not yet expired
+    assert robustness.current_deadline() is None
+    # <= 0 is the unbounded no-op scope
+    with robustness.deadline_scope(0.0) as d:
+        assert d is None and robustness.current_deadline() is None
+
+
+def test_deadline_expiry_raises():
+    with robustness.deadline_scope(1.0):
+        time.sleep(0.01)
+        assert robustness.remaining_s() == 0.0
+        with pytest.raises(robustness.DeadlineExceededError):
+            robustness.check_deadline("stage")
+
+
+def test_deadline_scopes_nest_and_restore():
+    with robustness.deadline_scope(10_000.0) as outer:
+        with robustness.deadline_scope(1.0) as inner:
+            assert robustness.current_deadline() is inner
+        assert robustness.current_deadline() is outer
+
+
+# -- unit: circuit breaker ----------------------------------------------------
+
+
+def test_breaker_state_machine():
+    br = robustness.CircuitBreaker(failure_threshold=3, reset_timeout_s=0.05,
+                                   half_open_probes=1)
+    assert br.state() == robustness.STATE_CLOSED and br.allow()
+    err = faults.InjectedDeviceError("boom")
+    br.record_failure(err)
+    br.record_failure(err)
+    assert br.state() == robustness.STATE_CLOSED  # below threshold
+    br.record_failure(err)
+    assert br.state() == robustness.STATE_OPEN
+    assert not br.allow()  # open: fallback
+    time.sleep(0.06)
+    assert br.allow()            # cooldown over: half-open probe 1
+    assert br.state() == robustness.STATE_HALF_OPEN
+    assert not br.allow()        # probe budget (1) spent
+    br.record_failure(err)       # probe failed
+    assert br.state() == robustness.STATE_OPEN
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_success()          # probe succeeded
+    assert br.state() == robustness.STATE_CLOSED and br.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = robustness.CircuitBreaker(failure_threshold=2, reset_timeout_s=9.0)
+    e = faults.InjectedDeviceError("x")
+    br.record_failure(e)
+    br.record_success()
+    br.record_failure(e)
+    assert br.state() == robustness.STATE_CLOSED  # never 2 consecutive
+
+
+def test_is_device_error_classification():
+    assert robustness.is_device_error(faults.InjectedDeviceError("x"))
+    assert robustness.is_device_error(faults.InjectedOOMError("x"))
+    assert not robustness.is_device_error(ValueError("bad k"))
+    assert not robustness.is_device_error(RuntimeError("logic bug"))
+
+    class Custom(RuntimeError):
+        device_error = True
+
+    assert robustness.is_device_error(Custom("backend says device died"))
+
+
+# -- unit: fault injector -----------------------------------------------------
+
+
+def test_fault_injector_count_window():
+    inj = faults.FaultInjector()
+    inj.plan("p", "device_error", times=2, after=1)
+    inj.fire("p")  # skipped (after=1)
+    with pytest.raises(faults.InjectedDeviceError):
+        inj.fire("p")
+    with pytest.raises(faults.InjectedDeviceError):
+        inj.fire("p")
+    inj.fire("p")  # window (times=2) exhausted
+    assert inj.fired("p") == 4 and inj.injected("p") == 2
+
+
+def test_fault_injector_seeded_bernoulli_is_reproducible():
+    def decisions(seed):
+        inj = faults.FaultInjector(seed=seed)
+        inj.plan("p", "device_error", times=None, p=0.5)
+        out = []
+        for _ in range(64):
+            try:
+                inj.fire("p")
+                out.append(0)
+            except faults.InjectedDeviceError:
+                out.append(1)
+        return out
+
+    a, b = decisions(7), decisions(7)
+    assert a == b and 0 < sum(a) < 64  # same schedule, actually mixed
+    assert decisions(8) != a           # a different seed differs
+
+
+def test_fault_injector_from_spec_and_gating():
+    inj = faults.from_spec(
+        "a.b:stall:stall_ms=1;c.d:oom:times=1;e.f:device_error:times=inf:p=0.5",
+        seed=3)
+    inj.fire("a.b")  # stalls 1ms, no error
+    with pytest.raises(faults.InjectedOOMError):
+        inj.fire("c.d")
+    with pytest.raises(ValueError):
+        faults.from_spec("justapoint")
+    with pytest.raises(ValueError):
+        faults.from_spec("a:device_error:bogus=1")
+    # disabled fast path: no injector configured => fire is a no-op
+    assert faults.get_injector() is None
+    faults.fire("a.b")
+
+
+def test_config_rejects_bad_fault_spec():
+    from weaviate_tpu.config.config import ConfigError, load_config
+
+    with pytest.raises(ConfigError):
+        load_config({"FAULT_INJECTION": "nocolon"})
+    cfg = load_config({"FAULT_INJECTION": "db.shard.search:oom:times=1",
+                       "QUERY_TIMEOUT_MS": "250",
+                       "BREAKER_FAILURE_THRESHOLD": "2"})
+    assert cfg.robustness.query_timeout_ms == 250.0
+    assert cfg.robustness.breaker_failure_threshold == 2
+
+
+# -- fixtures -----------------------------------------------------------------
+
+
+def _mk_app(tmp_path, *, coalesce=True, window_ms=30.0, max_queued_rows=4096,
+            wait_timeout_s=30.0, breaker_threshold=3, breaker_reset_ms=150.0,
+            query_timeout_ms=0.0, vecs=None, n=N):
+    from weaviate_tpu.server import App
+
+    cfg = Config()
+    cfg.coalescer.enabled = coalesce
+    cfg.coalescer.window_ms = window_ms
+    cfg.coalescer.max_queued_rows = max_queued_rows
+    cfg.coalescer.wait_timeout_s = wait_timeout_s
+    cfg.robustness.breaker_failure_threshold = breaker_threshold
+    cfg.robustness.breaker_reset_ms = breaker_reset_ms
+    cfg.robustness.query_timeout_ms = query_timeout_ms
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    app.schema.add_class({
+        "class": "Ro", "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "tag", "dataType": ["text"]}],
+    })
+    if vecs is None:
+        rng = np.random.default_rng(23)
+        # integer-valued vectors: distances are exact in f32, so host
+        # fallback results are bit-comparable to device results
+        vecs = rng.integers(-8, 8, (n, DIM)).astype(np.float32)
+    idx = app.db.get_index("Ro")
+    idx.put_batch([
+        StorObj(class_name="Ro", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"tag": "even" if i % 2 == 0 else "odd"},
+                vector=vecs[i])
+        for i in range(len(vecs))])
+    return app, idx, vecs
+
+
+def _tie_free_queries(vecs, count):
+    out, i = [], 0
+    while len(out) < count:
+        q = vecs[i] + 0.5
+        i += 1
+        d = np.sort(((vecs - q) ** 2).sum(1))[: K + 8]
+        if len(np.unique(d)) == len(d):
+            out.append(q)
+    return out
+
+
+def _rows(results):
+    return [(r.obj.uuid, r.distance) for r in results]
+
+
+# -- host fallback plane ------------------------------------------------------
+
+
+def test_host_fallback_parity_with_device(tmp_path):
+    """search_by_vectors_host returns exactly what the device path returns
+    on tie-free integer data (the breaker can swap planes mid-journey
+    without changing any answer), including post-delete and filtered."""
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False)
+    try:
+        shard = idx.single_local_shard()
+        vidx = shard.vector_index
+        queries = np.stack(_tie_free_queries(vecs, 6))
+        dev_ids, dev_d = vidx.search_by_vectors(queries, K)
+        host_ids, host_d = vidx.search_by_vectors_host(queries, K)
+        np.testing.assert_array_equal(dev_ids, host_ids)
+        np.testing.assert_array_equal(dev_d, host_d)
+        # deletes invalidate the cached host rows via the snapshot gen
+        for uid in (1, 2, 3):
+            shard.delete_object(str(uuidlib.UUID(int=uid)))
+        dev_ids, dev_d = vidx.search_by_vectors(queries, K)
+        host_ids, host_d = vidx.search_by_vectors_host(queries, K)
+        np.testing.assert_array_equal(dev_ids, host_ids)
+        np.testing.assert_array_equal(dev_d, host_d)
+        # filtered: allowList masks the same docs on both planes
+        allow = shard.build_allow_list(LocalFilter.from_dict({
+            "path": ["tag"], "operator": "Equal", "valueText": "even"}))
+        dev_ids, dev_d = vidx.search_by_vectors(queries, K, allow)
+        host_ids, host_d = vidx.search_by_vectors_host(queries, K, allow)
+        np.testing.assert_array_equal(dev_ids, host_ids)
+        np.testing.assert_array_equal(dev_d, host_d)
+    finally:
+        app.shutdown()
+
+
+# -- journey: device error mid-coalesced-dispatch -> breaker -> recovery ------
+
+
+def test_device_error_journey_breaker_trips_and_recovers(tmp_path):
+    """Repeated injected device failure mid-coalesced-dispatch: every rider
+    still gets a correct answer (lane fails -> direct retry -> breaker
+    trips -> host fallback serves), the breaker is observable OPEN in
+    /metrics, and once the fault clears a half-open probe closes it and
+    the device serves again."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=20.0, breaker_threshold=3,
+                             breaker_reset_ms=150.0)
+    inj = faults.configure(faults.FaultInjector())
+    try:
+        queries = _tie_free_queries(vecs, 8)
+        expected = [
+            _rows(idx.object_vector_search(q, K)[0]) for q in queries]
+        inj.plan("index.tpu.dispatch", "device_error", times=None)
+
+        got = [None] * len(queries)
+        errs = [None] * len(queries)
+
+        def run(i):
+            try:
+                got[i] = _rows(app.traverser.get_class(GetParams(
+                    class_name="Ro",
+                    near_vector={"vector": queries[i].tolist()}, limit=K)))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errs[i] = e
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(queries))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "request hung"
+        # zero hung, zero crashed: every request resolved to the CORRECT
+        # answer via the breaker-routed host fallback
+        assert errs == [None] * len(queries)
+        assert got == expected
+        # the parallel batch may have coalesced into fewer than `threshold`
+        # failed dispatches; sequential requests (one failed dispatch each
+        # while closed) deterministically finish tripping the breaker
+        for _ in range(6):
+            if app.breaker.state() == robustness.STATE_OPEN:
+                break
+            r = _rows(app.traverser.get_class(GetParams(
+                class_name="Ro", near_vector={"vector": queries[2].tolist()},
+                limit=K)))
+            assert r == expected[2]
+        assert app.breaker.state() == robustness.STATE_OPEN
+        exposed = app.metrics.expose().decode()
+        assert "weaviate_breaker_state 1.0" in exposed
+        assert "weaviate_device_fallback_total" in exposed
+
+        # while OPEN, serving keeps working from the host plane
+        again = _rows(app.traverser.get_class(GetParams(
+            class_name="Ro", near_vector={"vector": queries[0].tolist()},
+            limit=K)))
+        assert again == expected[0]
+
+        # fault clears -> cooldown -> half-open probe succeeds -> CLOSED
+        inj.clear()
+        time.sleep(0.2)
+        probe = _rows(app.traverser.get_class(GetParams(
+            class_name="Ro", near_vector={"vector": queries[1].tolist()},
+            limit=K)))
+        assert probe == expected[1]
+        assert app.breaker.state() == robustness.STATE_CLOSED
+        assert "weaviate_breaker_state 0.0" in app.metrics.expose().decode()
+        # recovery releases the host fallback copy (a full f32 store
+        # materialization at scale — it must not stay pinned)
+        assert idx.single_local_shard().vector_index._host_rows_cache is None
+    finally:
+        faults.unconfigure(inj)
+        app.shutdown()
+
+
+def test_zero_device_work_never_feeds_the_breaker(tmp_path):
+    """A search that succeeds WITHOUT device work (empty-allowList early
+    return) must not reset the consecutive-failure count: interleaved
+    empty-filter queries on a dying device would otherwise keep the
+    breaker from ever tripping."""
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False, breaker_threshold=2)
+    inj = faults.configure(faults.FaultInjector())
+    try:
+        shard = idx.single_local_shard()
+        empty_flt = LocalFilter.from_dict({
+            "path": ["tag"], "operator": "Equal", "valueText": "nosuchtag"})
+        inj.plan("index.tpu.dispatch", "device_error", times=1)
+        r = shard.object_vector_search(vecs[0], K)  # failure #1 (fallback)
+        assert r[0]
+        assert app.breaker.state() == robustness.STATE_CLOSED
+        # empty-allow success: zero device work, must NOT reset the count
+        assert shard.object_vector_search(vecs[0], K, flt=empty_flt) == [[]]
+        inj.plan("index.tpu.dispatch", "device_error", times=1)
+        shard.object_vector_search(vecs[0], K)      # failure #2 -> trips
+        assert app.breaker.state() == robustness.STATE_OPEN
+    finally:
+        faults.unconfigure(inj)
+        app.shutdown()
+
+
+def test_rest_zero_timeout_header_cannot_opt_out_of_default(tmp_path):
+    """X-Request-Timeout-Ms: 0 falls back to the operator's
+    QUERY_TIMEOUT_MS default (the gRPC twin's semantics) — a client
+    cannot make itself unbounded."""
+    from weaviate_tpu.server import RestServer
+
+    app, idx, vecs = _mk_app(tmp_path, window_ms=2000.0,
+                             query_timeout_ms=40.0)
+    srv = RestServer(app, port=0)
+    srv.start()
+    try:
+        st, _, out = _rest(srv.port, "POST", "/v1/graphql",
+                           {"query": _gql_near(vecs[0])},
+                           headers={"X-Request-Timeout-Ms": "0"})
+        assert st == 504, out
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_allocator_oom_on_write_is_a_device_error(tmp_path):
+    """index.tpu.alloc injection: a store-growth OOM surfaces as a device
+    error (recognized by the breaker's classifier), not a silent hang."""
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False, n=64)
+    inj = faults.configure(faults.FaultInjector())
+    try:
+        inj.plan("index.tpu.alloc", "oom", times=1)
+        shard = idx.single_local_shard()
+        big = np.ones((20000, DIM), np.float32)
+        with pytest.raises(faults.InjectedOOMError) as ei:
+            shard.vector_index.add_batch(list(range(10_000, 30_000)), big)
+        assert robustness.is_device_error(ei.value)
+    finally:
+        faults.unconfigure(inj)
+        app.shutdown()
+
+
+def test_async_enqueue_device_error_defers_host_fallback(tmp_path):
+    """A device error at the ASYNC enqueue returns a deferred host-fallback
+    closure; calling it later (another thread, after the except frame is
+    gone) still serves the correct answer — regression for the cleared
+    except-variable capture."""
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False)
+    inj = faults.configure(faults.FaultInjector())
+    try:
+        shard = idx.single_local_shard()
+        q = _tie_free_queries(vecs, 1)[0]
+        expected = _rows(shard.object_vector_search(q, K)[0])
+        inj.plan("index.tpu.dispatch", "device_error", times=1)
+        done = shard.object_vector_search_async(q, K)
+        out = [None]
+        t = threading.Thread(target=lambda: out.__setitem__(0, done()))
+        t.start()
+        t.join(timeout=30)
+        assert _rows(out[0][0]) == expected
+    finally:
+        faults.unconfigure(inj)
+        app.shutdown()
+
+
+# -- journey: deadline expired in queue ---------------------------------------
+
+
+def test_deadline_expires_in_admission_queue(tmp_path):
+    """A request whose deadline is shorter than the coalescer window fails
+    fast with DeadlineExceededError — bounded by its own budget, far
+    before the window flush — instead of occupying dispatch rows."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=2000.0)
+    try:
+        t0 = time.monotonic()
+        with robustness.deadline_scope(40.0):
+            with pytest.raises(robustness.DeadlineExceededError):
+                app.traverser.get_class(GetParams(
+                    class_name="Ro", near_vector={"vector": vecs[0].tolist()},
+                    limit=K))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, f"not fail-fast: {elapsed:.2f}s"
+        assert "weaviate_deadline_expired_total" in \
+            app.metrics.expose().decode()
+    finally:
+        app.shutdown()
+
+
+def test_already_expired_request_never_dispatches(tmp_path):
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False)
+    try:
+        with robustness.deadline_scope(1.0):
+            time.sleep(0.01)
+            with pytest.raises(robustness.DeadlineExceededError):
+                app.traverser.get_class(GetParams(
+                    class_name="Ro",
+                    near_vector={"vector": vecs[0].tolist()}, limit=K))
+    finally:
+        app.shutdown()
+
+
+# -- journey: queue-full shedding ---------------------------------------------
+
+
+def test_queue_full_sheds_with_retry_after(tmp_path):
+    """Admission beyond max_queued_rows sheds (OverloadedError with a
+    retry hint) instead of queueing unboundedly; the python-side and
+    prometheus shed counters both move."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=5000.0, max_queued_rows=3)
+    try:
+        shard = idx.single_local_shard()
+        co = app.coalescer
+        waits = [co.submit(shard, vecs[i], K) for i in range(3)]
+        assert all(w is not None for w in waits)
+        with pytest.raises(robustness.OverloadedError) as ei:
+            co.submit(shard, vecs[3], K)
+        assert ei.value.retry_after_s > 0
+        assert co.stats()["shed"].get("queue_full") == 1
+        assert 'weaviate_requests_shed_total{reason="queue_full"} 1.0' in \
+            app.metrics.expose().decode()
+    finally:
+        app.shutdown()  # queued waiters get the shutdown error
+
+
+def test_shed_requests_do_not_fall_through_to_direct_path(tmp_path):
+    """A shed MUST shed: the traverser propagates OverloadedError instead
+    of retrying the direct path (which would defeat admission control)."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=5000.0, max_queued_rows=2)
+    try:
+        shard = idx.single_local_shard()
+        for i in range(2):
+            assert app.coalescer.submit(shard, vecs[i], K) is not None
+        with pytest.raises(robustness.OverloadedError):
+            app.traverser.get_class(GetParams(
+                class_name="Ro", near_vector={"vector": vecs[5].tolist()},
+                limit=K))
+    finally:
+        app.shutdown()
+
+
+# -- journey: flush-thread death liveness -------------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_flush_thread_death_keeps_clients_live(tmp_path):
+    """Injected flush-thread death mid-flush (a BaseException the loop's
+    `except Exception` defense cannot catch, with a lane IN FLIGHT):
+    the stranded waiter hits its bounded wait and retries direct; later
+    submits bypass with `flusher_dead`. Zero hangs, every request gets
+    its correct answer."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=50.0, wait_timeout_s=0.5)
+    inj = faults.configure(faults.FaultInjector())
+    try:
+        q = _tie_free_queries(vecs, 1)[0]
+        expected = _rows(idx.object_vector_search(q, K)[0])
+        # the flusher dies AT the lane dispatch: the lane is stranded
+        # (never resolved, never failed) and the thread is gone
+        inj.plan("serving.coalescer.dispatch", "die", times=1)
+        t0 = time.monotonic()
+        got = _rows(app.traverser.get_class(GetParams(
+            class_name="Ro", near_vector={"vector": q.tolist()}, limit=K)))
+        elapsed = time.monotonic() - t0
+        assert got == expected          # served via the direct-path retry
+        assert elapsed < 5.0, f"hang: {elapsed:.1f}s"
+        # flusher is dead now: admission refuses instead of queueing into
+        # lanes nobody will flush — and serving still works
+        deadline = time.monotonic() + 5.0
+        while app.coalescer._thread.is_alive() \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not app.coalescer._thread.is_alive()
+        got2 = _rows(app.traverser.get_class(GetParams(
+            class_name="Ro", near_vector={"vector": q.tolist()}, limit=K)))
+        assert got2 == expected
+        assert app.coalescer.stats()["bypass"].get("flusher_dead", 0) >= 1
+    finally:
+        faults.unconfigure(inj)
+        app.shutdown()
+
+
+def test_dead_pool_task_wakes_waiters(tmp_path):
+    """A dispatch-pool submission that dies after admission (cancelled, or
+    killed outside its own error handling) wakes its waiters through the
+    future reaper — nobody waits out the liveness bound."""
+    from concurrent.futures import Future
+
+    app, idx, vecs = _mk_app(tmp_path, window_ms=10.0, wait_timeout_s=20.0)
+    try:
+        co = app.coalescer
+        shard = idx.single_local_shard()
+
+        class DyingPool:
+            def submit(self, fn, *a, **kw):
+                fut = Future()
+                # the task "ran" but died outside its error handling
+                fut.set_exception(faults.InjectedThreadDeath("pool died"))
+                return fut
+
+            def shutdown(self, wait=True):
+                pass
+
+        co._dispatch_pool = DyingPool()
+        w = co.submit(shard, vecs[0], K)
+        assert w is not None
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="dispatch task died"):
+            w()
+        # woken by the reaper, not by the 20 s liveness bound
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        app.shutdown()
+
+
+def test_waiter_timeout_is_bounded_and_typed(tmp_path):
+    """With the flusher wedged (never flushing: huge window) and no
+    deadline, a waiter raises CoalescerTimeoutError at its liveness cap."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=60_000.0,
+                             wait_timeout_s=0.25)
+    try:
+        w = app.coalescer.submit(idx.single_local_shard(), vecs[0], K)
+        assert w is not None
+        t0 = time.monotonic()
+        with pytest.raises(CoalescerTimeoutError):
+            w()
+        assert 0.2 < time.monotonic() - t0 < 3.0
+    finally:
+        app.shutdown()
+
+
+# -- REST / gRPC surfaces -----------------------------------------------------
+
+
+def _gql_near(vec):
+    return ('{ Get { Ro(limit: %d, nearVector: {vector: %s}) '
+            '{ tag _additional { distance } } } }'
+            % (K, json.dumps([float(x) for x in vec])))
+
+
+def _rest(port, method, path, body=None, headers=None, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        data = json.dumps(body).encode() if body is not None else None
+        hdrs = {"Content-Type": "application/json", **(headers or {})}
+        conn.request(method, path, body=data, headers=hdrs)
+        resp = conn.getresponse()
+        payload = resp.read()
+        return resp.status, dict(resp.getheaders()), \
+            json.loads(payload) if payload else None
+    finally:
+        conn.close()
+
+
+def test_rest_deadline_and_shed_statuses(tmp_path):
+    """X-Request-Timeout-Ms -> 504 on queue expiry; a full admission queue
+    -> 429 with a Retry-After header; a malformed header -> 400."""
+    from weaviate_tpu.server import RestServer
+
+    # cap 3: the 504 request's expired waiter holds its queue row until
+    # the (never-reached) window flush prunes it, so the two filler
+    # submits below bring the queue exactly to the cap
+    app, idx, vecs = _mk_app(tmp_path, window_ms=5000.0, max_queued_rows=3)
+    srv = RestServer(app, port=0)
+    srv.start()
+    try:
+        body = {"query": _gql_near(vecs[0])}
+        st, hdrs, out = _rest(
+            srv.port, "POST", "/v1/graphql", body,
+            headers={"X-Request-Timeout-Ms": "40"})
+        assert st == 504, out
+        assert "deadline" in out["error"][0]["message"]
+
+        # fill the queue so the next request sheds
+        shard = idx.single_local_shard()
+        for i in range(2):
+            assert app.coalescer.submit(shard, vecs[i], K) is not None
+        st, hdrs, out = _rest(srv.port, "POST", "/v1/graphql",
+                              {"query": _gql_near(vecs[5])})
+        assert st == 429, out
+        assert int(hdrs.get("Retry-After", "0")) >= 1
+        assert "overloaded" in out["error"][0]["message"]
+
+        st, _, out = _rest(srv.port, "POST", "/v1/graphql", body,
+                           headers={"X-Request-Timeout-Ms": "soon"})
+        assert st == 400
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_rest_generous_deadline_serves_normally(tmp_path):
+    from weaviate_tpu.server import RestServer
+
+    app, idx, vecs = _mk_app(tmp_path, window_ms=5.0)
+    srv = RestServer(app, port=0)
+    srv.start()
+    try:
+        st, _, out = _rest(
+            srv.port, "POST", "/v1/graphql",
+            {"query": _gql_near(vecs[0])},
+            headers={"X-Request-Timeout-Ms": "15000"})
+        assert st == 200 and "errors" not in out
+        assert len(out["data"]["Get"]["Ro"]) == K
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_grpc_deadline_and_overload_codes(tmp_path):
+    """x-request-timeout-ms metadata -> DEADLINE_EXCEEDED; a full queue ->
+    RESOURCE_EXHAUSTED with retry-after-s trailing metadata."""
+    import grpc
+
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
+
+    # cap 3: the DEADLINE_EXCEEDED request's expired waiter holds its
+    # queue row until the window flush (see the REST twin above)
+    app, idx, vecs = _mk_app(tmp_path, window_ms=5000.0, max_queued_rows=3)
+    srv = GrpcServer(app, port=0)
+    srv.start()
+    cl = SearchClient(f"127.0.0.1:{srv.port}")
+    try:
+        req = pb.SearchRequest(
+            class_name="Ro", limit=K,
+            near_vector=pb.NearVectorParams(vector=vecs[0].tolist()))
+        with pytest.raises(grpc.RpcError) as ei:
+            cl.search(req, metadata=(("x-request-timeout-ms", "40"),))
+        assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+
+        shard = idx.single_local_shard()
+        for i in range(2):
+            assert app.coalescer.submit(shard, vecs[i], K) is not None
+        with pytest.raises(grpc.RpcError) as ei:
+            cl.search(req)
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        md = {k: v for k, v in (ei.value.trailing_metadata() or ())}
+        assert float(md.get("retry-after-s", 0)) > 0
+    finally:
+        cl.close()
+        srv.stop()
+        app.shutdown()
+
+
+def test_grpc_config_default_survives_transport_deadline(tmp_path):
+    """The stub's implicit 30 s transport deadline must NOT override the
+    operator's QUERY_TIMEOUT_MS: with no explicit metadata, a request that
+    would sit past the config default gets DEADLINE_EXCEEDED."""
+    import grpc
+
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
+
+    app, idx, vecs = _mk_app(tmp_path, window_ms=500.0,
+                             query_timeout_ms=40.0)
+    srv = GrpcServer(app, port=0)
+    srv.start()
+    cl = SearchClient(f"127.0.0.1:{srv.port}")
+    try:
+        req = pb.SearchRequest(
+            class_name="Ro", limit=K,
+            near_vector=pb.NearVectorParams(vector=vecs[0].tolist()))
+        t0 = time.monotonic()
+        with pytest.raises(grpc.RpcError) as ei:
+            cl.search(req)  # 30 s transport timeout, no metadata
+        assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        assert time.monotonic() - t0 < 2.0  # the 40 ms default applied
+        # an EXPLICIT override may extend past the default (header twin)
+        rep = cl.search(req, metadata=(("x-request-timeout-ms", "20000"),))
+        assert len(rep.results) == K
+    finally:
+        cl.close()
+        srv.stop()
+        app.shutdown()
+
+
+# -- closed-loop acceptance (scaled): injected failure under load -------------
+
+
+def test_closed_loop_under_injected_device_failure(tmp_path):
+    """The acceptance criterion, scaled to tier-1: a closed-loop run with
+    repeated injected device failure completes with ZERO hung requests and
+    zero crashes — every request resolves to success, a fast
+    deadline/shed error, or a breaker-routed host-fallback answer, and the
+    breaker/shed metrics are observable in the exposition."""
+    app, idx, vecs = _mk_app(tmp_path, window_ms=5.0, breaker_threshold=3,
+                             breaker_reset_ms=50.0, wait_timeout_s=2.0,
+                             max_queued_rows=256)
+    inj = faults.configure(faults.FaultInjector(seed=11))
+    try:
+        inj.plan("index.tpu.dispatch", "device_error", times=None, p=0.25)
+        queries = _tie_free_queries(vecs, 8)
+        expected = {i: _rows(idx.object_vector_search(q, K)[0])
+                    for i, q in enumerate(queries)}
+        CLIENTS, PER = 16, 12
+        outcomes = [[] for _ in range(CLIENTS)]
+        unresolved = [PER] * CLIENTS
+
+        def loop(tid):
+            rng = np.random.default_rng(tid)
+            for _ in range(PER):
+                qi = int(rng.integers(0, len(queries)))
+                try:
+                    with robustness.deadline_scope(1500.0):
+                        res = _rows(app.traverser.get_class(GetParams(
+                            class_name="Ro",
+                            near_vector={"vector": queries[qi].tolist()},
+                            limit=K)))
+                    outcomes[tid].append(
+                        "ok" if res == expected[qi] else "wrong")
+                except robustness.OverloadedError:
+                    outcomes[tid].append("shed")
+                except robustness.DeadlineExceededError:
+                    outcomes[tid].append("deadline")
+                except Exception as e:  # noqa: BLE001 — outcome accounting
+                    outcomes[tid].append(f"error:{type(e).__name__}:{e}")
+                unresolved[tid] -= 1
+
+        threads = [threading.Thread(target=loop, args=(i,))
+                   for i in range(CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "client thread hung"
+        assert sum(unresolved) == 0, "requests left unresolved"
+        flat = [o for per in outcomes for o in per]
+        # zero crashes/unknowns: every request resolved to success or a
+        # fast lifecycle error; and correctness held (host fallback is
+        # exact — answers never went wrong, even mid-breaker-flap)
+        bad = [o for o in flat
+               if o not in ("ok", "shed", "deadline")]
+        assert not bad, f"unexpected outcomes: {bad[:5]}"
+        assert flat.count("ok") > 0
+        exposed = app.metrics.expose().decode()
+        assert "weaviate_breaker_state" in exposed
+        assert "weaviate_requests_shed_total" in exposed
+        assert "weaviate_deadline_expired_total" in exposed
+    finally:
+        faults.unconfigure(inj)
+        app.shutdown()
+
+
+# -- httputil backoff ---------------------------------------------------------
+
+
+def test_http_retry_jittered_backoff(monkeypatch):
+    from weaviate_tpu.cluster.httputil import Http
+
+    class FlakyConn:
+        def __init__(self, fail_times):
+            self.fail = fail_times
+
+        def request(self, *a, **kw):
+            if self.fail[0] > 0:
+                self.fail[0] -= 1
+                raise OSError("connection reset")
+
+        def getresponse(self):
+            class R:
+                status = 200
+
+                def read(self):
+                    return b"{}"
+
+            return R()
+
+        def close(self):
+            pass
+
+    h = Http(timeout=1.0, attempts=3, backoff_base_s=0.05)
+    fail = [2]
+    sleeps = []
+    monkeypatch.setattr(h, "_sleep", lambda s: sleeps.append(s))
+    monkeypatch.setattr(h, "_conn", lambda host: (FlakyConn(fail), False))
+    h._rng.seed(42)
+    status, _ = h.request("n1:1234", "GET", "/x")
+    assert status == 200
+    # attempt 1 (stale-socket retry) is immediate; attempt 2 backs off
+    # with jitter in [0.5, 1.5] * base
+    assert len(sleeps) == 1
+    assert 0.025 <= sleeps[0] <= 0.075
+    # two instances never sleep in lockstep (jitter decorrelates retries)
+    h2 = Http(timeout=1.0, attempts=3, backoff_base_s=0.05)
+    h2._rng.seed(43)
+    assert h._backoff_s(2) != h2._backoff_s(2)
+
+
+def test_http_exhausts_attempts_then_raises(monkeypatch):
+    from weaviate_tpu.cluster.httputil import Http
+
+    calls = []
+
+    class DeadConn:
+        def request(self, *a, **kw):
+            calls.append(1)
+            raise ConnectionRefusedError("down")
+
+        def close(self):
+            pass
+
+    h = Http(timeout=1.0, attempts=3)
+    monkeypatch.setattr(h, "_sleep", lambda s: None)
+    monkeypatch.setattr(h, "_conn", lambda host: (DeadConn(), False))
+    with pytest.raises(OSError):
+        h.request("n1:1234", "GET", "/x")
+    assert len(calls) == 3  # per-attempt bounded: exactly `attempts` tries
+
+
+def test_http_nonidempotent_fresh_conn_failure_never_retries(monkeypatch):
+    """A POST that dies mid-read on a FRESH connection must NOT re-execute
+    (the peer may already have applied a 2PC prepare/commit); a stale
+    reused keep-alive socket still gets its immediate retry."""
+    from weaviate_tpu.cluster.httputil import Http
+
+    calls = []
+
+    class MidReadDeath:
+        def request(self, *a, **kw):
+            calls.append(1)
+
+        def getresponse(self):
+            raise TimeoutError("timed out reading the response")
+
+        def close(self):
+            pass
+
+    h = Http(timeout=1.0, attempts=3)
+    monkeypatch.setattr(h, "_sleep", lambda s: None)
+    monkeypatch.setattr(h, "_conn", lambda host: (MidReadDeath(), False))
+    with pytest.raises(OSError):
+        h.request("n1:1234", "POST", "/replicas/x", body=b"{}")
+    assert len(calls) == 1  # executed once, never re-sent
+
+    # reused keep-alive: the send provably never executed -> retried
+    calls.clear()
+    seq = [True, False]  # first conn reused (stale), retry conn fresh
+
+    class StaleThenOk(MidReadDeath):
+        def __init__(self, ok):
+            self.ok = ok
+
+        def getresponse(self):
+            if not self.ok:
+                raise ConnectionResetError("stale keep-alive")
+
+            class R:
+                status = 200
+
+                def read(self):
+                    return b"{}"
+
+            return R()
+
+    conns = [StaleThenOk(False), StaleThenOk(True)]
+    monkeypatch.setattr(h, "_conn",
+                        lambda host: (conns[len(calls)], seq[len(calls)]))
+    status, _ = h.request("n1:1234", "POST", "/replicas/x", body=b"{}")
+    assert status == 200 and len(calls) == 2
+
+
+def test_breaker_half_open_probe_slot_expires():
+    """An abandoned probe (dispatch died without a success/failure verdict)
+    must not wedge the breaker in HALF_OPEN forever: after one cooldown
+    with no verdict the probe slot recycles."""
+    br = robustness.CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05,
+                                   half_open_probes=1)
+    br.record_failure(faults.InjectedDeviceError("x"))
+    assert br.state() == robustness.STATE_OPEN
+    time.sleep(0.06)
+    assert br.allow()                 # probe granted...
+    assert not br.allow()             # ...slot taken
+    # the probe is abandoned (no record_*); after another cooldown the
+    # slot recycles instead of locking every caller onto the host plane
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_success()
+    assert br.state() == robustness.STATE_CLOSED
+
+
+def test_is_device_error_excludes_jax_programming_errors():
+    """jax.* tracer/concretization errors are deterministic code bugs —
+    they must NOT read as device incidents; jaxlib runtime errors do."""
+    prog = type("ConcretizationTypeError", (RuntimeError,), {})
+    prog.__module__ = "jax.errors"
+    assert not robustness.is_device_error(prog("tracer leak"))
+    rt = type("SomeRuntimeFault", (RuntimeError,), {})
+    rt.__module__ = "jaxlib.xla_extension"
+    assert robustness.is_device_error(rt("device halted"))
+    named = type("XlaRuntimeError", (RuntimeError,), {})
+    named.__module__ = "somewhere.else"
+    assert robustness.is_device_error(named("RESOURCE_EXHAUSTED"))

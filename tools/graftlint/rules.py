@@ -17,6 +17,9 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
           .block_until_ready() on a device value lexically inside a
           `with <lock>:` block) — the read-path serialization the
           snapshot-isolated dispatch plane removed
+  JGL009  unbounded blocking wait (`wait()`/`get()`/`acquire()` with no
+          timeout) on the serving path — one wedged producer then hangs
+          a client forever instead of failing fast
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
@@ -25,7 +28,10 @@ gates the request-tracing scope (weaviate_tpu/serving/, weaviate_tpu/db/ —
 where spans cross the coalescer's thread handoffs and a leaked one
 corrupts every rider's trace tree); JGL008 gates weaviate_tpu/index/ +
 weaviate_tpu/db/ (where a fetch inside a lock convoys every concurrent
-reader AND writer on one mutex for a whole device round trip). JGL001
+reader AND writer on one mutex for a whole device round trip); JGL009
+gates weaviate_tpu/serving/ + weaviate_tpu/db/ (the request path whose
+every wait must be bounded by a deadline or a liveness cap —
+serving/robustness.py). JGL001
 additionally skips boundary functions whose JOB is host materialization —
 that allowlist lives here, in one place, so reviewers see every waiver.
 
@@ -104,6 +110,21 @@ JGL008_PREFIXES = (
     "weaviate_tpu/db/",
 )
 
+# JGL009 scope: the serving path, where every blocking wait must carry a
+# timeout (deadline-derived where one exists, a liveness cap otherwise) —
+# a bare wait() is how a wedged flush thread hangs a client forever
+JGL009_PREFIXES = (
+    "weaviate_tpu/serving/",
+    "weaviate_tpu/db/",
+)
+
+# zero-positional-arg attribute calls that block forever without a bound.
+# `.get(key)` / `.wait(5)` / `.acquire(timeout=...)` all pass: any
+# positional argument or a timeout/block(ing) kwarg counts as bounded
+# (approximate on purpose — what it over-reports lands in the baseline
+# with a written justification, the JGL001 philosophy)
+UNBOUNDED_WAIT_NAMES = frozenset({"wait", "get", "acquire", "join"})
+
 RULE_DOCS = {
     "JGL000": "suppression hygiene: every inline disable needs a reason and "
               "must still match a finding",
@@ -127,6 +148,10 @@ RULE_DOCS = {
     "JGL008": "blocking device fetch under a held lock — dispatch inside, "
               "fetch OUTSIDE the critical section (snapshot two-phase "
               "pattern, index/tpu.py _dispatch_search)",
+    "JGL009": "unbounded blocking wait — wait()/get()/acquire()/join() "
+              "with no timeout on the serving path can hang a request "
+              "forever; pass an explicit timeout (deadline-derived where "
+              "one exists — serving/robustness.py)",
     "JGL999": "file does not parse",
 }
 
@@ -136,6 +161,13 @@ def in_span_scope(rel_path: str) -> bool:
     rp = rel_path.replace("\\", "/")
     return any(rp == p or rp.startswith(p) or f"/{p}" in rp
                for p in JGL007_PREFIXES)
+
+
+def in_unbounded_wait_scope(rel_path: str) -> bool:
+    """JGL009 scope check (same interior-boundary matching as is_hot)."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL009_PREFIXES)
 
 
 def in_lock_fetch_scope(rel_path: str) -> bool:
@@ -201,6 +233,9 @@ class ModuleIndex:
         self.jitted_fns: set[str] = set()
         self.registries: dict[str, int] = {}   # name -> def line
         self.locks: set[str] = set()
+        # module-level ContextVars: their zero-arg .get() is a lookup, not
+        # a blocking wait — JGL009 must not flag it
+        self.contextvars: set[str] = set()
         for node in tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if _jit_decorated(node):
@@ -226,6 +261,9 @@ class ModuleIndex:
             if isinstance(value, ast.Call) and (dotted(value.func) or "") in (
                     "threading.Lock", "threading.RLock", "Lock", "RLock"):
                 self.locks.update(names)
+            if isinstance(value, ast.Call) and (dotted(value.func) or "") in (
+                    "contextvars.ContextVar", "ContextVar"):
+                self.contextvars.update(names)
 
     @staticmethod
     def _is_mutable_literal(value: ast.expr) -> bool:
@@ -246,6 +284,7 @@ class RuleWalker(ast.NodeVisitor):
         self.hot = is_hot(rel_path)
         self.span_scope = in_span_scope(rel_path)
         self.lock_fetch_scope = in_lock_fetch_scope(rel_path)
+        self.unbounded_wait_scope = in_unbounded_wait_scope(rel_path)
         self.mod = mod
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
@@ -439,7 +478,32 @@ class RuleWalker(ast.NodeVisitor):
         self._check_mutation_call(node)
         self._check_span_leak(node)
         self._check_lock_fetch(node)
+        self._check_unbounded_wait(node)
         self.generic_visit(node)
+
+    # -- JGL009: unbounded blocking wait --
+
+    def _check_unbounded_wait(self, node: ast.Call) -> None:
+        if not self.unbounded_wait_scope or self.fn_depth == 0:
+            return
+        f = node.func
+        if not isinstance(f, ast.Attribute) \
+                or f.attr not in UNBOUNDED_WAIT_NAMES:
+            return
+        if node.args:
+            return  # wait(5) / d.get(key) / acquire(True, 2): bounded or
+            # not a blocking primitive at all
+        if any(kw.arg in ("timeout", "block", "blocking")
+               for kw in node.keywords):
+            return
+        if f.attr == "get" \
+                and (dotted(f.value) or "") in self.mod.contextvars:
+            return  # ContextVar.get(): a lookup, not a blocking wait
+        self.emit("JGL009", node,
+                  f"`.{f.attr}()` with no timeout on the serving path "
+                  "blocks forever if the producer wedges or dies; bound "
+                  "it with the request's remaining deadline (serving/"
+                  "robustness.py) or an explicit liveness cap")
 
     # -- JGL008: blocking device fetch under a held lock --
 

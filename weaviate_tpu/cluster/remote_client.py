@@ -7,8 +7,11 @@ transfer. Addressing goes through a resolver callable
 membership, mirroring sharding.RemoteIndex's node lookup
 (usecases/sharding/remote_index.go).
 
-Connections are cached per (thread, host) and retried once on a stale
-keep-alive socket.
+Connections are cached per (thread, host); retries are bounded and
+jittered (httputil.Http): the `timeout` each client takes is PER ATTEMPT,
+the first retry (stale keep-alive socket) is immediate, and later retries
+back off exponentially with 0.5x-1.5x jitter so replica fan-out from many
+coordinators never retries in lockstep after a node blip.
 """
 
 from __future__ import annotations
@@ -31,9 +34,10 @@ class RemoteIndex:
     (adapters/clients/remote_index.go analog)."""
 
     def __init__(self, resolver: Callable[[str, str], Optional[str]],
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, attempts: int = 3):
+        # timeout is per attempt; see httputil.Http's retry policy
         self.resolve = resolver
-        self.http = _Http(timeout)
+        self.http = _Http(timeout, attempts=attempts)
 
     def _host(self, class_name: str, shard_name: str) -> str:
         host = self.resolve(class_name, shard_name)
@@ -205,8 +209,11 @@ class ReplicationClient:
     """Per-replica 2PC + digest + repair transport, addressed by explicit
     node hosts (adapters/clients/replication.go analog)."""
 
-    def __init__(self, timeout: float = 30.0):
-        self.http = _Http(timeout)
+    def __init__(self, timeout: float = 30.0, attempts: int = 3):
+        # per-attempt timeout + jittered backoff (httputil.Http): a 2PC
+        # coordinator retrying a blipped replica must not hammer it in
+        # lockstep with every other coordinator doing the same
+        self.http = _Http(timeout, attempts=attempts)
 
     def prepare(self, host: str, class_name: str, shard: str,
                 req_id: str, ops: list[dict]) -> None:
@@ -268,8 +275,8 @@ class ReplicationClient:
 class NodeClient:
     """Cluster-wide node status + schema fetch + shard files (scaler/nodes)."""
 
-    def __init__(self, timeout: float = 30.0):
-        self.http = _Http(timeout)
+    def __init__(self, timeout: float = 30.0, attempts: int = 3):
+        self.http = _Http(timeout, attempts=attempts)
 
     def node_status(self, host: str) -> dict:
         return self.http.json(host, "GET", "/nodes/status")

@@ -147,6 +147,14 @@ class CoalescerConfig:
     window_ms: float = 1.5        # deadline flush window per lane
     max_batch: int = 256          # rows that force an immediate flush
     max_request_rows: int = 16    # wider requests bypass to the direct path
+    # admission control (serving/robustness.py): the queue bound is
+    # cost-aware — queued ROWS, not requests — and overflow sheds with
+    # 429/RESOURCE_EXHAUSTED + Retry-After instead of silently stalling
+    max_queued_rows: int = 4096
+    # liveness bound on a queued request's wait for its coalesced result:
+    # even with no deadline set, a wedged flush thread can only cost a
+    # client this long before the request falls back to the direct path
+    wait_timeout_s: float = 30.0
     # lanes in flight between async enqueue and finalize. With the
     # snapshot-isolated read path (PR 4) finalize no longer contends with
     # the next lane's enqueue on an index lock, but on a CPU backend two
@@ -169,6 +177,26 @@ class TracingConfig:
     sample_rate: float = 1.0      # fraction of requests traced (0..1)
     ring_size: int = 256          # completed traces kept for /debug/traces
     slow_query_threshold_ms: float = 1000.0  # <=0 disables the slow log
+
+
+@dataclass
+class RobustnessConfig:
+    """Request-lifecycle robustness (serving/robustness.py). TPU extension:
+    end-to-end deadlines, a device circuit breaker with a host fallback
+    plane, and the fault-injection harness gate (testing/faults.py)."""
+
+    # default per-request deadline when the caller sends none
+    # (X-Request-Timeout-Ms / gRPC deadline override it). 0 = unbounded.
+    query_timeout_ms: float = 0.0
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = 5   # consecutive device errors to trip
+    breaker_reset_ms: float = 2000.0     # OPEN cooldown before half-open
+    breaker_half_open_probes: int = 1    # concurrent probe dispatches
+    # fault-injection spec (testing/faults.py from_spec); "" = harness off
+    # (the module global stays None; every injection point is a
+    # one-comparison no-op)
+    fault_injection: str = ""
+    fault_injection_seed: int = 0
 
 
 @dataclass
@@ -212,6 +240,7 @@ class Config:
     store_dtype: str = "float32"
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -236,6 +265,26 @@ class Config:
                 "[1, QUERY_COALESCER_MAX_BATCH]")
         if self.coalescer.pipeline_depth < 1:
             raise ConfigError("QUERY_COALESCER_PIPELINE_DEPTH must be >= 1")
+        if self.coalescer.max_queued_rows < 1:
+            raise ConfigError("QUERY_COALESCER_MAX_QUEUED_ROWS must be >= 1")
+        if self.coalescer.wait_timeout_s <= 0:
+            raise ConfigError("QUERY_COALESCER_WAIT_TIMEOUT_S must be > 0")
+        if self.robustness.query_timeout_ms < 0:
+            raise ConfigError("QUERY_TIMEOUT_MS must be >= 0")
+        if self.robustness.breaker_failure_threshold < 1:
+            raise ConfigError("BREAKER_FAILURE_THRESHOLD must be >= 1")
+        if self.robustness.breaker_reset_ms < 0:
+            raise ConfigError("BREAKER_RESET_TIMEOUT_MS must be >= 0")
+        if self.robustness.breaker_half_open_probes < 1:
+            raise ConfigError("BREAKER_HALF_OPEN_PROBES must be >= 1")
+        if self.robustness.fault_injection:
+            # fail at startup, not at the first injection-point firing
+            from weaviate_tpu.testing import faults
+
+            try:
+                faults.from_spec(self.robustness.fault_injection)
+            except ValueError as e:
+                raise ConfigError(f"invalid FAULT_INJECTION: {e}") from None
         if not (0.0 <= self.tracing.sample_rate <= 1.0):
             raise ConfigError("TRACING_SAMPLE_RATE must be in [0, 1]")
         if self.tracing.ring_size < 1:
@@ -323,6 +372,21 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         e, "QUERY_COALESCER_MAX_REQUEST_ROWS", 16)
     cfg.coalescer.pipeline_depth = _int(
         e, "QUERY_COALESCER_PIPELINE_DEPTH", 1)
+    cfg.coalescer.max_queued_rows = _int(
+        e, "QUERY_COALESCER_MAX_QUEUED_ROWS", 4096)
+    cfg.coalescer.wait_timeout_s = _float(
+        e, "QUERY_COALESCER_WAIT_TIMEOUT_S", 30.0)
+
+    cfg.robustness.query_timeout_ms = _float(e, "QUERY_TIMEOUT_MS", 0.0)
+    cfg.robustness.breaker_enabled = _bool(e, "BREAKER_ENABLED", True)
+    cfg.robustness.breaker_failure_threshold = _int(
+        e, "BREAKER_FAILURE_THRESHOLD", 5)
+    cfg.robustness.breaker_reset_ms = _float(
+        e, "BREAKER_RESET_TIMEOUT_MS", 2000.0)
+    cfg.robustness.breaker_half_open_probes = _int(
+        e, "BREAKER_HALF_OPEN_PROBES", 1)
+    cfg.robustness.fault_injection = e.get("FAULT_INJECTION", "")
+    cfg.robustness.fault_injection_seed = _int(e, "FAULT_INJECTION_SEED", 0)
 
     cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
     cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)

@@ -33,6 +33,13 @@ from weaviate_tpu.entities.schema import ClassDef, DataType
 from weaviate_tpu.entities.storobj import StorObj
 from weaviate_tpu.index import new_vector_index
 from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.monitoring.metrics import record_device_fallback
+# request-lifecycle robustness (stdlib-only module — no import cycle even
+# though serving/coalescer.py imports this file): deadline fail-fast +
+# the device circuit breaker that routes reads to the host fallback plane
+from weaviate_tpu.serving import robustness
+# named fault-injection point db.shard.search (testing/faults.py)
+from weaviate_tpu.testing import faults
 from weaviate_tpu.inverted.bm25 import BM25Searcher
 from weaviate_tpu.inverted.index import InvertedIndex
 from weaviate_tpu.inverted.searcher import FilterSearcher
@@ -524,24 +531,75 @@ class Shard:
         (shard_read.go:236-287 instrumentation parity) AND, when a trace is
         active, in the dispatch record (monitoring/tracing.py): the
         coalescer's record when this call is a coalesced lane flush, else a
-        single-rider record on the current request's trace."""
+        single-rider record on the current request's trace.
+
+        Robustness gates (serving/robustness.py): an expired deadline
+        fails fast BEFORE any device work; with the circuit breaker open
+        the read serves from the index's host fallback plane instead of
+        dispatching doomed device work; a device error on dispatch feeds
+        the breaker and — when a host plane exists — degrades to it for
+        THIS request too, so a single flaky dispatch costs a retry's
+        latency, not an error."""
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
+        robustness.check_deadline("shard.search")
+        faults.fire("db.shard.search")
+        br = robustness.get_breaker()
+        if br is not None and self._has_host_plane() and not br.allow():
+            return self._host_fallback_search(
+                q, k, flt, target_distance, include_vector, "breaker_open")
         rec = None
+        dispatched = [False]  # set by impl AFTER real device work succeeds
         try:
             rec = tracing.dispatch_record(q.shape[0])
-            return self._vector_search_impl(
-                q, k, flt, target_distance, include_vector, rec)
+            out = self._vector_search_impl(
+                q, k, flt, target_distance, include_vector, rec, dispatched)
+        except Exception as e:
+            if br is not None and robustness.is_device_error(e):
+                br.record_failure(e)
+                if self._has_host_plane():
+                    tracing.annotate_current(
+                        "device_error_fallback", f"{type(e).__name__}: {e}")
+                    return self._host_fallback_search(
+                        q, k, flt, target_distance, include_vector,
+                        "device_error", cause=e)
+            raise
+        else:
+            # only a real DEVICE dispatch may feed the breaker's success
+            # side: an empty-allowList early return (zero device work) or
+            # a device-less index (hnsw/mesh, no host plane) must not
+            # reset the consecutive-failure count — or close an OPEN
+            # breaker without a probe — while the device is down
+            if br is not None and dispatched[0] and self._has_host_plane():
+                self._record_device_success(br)
+            return out
         finally:
             # the direct path owns its record; a coalesced record is
             # finished by the coalescer after scatter (it knows the riders)
             if rec is not None and rec.owned:
                 rec.finish()
 
+    def _record_device_success(self, br) -> None:
+        """Feed the breaker's success side, and release THIS index's host
+        fallback copy — a multi-GB host materialization at serving scale —
+        once the device serves it again with the breaker CLOSED. Per-shard
+        on purpose: the global OPEN->CLOSED transition happens on ONE
+        shard's dispatch, but every shard that served during the degraded
+        window holds its own copy; each frees it on its own first healthy
+        dispatch (the shards holding copies are exactly the ones taking
+        traffic). Steady-state cost: one getattr returning None."""
+        br.record_success()
+        vidx = self.vector_index
+        if getattr(vidx, "_host_rows_cache", None) is not None \
+                and br.state() == robustness.STATE_CLOSED:
+            release = getattr(vidx, "release_host_fallback_cache", None)
+            if release is not None:
+                release()
+
     def _vector_search_impl(
         self, q: np.ndarray, k: int, flt, target_distance,
-        include_vector: bool, rec,
+        include_vector: bool, rec, dispatched=None,
     ) -> list[list[SearchResult]]:
         m = self.metrics
         cls = self.class_def.name
@@ -560,6 +618,8 @@ class Shard:
         if target_distance is not None:
             row_ids, row_dists = self._search_by_vectors_distance(
                 q, target_distance, k, allow)
+            if dispatched is not None:
+                dispatched[0] = True
             lock_wait = self._pop_lock_wait()
             t2 = time.perf_counter()
             # pad the ragged per-row results back to one rectangle so the
@@ -587,6 +647,8 @@ class Shard:
                     int(q.shape[0] * q.shape[1]))
             return hydrated
         ids, dists = self.vector_index.search_by_vectors(q, k, allow)
+        if dispatched is not None:
+            dispatched[0] = True
         lock_wait = self._pop_lock_wait()
         t2 = time.perf_counter()
         hydrated = self._hydrate_batch(ids, dists, include_vector)
@@ -603,6 +665,48 @@ class Shard:
             m.query_dimensions.labels("nearVector", "search", cls).inc(
                 int(q.shape[0] * q.shape[1]))
         return hydrated
+
+    def _has_host_plane(self) -> bool:
+        """Does this shard's index expose a host fallback read plane
+        (index/tpu.py search_by_vectors_host)? The breaker only gates
+        indexes that have one — failing fast with no fallback would be
+        strictly worse than trying the device."""
+        return hasattr(self.vector_index, "search_by_vectors_host")
+
+    def _host_fallback_search(
+        self, q: np.ndarray, k: int, flt, target_distance,
+        include_vector: bool, reason: str,
+        cause: Optional[BaseException] = None,
+    ) -> list[list[SearchResult]]:
+        """Serve a read from the index's host fallback plane (breaker open,
+        or a device error on this dispatch with a host plane available).
+        Counted per reason in weaviate_device_fallback_total — a fleet
+        serving at host speed is a capacity incident and must be visible
+        on a dashboard, not only in tail latency."""
+        record_device_fallback("db.shard.search", reason, cause,
+                               log=reason != "breaker_open")
+        hs = getattr(self.vector_index, "search_by_vectors_host", None)
+        if hs is None:  # caller checked; defensive for foreign indexes
+            if cause is not None:
+                raise cause
+            raise RuntimeError(
+                f"shard {self.name}: no host fallback plane available")
+        allow = self.build_allow_list(flt)
+        if allow is not None and len(allow) == 0:
+            return [[] for _ in range(q.shape[0])]
+        try:
+            ids, dists = hs(q, k, allow)
+        except Exception:
+            if cause is not None:
+                # the fallback itself failed (device unreadable even for
+                # the bulk row fetch): surface the ORIGINAL dispatch error
+                raise cause from None
+            raise
+        if target_distance is not None:
+            dists = np.asarray(dists, dtype=np.float32).copy()
+            dists[dists > float(target_distance)] = np.inf
+        tracing.annotate_current("host_fallback", reason)
+        return self._hydrate_batch(ids, dists, include_vector)
 
     def _pop_lock_wait(self) -> Optional[float]:
         """ms this thread's last snapshot read waited on the index write
@@ -701,10 +805,18 @@ class Shard:
         supports snapshot dispatch (`async_supports_filters`): the
         allowList builds HERE, on the submitting thread — its cost lands
         in the `filter` phase, never inside a lock a reader could convoy
-        on. Indexes without it (hnsw, mesh) fall back to the sync path."""
+        on. Indexes without it (hnsw, mesh) fall back to the sync path.
+
+        Robustness gates mirror object_vector_search: deadline fail-fast
+        at enqueue, breaker-open reads return a host-fallback closure
+        (still ONE batched host pass for a whole coalesced lane), and a
+        device error at enqueue or finalize feeds the breaker and
+        degrades to the host plane when one exists."""
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
+        robustness.check_deadline("shard.search")
+        faults.fire("db.shard.search")
         vidx = self.vector_index
         dispatch = getattr(vidx, "search_by_vectors_async", None)
         if dispatch is None or (
@@ -712,6 +824,10 @@ class Shard:
                 and not getattr(vidx, "async_supports_filters", False)):
             res = self.object_vector_search(q, k, flt, None, include_vector)
             return lambda: res
+        br = robustness.get_breaker()
+        if br is not None and self._has_host_plane() and not br.allow():
+            return lambda: self._host_fallback_search(
+                q, k, flt, None, include_vector, "breaker_open")
         m = self.metrics
         cls = self.class_def.name
         filter_ms = None
@@ -727,8 +843,21 @@ class Shard:
                 empty: list[list[SearchResult]] = [
                     [] for _ in range(q.shape[0])]
                 return lambda: empty
-        finalize = (dispatch(q, k, allow) if allow is not None
-                    else dispatch(q, k))
+        try:
+            finalize = (dispatch(q, k, allow) if allow is not None
+                        else dispatch(q, k))
+        except Exception as e:
+            if br is not None and robustness.is_device_error(e):
+                br.record_failure(e)
+                if self._has_host_plane():
+                    # rebind before capture: Python CLEARS the except
+                    # variable when the handler exits, and this closure
+                    # runs later on another thread
+                    err = e
+                    return lambda: self._host_fallback_search(
+                        q, k, flt, None, include_vector, "device_error",
+                        cause=err)
+            raise
         lock_wait = self._pop_lock_wait()
 
         def done() -> list[list[SearchResult]]:
@@ -744,7 +873,24 @@ class Shard:
                 if rec is not None and filter_ms is not None:
                     rec.phase("filter", filter_ms)
                 t0 = time.perf_counter()
-                ids, dists = finalize()
+                try:
+                    ids, dists = finalize()
+                except Exception as e:
+                    if br is not None and robustness.is_device_error(e):
+                        br.record_failure(e)
+                        if self._has_host_plane():
+                            tracing.annotate_current(
+                                "device_error_fallback",
+                                f"{type(e).__name__}: {e}")
+                            return self._host_fallback_search(
+                                q, k, flt, None, include_vector,
+                                "device_error", cause=e)
+                    raise
+                if br is not None:
+                    # this closure exists only when the index dispatched
+                    # async device work (hnsw/mesh take the sync path), so
+                    # a finalize() success IS a device success
+                    self._record_device_success(br)
                 t1 = time.perf_counter()
                 hydrated = self._hydrate_batch(ids, dists, include_vector)
                 t2 = time.perf_counter()

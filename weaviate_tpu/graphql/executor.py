@@ -20,6 +20,7 @@ from weaviate_tpu.graphql.parser import (
     parse_query,
 )
 from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.serving import robustness
 from weaviate_tpu.usecases.aggregator import AggregateParams
 from weaviate_tpu.usecases.traverser import GetParams
 
@@ -83,6 +84,12 @@ class GraphQLExecutor:
                     )
                 else:
                     errors.append({"message": f"unknown root field {sel.name!r}"})
+            except (robustness.DeadlineExceededError,
+                    robustness.OverloadedError):
+                # request-level lifecycle conditions, not per-field errors:
+                # propagate so the REST layer maps them to 504 / 429 (+
+                # Retry-After) instead of burying them in a 200 envelope
+                raise
             except Exception as e:
                 errors.append({"message": str(e), "path": [sel.name]})
         out: dict[str, Any] = {"data": data}

@@ -56,6 +56,10 @@ from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.interface import AllowList, VectorIndex
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.ops.distances import DISTANCE_FNS
+# named fault-injection points (testing/faults.py): index.tpu.dispatch /
+# index.tpu.finalize / index.tpu.alloc — one-comparison no-ops unless a
+# harness is configured
+from weaviate_tpu.testing import faults
 from weaviate_tpu.ops.topk import bitmap_to_mask, merge_top_k
 
 _CHUNK = 8192          # rows staged per device write (fixed => no recompiles)
@@ -1006,6 +1010,11 @@ class TpuVectorIndex(VectorIndex):
         # generation), so object identity IS the write generation. Strong
         # refs keep ids stable.
         self._blk_cache: dict = {}
+        # host f32 copy of the store (+ its row sq-norms) for the breaker's
+        # fallback plane (search_by_vectors_host), built once per snapshot
+        # generation — (gen, rows, sq_norms)
+        self._host_rows_cache: Optional[
+            tuple[int, np.ndarray, np.ndarray]] = None
         # compiled-shape keys (b, k, rg, active_g, use_allow) that completed a
         # materialized search — each key is its own Mosaic compilation, so one
         # small-shape success must not vouch for a larger VMEM footprint
@@ -1082,6 +1091,7 @@ class TpuVectorIndex(VectorIndex):
         while cap < needed:
             cap *= 2  # geometric growth (maintainance.go:31)
         if cap != self.capacity:
+            faults.fire("index.tpu.alloc")
             if self.compressed:
                 self._codes = _grow_store(self._codes, cap)
                 hv = np.zeros((cap, self.dim), np.float32)
@@ -1778,6 +1788,7 @@ class TpuVectorIndex(VectorIndex):
             empty = (np.zeros((b, 0), dtype=np.uint64),
                      np.zeros((b, 0), dtype=np.float32))
             return lambda: empty
+        faults.fire("index.tpu.dispatch")
         q, b = self._prep_queries(vectors)
         k_eff = min(k, snap.live)
         if allow_list is not None and len(allow_list) < self.config.flat_search_cutoff:
@@ -1793,6 +1804,7 @@ class TpuVectorIndex(VectorIndex):
 
         def finalize():
             try:
+                faults.fire("index.tpu.finalize")
                 return fin()
             finally:
                 if not done[0]:  # idempotent: finalize may be retried
@@ -1984,6 +1996,107 @@ class TpuVectorIndex(VectorIndex):
             return ids.astype(np.uint64), top.astype(np.float32)
 
         return finalize
+
+    # -- host fallback plane (serving/robustness.py circuit breaker) ---------
+
+    def _host_fallback_rows(
+            self, snap: IndexSnapshot) -> tuple[np.ndarray, np.ndarray]:
+        """Host f32 ([n, D] rows, [n] row sq-norms) of the snapshot's
+        live region for the breaker's fallback plane, built ONCE per
+        snapshot generation and cached: the fallback pays one bulk
+        transfer + one norms pass when the breaker first opens, not per
+        degraded query — this path exists precisely for sustained load on
+        the slowest plane. Under PQ the full-precision rows already live
+        host-side (host_vecs); only the norms are derived. (A device too
+        far gone even to read HBM makes the fetch raise; the caller then
+        surfaces the original dispatch error.)"""
+        cached = self._host_rows_cache
+        if cached is not None and cached[0] == snap.gen:
+            return cached[1], cached[2]
+        if snap.compressed and snap.host_vecs is not None:
+            rows = snap.host_vecs[: snap.n]  # a view — no extra memory
+        else:
+            rows = np.asarray(snap.store[: snap.n]).astype(
+                np.float32, copy=False)
+        # einsum: the norms pass must not transiently duplicate the rows
+        sq = np.einsum("ij,ij->i", rows, rows, dtype=np.float32)
+        self._host_rows_cache = (snap.gen, rows, sq)
+        return rows, sq
+
+    def release_host_fallback_cache(self) -> None:
+        """Drop the host fallback copy — a full f32 store materialization
+        at serving scale — once the breaker has recovered and the device
+        serves THIS index again (db/shard.py calls this on the first
+        healthy dispatch after a degraded window, per shard); it rebuilds
+        on the next breaker-open episode."""
+        self._host_rows_cache = None
+
+    def search_by_vectors_host(
+        self, vectors: np.ndarray, k: int,
+        allow_list: Optional[AllowList] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Batched kNN entirely on the HOST (numpy brute force) over the
+        published snapshot — the read path db/shard.py routes to while the
+        device circuit breaker is open (and for the breaker's own recovery
+        probes' riders). Same contract as search_by_vectors ([B, k] ids +
+        dists, inf-padded absent slots); selection is exact, so recall can
+        only go UP while degraded — latency and throughput pay instead."""
+        snap = self._read_snapshot()
+        q = np.asarray(vectors, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        b = q.shape[0]
+        empty = (np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32))
+        if snap.n == 0 or snap.live == 0:
+            return empty
+        if self.metric == vi.DISTANCE_COSINE:
+            norms = np.linalg.norm(q, axis=1, keepdims=True)
+            norms[norms == 0] = 1.0
+            q = q / norms
+        rows, row_sq = self._host_fallback_rows(snap)
+        live = ~snap.host_tombs[: snap.n]
+        if allow_list is not None:
+            from weaviate_tpu.storage.bitmap import Bitmap, allowed_mask
+
+            docs = snap.slot_to_doc[: snap.n]
+            if isinstance(allow_list, Bitmap):
+                amask = allowed_mask(allow_list, docs)
+            else:
+                amask = allow_list.contains_array(docs.astype(np.uint64))
+            live = live & amask
+        n_live = int(live.sum())
+        if n_live == 0:
+            return empty
+        if self.metric == vi.DISTANCE_L2:
+            qx = q @ rows.T
+            d = np.maximum(
+                (q ** 2).sum(1)[:, None] - 2.0 * qx + row_sq[None, :], 0.0)
+        elif self.metric == vi.DISTANCE_DOT:
+            d = -(q @ rows.T)
+        elif self.metric == vi.DISTANCE_COSINE:
+            d = 1.0 - q @ rows.T  # rows are insert-normalized
+        else:
+            # manhattan/hamming have no matmul form: stream row chunks so
+            # the [B, chunk, D] broadcast stays bounded
+            d = np.empty((b, snap.n), np.float32)
+            for s in range(0, snap.n, 4096):
+                blk = rows[s: s + 4096]
+                if self.metric == vi.DISTANCE_MANHATTAN:
+                    d[:, s: s + blk.shape[0]] = np.abs(
+                        q[:, None, :] - blk[None, :, :]).sum(-1)
+                else:  # hamming
+                    d[:, s: s + blk.shape[0]] = (
+                        q[:, None, :] != blk[None, :, :]).sum(-1)
+        d = d.astype(np.float32, copy=False)
+        d[:, ~live] = np.inf
+        kk = min(max(int(k), 1), n_live)
+        part = np.argpartition(d, kk - 1, axis=1)[:, :kk]
+        pd = np.take_along_axis(d, part, axis=1)
+        order = np.argsort(pd, axis=1, kind="stable")
+        idx = np.take_along_axis(part, order, axis=1)
+        top = np.take_along_axis(pd, order, axis=1)
+        ids = np.where(np.isinf(top), -1, snap.slot_to_doc[idx])
+        return ids.astype(np.uint64), top.astype(np.float32)
 
     def search_by_vector(
         self, vector: np.ndarray, k: int, allow_list: Optional[AllowList] = None

@@ -214,6 +214,25 @@ class Metrics:
             "finalized (the read pipeline's depth)",
             ("class_name", "shard_name"))
 
+        # request-lifecycle robustness (serving/robustness.py): breaker
+        # state + shed/deadline counters. Registered once here (the same
+        # pattern as the coalescer vecs); the serving path only touches
+        # them through exception-guarded helpers.
+        self.breaker_state = g(
+            "weaviate_breaker_state",
+            "device circuit breaker state (0=closed 1=open 2=half-open)")
+        self.breaker_transitions = c(
+            "weaviate_breaker_transitions_total",
+            "device circuit breaker state transitions", ("state",))
+        self.requests_shed = c(
+            "weaviate_requests_shed_total",
+            "requests shed by admission control (429/RESOURCE_EXHAUSTED "
+            "with a Retry-After hint)", ("reason",))
+        self.deadline_expired = c(
+            "weaviate_deadline_expired_total",
+            "requests that failed fast on an expired deadline, by the "
+            "stage that detected it", ("where",))
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

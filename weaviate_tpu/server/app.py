@@ -63,6 +63,35 @@ class App:
         else:
             self.tracer = None
 
+        # request-lifecycle robustness (serving/robustness.py): shed/
+        # deadline counters bind to this App's metrics; the device circuit
+        # breaker is a process-wide global (the device is shared — dispatch
+        # failures are a property of the accelerator, not of one shard),
+        # installed here and cleared on shutdown like the tracer.
+        from weaviate_tpu.serving import robustness
+
+        robustness.set_metrics(self.metrics)
+        rb = self.config.robustness
+        if rb.breaker_enabled:
+            self.breaker = robustness.configure_breaker(
+                robustness.CircuitBreaker(
+                    failure_threshold=rb.breaker_failure_threshold,
+                    reset_timeout_s=rb.breaker_reset_ms / 1000.0,
+                    half_open_probes=rb.breaker_half_open_probes,
+                    metrics=self.metrics))
+        else:
+            self.breaker = None
+        # fault-injection harness (testing/faults.py): config-gated; off =>
+        # the module global stays None and every injection point on the
+        # serving path is a one-comparison no-op
+        if rb.fault_injection:
+            from weaviate_tpu.testing import faults
+
+            self.fault_injector = faults.configure(faults.from_spec(
+                rb.fault_injection, seed=rb.fault_injection_seed))
+        else:
+            self.fault_injector = None
+
         # distributed deployments (CLUSTER_HOSTNAME/CLUSTER_JOIN set) build
         # the full cluster graph: membership, cluster-API listener, schema
         # 2PC, replication, scaler (configure_api.go startupRoutine's
@@ -157,7 +186,9 @@ class App:
                 max_batch=cc.max_batch,
                 max_request_rows=cc.max_request_rows,
                 metrics=self.metrics,
-                pipeline_depth=cc.pipeline_depth)
+                pipeline_depth=cc.pipeline_depth,
+                max_queued_rows=cc.max_queued_rows,
+                waiter_timeout_s=cc.wait_timeout_s)
             # persistent slot pool for concurrent batch fan-out (REST
             # /v1/graphql/batch): per-request executors would pay thread
             # churn on the exact hot path the coalescer optimizes
@@ -252,6 +283,16 @@ class App:
 
             # clear only if still ours: a newer App's tracer survives
             tracing.unconfigure(self.tracer)
+        # robustness globals: same still-ours discipline as the tracer
+        from weaviate_tpu.serving import robustness
+
+        if self.breaker is not None:
+            robustness.unconfigure_breaker(self.breaker)
+        robustness.unset_metrics(self.metrics)
+        if self.fault_injector is not None:
+            from weaviate_tpu.testing import faults
+
+            faults.unconfigure(self.fault_injector)
         if self.serving_pool is not None:
             self.serving_pool.shutdown(wait=False)
         self.disk_monitor.shutdown()

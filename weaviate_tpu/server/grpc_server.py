@@ -22,23 +22,46 @@ import numpy as np
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.grpcapi import weaviate_pb2 as pb
 from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.serving import robustness
 from weaviate_tpu.server import reply_native
 from weaviate_tpu.usecases.traverser import GetParams
 
 _SERVICE = "weaviatetpu.v1.Weaviate"
 
 
-def _request_meta(context) -> tuple[str, Optional[str]]:
-    """(request_id, traceparent) from invocation metadata. The request id
-    (inbound ``x-request-id`` honored, else generated) is the gRPC twin of
-    the REST X-Request-Id header; `_set_reply_meta` echoes it back."""
+def _request_meta(context) -> tuple[str, Optional[str], float, float]:
+    """(request_id, traceparent, explicit_timeout_ms, transport_timeout_ms)
+    from invocation metadata. The request id (inbound ``x-request-id``
+    honored, else generated) is the gRPC twin of the REST X-Request-Id
+    header; `_set_reply_meta` echoes it back. The EXPLICIT deadline is the
+    ``x-request-timeout-ms`` metadata entry (the REST header's twin — an
+    intentional caller override, may extend past the config default); the
+    TRANSPORT deadline is ``context.time_remaining()`` — usually just the
+    stub's generous default (e.g. 30 s), so the servicer treats it as a
+    CAP on the config default, never as an override: an implicit client
+    timeout must not silently opt the request out of the operator's
+    QUERY_TIMEOUT_MS. 0 = absent for either."""
     md = {}
     try:
         md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
     except Exception:  # noqa: BLE001 — metadata is best-effort plumbing
         pass
+    transport_ms = 0.0
+    try:
+        tr = context.time_remaining()
+        if tr is not None:
+            transport_ms = float(tr) * 1000.0
+    except Exception:  # noqa: BLE001 — deadline introspection is optional
+        pass
+    explicit_ms = 0.0
+    raw = md.get("x-request-timeout-ms")
+    if raw:
+        try:
+            explicit_ms = float(raw)
+        except ValueError:
+            pass  # malformed metadata entry: ignore, keep the defaults
     return tracing.clean_request_id(md.get("x-request-id")), \
-        md.get("traceparent")
+        md.get("traceparent"), explicit_ms, transport_ms
 
 
 def _set_reply_meta(context, rid: str, trace) -> None:
@@ -171,9 +194,44 @@ class SearchServicer:
     def __init__(self, app):
         self.app = app
 
+    def _timeout_ms(self, explicit_ms: float, transport_ms: float) -> float:
+        """The effective deadline: an EXPLICIT x-request-timeout-ms wins
+        outright (the REST header's semantics — an intentional override
+        may extend past the default); otherwise the config default capped
+        by the transport deadline (the stub's implicit 30 s timeout must
+        not override the operator's QUERY_TIMEOUT_MS — see
+        _request_meta); 0 = unbounded."""
+        if explicit_ms > 0:
+            return explicit_ms
+        bounds = [v for v in (transport_ms,
+                              self.app.config.robustness.query_timeout_ms)
+                  if v > 0]
+        return min(bounds) if bounds else 0.0
+
+    def _abort_lifecycle(self, context, rid: str, e: BaseException,
+                         trace=None) -> None:
+        """Map robustness errors to their canonical gRPC codes. Shed
+        replies carry retry-after-s in trailing metadata (the Retry-After
+        twin) so clients back off instead of retrying in lockstep.
+        set_trailing_metadata REPLACES what _set_reply_meta installed, so
+        the request id AND (for traced requests) the traceparent are
+        re-included — the error-reply header-echo contract holds on the
+        shed path too."""
+        if isinstance(e, robustness.OverloadedError):
+            md = [("x-request-id", rid),
+                  ("retry-after-s", f"{e.retry_after_s:.3f}")]
+            if trace is not None:
+                md.append(("traceparent", trace.traceparent()))
+            try:
+                context.set_trailing_metadata(tuple(md))
+            except Exception:  # noqa: BLE001 — metadata is best-effort
+                pass
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+
     def Search(self, request: pb.SearchRequest, context) -> pb.SearchReply:
         start = time.perf_counter()
-        rid, traceparent = _request_meta(context)
+        rid, traceparent, expl_tmo, trans_tmo = _request_meta(context)
         with tracing.request("grpc", "Search", traceparent=traceparent,
                              request_id=rid,
                              class_name=request.class_name) as tr:
@@ -184,7 +242,13 @@ class SearchServicer:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
             try:
-                results = self.app.traverser.get_class(params)
+                with robustness.deadline_scope(
+                        self._timeout_ms(expl_tmo, trans_tmo)):
+                    results = self.app.traverser.get_class(params)
+            except (robustness.DeadlineExceededError,
+                    robustness.OverloadedError) as e:
+                self._abort_lifecycle(context, rid, e, trace=tr)
+                return
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
@@ -258,12 +322,21 @@ class SearchServicer:
         query yields a reply with error_message; the other slots still ride
         the shared device dispatch."""
         start = time.perf_counter()
-        rid, traceparent = _request_meta(context)
+        rid, traceparent, expl_tmo, trans_tmo = _request_meta(context)
         with tracing.request("grpc", "BatchSearch", traceparent=traceparent,
                              request_id=rid,
                              slots=len(request.requests)) as tr:
             _set_reply_meta(context, rid, tr)
-            return self._batch_search(request, start)
+            try:
+                # ONE deadline scopes the whole batch (the RPC is the unit
+                # the caller is waiting on); per-slot shed/expired errors
+                # land in their slot's error_message via get_class_batched
+                with robustness.deadline_scope(
+                        self._timeout_ms(expl_tmo, trans_tmo)):
+                    return self._batch_search(request, start)
+            except (robustness.DeadlineExceededError,
+                    robustness.OverloadedError) as e:
+                self._abort_lifecycle(context, rid, e, trace=tr)
 
     def _batch_search(self, request: pb.BatchSearchRequest, start: float):
         # with the coalescer on, a NARROW batch (up to max_request_rows —
@@ -393,11 +466,16 @@ class SearchClient:
             response_deserializer=pb.BatchSearchReply.FromString,
         )
 
-    def search(self, request: pb.SearchRequest, timeout: float = 30.0) -> pb.SearchReply:
-        return self._search(request, timeout=timeout)
+    def search(self, request: pb.SearchRequest, timeout: float = 30.0,
+               metadata=None) -> pb.SearchReply:
+        # metadata: e.g. (("x-request-timeout-ms", "50"),) — the server-side
+        # deadline (shed/expire without a client-side transport deadline)
+        return self._search(request, timeout=timeout, metadata=metadata)
 
-    def batch_search(self, request: pb.BatchSearchRequest, timeout: float = 60.0) -> pb.BatchSearchReply:
-        return self._batch(request, timeout=timeout)
+    def batch_search(self, request: pb.BatchSearchRequest,
+                     timeout: float = 60.0,
+                     metadata=None) -> pb.BatchSearchReply:
+        return self._batch(request, timeout=timeout, metadata=metadata)
 
     def close(self) -> None:
         self.channel.close()

@@ -16,6 +16,7 @@ when PROMETHEUS_MONITORING_ENABLED, on its own port (configure_api.go:116).
 from __future__ import annotations
 
 import json
+import math
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -24,6 +25,7 @@ from urllib.parse import parse_qs, urlparse
 
 from weaviate_tpu.auth import ForbiddenError, UnauthorizedError
 from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.serving import robustness
 from weaviate_tpu.schema.manager import SchemaError
 from weaviate_tpu.usecases.objects import NotFoundError, ObjectsError
 from weaviate_tpu.version import __version__ as VERSION
@@ -181,6 +183,11 @@ class Handler(BaseHTTPRequestHandler):
         rid = getattr(self, "_request_id", None)
         if rid:
             self.send_header("X-Request-Id", rid)
+        # shed responses (429) carry the server's drain estimate so
+        # well-behaved clients back off instead of retrying in lockstep
+        ra = getattr(self, "_retry_after", None)
+        if ra is not None:
+            self.send_header("Retry-After", str(max(1, math.ceil(ra))))
         # ...and a traced request emits its W3C traceparent (this server's
         # root span id), so a caller can join its own outbound trace to
         # the /debug/traces entry this request produced
@@ -205,6 +212,27 @@ class Handler(BaseHTTPRequestHandler):
         "pprof_cmdline",
     })
 
+    def _request_timeout_ms(self, route: str) -> float:
+        """Per-request deadline in ms: the caller's X-Request-Timeout-Ms
+        wins, else the config default (QUERY_TIMEOUT_MS); <= 0 (or a
+        plumbing route) = unbounded. A malformed header is a caller error,
+        not a silently-unbounded request."""
+        if route in self._UNTRACED:
+            return 0.0
+        hdr = self.headers.get("X-Request-Timeout-Ms")
+        if hdr:
+            try:
+                v = float(hdr)
+            except ValueError:
+                raise HTTPError(
+                    400, f"invalid X-Request-Timeout-Ms: {hdr!r}") from None
+            if v > 0:
+                return v
+            # <= 0 falls through to the config default (the gRPC twin's
+            # semantics): a client cannot opt OUT of the operator's
+            # deadline by sending 0
+        return self.app.config.robustness.query_timeout_ms
+
     def _dispatch(self):
         self._body_consumed = False
         # request id before anything can fail: the error envelope carries
@@ -214,6 +242,7 @@ class Handler(BaseHTTPRequestHandler):
         self._request_id = tracing.clean_request_id(
             self.headers.get("X-Request-Id"))
         self._traceparent = None
+        self._retry_after = None
         try:
             parsed = urlparse(self.path)
             self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
@@ -227,16 +256,21 @@ class Handler(BaseHTTPRequestHandler):
                 verb = _WRITE_METHODS.get(self.command, "get")
                 self.app.authorizer.authorize(principal, verb, parsed.path)
             handler = getattr(self, "h_" + name)
-            if tracing.get_tracer() is None or name in self._UNTRACED:
-                handler(**mt.groupdict())
-            else:
-                with tracing.request(
-                        "rest", f"{self.command} {parsed.path}",
-                        traceparent=self.headers.get("traceparent"),
-                        request_id=self._request_id, route=name) as tr:
-                    if tr is not None:
-                        self._traceparent = tr.traceparent()
+            # the deadline scope wraps the WHOLE handler (serving/
+            # robustness.py): it propagates via contextvars through the
+            # graphql executor and traverser into coalescer lanes and
+            # shard dispatches; 0 => a no-op scope
+            with robustness.deadline_scope(self._request_timeout_ms(name)):
+                if tracing.get_tracer() is None or name in self._UNTRACED:
                     handler(**mt.groupdict())
+                else:
+                    with tracing.request(
+                            "rest", f"{self.command} {parsed.path}",
+                            traceparent=self.headers.get("traceparent"),
+                            request_id=self._request_id, route=name) as tr:
+                        if tr is not None:
+                            self._traceparent = tr.traceparent()
+                        handler(**mt.groupdict())
         except HTTPError as e:
             self._reply(e.status, _err_body(e.message))
         except UnauthorizedError as e:
@@ -245,6 +279,13 @@ class Handler(BaseHTTPRequestHandler):
             self._reply(403, _err_body(str(e)))
         except NotFoundError as e:
             self._reply(404, _err_body(str(e)))
+        except robustness.OverloadedError as e:
+            # shed by admission control: 429 + Retry-After (the server's
+            # queue-drain estimate) so clients back off with jitter
+            self._retry_after = e.retry_after_s
+            self._reply(429, _err_body(str(e)))
+        except robustness.DeadlineExceededError as e:
+            self._reply(504, _err_body(str(e)))
         except (ObjectsError, SchemaError, ValueError) as e:
             self._reply(422, _err_body(str(e)))
         except BrokenPipeError:

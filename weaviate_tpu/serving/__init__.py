@@ -1,9 +1,26 @@
 """Serving-layer subsystems that sit between the API frontends and the
-shard read path (currently: the cross-request query coalescer)."""
+shard read path: the cross-request query coalescer and the
+request-lifecycle robustness primitives (deadlines, shedding, breaker).
 
-from weaviate_tpu.serving.coalescer import (
-    CoalescerShutdownError,
-    QueryCoalescer,
-)
+The package re-exports are LAZY (PEP 562): ``db/shard.py`` imports
+``weaviate_tpu.serving.robustness`` (stdlib-only) for its breaker gate,
+and an eager ``from .coalescer import ...`` here would close an import
+cycle back through the coalescer's own ``db.shard`` import."""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover — type-checker convenience only
+    from weaviate_tpu.serving.coalescer import (  # noqa: F401
+        CoalescerShutdownError,
+        QueryCoalescer,
+    )
 
 __all__ = ["CoalescerShutdownError", "QueryCoalescer"]
+
+
+def __getattr__(name: str):
+    if name in __all__:
+        from weaviate_tpu.serving import coalescer
+
+        return getattr(coalescer, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -41,21 +41,46 @@ filters with no stable signature (a per-request allowList can never share a
 lane), COLD filter signatures (first sighting within the recency TTL — a
 unique per-tenant filter would otherwise pay the full window in a
 singleton lane for zero merging; only filters proven hot by a recent
-repeat are queued), multi-shard/remote layouts, and a shut-down coalescer.
+repeat are queued), multi-shard/remote layouts, a shut-down coalescer,
+and a DEAD flush thread (`flusher_dead` — liveness: queueing into a lane
+nobody will ever flush would strand every admitted request on its wait
+bound).
 
 The flush thread only ADMITS and ENQUEUES: each lane's blocking work
 (async finalize + hydration, or the sync filtered search) runs on a small
 dispatch pool, so one slow lane — an expensive allowList build, a big
 hydration — cannot head-of-line-block other lanes' flushes.
 
+Request-lifecycle robustness (serving/robustness.py):
+
+  - ADMISSION CONTROL: the queue is bounded in ROWS (`max_queued_rows` —
+    cost-aware: one 16-row request occupies 16 slots), and a request whose
+    estimated queue wait (queued rows over the EWMA service rate) already
+    exceeds its remaining deadline is shed at admission — both raise
+    ``OverloadedError`` (-> 429/RESOURCE_EXHAUSTED + Retry-After) instead
+    of silently stalling the whole client population.
+  - DEADLINES: a waiter carries its request's deadline; the flush path
+    fails deadline-expired waiters fast (they never occupy dispatch rows),
+    and every waiter wait is bounded by min(remaining deadline, the
+    `waiter_timeout_s` liveness cap) — a wedged flush thread can cost a
+    client a bounded wait, never a hang.
+  - NO ORPHANED LANES: every pool submission carries a done-callback
+    (`_reap_lane_future`) that wakes the lane's waiters and frees its
+    in-flight slot if the task was cancelled at shutdown or died outside
+    its own error handling — waiters never depend on the 0.1 s inflight
+    poll (that poll remains only as the flusher's shutdown check).
+
 Error handling is all-or-nothing per lane: a dispatch exception (or
 shutdown) propagates to EVERY queued waiter — no request may hang on a
 dead batch. The flush loop itself is defended: any unexpected error fails
-the affected lanes and the loop keeps serving.
+the affected lanes and the loop keeps serving. (A BaseException — the
+fault harness's injected thread death — still kills the thread; the
+bounded waits plus the `flusher_dead` bypass keep every client live.)
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -72,10 +97,18 @@ from weaviate_tpu.db.shard import filter_signature
 from weaviate_tpu.index.tpu import _B_BUCKETS
 from weaviate_tpu.monitoring import tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
+from weaviate_tpu.serving import robustness
+from weaviate_tpu.testing import faults
 
 
 class CoalescerShutdownError(RuntimeError):
     """Raised to waiters whose lane was still queued at shutdown."""
+
+
+class CoalescerTimeoutError(RuntimeError):
+    """A waiter's liveness bound expired before its lane resolved (wedged
+    or dead flush path). The serving thread retries on the direct path —
+    this is NOT a deadline error (the request's own budget may be fine)."""
 
 
 def _bucket_floor(n: int) -> int:
@@ -98,22 +131,47 @@ class _Waiter:
     blocks on. `trace_span` is the submitter's active span, captured on the
     serving thread at admission — the explicit handoff that carries trace
     context across the flush-thread / dispatch-pool boundary (contextvars
-    do not follow the lane)."""
+    do not follow the lane). `deadline` is captured the same way: the
+    flush path prunes expired waiters, and wait() is bounded by it."""
 
     __slots__ = ("vectors", "event", "result", "error", "enqueued_at",
-                 "trace_span")
+                 "trace_span", "deadline", "max_wait_s")
 
-    def __init__(self, vectors: np.ndarray):
+    def __init__(self, vectors: np.ndarray, max_wait_s: float = 30.0):
         self.vectors = vectors
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.monotonic()
         self.trace_span = tracing.current_span()
+        self.deadline = robustness.current_deadline()
+        self.max_wait_s = max_wait_s
 
     def wait(self):
-        """Block until the lane resolves -> per-row result lists."""
-        self.event.wait()
+        """Block until the lane resolves -> per-row result lists. BOUNDED:
+        by the request's remaining deadline when one is set (plus a small
+        grace for the scatter), and always by `max_wait_s` — a wedged
+        flush thread can never hang a client forever. A deadline-bound
+        timeout raises DeadlineExceededError (fail fast, no retry); a
+        liveness-bound one raises CoalescerTimeoutError (the serving
+        thread retries on the direct path)."""
+        timeout = self.max_wait_s
+        d = self.deadline
+        if d is not None:
+            timeout = min(timeout, max(d.remaining_s(), 0.0) + 0.05)
+        if not self.event.wait(timeout):
+            if d is not None and d.expired():
+                robustness.count_deadline("coalescer.wait")
+                raise robustness.DeadlineExceededError(
+                    "request deadline expired waiting for a coalesced "
+                    "dispatch")
+            # degraded liveness path: the caller re-runs direct — make the
+            # double device work countable, not invisible
+            record_device_fallback("serving.coalescer", "waiter_timeout",
+                                   note=f"waited {timeout:.1f}s")
+            raise CoalescerTimeoutError(
+                f"coalesced dispatch did not resolve within {timeout:.1f}s "
+                "(wedged or dead flush path); retry direct")
         if self.error is not None:
             raise self.error
         return self.result
@@ -121,10 +179,13 @@ class _Waiter:
 
 class _Lane:
     """Accumulating batch for one (shard, k, metric, filter-sig, inc_vec)
-    key. Never touched outside the coalescer lock until popped for flush."""
+    key. Never touched outside the coalescer lock until popped for flush.
+    `settled`/`released` (guarded by the coalescer lock) make waiter
+    wakeup and in-flight-slot release idempotent across the normal path
+    and the pool-future reaper."""
 
     __slots__ = ("key", "shard", "flt", "k", "include_vector", "items",
-                 "rows", "deadline")
+                 "rows", "deadline", "settled", "released", "dispatch_start")
 
     def __init__(self, key, shard, flt, k: int, include_vector: bool,
                  deadline: float):
@@ -136,12 +197,16 @@ class _Lane:
         self.items: list[_Waiter] = []
         self.rows = 0
         self.deadline = deadline
+        self.settled = False     # waiters woken (resolved or failed)
+        self.released = False    # in-flight slot given back
+        self.dispatch_start: Optional[float] = None
 
 
 class QueryCoalescer:
     def __init__(self, window_s: float = 0.0015, max_batch: int = 256,
                  max_request_rows: int = 16, metrics=None,
-                 pipeline_depth: int = 1):
+                 pipeline_depth: int = 1, max_queued_rows: int = 4096,
+                 waiter_timeout_s: float = 30.0):
         self.window_s = max(float(window_s), 0.0)
         # snap DOWN to the index's padding buckets: a full lane then
         # compiles/hits the exact shape a direct dispatch of that width
@@ -161,6 +226,10 @@ class QueryCoalescer:
         # cap, and a single admitted request must never overflow a dispatch
         self.max_request_rows = max(
             1, min(int(max_request_rows), self.max_batch))
+        # admission bound in ROWS (cost-aware shedding: a 16-row request
+        # costs 16 queue slots); overflow sheds with OverloadedError
+        self.max_queued_rows = max(int(max_queued_rows), 1)
+        self.waiter_timeout_s = max(float(waiter_timeout_s), 0.001)
         self.metrics = metrics
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -180,6 +249,17 @@ class QueryCoalescer:
         self._dispatched_requests = 0
         self._dispatched_rows = 0
         self._bypass: dict[str, int] = {}
+        self._shed: dict[str, int] = {}
+        # EWMA of the PER-LANE dispatch service rate (rows/s), fed by
+        # resolved lanes: the admission-time queue-wait estimate that
+        # sheds requests whose deadline the queue can't meet. 0.0 =
+        # unknown (no resolved dispatch yet) — only the hard row cap
+        # sheds then. Up to `pipeline_depth` lanes drain CONCURRENTLY, so
+        # the aggregate drain rate is ~depth x the per-lane EWMA — the
+        # estimate divides by it, or shedding would over-fire by depth x
+        # exactly under the load it protects.
+        self._depth = max(int(pipeline_depth), 1)
+        self._ewma_rows_per_s = 0.0
         # blocking per-lane work (finalize+hydration, sync filtered search)
         # runs on this pool; the flush thread only admits/enqueues, capped
         # at `pipeline_depth` lanes in flight. While every slot is busy the
@@ -208,7 +288,13 @@ class QueryCoalescer:
 
         -> a blocking callable() -> list[list[SearchResult]] (one list per
         row), or None when the request must bypass to the direct path
-        (reason counted)."""
+        (reason counted). Raises DeadlineExceededError for an
+        already-expired request (fail fast: it must not occupy queue
+        rows), and OverloadedError when admission control sheds it
+        (bounded queue full, or the estimated queue wait exceeds the
+        remaining deadline) — shed requests must NOT fall through to the
+        direct path, or shedding would shed nothing."""
+        robustness.check_deadline("coalescer.admit")
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
@@ -219,11 +305,23 @@ class QueryCoalescer:
         if sig is None:
             self.record_bypass("unique_allow_list")
             return None
+        if not self._thread.is_alive():
+            # liveness: a dead flush thread (fault-injected or real) must
+            # not collect requests into lanes nobody will ever flush. A
+            # normally-shut-down coalescer also has no flusher — keep that
+            # counted as "shutdown", not as a liveness incident.
+            with self._lock:
+                closed_now = self._closed
+            self.record_bypass("shutdown" if closed_now else "flusher_dead")
+            return None
+        d = robustness.current_deadline()
         # dim is part of the key: a wrong-dim request must land in its own
         # lane and fail ALONE, not poison the concatenate of its lane-mates
         key = (id(shard), int(k), getattr(shard.vector_index, "metric", ""),
                sig, bool(include_vector), int(q.shape[1]))
         cold = False
+        shed_reason: Optional[str] = None
+        retry_after = 0.1
         with self._cv:
             closed = self._closed
             if not closed and sig:
@@ -246,6 +344,24 @@ class QueryCoalescer:
                                          else {sig: now})
                 cold = last is None or now - last > self._sig_ttl
             if not closed and not cold:
+                # admission control BEFORE touching any lane: shed with a
+                # retry hint instead of silently stalling. Cost-aware: the
+                # bound is queued ROWS. Deadline-aware: when the EWMA
+                # service rate is known and the queue's drain time already
+                # exceeds the remaining deadline, admitting would only
+                # manufacture a guaranteed 504 that occupies queue rows.
+                rows = int(q.shape[0])
+                est_wait = (
+                    self._queued_rows / (self._ewma_rows_per_s * self._depth)
+                    if self._ewma_rows_per_s > 0.0 else None)
+                if self._queued_rows + rows > self.max_queued_rows:
+                    shed_reason = "queue_full"
+                    retry_after = est_wait if est_wait is not None else 0.1
+                elif (d is not None and est_wait is not None
+                      and est_wait > max(d.remaining_s(), 0.0)):
+                    shed_reason = "deadline_unreachable"
+                    retry_after = est_wait
+            if not closed and not cold and shed_reason is None:
                 # wake the flusher only when the picture it sleeps on
                 # changes: a new lane (new earliest deadline) or a lane
                 # popped to _full (new due work). Appending to an existing
@@ -269,7 +385,7 @@ class QueryCoalescer:
                                  time.monotonic() + self.window_s)
                     self._lanes[key] = lane
                     wake = True
-                w = _Waiter(q)
+                w = _Waiter(q, max_wait_s=self.waiter_timeout_s)
                 lane.items.append(w)
                 lane.rows += q.shape[0]
                 self._queued_rows += q.shape[0]
@@ -288,6 +404,12 @@ class QueryCoalescer:
         if cold:
             self.record_bypass("cold_filter")
             return None
+        if shed_reason is not None:
+            self._record_shed(shed_reason)
+            raise robustness.OverloadedError(
+                f"query admission queue overloaded ({shed_reason}: "
+                f"{self._queued_rows} rows queued, cap "
+                f"{self.max_queued_rows})", retry_after_s=retry_after)
         return w.wait
 
     def record_bypass(self, reason: str) -> None:
@@ -305,10 +427,21 @@ class QueryCoalescer:
             except Exception:  # noqa: BLE001 — metrics must not break serving
                 pass
 
+    def _record_shed(self, reason: str) -> None:
+        tracing.annotate_current("coalescer_shed", reason)
+        with self._lock:
+            self._shed[reason] = self._shed.get(reason, 0) + 1
+        robustness.count_shed(reason)
+
     # -- flush loop ----------------------------------------------------------
 
     def _run(self) -> None:
         while True:
+            # fault-injection point: a `die` action here (BaseException)
+            # kills the flush thread the way a real thread death would —
+            # liveness then rests on bounded waiter waits + the
+            # `flusher_dead` bypass, which the journey tests pin
+            faults.fire("serving.coalescer.flush")
             due: list[_Lane] = []
             with self._cv:
                 while not self._closed:
@@ -358,6 +491,8 @@ class QueryCoalescer:
         hydration overlaps the next lane's device compute."""
         for i, ln in enumerate(due):
             while not self._inflight.acquire(timeout=0.1):
+                # this poll is ONLY the flusher's shutdown check now: a
+                # pool task that dies frees its slot via _reap_lane_future
                 if self._closed:
                     # a wedged in-flight dispatch must not strand the rest
                     err = CoalescerShutdownError(
@@ -365,8 +500,15 @@ class QueryCoalescer:
                     for rest in due[i:]:
                         self._fail_lane(rest, err)
                     return
+            if not self._prune_expired(ln):
+                # every rider's deadline passed in the queue: the lane
+                # must not occupy a dispatch slot
+                self._mark_settled(ln)
+                self._release_lane(ln)
+                continue
             done = None
             try:
+                faults.fire("serving.coalescer.dispatch")
                 vidx = ln.shard.vector_index
                 if not hasattr(vidx, "search_by_vectors_async"):
                     # indexes without true async dispatch (hnsw, noop,
@@ -374,7 +516,7 @@ class QueryCoalescer:
                     # object_vector_search_async's sync fallback would
                     # otherwise execute it inline in THIS thread and
                     # head-of-line-block every other lane
-                    self._dispatch_pool.submit(self._dispatch_sync, ln)
+                    self._submit_lane_task(self._dispatch_sync, ln)
                     continue
                 if ln.flt is not None:
                     # filtered lanes: the allowList resolution (an
@@ -384,7 +526,7 @@ class QueryCoalescer:
                     # still rides the lock-free two-phase snapshot path
                     # inside object_vector_search_async (or the sync
                     # fallback for index types without filtered async).
-                    self._dispatch_pool.submit(self._dispatch_filtered, ln)
+                    self._submit_lane_task(self._dispatch_filtered, ln)
                     continue
                 q = (ln.items[0].vectors if len(ln.items) == 1
                      else np.concatenate([w.vectors for w in ln.items]))
@@ -392,12 +534,11 @@ class QueryCoalescer:
                 rec = self._trace_record(ln)
                 done = ln.shard.object_vector_search_async(
                     q, ln.k, include_vector=ln.include_vector)
-                self._dispatch_pool.submit(self._finalize_async, ln, done,
-                                           rec)
+                self._submit_lane_task(self._finalize_async, ln, done, rec)
             except Exception as e:  # noqa: BLE001 — propagate to all waiters
                 # covers pool.submit after shutdown too: no waiter may hang
-                self._inflight.release()
                 self._fail_lane(ln, e)
+                self._release_lane(ln)
                 if done is not None:
                     # the dispatch WAS enqueued (submit itself failed):
                     # settle it so the index's in-flight gauge and any
@@ -407,6 +548,70 @@ class QueryCoalescer:
                     except Exception:  # noqa: BLE001 — already failed lane
                         pass
 
+    def _submit_lane_task(self, fn, lane: _Lane, *args) -> None:
+        """Pool submission with a reaper: if the task is cancelled at
+        shutdown before running, or dies OUTSIDE its own error handling
+        (BaseException, pool teardown), its waiters still wake and its
+        in-flight slot still frees — nobody waits on the 0.1 s poll."""
+        fut = self._dispatch_pool.submit(fn, lane, *args)
+        fut.add_done_callback(functools.partial(self._reap_lane_future, lane))
+
+    def _reap_lane_future(self, lane: _Lane, fut) -> None:
+        if fut.cancelled():
+            err: BaseException = CoalescerShutdownError(
+                "dispatch task cancelled before running")
+        else:
+            err = fut.exception()
+            if err is None:
+                return  # the task ran its own settle/release path
+            if not isinstance(err, Exception):
+                # a BaseException must not propagate into a serving thread
+                err = RuntimeError(
+                    f"coalescer dispatch task died: {err!r}")
+        self._fail_lane(lane, err)
+        self._release_lane(lane)
+
+    # -- lane lifecycle (idempotent under the coalescer lock) ----------------
+
+    def _mark_settled(self, lane: _Lane) -> bool:
+        """First-caller-wins claim on waking the lane's waiters."""
+        with self._lock:
+            if lane.settled:
+                return False
+            lane.settled = True
+            return True
+
+    def _release_lane(self, lane: _Lane) -> None:
+        """Give the lane's in-flight slot back exactly once."""
+        with self._lock:
+            if lane.released:
+                return
+            lane.released = True
+        self._inflight.release()
+
+    def _prune_expired(self, lane: _Lane) -> bool:
+        """Fail the lane's deadline-expired waiters fast (they must not
+        occupy dispatch rows) -> True when live riders remain. Runs on the
+        flusher AND again on the pool thread right before the dispatch —
+        time passes between the two."""
+        live: list[_Waiter] = []
+        expired: list[_Waiter] = []
+        for w in lane.items:
+            (expired if w.deadline is not None and w.deadline.expired()
+             else live).append(w)
+        if not expired:
+            return True
+        for w in expired:
+            robustness.count_deadline("coalescer.queue")
+            tracing.annotate_span(w.trace_span, "coalescer_deadline",
+                                  "expired in admission queue")
+            w.error = robustness.DeadlineExceededError(
+                "request deadline expired in the coalescer admission queue")
+            w.event.set()
+        lane.items = live
+        lane.rows = sum(w.vectors.shape[0] for w in live)
+        return bool(live)
+
     def _dispatch_filtered(self, lane: _Lane) -> None:
         """Pool-side twin of the flusher's async enqueue for FILTERED
         lanes: allowList build + two-phase enqueue + finalize, all off the
@@ -414,6 +619,10 @@ class QueryCoalescer:
         order (exactly the pre-snapshot behavior); the win vs the old
         sync path is that the search holds no index lock."""
         try:
+            if not self._prune_expired(lane):
+                self._mark_settled(lane)
+                self._release_lane(lane)
+                return
             q = (lane.items[0].vectors if len(lane.items) == 1
                  else np.concatenate([w.vectors for w in lane.items]))
             self._observe_wait(lane)
@@ -430,12 +639,15 @@ class QueryCoalescer:
                 tracing.pop_dispatch(tok)
         except Exception as e:  # noqa: BLE001 — propagate to all waiters
             self._fail_lane(lane, e)
-            self._inflight.release()
+            self._release_lane(lane)
             return
         self._finalize_async(lane, done, rec)
 
     def _dispatch_sync(self, lane: _Lane) -> None:
         try:
+            if not self._prune_expired(lane):
+                self._mark_settled(lane)
+                return
             q = np.concatenate([w.vectors for w in lane.items]) \
                 if len(lane.items) > 1 else lane.items[0].vectors
             self._observe_wait(lane)
@@ -457,7 +669,7 @@ class QueryCoalescer:
         except Exception as e:  # noqa: BLE001 — propagate to all waiters
             self._fail_lane(lane, e)
         finally:
-            self._inflight.release()
+            self._release_lane(lane)
 
     def _finalize_async(self, lane: _Lane, done, rec=None) -> None:
         try:
@@ -472,7 +684,7 @@ class QueryCoalescer:
         except Exception as e:  # noqa: BLE001 — propagate to all waiters
             self._fail_lane(lane, e)
         finally:
-            self._inflight.release()
+            self._release_lane(lane)
 
     def _trace_record(self, lane: _Lane):
         """DispatchRecord for this lane's traced riders (span + rows +
@@ -494,11 +706,13 @@ class QueryCoalescer:
     def _observe_wait(self, lane: _Lane) -> None:
         """Admission-queue wait per request, observed AT dispatch start —
         observing at resolution would fold the search+hydration latency in
-        and make the histogram useless for tuning the window."""
+        and make the histogram useless for tuning the window. Also stamps
+        `dispatch_start` for the EWMA service-rate estimate."""
+        now = time.monotonic()
+        lane.dispatch_start = now
         m = self.metrics
         if m is not None:
             try:
-                now = time.monotonic()
                 for w in lane.items:
                     m.coalescer_wait.observe((now - w.enqueued_at) * 1000.0)
             except Exception:  # noqa: BLE001 — metrics must not break serving
@@ -508,6 +722,8 @@ class QueryCoalescer:
         """Scatter [rows] result lists back to the lane's waiters. No k
         trimming is needed: k is part of the lane key (see submit), so every
         waiter here asked for exactly the k the dispatch ran at."""
+        if not self._mark_settled(lane):
+            return  # reaper/failure path won the race; results discarded
         pos = 0
         try:
             for w in lane.items:
@@ -522,10 +738,17 @@ class QueryCoalescer:
                     w.error = RuntimeError(
                         "coalescer failed to scatter batch results")
                     w.event.set()
+        now = time.monotonic()
         with self._lock:
             self._dispatches += 1
             self._dispatched_requests += len(lane.items)
             self._dispatched_rows += lane.rows
+            if lane.dispatch_start is not None and lane.rows > 0:
+                dur = max(now - lane.dispatch_start, 1e-4)
+                rate = lane.rows / dur
+                self._ewma_rows_per_s = (
+                    rate if self._ewma_rows_per_s <= 0.0
+                    else 0.3 * rate + 0.7 * self._ewma_rows_per_s)
         m = self.metrics
         if m is not None:
             try:
@@ -534,8 +757,9 @@ class QueryCoalescer:
             except Exception:  # noqa: BLE001 — metrics must not break serving
                 pass
 
-    @staticmethod
-    def _fail_lane(lane: _Lane, err: BaseException) -> None:
+    def _fail_lane(self, lane: _Lane, err: BaseException) -> None:
+        if not self._mark_settled(lane):
+            return
         # a failed lane means every waiter silently re-runs on the direct
         # path (coalesce window + dead dispatch + duplicate search): make
         # that degradation COUNTABLE, not invisible — the JGL004 rule
@@ -577,6 +801,8 @@ class QueryCoalescer:
                 "mean_rows_per_dispatch":
                     (self._dispatched_rows / d) if d else 0.0,
                 "bypass": dict(self._bypass),
+                "shed": dict(self._shed),
+                "ewma_rows_per_s": self._ewma_rows_per_s,
             }
 
     def shutdown(self) -> None:
@@ -587,5 +813,6 @@ class QueryCoalescer:
             self._cv.notify_all()
         self._thread.join(timeout=5.0)
         # in-flight dispatch tasks run to completion (each wakes its own
-        # waiters, success or failure); nothing new can be submitted
+        # waiters, success or failure); nothing new can be submitted —
+        # tasks cancelled before running are reaped by _reap_lane_future
         self._dispatch_pool.shutdown(wait=False)

@@ -1,0 +1,339 @@
+"""Request-lifecycle robustness: deadlines, overload shedding, breaker.
+
+The serving stack before this module had three failure modes unbecoming of
+a system meant to serve heavy traffic: a request admitted to the coalescer
+had no deadline (a wedged flush thread could hang a client forever), a full
+pipeline applied backpressure by silently stalling rather than shedding
+(every queued client eventually timed out instead of a few failing fast
+with a retry hint), and a device error mid-dispatch had no engineered
+recovery beyond per-kernel fallbacks. This module supplies the three
+primitives; the wiring lives where the requests flow:
+
+  Deadline        REST ``X-Request-Timeout-Ms`` / gRPC deadline / config
+                  ``QUERY_TIMEOUT_MS`` -> a monotonic expiry carried in a
+                  ContextVar through usecases/traverser into
+                  serving/coalescer lanes and db/shard dispatches. Expired
+                  requests fail fast (``DeadlineExceededError`` -> 504 /
+                  DEADLINE_EXCEEDED) instead of occupying a dispatch slot,
+                  and every waiter wait on the serving path is bounded by
+                  the remaining deadline.
+
+  OverloadedError the shed signal (-> 429 / RESOURCE_EXHAUSTED with a
+                  Retry-After hint). Raised by the coalescer's bounded
+                  admission queue when the queue is full (cost-aware:
+                  queued ROWS, not requests) or the estimated queue wait
+                  already exceeds the request's remaining deadline.
+
+  CircuitBreaker  trips OPEN after N consecutive device dispatch failures;
+                  while open the shard serves reads from the index's host
+                  fallback plane (``search_by_vectors_host``) instead of
+                  queueing doomed device work; after a cooldown it
+                  HALF-OPENs and lets a bounded number of probe dispatches
+                  through — one success closes it, one failure re-opens.
+
+Like monitoring/tracing.py, the module state is process-wide globals with
+one-comparison disabled fast paths: no deadline set => ``check_deadline``
+is a ContextVar read and a None compare; breaker disabled => ``get_breaker``
+returns None and the shard gate is one comparison. The module imports only
+the stdlib, so every layer (db, index, usecases, server) can import it
+without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+_LOG = logging.getLogger(__name__)
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed — mapped to HTTP 504 / gRPC
+    DEADLINE_EXCEEDED by the frontends. Fail-fast by design: the holder
+    must NOT retry on the direct path (the budget is already spent)."""
+
+
+class OverloadedError(RuntimeError):
+    """The request was shed by admission control — mapped to HTTP 429 (+
+    Retry-After) / gRPC RESOURCE_EXHAUSTED. ``retry_after_s`` is the
+    server's drain estimate; clients should back off at least that long."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.1):
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.001)
+
+
+class Deadline:
+    """Monotonic expiry for one request. Immutable; cheap to test."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, timeout_s: float):
+        self.expires_at = time.monotonic() + max(float(timeout_s), 0.0)
+
+    def remaining_s(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+
+# the active request's deadline (None = unbounded). Rides contextvars like
+# the trace span, so it follows the request through the graphql executor,
+# batch pool slots, and into coalescer admission on the serving thread.
+_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
+    "weaviate_deadline", default=None)
+
+
+@contextlib.contextmanager
+def deadline_scope(timeout_ms: float) -> Iterator[Optional[Deadline]]:
+    """Install a deadline for the enclosed request. timeout_ms <= 0 is the
+    unbounded no-op (yields None, touches nothing)."""
+    if timeout_ms is None or timeout_ms <= 0:
+        yield None
+        return
+    d = Deadline(timeout_ms / 1000.0)
+    token = _DEADLINE.set(d)
+    try:
+        yield d
+    finally:
+        _DEADLINE.reset(token)
+
+
+def current_deadline() -> Optional[Deadline]:
+    return _DEADLINE.get()
+
+
+def remaining_s() -> Optional[float]:
+    """Seconds until the current deadline (clamped >= 0), or None when the
+    request is unbounded."""
+    d = _DEADLINE.get()
+    if d is None:
+        return None
+    return max(d.remaining_s(), 0.0)
+
+
+def check_deadline(where: str) -> None:
+    """Raise (and count) if the current request's deadline already passed.
+    The fail-fast gate at every stage boundary: an expired request must
+    not occupy a dispatch slot, a gate permit, or a coalescer lane."""
+    d = _DEADLINE.get()
+    if d is None or not d.expired():
+        return
+    count_deadline(where)
+    raise DeadlineExceededError(f"request deadline expired at {where}")
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+STATE_CLOSED = 0
+STATE_OPEN = 1
+STATE_HALF_OPEN = 2
+
+_STATE_NAMES = {STATE_CLOSED: "closed", STATE_OPEN: "open",
+                STATE_HALF_OPEN: "half_open"}
+
+
+class CircuitBreaker:
+    """Device-dispatch circuit breaker (three-state, consecutive-failure
+    trip). One instance guards the process's device: dispatch failures are
+    a property of the accelerator, not of one shard.
+
+    CLOSED     normal serving; ``allow()`` is lock-free. N consecutive
+               device errors (``record_failure``) trip to OPEN.
+    OPEN       ``allow()`` returns False — callers serve from the host
+               fallback plane instead of dispatching doomed device work.
+               After ``reset_timeout_s`` the next ``allow()`` moves to
+               HALF_OPEN.
+    HALF_OPEN  up to ``half_open_probes`` callers get True (probe
+               dispatches); the first probe success closes the breaker,
+               the first failure re-opens it for another cooldown.
+    """
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 2.0, half_open_probes: int = 1,
+                 metrics=None, name: str = "device"):
+        self.failure_threshold = max(int(failure_threshold), 1)
+        self.reset_timeout_s = max(float(reset_timeout_s), 0.0)
+        self.half_open_probes = max(int(half_open_probes), 1)
+        self.metrics = metrics
+        self.name = name
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._consecutive = 0
+        self._open_until = 0.0
+        self._probes_out = 0
+        self._half_open_since = 0.0
+        self._publish_state()
+
+    # -- gate ---------------------------------------------------------------
+
+    def allow(self) -> bool:
+        """May this dispatch go to the device? False => host fallback. The
+        CLOSED read is deliberately lockless (a stale read during a
+        transition admits/rejects one extra dispatch, which the next
+        record_* call corrects)."""
+        if self._state == STATE_CLOSED:
+            return True
+        with self._lock:
+            if self._state == STATE_CLOSED:
+                return True
+            now = time.monotonic()
+            if self._state == STATE_OPEN:
+                if now < self._open_until:
+                    return False
+                self._transition(STATE_HALF_OPEN)
+                self._probes_out = 0
+                self._half_open_since = now
+            # HALF_OPEN: bounded probe admission. Probe slots EXPIRE: a
+            # probe whose dispatch died without reaching record_success/
+            # record_failure (a non-device exception, an abandoned lane)
+            # must not wedge the breaker in HALF_OPEN forever — after one
+            # cooldown with no verdict, the slots recycle
+            if self._probes_out >= self.half_open_probes \
+                    and now - self._half_open_since > self.reset_timeout_s:
+                self._probes_out = 0
+                self._half_open_since = now
+            if self._probes_out < self.half_open_probes:
+                self._probes_out += 1
+                return True
+            return False
+
+    def record_success(self) -> bool:
+        """-> True when this success RECOVERED the breaker (a transition
+        back to CLOSED) — callers use it to release degraded-mode
+        resources (e.g. the index's host fallback copy) exactly once."""
+        # hot-path fast exit: a healthy breaker pays one attr compare
+        if self._state == STATE_CLOSED and self._consecutive == 0:
+            return False
+        with self._lock:
+            self._consecutive = 0
+            if self._state != STATE_CLOSED:
+                self._transition(STATE_CLOSED)
+                return True
+        return False
+
+    def record_failure(self, err: Optional[BaseException] = None) -> None:
+        with self._lock:
+            if self._state == STATE_HALF_OPEN:
+                # the probe failed: straight back to OPEN for a cooldown
+                self._reopen(err)
+                return
+            self._consecutive += 1
+            if self._state == STATE_CLOSED \
+                    and self._consecutive >= self.failure_threshold:
+                self._reopen(err)
+
+    def _reopen(self, err: Optional[BaseException]) -> None:
+        self._open_until = time.monotonic() + self.reset_timeout_s
+        self._transition(STATE_OPEN, err)
+
+    def state(self) -> int:
+        return self._state
+
+    # -- observability -------------------------------------------------------
+
+    def _transition(self, state: int, err: Optional[BaseException] = None) -> None:
+        """Caller holds the lock (or is __init__). Gauge + counter + one log
+        line per transition — transitions are rare by construction."""
+        prev, self._state = self._state, state
+        if state != prev:
+            detail = f" ({type(err).__name__}: {err})" if err is not None else ""
+            _LOG.warning(
+                "%s circuit breaker %s -> %s after %d consecutive "
+                "failure(s)%s", self.name, _STATE_NAMES[prev],
+                _STATE_NAMES[state], self._consecutive, detail)
+        self._publish_state()
+        m = self.metrics
+        if m is not None and state != prev:
+            try:
+                m.breaker_transitions.labels(_STATE_NAMES[state]).inc()
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+
+    def _publish_state(self) -> None:
+        m = self.metrics
+        if m is not None:
+            try:
+                m.breaker_state.set(self._state)
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+
+
+def is_device_error(exc: BaseException) -> bool:
+    """Does this exception mean the DEVICE dispatch failed (vs. a logic
+    error in the request)? Only device errors feed the breaker — tripping
+    on a caller's ValueError would take a healthy accelerator out of
+    service. Recognized: jaxlib's XlaRuntimeError family (by name/module —
+    the class path moved across jaxlib versions), and anything carrying a
+    truthy ``device_error`` attribute (the fault harness's injected errors
+    use it; a custom backend can too). Deliberately NOT any jax.* error:
+    tracer/concretization errors are deterministic PROGRAMMING bugs —
+    tripping on one would mask it behind 'device incident' metrics while
+    the host plane quietly serves around it."""
+    if getattr(exc, "device_error", False):
+        return True
+    t = type(exc)
+    if t.__name__ in ("XlaRuntimeError", "XlaError"):
+        return True
+    mod = getattr(t, "__module__", "") or ""
+    return mod.startswith("jaxlib")
+
+
+# -- module state + accessors (the tracing.py pattern) ------------------------
+
+_breaker: Optional[CircuitBreaker] = None
+_metrics: Optional[Any] = None
+
+
+def configure_breaker(breaker: Optional[CircuitBreaker]) -> Optional[CircuitBreaker]:
+    """Install (or clear, with None) the process-wide device breaker."""
+    global _breaker
+    _breaker = breaker
+    return breaker
+
+
+def unconfigure_breaker(breaker: CircuitBreaker) -> None:
+    """Clear the global only if still `breaker` (an App shutdown must not
+    tear down a newer App's breaker)."""
+    global _breaker
+    if _breaker is breaker:
+        _breaker = None
+
+
+def get_breaker() -> Optional[CircuitBreaker]:
+    return _breaker
+
+
+def set_metrics(metrics) -> None:
+    """Metrics registry for the shed/deadline counters (None to clear)."""
+    global _metrics
+    _metrics = metrics
+
+
+def unset_metrics(metrics) -> None:
+    global _metrics
+    if _metrics is metrics:
+        _metrics = None
+
+
+def count_shed(reason: str) -> None:
+    m = _metrics
+    if m is not None:
+        try:
+            m.requests_shed.labels(reason).inc()
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            pass
+
+
+def count_deadline(where: str) -> None:
+    m = _metrics
+    if m is not None:
+        try:
+            m.deadline_expired.labels(where).inc()
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            pass

@@ -1,0 +1,5 @@
+"""In-process test/chaos instrumentation that ships WITH the package (not
+under tests/): the fault-injection harness is reachable from a deployed
+binary via config (``FAULT_INJECTION``), so failure journeys reproduce in
+any environment, not just the unit-test tree. Import submodules directly
+(``from weaviate_tpu.testing import faults``)."""

@@ -20,6 +20,7 @@ from weaviate_tpu.db.shard import SearchResult
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.entities.vectorindex import DISTANCE_COSINE
 from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.serving import robustness
 from weaviate_tpu.usecases import hybrid as hybrid_mod
 
 
@@ -62,12 +63,27 @@ class Traverser:
     def get_class(self, params: GetParams) -> list[SearchResult]:
         # the span context propagates from here via contextvars into the
         # coalescer lane (submit captures the active span) and into the
-        # shard's dispatch record on the direct path
+        # shard's dispatch record on the direct path; the request DEADLINE
+        # rides its own contextvar the same way (serving/robustness.py)
         with tracing.span("traverser.get_class",
                           class_name=params.class_name):
+            robustness.check_deadline("traverser")
             if self._gate is not None:
-                with self._gate:
+                # the concurrency gate is deadline-bounded: a request that
+                # can't get a permit inside its budget fails fast instead
+                # of occupying the accept queue until it times out anyway
+                timeout = robustness.remaining_s()
+                acquired = (self._gate.acquire() if timeout is None
+                            else self._gate.acquire(timeout=timeout))
+                if not acquired:
+                    robustness.count_deadline("traverser.gate")
+                    raise robustness.DeadlineExceededError(
+                        "deadline expired waiting for the concurrent-GET "
+                        "gate")
+                try:
                     return self.explorer.get_class(params)
+                finally:
+                    self._gate.release()
             return self.explorer.get_class(params)
 
     def get_class_batched(
@@ -81,6 +97,7 @@ class Traverser:
         bad query must not fail the whole device batch."""
         with tracing.span("traverser.get_class_batched",
                           slots=len(params_list)):
+            robustness.check_deadline("traverser")
             return self.explorer.get_class_batched(params_list)
 
 
@@ -302,6 +319,12 @@ class Explorer:
                             vecs, limit + offset, include_vector=inc_vec)
                         done = (lambda res=res: res)
                 pending.append((idxs, offset, done))
+            except (robustness.DeadlineExceededError,
+                    robustness.OverloadedError) as e:
+                # shed/expired at admission: fail the whole group fast —
+                # per-slot retries would hammer the same full queue
+                for i in idxs:
+                    out[i] = e
             except Exception:
                 # ragged shapes or a bad class: isolate per query
                 for i in idxs:
@@ -342,6 +365,11 @@ class Explorer:
                 res = done()
                 for j, i in enumerate(idxs):
                     out[i] = self._postprocess(params_list[i], res[j][offset:])
+            except (robustness.DeadlineExceededError,
+                    robustness.OverloadedError) as e:
+                # fail fast per slot — no direct-path retry (see _get_one)
+                for i in idxs:
+                    out[i] = e
             except Exception:
                 for i in idxs:
                     try:
@@ -393,6 +421,12 @@ class Explorer:
                     if wait is not None:
                         try:
                             res = wait()[0][params.offset:]
+                        except (robustness.DeadlineExceededError,
+                                robustness.OverloadedError):
+                            # fail-fast classes by contract: the budget is
+                            # spent / the server shed this request — a
+                            # direct-path retry would defeat both
+                            raise
                         except Exception as ce:  # noqa: BLE001 — dead batch:
                             res = None     # re-run on the direct path
                             # the retry is invisible in aggregate metrics
