@@ -775,12 +775,23 @@ def _grpc_e2e(rng, n=50_000):
     }
 
 
+# pre-run image of the matrix's LIVE (non-stale) rows, captured at the
+# first merge of this process: if the device later proves unreachable
+# (rc=3), _restore_live_rows puts back any live row this dying run
+# overwrote — BENCH_r02-r05 all died on an unreachable device, and a
+# half-made measurement from a doomed session must never replace a
+# previously live row for the same key.
+_MATRIX_PREIMAGE = None
+
+
 def _merge_matrix(new_rows: dict) -> dict:
     """Merge rows into bench_matrix.json, preserving TPU-measured history.
 
     Legacy rows (written before per-row provenance existed) are annotated
-    once as round-2 TPU numbers that predate the round-3 rewrites; new rows
-    carry their own backend/round fields."""
+    once as round-2 TPU numbers that predate the round-3 rewrites
+    (``stale: true`` + the reason in ``stale_note``); new rows carry their
+    own backend/round fields."""
+    global _MATRIX_PREIMAGE
     data = {}
     if os.path.exists(MATRIX_FILE):
         with open(MATRIX_FILE) as f:
@@ -791,10 +802,16 @@ def _merge_matrix(new_rows: dict) -> dict:
         if "backend" not in row:
             row["backend"] = "tpu-v5e"
             row["round"] = 2
-            row["stale"] = (
+            row["stale"] = True
+            row["stale_note"] = (
                 "predates the round-3 serving/import/PQ rewrites; regenerate "
                 "with BENCH_MATRIX=1 on hardware"
             )
+    if _MATRIX_PREIMAGE is None:
+        _MATRIX_PREIMAGE = {
+            k: json.loads(json.dumps(r)) for k, r in data.items()
+            if k != "_meta" and isinstance(r, dict) and not r.get("stale")
+        }
     _gate_check(data, new_rows)
     data.update(new_rows)
     data["_meta"] = {
@@ -805,6 +822,32 @@ def _merge_matrix(new_rows: dict) -> dict:
     with open(MATRIX_FILE, "w") as f:
         json.dump(data, f, indent=1)
     return data
+
+
+def _restore_live_rows() -> list:
+    """Undo this process's overwrites of previously LIVE matrix rows (the
+    rc=3 unreachable-device path). Rows this run ADDED under new keys are
+    kept — they were measured before the device died; only replacements
+    of live history roll back. -> the restored keys."""
+    if not _MATRIX_PREIMAGE or not os.path.exists(MATRIX_FILE):
+        return []
+    try:
+        with open(MATRIX_FILE) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    restored = []
+    for key, old in _MATRIX_PREIMAGE.items():
+        if data.get(key) != old:
+            data[key] = old
+            restored.append(key)
+    if restored:
+        with open(MATRIX_FILE, "w") as f:
+            json.dump(data, f, indent=1)
+        log(f"unreachable-device exit: restored previously live matrix "
+            f"row(s) {restored} (a doomed session's partial rows must not "
+            "replace measured history)")
+    return restored
 
 
 def run_cpu_matrix(rng):
@@ -1009,6 +1052,7 @@ def _probe_device(timeout_s: Optional[int] = None) -> None:
         detail = f"device claim still hung after {timeout_s}s"
     log(f"FATAL: TPU device unreachable ({detail}); refusing to hang — "
         "this is an infrastructure failure, not a benchmark result (rc=3)")
+    _restore_live_rows()
     raise SystemExit(3)
 
 
@@ -1044,6 +1088,22 @@ def _parse_args(argv=None):
                         "via BENCH_OVERLOAD_FAULTS (a FAULT_INJECTION "
                         "spec, e.g. "
                         "'index.tpu.dispatch:device_error:times=inf:p=0.2')")
+    p.add_argument("--tenants", type=int, default=0,
+                   help="closed-loop FAIRNESS mode: one saturating tenant "
+                        "vs N-1 light tenants through the real gRPC stack "
+                        "(x-tenant-id metadata), proving the light tenants' "
+                        "p99 isolation bound under the abusive one. Phase "
+                        "1 measures each light tenant SOLO (no abuser); "
+                        "phase 2 adds the abuser with the remaining "
+                        "--clients budget. Records per-tenant goodput/p99/"
+                        "shed-rate into the bench_matrix fairness row. "
+                        "Optional chaos via BENCH_FAIRNESS_FAULTS (a "
+                        "FAULT_INJECTION spec, e.g. "
+                        "'serving.coalescer.admit:stall:times=inf:p=0.05')")
+    p.add_argument("--zipf", type=float, nargs="?", const=1.1, default=None,
+                   help="skew the light tenants' traffic zipf(a) across "
+                        "tenant ids (default a=1.1 when given bare) "
+                        "instead of uniform")
     p.add_argument("--serve-n", type=int,
                    default=int(os.environ.get("BENCH_SERVE_N", 50_000)),
                    help="objects imported for the serving run")
@@ -1269,6 +1329,369 @@ def run_overload_bench(args, rng):
                 f"n={n}, d={dim}, backend {backend})"),
             "value": row["goodput_qps"],
             "unit": "qps-within-deadline",
+            "vs_baseline": 0,
+            "row": out_row,
+        }))
+    finally:
+        if srv is not None:
+            srv.stop()
+        if app is not None:
+            app.shutdown()
+        shutil.rmtree(data_dir, ignore_errors=True)
+    _gate_exit()
+
+
+def run_fairness_bench(args, rng):
+    """Closed-loop FAIRNESS mode (multi-tenant tentpole): one saturating
+    tenant hammers the serving stack while N-1 light tenants send modest
+    traffic, all through the real gRPC stack with ``x-tenant-id``
+    metadata. Phase 1 measures the light tenants SOLO (their baseline
+    p99); phase 2 adds the abusive tenant with the rest of the --clients
+    budget. The isolation claim under weighted-fair admission: each light
+    tenant's p99 stays within 2x of its solo p99 and its shed rate stays
+    under 5%, while the ABUSIVE tenant absorbs the shedding
+    (tenant_budget / queue_full land on its label). Per-tenant goodput/
+    p99/shed-rate go into the bench_matrix ``fairness_{cpu,tpu}`` row.
+    BENCH_FAIRNESS_FAULTS (a FAULT_INJECTION spec) adds a deterministic
+    chaos storm on top — e.g. admission stalls at
+    serving.coalescer.admit."""
+    import shutil
+    import tempfile
+    import threading
+    import uuid as uuidlib
+
+    import jax
+
+    if os.environ.get("BENCH_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        _probe_device()
+    import grpc
+
+    from weaviate_tpu.config import Config
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server import App
+    from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
+
+    # the fairness regime needs the ADMISSION QUEUE to be the bottleneck:
+    # per-dispatch device cost must be small enough that the host is not
+    # compute-saturated by light traffic alone (then both phases just
+    # measure CPU starvation and no admission policy can change the
+    # ratio). Default the corpus to a size this host serves with
+    # headroom; BENCH_FAIRNESS_N overrides for bigger hosts/chips.
+    n = min(args.serve_n, int(os.environ.get("BENCH_FAIRNESS_N", 10_000)))
+    dim = args.serve_dim
+    n_tenants = max(int(args.tenants), 2)
+    clients = args.clients or 64
+    deadline_ms = float(os.environ.get("BENCH_FAIRNESS_DEADLINE_MS", 1000.0))
+    # the queue cap is deliberately sized BELOW the abusive tenant's
+    # in-flight row count (closed loop: ~1 row per abusive thread), and
+    # the per-tenant fraction keeps its admitted backlog to a couple of
+    # dispatches — the regime where admission-layer fairness, not raw
+    # host capacity, decides the light tenants' tail
+    max_rows = int(os.environ.get("BENCH_FAIRNESS_MAX_QUEUED_ROWS", 64))
+    fraction = float(os.environ.get("BENCH_FAIRNESS_TENANT_FRACTION", 0.0625))
+    # per-tenant front-door concurrency bound: a tenant's excess parallel
+    # connections shed before any per-request work — the queue bounds a
+    # tenant's ROWS, this bounds the host-side request-handling the
+    # tenant can occupy (57 handler threads of one tenant would starve a
+    # small host below the admission layer). Scaled to the host: roughly
+    # one concurrent in-server request per tenant per two cores.
+    max_conc = int(os.environ.get(
+        "BENCH_FAIRNESS_MAX_CONCURRENT",
+        max(1, (os.cpu_count() or 1) // 2)))
+    # p99-of-p99 comparisons need samples: fairness windows default
+    # longer than the generic serving modes' (a 6 s window gives a zipf
+    # tail tenant a p99 that is just its max sample)
+    measure_s = float(os.environ.get(
+        "BENCH_FAIRNESS_SECONDS", max(args.serve_seconds, 15.0)))
+    warm_s = max(args.serve_warmup, 4.0)
+    think_s = float(os.environ.get("BENCH_FAIRNESS_THINK_MS", 10.0)) / 1000.0
+    fault_spec = os.environ.get("BENCH_FAIRNESS_FAULTS", "")
+    light = [f"light-{i}" for i in range(1, n_tenants)]
+    ABUSER = "abusive-0"
+    n_light_threads = min(len(light), 16)
+    n_abuse_threads = max(clients - n_light_threads, 4)
+    log(f"fairness bench: n={n} dim={dim} tenants={n_tenants} "
+        f"(1 abusive + {len(light)} light) zipf={args.zipf} "
+        f"threads={n_light_threads} light / {n_abuse_threads} abusive "
+        f"deadline={deadline_ms}ms max_queued_rows={max_rows} "
+        f"faults={fault_spec or 'none'}")
+    vecs = make_data(n, dim, rng)
+    pool_q = vecs[rng.integers(0, n, 256)] + 0.05 * rng.standard_normal(
+        (256, dim), dtype=np.float32)
+
+    cfg = Config()
+    cfg.coalescer.enabled = True
+    cfg.coalescer.max_queued_rows = max_rows
+    cfg.coalescer.wait_timeout_s = max(deadline_ms / 1000.0 * 4, 2.0)
+    cfg.tenancy.max_queued_rows_fraction = fraction
+    cfg.tenancy.max_concurrent_requests = max_conc
+    # the per-tenant cap floors at max_request_rows (a budget below one
+    # admissible request would deadlock that tenant); this workload is
+    # single-query requests, so lower the per-request bound to let the
+    # fraction bite — the abusive tenant's head-of-line dispatch is then
+    # a few rows, not a full direct-path-width batch
+    cfg.coalescer.max_request_rows = max(int(max_rows * fraction), 2)
+    if fault_spec:
+        cfg.robustness.fault_injection = fault_spec
+        cfg.robustness.fault_injection_seed = 23
+    data_dir = tempfile.mkdtemp(prefix="benchfairness")
+    app = srv = None
+    try:
+        app = App(config=cfg, data_path=data_dir)
+        app.schema.add_class({
+            "class": "Serve", "vectorIndexType": "hnsw_tpu",
+            "vectorIndexConfig": {"distance": "l2-squared"},
+            "properties": [{"name": "tag", "dataType": ["text"]}],
+        })
+        idx = app.db.get_index("Serve")
+        for s in range(0, n, 10_000):
+            idx.put_batch([
+                StorObj(class_name="Serve",
+                        uuid=str(uuidlib.UUID(int=i + 1)),
+                        properties={"tag": f"t{i % 16}"}, vector=vecs[i])
+                for i in range(s, min(s + 10_000, n))])
+        srv = GrpcServer(app, port=0,
+                         max_workers=max(32, clients + 8))
+        srv.start()
+        addr = f"127.0.0.1:{srv.port}"
+        reqs = [pb.SearchRequest(
+            class_name="Serve", limit=K,
+            near_vector=pb.NearVectorParams(vector=q.tolist()))
+            for q in pool_q]
+
+        # deterministic prewarm: the first dispatch of each padded shape
+        # pays the jit compile (seconds on the CPU backend) — that cost
+        # must not land inside EITHER measured phase, or the solo
+        # baseline is compile noise and every ratio is fiction. Merged
+        # lanes dispatch at EVERY padding bucket up to max_batch's floor,
+        # so warm each bucket via same-width direct batches (the jit
+        # cache keys on (padded rows, k) — a direct 8-wide dispatch
+        # compiles the exact shape an 8-row merged lane uses).
+        warm_cl = SearchClient(addr)
+        try:
+            for i in range(10):
+                try:
+                    warm_cl.search(reqs[i % len(reqs)], timeout=120.0)
+                except Exception:  # noqa: BLE001 — warmup best-effort
+                    pass
+            for width in (2, 4, 8, 16, 32, 64):
+                breq = pb.BatchSearchRequest(requests=[
+                    pb.SearchRequest(
+                        class_name="Serve", limit=K,
+                        near_vector=pb.NearVectorParams(
+                            vector=pool_q[j % len(pool_q)].tolist()))
+                    for j in range(width)])
+                for _ in range(2):
+                    try:
+                        warm_cl.batch_search(breq, timeout=120.0)
+                    except Exception:  # noqa: BLE001 — warmup best-effort
+                        pass
+        finally:
+            warm_cl.close()
+
+        def tenant_stats():
+            return dict(ok=0, shed=0, deadline=0, error=0, hung=0, lat=[])
+
+        def run_phase(with_abuser: bool) -> dict:
+            stop = threading.Event()
+            counting = threading.Event()
+            acc_lock = threading.Lock()
+            acc: dict = {}
+
+            def record(tenant, outcome, dt):
+                with acc_lock:
+                    st = acc.setdefault(tenant, tenant_stats())
+                    st[outcome] += 1
+                    if outcome == "ok":
+                        st["lat"].append(dt)
+
+            def one(cl, lrng, tenant):
+                """-> the server's retry-after hint in seconds when the
+                request was shed, else 0.0."""
+                qi = int(lrng.integers(0, len(reqs)))
+                meta = (("x-tenant-id", tenant),
+                        ("x-request-timeout-ms", f"{deadline_ms:.0f}"))
+                t0 = time.perf_counter()
+                outcome, retry_after = "ok", 0.0
+                try:
+                    # generous transport timeout: the SERVER must resolve
+                    # (serve/shed/expire); a transport timeout = a hang
+                    cl.search(reqs[qi], timeout=30.0, metadata=meta)
+                except grpc.RpcError as e:
+                    code = e.code()
+                    if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                        outcome = "shed"
+                        retry_after = 0.02
+                        try:
+                            md = {k: v for k, v in
+                                  (e.trailing_metadata() or ())}
+                            retry_after = float(
+                                md.get("retry-after-s", retry_after))
+                        except Exception:  # noqa: BLE001 — hint optional
+                            pass
+                    elif code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                        outcome = "deadline"
+                    else:
+                        outcome = "error"
+                except Exception:  # noqa: BLE001 — outcome accounting
+                    outcome = "error"
+                dt = time.perf_counter() - t0
+                if dt > 25.0:
+                    outcome = "hung"  # the zero-hung-requests gate
+                if counting.is_set():
+                    record(tenant, outcome, dt)
+                return retry_after
+
+            def light_loop(tid: int) -> None:
+                # one client session pinned to one light tenant; --zipf
+                # skews the PER-TENANT request rate (think time scales
+                # with the tenant's zipf rank) instead of sampling the
+                # tenant per request — sampling would let two light
+                # threads collide on one tenant id and muddy per-tenant
+                # accounting (and concurrency budgets) with phantom
+                # parallelism no real light tenant has
+                cl = SearchClient(addr)
+                lrng = np.random.default_rng(3000 + tid)
+                tenant = light[tid % len(light)]
+                think = think_s * ((tid % len(light) + 1) ** args.zipf
+                                   if args.zipf else 1.0)
+                try:
+                    while not stop.is_set():
+                        one(cl, lrng, tenant)
+                        time.sleep(think)
+                finally:
+                    cl.close()
+
+            def abuse_loop(tid: int) -> None:
+                # saturating but PROTOCOL-CONFORMANT: no think time, and
+                # on a shed it honors the server's Retry-After hint
+                # (bounded) — the saturation the fairness layer is built
+                # for. A client that ignores Retry-After in a hot retry
+                # loop is a connection-level DoS (rate limiting's job),
+                # not an admission-fairness workload.
+                cl = SearchClient(addr)
+                lrng = np.random.default_rng(9000 + tid)
+                try:
+                    while not stop.is_set():
+                        ra = one(cl, lrng, ABUSER)
+                        if ra > 0.0:
+                            # back off at least the server's hint (a
+                            # client may wait LONGER than Retry-After —
+                            # doubling with jitter is the conformant
+                            # congestion response), floored at 20 ms so a
+                            # sub-ms hint can't license a hot retry loop
+                            time.sleep(min(max(2.0 * ra, 0.02), 2.0)
+                                       * (0.75 + 0.5 * lrng.random()))
+                finally:
+                    cl.close()
+
+            threads = [threading.Thread(target=light_loop, args=(i,),
+                                        daemon=True)
+                       for i in range(n_light_threads)]
+            if with_abuser:
+                threads += [threading.Thread(target=abuse_loop, args=(i,),
+                                             daemon=True)
+                            for i in range(n_abuse_threads)]
+            for t in threads:
+                t.start()
+            time.sleep(warm_s)
+            counting.set()
+            t0 = time.perf_counter()
+            time.sleep(measure_s)
+            counting.clear()
+            elapsed = time.perf_counter() - t0
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads), "client hung"
+            out = {}
+            for tenant, st in acc.items():
+                lat = np.asarray(st.pop("lat"), np.float64)
+                total = int(sum(st.values()))
+                out[tenant] = {
+                    "requests": total,
+                    "goodput_qps": round(lat.size / elapsed, 2),
+                    "shed_rate": round(st["shed"] / total, 4) if total else 0,
+                    "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 2)
+                    if lat.size else None,
+                    "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 2)
+                    if lat.size else None,
+                    **st,
+                }
+            return out
+
+        log("  phase 1: light tenants SOLO (baseline p99)...")
+        solo = run_phase(with_abuser=False)
+        log(f"  solo: { {t: v['p99_ms'] for t, v in sorted(solo.items())} }")
+        log("  phase 2: + abusive tenant storm...")
+        storm = run_phase(with_abuser=True)
+
+        # the isolation gate: per light tenant with enough samples (a
+        # zipf tail tenant with a handful of requests has no meaningful
+        # p99), the storm p99 vs its own solo p99, and its shed rate
+        MIN_SAMPLES = 15
+        ratios = {}
+        light_shed = {}
+        for t in light:
+            s, st = solo.get(t), storm.get(t)
+            if not s or not st or s["p99_ms"] is None \
+                    or st["p99_ms"] is None \
+                    or min(s["requests"], st["requests"]) < MIN_SAMPLES:
+                continue
+            ratios[t] = round(st["p99_ms"] / max(s["p99_ms"], 1e-6), 2)
+            light_shed[t] = st["shed_rate"]
+        hung = sum(v.get("hung", 0) for v in storm.values()) \
+            + sum(v.get("hung", 0) for v in solo.values())
+        worst_ratio = max(ratios.values()) if ratios else None
+        worst_shed = max(light_shed.values()) if light_shed else None
+        abuse_row = storm.get(ABUSER, {})
+        co_stats = app.coalescer.stats() if app.coalescer is not None else {}
+        isolation_pass = (
+            hung == 0 and worst_ratio is not None
+            and worst_ratio <= 2.0
+            and (worst_shed or 0.0) < 0.05)
+        row = {
+            "tenants": n_tenants, "zipf": args.zipf, "clients": clients,
+            "n": n, "dim": dim, "k": K, "deadline_ms": deadline_ms,
+            "max_queued_rows": max_rows,
+            "tenant_row_cap": co_stats.get("tenant_row_cap"),
+            "tenant_max_concurrent": max_conc,
+            "faults": fault_spec or None,
+            "light_threads": n_light_threads,
+            "abusive_threads": n_abuse_threads,
+            "hung_requests": hung,
+            "light_p99_worst_ratio_vs_solo": worst_ratio,
+            "light_p99_ratios": ratios,
+            "light_shed_worst": worst_shed,
+            "abusive_shed_rate": abuse_row.get("shed_rate"),
+            "abusive_goodput_qps": abuse_row.get("goodput_qps"),
+            "isolation_pass_2x_p99_5pct_shed": isolation_pass,
+            "solo": solo, "storm": storm,
+            "server_tenants": co_stats.get("tenants"),
+            "shed": co_stats.get("shed"),
+        }
+        log(f"  fairness: worst light p99 ratio {worst_ratio} "
+            f"(bound 2.0), worst light shed {worst_shed} (bound 0.05), "
+            f"abusive shed {abuse_row.get('shed_rate')}, hung {hung} -> "
+            f"{'PASS' if isolation_pass else 'MISS'}")
+        plat = jax.devices()[0].platform
+        backend = "tpu-v5e" if plat in ("tpu", "axon") else "cpu"
+        suffix = "cpu" if backend == "cpu" else "tpu"
+        out_row = {"backend": backend, "round": 6,
+                   "date": time.strftime("%Y-%m-%d"), **row}
+        _merge_matrix({f"fairness_{suffix}": out_row})
+        print(json.dumps({
+            "metric": (
+                f"light-tenant p99 isolation under one abusive tenant "
+                f"({n_tenants} tenants, {clients} clients, zipf "
+                f"{args.zipf}, queue cap {max_rows} rows, backend "
+                f"{backend}) — worst light p99 storm/solo ratio "
+                "(bound 2.0)"),
+            "value": worst_ratio,
+            "unit": "x-solo-p99",
             "vs_baseline": 0,
             "row": out_row,
         }))
@@ -1673,6 +2096,11 @@ def main():
     rng = np.random.default_rng(7)
     if args.readers:
         run_reader_scaling_bench(args, rng)
+        return
+    if args.tenants:
+        # before --clients: the acceptance command passes both (--clients
+        # is the fairness mode's thread budget, not the serving mode)
+        run_fairness_bench(args, rng)
         return
     if args.overload:
         run_overload_bench(args, rng)

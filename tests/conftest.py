@@ -25,6 +25,18 @@ except AttributeError:
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# CI artifact mirror: when ci_check.sh sets SLOW_QUERY_LOG_FILE, every
+# slow-query JSON line the suite's journeys emit (full span trees, tenant
+# tags) lands in a file the workflow uploads on failure — a red fairness
+# or tracing journey is then debuggable from the artifact alone.
+_slow_log_path = os.environ.get("SLOW_QUERY_LOG_FILE")
+if _slow_log_path:
+    import logging as _logging
+
+    _h = _logging.FileHandler(_slow_log_path, delay=True)
+    _h.setFormatter(_logging.Formatter("%(message)s"))
+    _logging.getLogger("weaviate_tpu.slowquery").addHandler(_h)
+
 
 @pytest.fixture
 def rng():

@@ -576,6 +576,61 @@ def test_jgl009_module_level_calls_unflagged():
     assert "JGL009" not in codes(src, SERVING)
 
 
+# -- JGL010: dynamically-constructed metric label value -----------------------
+
+
+def test_jgl010_fstring_format_percent_concat_fire():
+    src = (
+        "def f(self, m, tenant, op):\n"
+        "    m.requests.labels(f'tenant-{tenant}').inc()\n"
+        "    m.requests.labels('tenant-{}'.format(tenant)).inc()\n"
+        "    m.requests.labels('tenant-%s' % tenant).inc()\n"
+        "    m.requests.labels('tenant-' + tenant).inc()\n"
+        "    m.requests.labels(op, reason='shed-' + tenant).inc()\n"
+    )
+    # package-wide scope: the serving path AND cold modules both count
+    assert codes(src, SERVING).count("JGL010") == 5
+    assert codes(src, COLD).count("JGL010") == 5
+
+
+def test_jgl010_bounded_values_pass():
+    src = (
+        "NAMES = {0: 'closed', 1: 'open'}\n"
+        "def f(self, m, state, reason, tenant):\n"
+        "    m.breaker.labels(NAMES[state]).inc()\n"      # dict lookup
+        "    m.shed.labels(reason).inc()\n"               # plain name
+        "    m.shed.labels('queue_full').inc()\n"         # constant
+        "    m.t.labels(m.tenant_labels.observe(tenant)).inc()\n"  # mapper
+        "    m.rows.labels('a' + 'b').inc()\n"            # all-constant concat
+        "    s = f'tenant-{tenant}'\n"                    # f-string NOT a label
+        "    return s\n"
+    )
+    assert "JGL010" not in codes(src, SERVING)
+
+
+def test_jgl010_nested_concat_and_kwarg_fire():
+    src = (
+        "def f(self, m, cls, shard):\n"
+        "    m.ops.labels('c-' + cls + '-s-' + shard).inc()\n"
+        "    m.ops.labels(component=f'{cls}.{shard}').inc()\n"
+    )
+    assert codes(src, SERVING).count("JGL010") == 2
+
+
+def test_jgl010_non_labels_calls_and_foreign_scope_pass():
+    # .format()/f-strings OUTSIDE a .labels() call are not this rule's
+    # business, and files outside weaviate_tpu/ are out of scope entirely
+    src = (
+        "def f(self, log, tenant):\n"
+        "    log.warning('tenant %s shed', tenant)\n"
+        "    return 'x-{}'.format(tenant)\n"
+    )
+    assert "JGL010" not in codes(src, SERVING)
+    bad = "def f(m, t):\n    m.c.labels(f'{t}').inc()\n"
+    assert "JGL010" not in codes(bad, "scripts/offline_report.py")
+    assert "JGL010" in codes(bad, SERVING)
+
+
 # -- suppressions (JGL000) ----------------------------------------------------
 
 def test_suppression_with_reason_silences_finding():

@@ -16,6 +16,11 @@ fail=0
 art="${CI_ARTIFACT_DIR:-}"
 if [ -n "$art" ]; then
     mkdir -p "$art"
+    # tier-1's tracing/fairness journeys emit slow-query JSON lines on the
+    # weaviate_tpu.slowquery logger; conftest.py mirrors them to this file
+    # so a red run's artifact carries the span trees (tenant tags included)
+    # alongside the pytest log
+    export SLOW_QUERY_LOG_FILE="${SLOW_QUERY_LOG_FILE:-$art/slowquery.jsonl}"
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="

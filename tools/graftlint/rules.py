@@ -20,6 +20,12 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
   JGL009  unbounded blocking wait (`wait()`/`get()`/`acquire()` with no
           timeout) on the serving path — one wedged producer then hangs
           a client forever instead of failing fast
+  JGL010  dynamically-constructed metric label value (f-string/.format/
+          %-format/concat of a runtime value passed to `.labels(...)`) —
+          unbounded label cardinality mints a Prometheus series per
+          distinct value (10k tenants = 10k series); route identities
+          through a bounded mapper (metrics.TenantLabeler) or a fixed
+          enum instead
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
@@ -31,7 +37,9 @@ weaviate_tpu/db/ (where a fetch inside a lock convoys every concurrent
 reader AND writer on one mutex for a whole device round trip); JGL009
 gates weaviate_tpu/serving/ + weaviate_tpu/db/ (the request path whose
 every wait must be bounded by a deadline or a liveness cap —
-serving/robustness.py). JGL001
+serving/robustness.py); JGL010 gates all of weaviate_tpu/ (every
+monitoring/metrics.py call site — labels are registered in one place but
+observed everywhere). JGL001
 additionally skips boundary functions whose JOB is host materialization —
 that allowlist lives here, in one place, so reviewers see every waiver.
 
@@ -152,8 +160,25 @@ RULE_DOCS = {
               "with no timeout on the serving path can hang a request "
               "forever; pass an explicit timeout (deadline-derived where "
               "one exists — serving/robustness.py)",
+    "JGL010": "dynamically-constructed metric label value — an f-string/"
+              ".format/%-format/concat of a runtime value at a "
+              ".labels(...) call site mints one Prometheus series per "
+              "distinct value; pass a bounded variable (route identities "
+              "through metrics.TenantLabeler or a fixed enum)",
     "JGL999": "file does not parse",
 }
+
+# JGL010 scope: the whole package — metric vecs are registered once in
+# monitoring/metrics.py but label values are supplied at every call site,
+# and ONE dynamic value anywhere unbounds the series set
+JGL010_PREFIXES = ("weaviate_tpu/",)
+
+
+def in_metric_label_scope(rel_path: str) -> bool:
+    """JGL010 scope check (same interior-boundary matching as is_hot)."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL010_PREFIXES)
 
 
 def in_span_scope(rel_path: str) -> bool:
@@ -285,6 +310,7 @@ class RuleWalker(ast.NodeVisitor):
         self.span_scope = in_span_scope(rel_path)
         self.lock_fetch_scope = in_lock_fetch_scope(rel_path)
         self.unbounded_wait_scope = in_unbounded_wait_scope(rel_path)
+        self.metric_label_scope = in_metric_label_scope(rel_path)
         self.mod = mod
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
@@ -479,7 +505,61 @@ class RuleWalker(ast.NodeVisitor):
         self._check_span_leak(node)
         self._check_lock_fetch(node)
         self._check_unbounded_wait(node)
+        self._check_dynamic_label(node)
         self.generic_visit(node)
+
+    # -- JGL010: dynamically-constructed metric label value --
+
+    @classmethod
+    def _is_dynamic_string(cls, node: ast.expr) -> bool:
+        """A string whose VALUE depends on runtime data: an f-string with
+        interpolations, a .format(...) call, or a +/% expression mixing a
+        string with a non-constant. A plain Name/Attribute/Subscript is
+        fine — it may carry a bounded value (reason enums, a TenantLabeler
+        label); only CONSTRUCTION proves unboundedness statically."""
+        if isinstance(node, ast.JoinedStr):
+            return any(isinstance(v, ast.FormattedValue) for v in node.values)
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "format" \
+                and (node.args or node.keywords):
+            return True
+        if isinstance(node, ast.BinOp) \
+                and isinstance(node.op, (ast.Add, ast.Mod)):
+            leaves: list[ast.expr] = []
+
+            def flatten(n: ast.expr) -> None:
+                if isinstance(n, ast.BinOp) \
+                        and isinstance(n.op, (ast.Add, ast.Mod)):
+                    flatten(n.left)
+                    flatten(n.right)
+                else:
+                    leaves.append(n)
+
+            flatten(node)
+            stringish = any(
+                isinstance(x, ast.JoinedStr)
+                or (isinstance(x, ast.Constant) and isinstance(x.value, str))
+                for x in leaves)
+            dynamic = any(not isinstance(x, ast.Constant) for x in leaves)
+            return stringish and dynamic
+        return False
+
+    def _check_dynamic_label(self, node: ast.Call) -> None:
+        if not self.metric_label_scope or self.fn_depth == 0:
+            return
+        f = node.func
+        if not isinstance(f, ast.Attribute) or f.attr != "labels":
+            return
+        values = list(node.args) + [kw.value for kw in node.keywords]
+        for v in values:
+            if self._is_dynamic_string(v):
+                self.emit("JGL010", v,
+                          "metric label value built from a runtime string "
+                          "at a `.labels(...)` call site — every distinct "
+                          "value mints a Prometheus series forever; pass a "
+                          "bounded value (metrics.TenantLabeler top-K + "
+                          "'other', or a fixed enum) instead")
 
     # -- JGL009: unbounded blocking wait --
 

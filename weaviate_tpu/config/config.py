@@ -200,6 +200,53 @@ class RobustnessConfig:
 
 
 @dataclass
+class TenancyConfig:
+    """Multi-tenant fairness (serving/coalescer.py weighted-fair
+    admission + monitoring/metrics.py bounded tenant labels). TPU
+    extension: tenant identity defaults to the queried class name and is
+    overridable per request via REST ``X-Tenant-Id`` / gRPC
+    ``x-tenant-id`` metadata."""
+
+    # "tenantA=4,tenantB=2" — DRR weights; unlisted tenants weigh 1
+    weights: dict = field(default_factory=dict)
+    # the fraction of QUERY_COALESCER_MAX_QUEUED_ROWS one tenant may
+    # occupy while OTHER tenants have rows waiting (alone it may use the
+    # whole queue); overflow sheds that tenant with `tenant_budget`
+    max_queued_rows_fraction: float = 0.5
+    # per-tenant metric labels: the top-K tenants by traffic get their
+    # own label value, the rest aggregate under "other" (bounded
+    # prometheus cardinality no matter how many tenant ids exist)
+    metrics_top_k: int = 10
+    # front-door bound on ONE tenant's concurrent in-server requests
+    # (explicit X-Tenant-Id traffic): excess sheds with 429/
+    # RESOURCE_EXHAUSTED before any per-request work. 0 = disabled.
+    max_concurrent_requests: int = 0
+
+
+def _tenant_weights(env: Mapping[str, str], key: str) -> dict:
+    """Parse "a=4,b=2" into {tenant: float}; reject non-positive or
+    malformed entries at startup, not at the first admission."""
+    out: dict = {}
+    for item in _list(env, key):
+        if "=" not in item:
+            raise ConfigError(
+                f"invalid {key} entry {item!r} (want tenant=weight)")
+        name, w = item.split("=", 1)
+        name = name.strip()
+        try:
+            weight = float(w)
+        except ValueError:
+            raise ConfigError(
+                f"invalid {key} weight for {name!r}: {w!r}") from None
+        if not name or weight <= 0:
+            raise ConfigError(
+                f"invalid {key} entry {item!r} (want nonempty tenant, "
+                "weight > 0)")
+        out[name] = weight
+    return out
+
+
+@dataclass
 class AutoSchemaConfig:
     enabled: bool = True
     default_string: str = "text"
@@ -241,6 +288,7 @@ class Config:
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -289,6 +337,19 @@ class Config:
             raise ConfigError("TRACING_SAMPLE_RATE must be in [0, 1]")
         if self.tracing.ring_size < 1:
             raise ConfigError("TRACING_RING_SIZE must be >= 1")
+        if not (0.0 < self.tenancy.max_queued_rows_fraction <= 1.0):
+            raise ConfigError(
+                "TENANT_MAX_QUEUED_ROWS_FRACTION must be in (0, 1]")
+        if self.tenancy.metrics_top_k < 1:
+            raise ConfigError("TENANT_METRICS_TOP_K must be >= 1")
+        if self.tenancy.max_concurrent_requests < 0:
+            raise ConfigError(
+                "TENANT_MAX_CONCURRENT_REQUESTS must be >= 0 (0 disables)")
+        for t, w in self.tenancy.weights.items():
+            if not t or w <= 0:
+                raise ConfigError(
+                    f"TENANT_WEIGHTS entry {t!r}={w!r} must have a "
+                    "nonempty tenant and weight > 0")
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -387,6 +448,13 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
         e, "BREAKER_HALF_OPEN_PROBES", 1)
     cfg.robustness.fault_injection = e.get("FAULT_INJECTION", "")
     cfg.robustness.fault_injection_seed = _int(e, "FAULT_INJECTION_SEED", 0)
+
+    cfg.tenancy.weights = _tenant_weights(e, "TENANT_WEIGHTS")
+    cfg.tenancy.max_queued_rows_fraction = _float(
+        e, "TENANT_MAX_QUEUED_ROWS_FRACTION", 0.5)
+    cfg.tenancy.metrics_top_k = _int(e, "TENANT_METRICS_TOP_K", 10)
+    cfg.tenancy.max_concurrent_requests = _int(
+        e, "TENANT_MAX_CONCURRENT_REQUESTS", 0)
 
     cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
     cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)

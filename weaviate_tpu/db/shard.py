@@ -183,9 +183,11 @@ class Shard:
         self.store.start_compaction_cycle()
         self.status = STATUS_READY
         self._deleted: dict[str, int] = {}  # uuid -> deletion ms (digests)
-        # allowList cache: filter-content key -> (write generation, Bitmap)
+        # allowList cache: filter-content key -> (write generation, Bitmap,
+        # inserting tenant) — the tenant bounds each tenant's share at
+        # eviction time (see build_allow_list)
         self._write_gen = 0
-        self._allow_cache: dict[str, tuple[int, Bitmap]] = {}
+        self._allow_cache: dict[str, tuple[int, Bitmap, str]] = {}
         self._lock = threading.RLock()
 
     # -- geo props (propertyspecific/ + vector/geo) --------------------------
@@ -490,7 +492,17 @@ class Shard:
         pack (which caches on the Bitmap object — index/tpu.py
         _allow_words) re-run on every query of a repeated filter. Any
         write bumps the generation and invalidates; the double generation
-        read refuses to cache when a write overlapped the evaluation."""
+        read refuses to cache when a write overlapped the evaluation.
+
+        Tenant-fair eviction: entries remember the inserting tenant
+        (robustness.effective_tenant, class-name default), and when the
+        LRU is full the victim comes from the tenant holding the MOST
+        entries, oldest of that tenant first — an abusive tenant issuing
+        unique filters evicts its own cold entries instead of every other
+        tenant's hot ones (the admission-queue starvation bug, replayed
+        at the cache layer). With a single tenant (the anonymous
+        same-class common case) this degenerates to exactly the old
+        global LRU."""
         if flt is None:
             return None
         key = filter_signature(flt)
@@ -508,14 +520,32 @@ class Shard:
             return hit[1]
         allow = self.searcher.doc_ids(flt)
         if self._locked_gen() == gen:
+            tenant = robustness.effective_tenant(self.class_def.name) or ""
             if len(self._allow_cache) >= 16:  # small LRU: hot filters are few
                 try:
-                    # oldest = least recently used under move-to-end
-                    self._allow_cache.pop(next(iter(self._allow_cache)))
-                except (StopIteration, KeyError, RuntimeError):
+                    self._allow_cache.pop(self._allow_evict_key(tenant))
+                except (StopIteration, KeyError, IndexError, RuntimeError,
+                        ValueError):
                     pass  # concurrent readers emptied/mutated it first
-            self._allow_cache[key] = (gen, allow)
+            self._allow_cache[key] = (gen, allow, tenant)
         return allow
+
+    def _allow_evict_key(self, inserting: str) -> str:
+        """The allowList-cache victim: the LRU entry of the tenant with
+        the most cached entries (the inserting tenant wins ties — its own
+        new entry is about to join its share). Snapshot-iterates so a
+        concurrent reader's benign move-to-end can at worst pick a
+        slightly stale victim, never raise."""
+        entries = list(self._allow_cache.items())
+        counts: dict[str, int] = {}
+        for _, (_, _, t) in entries:
+            counts[t] = counts.get(t, 0) + 1
+        counts[inserting] = counts.get(inserting, 0) + 1
+        heaviest = max(counts, key=lambda t: (counts[t], t == inserting))
+        for k, (_, _, t) in entries:
+            if t == heaviest:
+                return k  # oldest = least recently used under move-to-end
+        return entries[0][0]  # heaviest only has the not-yet-inserted entry
 
     def object_vector_search(
         self,

@@ -150,7 +150,12 @@ class GraphQLExecutor:
                 raise GraphQLParseError("expected class field under Get")
             # one span per Get class: a multi-class query's trace shows
             # which class the time went to, not one opaque "graphql" blob
-            with tracing.span("graphql.get", class_name=class_field.name):
+            # the tenant rides the same contextvar plumbing as the
+            # deadline; tagging the span here keeps multi-class queries'
+            # per-class time attributable per tenant in the slow-query log
+            with tracing.span(
+                    "graphql.get", class_name=class_field.name,
+                    tenant=robustness.effective_tenant(class_field.name)):
                 self._validate_get_class(class_field)
                 params = self._get_params(class_field)
                 results = self.traverser.get_class(params)

@@ -35,6 +35,79 @@ _MS_BUCKETS = (0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 10000)
 _COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
+class TenantLabeler:
+    """Bounded-cardinality mapper from tenant ids to metric label values:
+    the top-K tenants by observed traffic get their own label, everyone
+    else aggregates under ``other`` — 10k distinct tenants must not mint
+    10k prometheus series (graftlint JGL010 is the static twin of this
+    runtime bound: label values may never be dynamically-built strings).
+
+    Prometheus series are forever once emitted, so the promotion policy is
+    conservative: a tenant is labeled while fewer than ``top_k`` are, and
+    afterwards only DISPLACES the weakest labeled tenant when its traffic
+    exceeds twice the weakest's — and the total number of tenants ever
+    labeled in one process is hard-capped at ``3 * top_k`` (after that the
+    set freezes; latecomers stay in ``other``). Traffic counts live in a
+    dict pruned to its heaviest half at ``max_tracked``, so memory is
+    bounded no matter how many tenant ids a storm invents."""
+
+    OTHER = "other"
+
+    # observations between halvings of every traffic count: ages out a
+    # tenant that was heavy long ago, so a CURRENTLY-abusive tenant can
+    # displace it within ~one decay window instead of having to out-count
+    # its whole lifetime history
+    DECAY_EVERY = 50_000
+
+    def __init__(self, top_k: int = 10, max_tracked: int = 4096):
+        self.top_k = max(int(top_k), 1)
+        self.max_tracked = max(int(max_tracked), 16)
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._labeled: set[str] = set()
+        self._ever_labeled = 0
+        self._since_decay = 0
+
+    def observe(self, tenant: str) -> str:
+        """Count one unit of traffic for `tenant` -> its label value."""
+        with self._lock:
+            self._since_decay += 1
+            if self._since_decay >= self.DECAY_EVERY:
+                self._since_decay = 0
+                self._counts = {t: c // 2 for t, c in self._counts.items()
+                                if c // 2 > 0 or t in self._labeled}
+            c = self._counts.get(tenant, 0) + 1
+            self._counts[tenant] = c
+            if tenant in self._labeled:
+                return tenant
+            if len(self._labeled) < self.top_k \
+                    and self._ever_labeled < 3 * self.top_k:
+                self._labeled.add(tenant)
+                self._ever_labeled += 1
+                return tenant
+            if self._ever_labeled < 3 * self.top_k and self._labeled:
+                weakest = min(self._labeled,
+                              key=lambda t: self._counts.get(t, 0))
+                if c > 2 * self._counts.get(weakest, 0):
+                    self._labeled.discard(weakest)
+                    self._labeled.add(tenant)
+                    self._ever_labeled += 1
+                    return tenant
+            if len(self._counts) > self.max_tracked:
+                # keep the heaviest half (labeled tenants always survive)
+                keep = sorted(self._counts, key=self._counts.get,
+                              reverse=True)[: self.max_tracked // 2]
+                self._counts = {t: self._counts[t]
+                                for t in set(keep) | self._labeled
+                                if t in self._counts}
+            return self.OTHER
+
+    def label_for(self, tenant: str) -> str:
+        """The label value for `tenant` WITHOUT counting traffic."""
+        with self._lock:
+            return tenant if tenant in self._labeled else self.OTHER
+
+
 class Metrics:
     """All metric vecs; label names mirror the reference's (class_name,
     shard_name, operation ...)."""
@@ -232,6 +305,34 @@ class Metrics:
             "weaviate_deadline_expired_total",
             "requests that failed fast on an expired deadline, by the "
             "stage that detected it", ("where",))
+
+        # multi-tenant fairness (serving/coalescer.py weighted-fair
+        # admission): per-tenant shed/deadline/queue-depth accounting.
+        # EVERY tenant label value is routed through `tenant_labels`
+        # (top-K by traffic + "other"), so cardinality stays bounded no
+        # matter how many tenant ids traffic invents — the runtime twin
+        # of the JGL010 static rule.
+        self.tenant_labels = TenantLabeler()
+        self.tenant_requests = c(
+            "weaviate_tenant_requests_total",
+            "requests admitted to the serving path, by (bounded) tenant",
+            ("tenant",))
+        self.tenant_shed = c(
+            "weaviate_tenant_requests_shed_total",
+            "requests shed by admission control, by (bounded) tenant — an "
+            "abusive tenant's sheds land on ITS label, not the fleet's",
+            ("tenant", "reason"))
+        self.tenant_deadline = c(
+            "weaviate_tenant_deadline_expired_total",
+            "requests that failed fast on an expired deadline in the "
+            "serving queue, by (bounded) tenant", ("tenant",))
+        self.tenant_queued_rows = g(
+            "weaviate_tenant_queued_rows",
+            "query rows in the serving pipeline per (bounded) tenant, "
+            "admission until lane settle — the occupancy the "
+            "tenant_budget cap bounds (queue-only depth is "
+            "weaviate_coalescer_queue_depth)",
+            ("tenant",))
 
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so

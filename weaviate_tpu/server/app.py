@@ -176,6 +176,18 @@ class App:
         # self.coalescer is None and every read path below is untouched
         # (zero queue hops) — the knob must be a true no-op when off
         cc = self.config.coalescer
+        # multi-tenant fairness: the bounded tenant-label mapper is sized
+        # here (it lives on the metrics registry so robustness counters
+        # and the coalescer share ONE top-K view of who is heavy)
+        tn = self.config.tenancy
+        self.metrics.tenant_labels.top_k = max(int(tn.metrics_top_k), 1)
+        # front-door per-tenant concurrency gate: process-wide like the
+        # breaker (the frontends check it before any per-request work)
+        if tn.max_concurrent_requests > 0:
+            self.tenant_gate = robustness.configure_tenant_gate(
+                robustness.TenantConcurrencyGate(tn.max_concurrent_requests))
+        else:
+            self.tenant_gate = None
         if cc.enabled:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -188,7 +200,9 @@ class App:
                 metrics=self.metrics,
                 pipeline_depth=cc.pipeline_depth,
                 max_queued_rows=cc.max_queued_rows,
-                waiter_timeout_s=cc.wait_timeout_s)
+                waiter_timeout_s=cc.wait_timeout_s,
+                tenant_weights=tn.weights,
+                tenant_rows_fraction=tn.max_queued_rows_fraction)
             # persistent slot pool for concurrent batch fan-out (REST
             # /v1/graphql/batch): per-request executors would pay thread
             # churn on the exact hot path the coalescer optimizes
@@ -288,6 +302,8 @@ class App:
 
         if self.breaker is not None:
             robustness.unconfigure_breaker(self.breaker)
+        if self.tenant_gate is not None:
+            robustness.unconfigure_tenant_gate(self.tenant_gate)
         robustness.unset_metrics(self.metrics)
         if self.fault_injector is not None:
             from weaviate_tpu.testing import faults

@@ -29,18 +29,23 @@ from weaviate_tpu.usecases.traverser import GetParams
 _SERVICE = "weaviatetpu.v1.Weaviate"
 
 
-def _request_meta(context) -> tuple[str, Optional[str], float, float]:
-    """(request_id, traceparent, explicit_timeout_ms, transport_timeout_ms)
-    from invocation metadata. The request id (inbound ``x-request-id``
-    honored, else generated) is the gRPC twin of the REST X-Request-Id
-    header; `_set_reply_meta` echoes it back. The EXPLICIT deadline is the
-    ``x-request-timeout-ms`` metadata entry (the REST header's twin — an
-    intentional caller override, may extend past the config default); the
-    TRANSPORT deadline is ``context.time_remaining()`` — usually just the
-    stub's generous default (e.g. 30 s), so the servicer treats it as a
-    CAP on the config default, never as an override: an implicit client
-    timeout must not silently opt the request out of the operator's
-    QUERY_TIMEOUT_MS. 0 = absent for either."""
+def _request_meta(context) -> tuple[str, Optional[str], float, float,
+                                    Optional[str]]:
+    """(request_id, traceparent, explicit_timeout_ms, transport_timeout_ms,
+    raw_tenant) from invocation metadata. The request id (inbound
+    ``x-request-id`` honored, else generated) is the gRPC twin of the REST
+    X-Request-Id header; `_set_reply_meta` echoes it back. The EXPLICIT
+    deadline is the ``x-request-timeout-ms`` metadata entry (the REST
+    header's twin — an intentional caller override, may extend past the
+    config default); the TRANSPORT deadline is
+    ``context.time_remaining()`` — usually just the stub's generous
+    default (e.g. 30 s), so the servicer treats it as a CAP on the config
+    default, never as an override: an implicit client timeout must not
+    silently opt the request out of the operator's QUERY_TIMEOUT_MS. 0 =
+    absent for either. ``raw_tenant`` is the UNVALIDATED ``x-tenant-id``
+    entry — the servicer validates it (robustness.validate_tenant_id)
+    and aborts INVALID_ARGUMENT on an injection-shaped value, the REST
+    400's twin."""
     md = {}
     try:
         md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
@@ -61,7 +66,8 @@ def _request_meta(context) -> tuple[str, Optional[str], float, float]:
         except ValueError:
             pass  # malformed metadata entry: ignore, keep the defaults
     return tracing.clean_request_id(md.get("x-request-id")), \
-        md.get("traceparent"), explicit_ms, transport_ms
+        md.get("traceparent"), explicit_ms, transport_ms, \
+        md.get("x-tenant-id")
 
 
 def _set_reply_meta(context, rid: str, trace) -> None:
@@ -231,19 +237,32 @@ class SearchServicer:
 
     def Search(self, request: pb.SearchRequest, context) -> pb.SearchReply:
         start = time.perf_counter()
-        rid, traceparent, expl_tmo, trans_tmo = _request_meta(context)
+        rid, traceparent, expl_tmo, trans_tmo, raw_tenant = \
+            _request_meta(context)
         with tracing.request("grpc", "Search", traceparent=traceparent,
                              request_id=rid,
                              class_name=request.class_name) as tr:
             _set_reply_meta(context, rid, tr)
+            try:
+                # inside the traced scope, after _set_reply_meta: the
+                # invalid-tenant abort must carry the request-id /
+                # traceparent echo like every other error reply
+                tenant = robustness.validate_tenant_id(raw_tenant)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                return
+            if tenant:
+                tracing.annotate_current("tenant", tenant)
             try:
                 params = params_from_proto(request)
             except Exception as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
             try:
-                with robustness.deadline_scope(
-                        self._timeout_ms(expl_tmo, trans_tmo)):
+                with robustness.tenant_concurrency(tenant), \
+                        robustness.tenant_scope(tenant), \
+                        robustness.deadline_scope(
+                            self._timeout_ms(expl_tmo, trans_tmo)):
                     results = self.app.traverser.get_class(params)
             except (robustness.DeadlineExceededError,
                     robustness.OverloadedError) as e:
@@ -322,17 +341,28 @@ class SearchServicer:
         query yields a reply with error_message; the other slots still ride
         the shared device dispatch."""
         start = time.perf_counter()
-        rid, traceparent, expl_tmo, trans_tmo = _request_meta(context)
+        rid, traceparent, expl_tmo, trans_tmo, raw_tenant = \
+            _request_meta(context)
         with tracing.request("grpc", "BatchSearch", traceparent=traceparent,
                              request_id=rid,
                              slots=len(request.requests)) as tr:
             _set_reply_meta(context, rid, tr)
             try:
+                # traced + metadata-echoed like the Search twin above
+                tenant = robustness.validate_tenant_id(raw_tenant)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                return
+            if tenant:
+                tracing.annotate_current("tenant", tenant)
+            try:
                 # ONE deadline scopes the whole batch (the RPC is the unit
                 # the caller is waiting on); per-slot shed/expired errors
                 # land in their slot's error_message via get_class_batched
-                with robustness.deadline_scope(
-                        self._timeout_ms(expl_tmo, trans_tmo)):
+                with robustness.tenant_concurrency(tenant), \
+                        robustness.tenant_scope(tenant), \
+                        robustness.deadline_scope(
+                            self._timeout_ms(expl_tmo, trans_tmo)):
                     return self._batch_search(request, start)
             except (robustness.DeadlineExceededError,
                     robustness.OverloadedError) as e:

@@ -244,6 +244,16 @@ class Handler(BaseHTTPRequestHandler):
         self._traceparent = None
         self._retry_after = None
         try:
+            # tenant identity: X-Tenant-Id is an ACCOUNTING key (budgets,
+            # metrics), so unlike X-Request-Id an invalid value is a 400,
+            # never cleaned-and-used — two spellings of one tenant must
+            # not split its budget, and injection bytes must not reach a
+            # metric label or log line
+            try:
+                tenant = robustness.validate_tenant_id(
+                    self.headers.get("X-Tenant-Id"))
+            except ValueError as e:
+                raise HTTPError(400, str(e)) from None
             parsed = urlparse(self.path)
             self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             name, mt = ROUTES.match(self.command, parsed.path)
@@ -259,15 +269,23 @@ class Handler(BaseHTTPRequestHandler):
             # the deadline scope wraps the WHOLE handler (serving/
             # robustness.py): it propagates via contextvars through the
             # graphql executor and traverser into coalescer lanes and
-            # shard dispatches; 0 => a no-op scope
-            with robustness.deadline_scope(self._request_timeout_ms(name)):
+            # shard dispatches; 0 => a no-op scope. The tenant scope rides
+            # the same plumbing (None => class-name default downstream);
+            # the concurrency gate sheds an over-parallel tenant HERE,
+            # before the handler does any per-request work.
+            with robustness.tenant_concurrency(tenant), \
+                    robustness.tenant_scope(tenant), \
+                    robustness.deadline_scope(self._request_timeout_ms(name)):
                 if tracing.get_tracer() is None or name in self._UNTRACED:
                     handler(**mt.groupdict())
                 else:
+                    attrs = {"route": name}
+                    if tenant:
+                        attrs["tenant"] = tenant
                     with tracing.request(
                             "rest", f"{self.command} {parsed.path}",
                             traceparent=self.headers.get("traceparent"),
-                            request_id=self._request_id, route=name) as tr:
+                            request_id=self._request_id, **attrs) as tr:
                         if tr is not None:
                             self._traceparent = tr.traceparent()
                         handler(**mt.groupdict())

@@ -59,6 +59,43 @@ Request-lifecycle robustness (serving/robustness.py):
     exceeds its remaining deadline is shed at admission — both raise
     ``OverloadedError`` (-> 429/RESOURCE_EXHAUSTED + Retry-After) instead
     of silently stalling the whole client population.
+
+Multi-tenant fairness (ROADMAP item 4 — the PR-6 tentpole). The bounds
+above are GLOBAL: without tenant accounting one abusive tenant fills
+`max_queued_rows` with its own requests and every other tenant starves
+while each individual request stays under the row bound. Admission is
+therefore tenant-aware end to end:
+
+  - IDENTITY: every request resolves a tenant (`robustness.
+    effective_tenant` — the REST/gRPC `X-Tenant-Id` identity when one
+    rode in, else the queried class name) and the tenant is part of the
+    lane key: a lane belongs to exactly ONE tenant, so fairness decisions
+    and accounting operate on whole lanes.
+  - BUDGET: no tenant may occupy more than `tenant_rows_fraction` of
+    `max_queued_rows` while other tenants have work in the system
+    (`tenant_budget` shed). Occupancy counts a tenant's rows from
+    ADMISSION until its lane SETTLES (queued + in-flight): a queue-only
+    bound refills the instant the flusher pops a lane, so an abusive
+    tenant bounded to N queued rows still monopolizes the dispatch
+    pipeline one popped lane at a time — the in-flight extension is
+    what actually caps its share of dispatch slots. Alone, a tenant may
+    still use the whole queue — the cap costs an only-tenant nothing.
+  - DEFICIT ROUND-ROBIN: due lanes drain in weighted DRR order
+    (configurable `tenant_weights`, default 1): each tenant's deficit
+    grows by `weight * max_batch` rows per round and pays for its lanes
+    in rotation, so under a saturated pipeline (depth-1 semaphore — the
+    drain ORDER is the fairness lever) an abusive tenant cannot
+    monopolize dispatch slots.
+  - PER-TENANT SHED ESTIMATES: the deadline-unreachable estimate divides
+    the TENANT'S OWN queued rows by its own EWMA drain rate — an abusive
+    tenant sheds against its backlog while light tenants admit against
+    theirs (a shared estimate would shed everyone for one tenant's
+    queue).
+  - ACCOUNTING: per-tenant shed/deadline/queue-depth metrics with
+    BOUNDED label cardinality (metrics.TenantLabeler: top-K by traffic +
+    "other"), tenant tags on dispatch trace records and the admission
+    annotation on rider traces, and a `serving.coalescer.admit` fault
+    point for abusive-tenant storm journeys.
   - DEADLINES: a waiter carries its request's deadline; the flush path
     fails deadline-expired waiters fast (they never occupy dispatch rows),
     and every waiter wait is bounded by min(remaining deadline, the
@@ -83,6 +120,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
@@ -135,9 +173,11 @@ class _Waiter:
     flush path prunes expired waiters, and wait() is bounded by it."""
 
     __slots__ = ("vectors", "event", "result", "error", "enqueued_at",
-                 "trace_span", "deadline", "max_wait_s")
+                 "trace_span", "deadline", "max_wait_s", "tenant",
+                 "tenant_label")
 
-    def __init__(self, vectors: np.ndarray, max_wait_s: float = 30.0):
+    def __init__(self, vectors: np.ndarray, max_wait_s: float = 30.0,
+                 tenant: Optional[str] = None, tenant_label: str = ""):
         self.vectors = vectors
         self.event = threading.Event()
         self.result = None
@@ -146,6 +186,8 @@ class _Waiter:
         self.trace_span = tracing.current_span()
         self.deadline = robustness.current_deadline()
         self.max_wait_s = max_wait_s
+        self.tenant = tenant
+        self.tenant_label = tenant_label
 
     def wait(self):
         """Block until the lane resolves -> per-row result lists. BOUNDED:
@@ -162,6 +204,7 @@ class _Waiter:
         if not self.event.wait(timeout):
             if d is not None and d.expired():
                 robustness.count_deadline("coalescer.wait")
+                robustness.count_tenant_deadline(self.tenant)
                 raise robustness.DeadlineExceededError(
                     "request deadline expired waiting for a coalesced "
                     "dispatch")
@@ -178,17 +221,23 @@ class _Waiter:
 
 
 class _Lane:
-    """Accumulating batch for one (shard, k, metric, filter-sig, inc_vec)
-    key. Never touched outside the coalescer lock until popped for flush.
-    `settled`/`released` (guarded by the coalescer lock) make waiter
-    wakeup and in-flight-slot release idempotent across the normal path
-    and the pool-future reaper."""
+    """Accumulating batch for one (tenant, shard, k, metric, filter-sig,
+    inc_vec) key. Never touched outside the coalescer lock until popped
+    for flush. `settled`/`released` (guarded by the coalescer lock) make
+    waiter wakeup and in-flight-slot release idempotent across the normal
+    path and the pool-future reaper. A lane belongs to exactly ONE tenant
+    (the tenant is part of the key), so DRR drains whole lanes and the
+    per-tenant row accounting is exact; `tenant_label` is the bounded
+    metric label captured at lane creation — gauge inc/dec must use the
+    SAME label even if the labeler's top-K churns in between."""
 
     __slots__ = ("key", "shard", "flt", "k", "include_vector", "items",
-                 "rows", "deadline", "settled", "released", "dispatch_start")
+                 "rows", "deadline", "settled", "released", "dispatch_start",
+                 "tenant", "tenant_label")
 
     def __init__(self, key, shard, flt, k: int, include_vector: bool,
-                 deadline: float):
+                 deadline: float, tenant: str = "",
+                 tenant_label: str = ""):
         self.key = key
         self.shard = shard
         self.flt = flt
@@ -200,13 +249,39 @@ class _Lane:
         self.settled = False     # waiters woken (resolved or failed)
         self.released = False    # in-flight slot given back
         self.dispatch_start: Optional[float] = None
+        self.tenant = tenant
+        self.tenant_label = tenant_label
+
+
+class _TenantState:
+    """Per-tenant fairness bookkeeping, guarded by the coalescer lock:
+    in-system rows (admission -> lane settle, the budget cap's
+    numerator), the tenant's own EWMA drain rate (rows/s — feeds ITS
+    deadline-unreachable estimate), and shed counts for stats()/bench.
+    DRR deficits are deliberately NOT stored here: classic DRR forfeits
+    credit when a queue empties, and every _drr_order call drains its
+    whole input, so deficits are per-call locals — persistent fields
+    would imply cross-flush carryover that does not exist."""
+
+    __slots__ = ("tenant", "weight", "rows", "ewma_rows_per_s",
+                 "shed", "last_seen")
+
+    def __init__(self, tenant: str, weight: float = 1.0):
+        self.tenant = tenant
+        self.weight = max(float(weight), 0.001)
+        self.rows = 0
+        self.ewma_rows_per_s = 0.0
+        self.shed: dict[str, int] = {}
+        self.last_seen = time.monotonic()
 
 
 class QueryCoalescer:
     def __init__(self, window_s: float = 0.0015, max_batch: int = 256,
                  max_request_rows: int = 16, metrics=None,
                  pipeline_depth: int = 1, max_queued_rows: int = 4096,
-                 waiter_timeout_s: float = 30.0):
+                 waiter_timeout_s: float = 30.0,
+                 tenant_weights: Optional[dict] = None,
+                 tenant_rows_fraction: float = 0.5):
         self.window_s = max(float(window_s), 0.0)
         # snap DOWN to the index's padding buckets: a full lane then
         # compiles/hits the exact shape a direct dispatch of that width
@@ -250,6 +325,23 @@ class QueryCoalescer:
         self._dispatched_rows = 0
         self._bypass: dict[str, int] = {}
         self._shed: dict[str, int] = {}
+        # multi-tenant fairness state (guarded by the coalescer lock):
+        # per-tenant queued rows / DRR deficit / own-EWMA, the configured
+        # weights, and the per-tenant slice of max_queued_rows no tenant
+        # may exceed while others are waiting. The cap never falls below
+        # max_request_rows: a budget smaller than one admissible request
+        # would deadlock that tenant outright.
+        self._tenant_weights = dict(tenant_weights or {})
+        self.tenant_rows_fraction = min(max(float(tenant_rows_fraction),
+                                            0.01), 1.0)
+        self._tenant_row_cap = max(
+            int(self.max_queued_rows * self.tenant_rows_fraction),
+            self.max_request_rows)
+        self._tenants: dict[str, _TenantState] = {}
+        # sum of every tenant's in-system rows (admission -> settle);
+        # "other tenants have work" is then one subtraction, not a scan
+        self._pipeline_rows_total = 0
+        self._drr_cursor = 0
         # EWMA of the PER-LANE dispatch service rate (rows/s), fed by
         # resolved lanes: the admission-time queue-wait estimate that
         # sheds requests whose deadline the queue can't meet. 0.0 =
@@ -283,7 +375,7 @@ class QueryCoalescer:
     # -- admission -----------------------------------------------------------
 
     def submit(self, shard, vectors: np.ndarray, k: int, flt=None,
-               include_vector: bool = False):
+               include_vector: bool = False, tenant: Optional[str] = None):
         """Queue a request's rows for a coalesced dispatch.
 
         -> a blocking callable() -> list[list[SearchResult]] (one list per
@@ -291,10 +383,23 @@ class QueryCoalescer:
         (reason counted). Raises DeadlineExceededError for an
         already-expired request (fail fast: it must not occupy queue
         rows), and OverloadedError when admission control sheds it
-        (bounded queue full, or the estimated queue wait exceeds the
+        (bounded queue full, the tenant's row budget exhausted while
+        others wait, or the tenant's estimated queue wait exceeds the
         remaining deadline) — shed requests must NOT fall through to the
-        direct path, or shedding would shed nothing."""
+        direct path, or shedding would shed nothing.
+
+        `tenant` is the request's accounting identity; None resolves via
+        robustness.effective_tenant (explicit X-Tenant-Id, else the
+        shard's class name)."""
         robustness.check_deadline("coalescer.admit")
+        # fault-injection point: the abusive-tenant storm journeys inject
+        # stalls/errors at ADMISSION — before any queue state is touched,
+        # so an injected failure can never strand a half-admitted waiter
+        faults.fire("serving.coalescer.admit")
+        if tenant is None:
+            cd = getattr(shard, "class_def", None)
+            tenant = robustness.effective_tenant(
+                getattr(cd, "name", None) or "default")
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
@@ -315,9 +420,12 @@ class QueryCoalescer:
             self.record_bypass("shutdown" if closed_now else "flusher_dead")
             return None
         d = robustness.current_deadline()
-        # dim is part of the key: a wrong-dim request must land in its own
-        # lane and fail ALONE, not poison the concatenate of its lane-mates
-        key = (id(shard), int(k), getattr(shard.vector_index, "metric", ""),
+        # tenant first in the key: a lane belongs to one tenant (fair
+        # drain + exact accounting); dim is part of the key so a
+        # wrong-dim request lands in its own lane and fails ALONE, not
+        # poisoning the concatenate of its lane-mates
+        key = (tenant, id(shard), int(k),
+               getattr(shard.vector_index, "metric", ""),
                sig, bool(include_vector), int(q.shape[1]))
         cold = False
         shed_reason: Optional[str] = None
@@ -344,18 +452,29 @@ class QueryCoalescer:
                                          else {sig: now})
                 cold = last is None or now - last > self._sig_ttl
             if not closed and not cold:
+                st = self._tenant_state(tenant)
                 # admission control BEFORE touching any lane: shed with a
                 # retry hint instead of silently stalling. Cost-aware: the
-                # bound is queued ROWS. Deadline-aware: when the EWMA
-                # service rate is known and the queue's drain time already
-                # exceeds the remaining deadline, admitting would only
-                # manufacture a guaranteed 504 that occupies queue rows.
+                # bound is ROWS. Tenant-aware: the budget counts the
+                # tenant's rows from admission to lane SETTLE and fires
+                # only while OTHER tenants have work in the system
+                # (alone, a tenant may use the whole queue), and the
+                # deadline-unreachable estimate divides the tenant's OWN
+                # backlog by its OWN drain rate — an abusive tenant sheds
+                # against its queue, light tenants admit against theirs.
                 rows = int(q.shape[0])
-                est_wait = (
+                rate = st.ewma_rows_per_s or self._ewma_rows_per_s
+                est_wait = (st.rows / (rate * self._depth)
+                            if rate > 0.0 else None)
+                global_est = (
                     self._queued_rows / (self._ewma_rows_per_s * self._depth)
                     if self._ewma_rows_per_s > 0.0 else None)
                 if self._queued_rows + rows > self.max_queued_rows:
                     shed_reason = "queue_full"
+                    retry_after = global_est if global_est is not None else 0.1
+                elif (st.rows + rows > self._tenant_row_cap
+                      and self._pipeline_rows_total > st.rows):
+                    shed_reason = "tenant_budget"
                     retry_after = est_wait if est_wait is not None else 0.1
                 elif (d is not None and est_wait is not None
                       and est_wait > max(d.remaining_s(), 0.0)):
@@ -382,19 +501,26 @@ class QueryCoalescer:
                 if lane is None:
                     lane = _Lane(key, shard, flt, int(k),
                                  bool(include_vector),
-                                 time.monotonic() + self.window_s)
+                                 time.monotonic() + self.window_s,
+                                 tenant=tenant,
+                                 tenant_label=self._tenant_label(tenant))
                     self._lanes[key] = lane
                     wake = True
-                w = _Waiter(q, max_wait_s=self.waiter_timeout_s)
+                w = _Waiter(q, max_wait_s=self.waiter_timeout_s,
+                            tenant=tenant, tenant_label=lane.tenant_label)
                 lane.items.append(w)
                 lane.rows += q.shape[0]
                 self._queued_rows += q.shape[0]
+                st.rows += q.shape[0]
+                self._pipeline_rows_total += q.shape[0]
+                st.last_seen = time.monotonic()
                 if lane.rows >= self.max_batch:
                     # bucket full: pop now so later arrivals start fresh
                     del self._lanes[key]
                     self._full.append(lane)
                     wake = True
                 self._set_depth_gauge()
+                self._tenant_gauge(lane.tenant_label, q.shape[0])
                 if wake:
                     self._cv.notify()
         if closed:
@@ -405,11 +531,33 @@ class QueryCoalescer:
             self.record_bypass("cold_filter")
             return None
         if shed_reason is not None:
-            self._record_shed(shed_reason)
+            self._record_shed(shed_reason, tenant)
+            if shed_reason == "queue_full":
+                detail = (f"{self._queued_rows} rows queued, cap "
+                          f"{self.max_queued_rows}")
+            else:
+                # tenant-scoped reasons cite the TENANT's numbers: a 429
+                # naming a near-empty global queue would read as a bug to
+                # the operator debugging it
+                st_now = self._tenants.get(tenant)
+                detail = (f"tenant {tenant!r}: "
+                          f"{st_now.rows if st_now is not None else 0} "
+                          f"rows in system, tenant cap "
+                          f"{self._tenant_row_cap}")
             raise robustness.OverloadedError(
                 f"query admission queue overloaded ({shed_reason}: "
-                f"{self._queued_rows} rows queued, cap "
-                f"{self.max_queued_rows})", retry_after_s=retry_after)
+                f"{detail})", retry_after_s=retry_after)
+        # outside the lock: the tenant tag lands on the rider's trace at
+        # admission (the slow-query log's join key), and the per-tenant
+        # admitted-request counter moves through the bounded labeler
+        tracing.annotate_current("tenant", tenant)
+        m = self.metrics
+        if m is not None:
+            try:
+                m.tenant_requests.labels(
+                    m.tenant_labels.observe(tenant)).inc()
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
         return w.wait
 
     def record_bypass(self, reason: str) -> None:
@@ -427,11 +575,124 @@ class QueryCoalescer:
             except Exception:  # noqa: BLE001 — metrics must not break serving
                 pass
 
-    def _record_shed(self, reason: str) -> None:
+    def _record_shed(self, reason: str, tenant: Optional[str] = None) -> None:
         tracing.annotate_current("coalescer_shed", reason)
+        if tenant:
+            tracing.annotate_current("tenant", tenant)
         with self._lock:
             self._shed[reason] = self._shed.get(reason, 0) + 1
+            if tenant:
+                st = self._tenant_state(tenant)
+                st.shed[reason] = st.shed.get(reason, 0) + 1
         robustness.count_shed(reason)
+        robustness.count_tenant_shed(tenant, reason)
+
+    # -- per-tenant fairness state (callers hold the coalescer lock unless
+    # -- noted) ---------------------------------------------------------------
+
+    def _tenant_state(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = _TenantState(tenant, self._tenant_weights.get(tenant, 1.0))
+            self._tenants[tenant] = st
+            if len(self._tenants) > 1024:
+                # a storm of invented tenant ids must not grow this dict
+                # without bound: drop idle states (no queued rows), oldest
+                # first — their deficit/EWMA re-warm on the next request
+                idle = sorted((t for t, s in self._tenants.items()
+                               if s.rows <= 0 and t != tenant),
+                              key=lambda t: self._tenants[t].last_seen)
+                for t in idle[: max(len(self._tenants) - 768, 0)]:
+                    del self._tenants[t]
+        return st
+
+    def _tenant_label(self, tenant: str) -> str:
+        """Bounded metric label for `tenant` (no lock needed — the labeler
+        has its own)."""
+        m = self.metrics
+        if m is None:
+            return tenant
+        try:
+            return m.tenant_labels.label_for(tenant)
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            return tenant
+
+    def _tenant_gauge(self, label: str, delta: int) -> None:
+        """Move the per-tenant queued-rows gauge by `delta` under the SAME
+        label the lane captured at creation (labeler churn between inc
+        and dec must not leak gauge value into another label)."""
+        m = self.metrics
+        if m is not None and label:
+            try:
+                m.tenant_queued_rows.labels(label).inc(delta)
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+
+    def _merge_due(self, due: "list[_Lane]") -> "list[_Lane]":
+        """Coalesce due lanes that differ ONLY by tenant into one
+        dispatch-ready lane (runs after _drr_order, flusher-owned lanes,
+        no lock needed). The base key — (shard, k, metric, filter-sig,
+        include_vector, dim) — is exactly the pre-tenancy lane key, so a
+        merged dispatch is bit-identical to what the tenant-blind
+        coalescer would have dispatched. DRR order is preserved: the
+        accumulator lane keeps the earliest DRR position, and when a
+        merged dispatch would exceed max_batch the overflow starts a new
+        one in order — under contention the DRR-favored tenants' rows
+        get the batch slots, which IS the weighted-fair drain."""
+        groups: dict[tuple, _Lane] = {}
+        out: list[_Lane] = []
+        for ln in due:
+            base = ln.key[1:] if isinstance(ln.key, tuple) else ln.key
+            acc = groups.get(base)
+            if acc is None or acc.rows + ln.rows > self.max_batch:
+                groups[base] = ln
+                out.append(ln)
+                continue
+            acc.items.extend(ln.items)
+            acc.rows += ln.rows
+            if acc.tenant != ln.tenant:
+                # mixed riders: per-waiter accounting handles budgets and
+                # gauges; the lane-level tag only labels traces
+                acc.tenant = "multi"
+                acc.tenant_label = ""
+        return out
+
+    def _drr_order(self, due: "list[_Lane]") -> "list[_Lane]":
+        """Deficit-round-robin over the due lanes' tenants (caller holds
+        the coalescer lock). Per round, each tenant's deficit grows by
+        `weight * max_batch` rows and pays for its lanes (FIFO within the
+        tenant) while the deficit covers them — a weight-2 tenant drains
+        two full dispatches for a weight-1 tenant's one. Classic DRR
+        discipline: a tenant whose queue empties forfeits its remaining
+        deficit (credit must not accumulate while idle), and the rotation
+        start advances every cycle so the same tenant never structurally
+        goes first. Single-tenant input returns unchanged (FIFO — the
+        anonymous same-class common case pays nothing)."""
+        by_t: dict[str, deque] = {}
+        for ln in due:
+            by_t.setdefault(ln.tenant, deque()).append(ln)
+        if len(by_t) <= 1:
+            return due
+        rotation = list(by_t.keys())
+        start = self._drr_cursor % len(rotation)
+        rotation = rotation[start:] + rotation[:start]
+        self._drr_cursor += 1
+        quantum = float(self.max_batch)
+        deficits = {t: 0.0 for t in rotation}  # per-call: see _TenantState
+        order: list[_Lane] = []
+        while by_t:
+            for t in rotation:
+                q = by_t.get(t)
+                if q is None:
+                    continue
+                deficits[t] += quantum * self._tenant_state(t).weight
+                while q and q[0].rows <= deficits[t]:
+                    ln = q.popleft()
+                    deficits[t] -= ln.rows
+                    order.append(ln)
+                if not q:
+                    del by_t[t]
+        return order
 
     # -- flush loop ----------------------------------------------------------
 
@@ -466,7 +727,18 @@ class QueryCoalescer:
                     self._full = []
                     self._lanes.clear()
                 for ln in due:
+                    # global queue bound releases at pop; the PER-TENANT
+                    # budget holds until the lane SETTLES (_mark_settled)
+                    # — a queue-only budget would refill the instant the
+                    # flusher popped, letting one tenant monopolize the
+                    # dispatch pipeline one popped lane at a time
                     self._queued_rows -= ln.rows
+                if len(due) > 1:
+                    # weighted-fair drain: under a saturated pipeline the
+                    # in-flight semaphore serializes dispatches, so the
+                    # ORDER lanes leave this loop is the fairness lever —
+                    # deficit-round-robin across tenants replaces FIFO
+                    due = self._drr_order(due)
                 self._set_depth_gauge()
                 closed = self._closed
             if closed:
@@ -475,6 +747,15 @@ class QueryCoalescer:
                 for ln in due:
                     self._fail_lane(ln, err)
                 return
+            if len(due) > 1:
+                # per-tenant lanes are the DRR sub-queues; compatible
+                # ones MERGE back into one device dispatch here (the
+                # issue's "sub-queues drained by DRR into lanes"):
+                # isolation lives in admission budgets and drain order,
+                # while the dispatch itself stays shared — an admitted
+                # abusive rider widens a light tenant's batch instead of
+                # serializing a whole dispatch ahead of it
+                due = self._merge_due(due)
             try:
                 self._flush(due)
             except Exception as e:  # noqa: BLE001 — the loop must survive
@@ -573,13 +854,36 @@ class QueryCoalescer:
 
     # -- lane lifecycle (idempotent under the coalescer lock) ----------------
 
+    def _release_rows_locked(self, waiters) -> "list[tuple[str, int]]":
+        """Release `waiters`' per-tenant budget rows (caller holds the
+        coalescer lock). Accounting is PER WAITER, not per lane — a
+        flush-merged dispatch carries several tenants' riders in one
+        lane. -> [(gauge label, rows)] for the metric moves the caller
+        makes OFF-lock."""
+        out = []
+        for w in waiters:
+            rows = int(w.vectors.shape[0])
+            st = self._tenants.get(w.tenant or "")
+            if st is not None:
+                st.rows = max(st.rows - rows, 0)
+            self._pipeline_rows_total = max(
+                self._pipeline_rows_total - rows, 0)
+            out.append((w.tenant_label, rows))
+        return out
+
     def _mark_settled(self, lane: _Lane) -> bool:
-        """First-caller-wins claim on waking the lane's waiters."""
+        """First-caller-wins claim on waking the lane's waiters. The
+        claim also RELEASES the waiters' per-tenant budget rows
+        (admission -> settle is the occupancy the tenant_budget cap
+        bounds)."""
         with self._lock:
             if lane.settled:
                 return False
             lane.settled = True
-            return True
+            released = self._release_rows_locked(lane.items)
+        for label, rows in released:
+            self._tenant_gauge(label, -rows)
+        return True
 
     def _release_lane(self, lane: _Lane) -> None:
         """Give the lane's in-flight slot back exactly once."""
@@ -603,6 +907,7 @@ class QueryCoalescer:
             return True
         for w in expired:
             robustness.count_deadline("coalescer.queue")
+            robustness.count_tenant_deadline(w.tenant)
             tracing.annotate_span(w.trace_span, "coalescer_deadline",
                                   "expired in admission queue")
             w.error = robustness.DeadlineExceededError(
@@ -610,6 +915,15 @@ class QueryCoalescer:
             w.event.set()
         lane.items = live
         lane.rows = sum(w.vectors.shape[0] for w in live)
+        # expired waiters leave the lane before settle: release their
+        # share of the tenant budget now (settle only releases the
+        # waiters still aboard)
+        released = []
+        with self._lock:
+            if not lane.settled:
+                released = self._release_rows_locked(expired)
+        for label, rows in released:
+            self._tenant_gauge(label, -rows)
         return bool(live)
 
     def _dispatch_filtered(self, lane: _Lane) -> None:
@@ -629,12 +943,20 @@ class QueryCoalescer:
             rec = self._trace_record(lane)
             # record pushed around the enqueue too: an index without
             # filtered async runs the WHOLE sync search eagerly inside
-            # this call, and its phases must land on the lane's record
+            # this call, and its phases must land on the lane's record.
+            # The tenant scope rides along explicitly: contextvars do not
+            # follow the flush-thread/pool handoff, and the shard's
+            # allowList cache attributes entries by the ACTIVE tenant —
+            # without this, every coalesced filtered entry would land on
+            # the class-name bucket and the per-tenant share bound would
+            # bound nothing ("multi" for merged cross-tenant lanes: a
+            # shared filter belongs to no single tenant's share).
             tok = tracing.push_dispatch(rec)
             try:
-                done = lane.shard.object_vector_search_async(
-                    q, lane.k, include_vector=lane.include_vector,
-                    flt=lane.flt)
+                with robustness.tenant_scope(lane.tenant or None):
+                    done = lane.shard.object_vector_search_async(
+                        q, lane.k, include_vector=lane.include_vector,
+                        flt=lane.flt)
             finally:
                 tracing.pop_dispatch(tok)
         except Exception as e:  # noqa: BLE001 — propagate to all waiters
@@ -655,9 +977,12 @@ class QueryCoalescer:
             tok = tracing.push_dispatch(rec)
             try:
                 # the shard's phase recording lands in `rec` via the
-                # dispatch contextvar set for THIS pool thread
-                res = lane.shard.object_vector_search(
-                    q, lane.k, lane.flt, None, lane.include_vector)
+                # dispatch contextvar set for THIS pool thread; the
+                # tenant scope is the same explicit handoff as
+                # _dispatch_filtered (allowList-cache attribution)
+                with robustness.tenant_scope(lane.tenant or None):
+                    res = lane.shard.object_vector_search(
+                        q, lane.k, lane.flt, None, lane.include_vector)
             finally:
                 tracing.pop_dispatch(tok)
             if rec is not None:
@@ -701,7 +1026,7 @@ class QueryCoalescer:
             return None
         return tracing.DispatchRecord(
             riders, owned=False, actual_rows=lane.rows, coalesced=True,
-            lane_requests=len(lane.items), k=lane.k)
+            lane_requests=len(lane.items), k=lane.k, tenant=lane.tenant)
 
     def _observe_wait(self, lane: _Lane) -> None:
         """Admission-queue wait per request, observed AT dispatch start —
@@ -749,6 +1074,16 @@ class QueryCoalescer:
                 self._ewma_rows_per_s = (
                     rate if self._ewma_rows_per_s <= 0.0
                     else 0.3 * rate + 0.7 * self._ewma_rows_per_s)
+                # each rider tenant's OWN drain-rate estimate: feeds ITS
+                # deadline-unreachable shedding, so one tenant's slow
+                # lanes never shed another tenant's requests (a merged
+                # dispatch drains every rider at the lane's rate)
+                for t in {w.tenant for w in lane.items if w.tenant}:
+                    st = self._tenants.get(t)
+                    if st is not None:
+                        st.ewma_rows_per_s = (
+                            rate if st.ewma_rows_per_s <= 0.0
+                            else 0.3 * rate + 0.7 * st.ewma_rows_per_s)
         m = self.metrics
         if m is not None:
             try:
@@ -803,6 +1138,13 @@ class QueryCoalescer:
                 "bypass": dict(self._bypass),
                 "shed": dict(self._shed),
                 "ewma_rows_per_s": self._ewma_rows_per_s,
+                "tenant_row_cap": self._tenant_row_cap,
+                "tenants": {
+                    t: {"rows_in_system": s.rows, "weight": s.weight,
+                        "shed": dict(s.shed),
+                        "ewma_rows_per_s": s.ewma_rows_per_s}
+                    for t, s in self._tenants.items()
+                },
             }
 
     def shutdown(self) -> None:

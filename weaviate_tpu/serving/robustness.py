@@ -31,6 +31,19 @@ primitives; the wiring lives where the requests flow:
                   HALF-OPENs and lets a bounded number of probe dispatches
                   through — one success closes it, one failure re-opens.
 
+  Tenant scope    WHO a request belongs to (multi-tenant fairness): a
+                  ContextVar riding the same plumbing as the deadline.
+                  Defaults to the queried class name when no explicit
+                  identity arrives; REST ``X-Tenant-Id`` / gRPC
+                  ``x-tenant-id`` metadata override it (validated against
+                  header injection like ``X-Request-Id`` — an invalid
+                  value is REJECTED, not cleaned, because a tenant id is
+                  an accounting key, not an echo). The coalescer's
+                  weighted-fair admission, the per-tenant shed/deadline
+                  metrics, the allowList cache's share bound, and the
+                  tenant tags on traces all read it through
+                  ``effective_tenant``.
+
 Like monitoring/tracing.py, the module state is process-wide globals with
 one-comparison disabled fast paths: no deadline set => ``check_deadline``
 is a ContextVar read and a None compare; breaker disabled => ``get_breaker``
@@ -44,6 +57,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import logging
+import re
 import threading
 import time
 from typing import Any, Iterator, Optional
@@ -126,6 +140,193 @@ def check_deadline(where: str) -> None:
         return
     count_deadline(where)
     raise DeadlineExceededError(f"request deadline expired at {where}")
+
+
+# -- tenant identity ----------------------------------------------------------
+
+# the active request's tenant (None = not explicitly set; consumers fall
+# back to the queried class name via effective_tenant). Rides contextvars
+# exactly like the deadline: installed by the REST/gRPC frontends, copied
+# into batch pool slots, read at coalescer admission and in the shard's
+# allowList cache.
+_TENANT: contextvars.ContextVar = contextvars.ContextVar(
+    "weaviate_tenant", default=None)
+
+# printable ASCII, no separators that could smuggle into a header or a
+# metric label, bounded length. Deliberately stricter than the request-id
+# cleaner: a tenant id keys ACCOUNTING (queues, budgets, metrics), so an
+# invalid one is rejected with a 4xx instead of silently rewritten — two
+# spellings of one tenant must never split its budget.
+_TENANT_ID_RE = re.compile(r"^[\x21-\x7e]{1,64}$")
+
+
+# identities the SYSTEM emits: "other" is the TenantLabeler's aggregate
+# metric bucket, "multi" tags merged cross-tenant dispatches in traces. A
+# client claiming either would hide its accounting inside the aggregate.
+_RESERVED_TENANT_IDS = frozenset({"other", "multi"})
+
+
+def validate_tenant_id(value: Optional[str]) -> Optional[str]:
+    """Parse an inbound tenant header/metadata value. None/empty -> None
+    (the class-name default applies). Invalid (injection bytes, blanks,
+    over-long, a reserved system identity) -> ValueError — the frontends
+    map it to 400 / INVALID_ARGUMENT; it is never cleaned-and-echoed."""
+    if value is None:
+        return None
+    v = value.strip()
+    if not v:
+        return None
+    if not _TENANT_ID_RE.match(v):
+        raise ValueError(
+            "invalid tenant id: printable ASCII without spaces, "
+            "at most 64 chars")
+    if v.lower() in _RESERVED_TENANT_IDS:
+        raise ValueError(
+            f"invalid tenant id: {v!r} is reserved (system aggregate "
+            "bucket)")
+    return v
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]) -> Iterator[Optional[str]]:
+    """Install the request's explicit tenant identity. None is the no-op
+    scope (consumers fall back to the class-name default)."""
+    if not tenant:
+        yield None
+        return
+    token = _TENANT.set(tenant)
+    try:
+        yield tenant
+    finally:
+        _TENANT.reset(token)
+
+
+def current_tenant() -> Optional[str]:
+    return _TENANT.get()
+
+
+def effective_tenant(default: Optional[str] = None) -> Optional[str]:
+    """The accounting identity for the current request: the explicitly
+    installed tenant when one rode in on the request, else `default`
+    (callers pass the queried class name — per-class isolation is the
+    sane default when clients send no identity at all)."""
+    t = _TENANT.get()
+    if t is not None:
+        return t
+    return default
+
+
+def count_tenant_shed(tenant: Optional[str], reason: str) -> None:
+    """Per-tenant shed accounting, cardinality-bounded by the metrics
+    registry's TenantLabeler (top-K by traffic + 'other')."""
+    m = _metrics
+    if m is not None and tenant:
+        try:
+            m.tenant_shed.labels(m.tenant_labels.observe(tenant),
+                                 reason).inc()
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            pass
+
+
+def count_tenant_deadline(tenant: Optional[str]) -> None:
+    """Per-tenant deadline-expired accounting (same bounded labels)."""
+    m = _metrics
+    if m is not None and tenant:
+        try:
+            m.tenant_deadline.labels(m.tenant_labels.observe(tenant)).inc()
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            pass
+
+
+class TenantConcurrencyGate:
+    """Front-door bound on one tenant's CONCURRENT in-server requests.
+
+    The admission queue bounds a tenant's rows, but the Python work a
+    request costs BEFORE admission (transport, parse, traverse) is paid
+    per concurrent request — a tenant opening hundreds of connections
+    starves every other tenant's handler threads on the host CPU no
+    matter how hard the queue sheds it. This gate is the cheapest
+    possible refusal: one dict increment at the frontend, before any
+    per-request work, shedding the excess with the same
+    429/RESOURCE_EXHAUSTED + Retry-After contract as the queue. Applied
+    to requests carrying an EXPLICIT tenant identity (anonymous traffic
+    resolves its class-name tenant too deep for a front-door check).
+    """
+
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max(int(max_concurrent), 1)
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def enter(self, tenant: str) -> bool:
+        with self._lock:
+            c = self._counts.get(tenant, 0)
+            if c >= self.max_concurrent:
+                return False
+            self._counts[tenant] = c + 1
+            return True
+
+    def leave(self, tenant: str) -> None:
+        with self._lock:
+            c = self._counts.get(tenant, 0) - 1
+            if c <= 0:
+                # drop zeros so a storm of invented tenant ids cannot
+                # grow the dict without bound
+                self._counts.pop(tenant, None)
+            else:
+                self._counts[tenant] = c
+
+    def in_flight(self, tenant: str) -> int:
+        with self._lock:
+            return self._counts.get(tenant, 0)
+
+
+_tenant_gate: Optional[TenantConcurrencyGate] = None
+
+
+def configure_tenant_gate(
+        gate: Optional[TenantConcurrencyGate]
+) -> Optional[TenantConcurrencyGate]:
+    """Install (or clear, with None) the process-wide concurrency gate."""
+    global _tenant_gate
+    _tenant_gate = gate
+    return gate
+
+
+def unconfigure_tenant_gate(gate: TenantConcurrencyGate) -> None:
+    global _tenant_gate
+    if _tenant_gate is gate:
+        _tenant_gate = None
+
+
+def get_tenant_gate() -> Optional[TenantConcurrencyGate]:
+    return _tenant_gate
+
+
+@contextlib.contextmanager
+def tenant_concurrency(tenant: Optional[str]) -> Iterator[None]:
+    """Hold one slot of `tenant`'s concurrent-request budget for the
+    enclosed request. No gate configured or no explicit tenant => no-op
+    (one comparison). Over budget => OverloadedError, counted per tenant
+    under reason ``concurrency`` — shed BEFORE any per-request work."""
+    gate = _tenant_gate
+    if gate is None or not tenant:
+        yield
+        return
+    if not gate.enter(tenant):
+        count_shed("tenant_concurrency")
+        count_tenant_shed(tenant, "concurrency")
+        # a deliberately GENEROUS hint: the tenant is over its PARALLELISM
+        # budget, so a slot only frees when one of its own in-flight
+        # requests finishes — fast retries from its other connections
+        # would just burn frontend CPU on more refusals
+        raise OverloadedError(
+            f"tenant {tenant!r} exceeds its concurrent-request budget "
+            f"({gate.max_concurrent})", retry_after_s=1.0)
+    try:
+        yield
+    finally:
+        gate.leave(tenant)
 
 
 # -- circuit breaker ----------------------------------------------------------

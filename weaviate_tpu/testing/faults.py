@@ -21,6 +21,11 @@ Injection points (the fault matrix; see docs/robustness.md):
                            flush-thread death (a BaseException that
                            escapes the loop's `except Exception` defense)
   serving.coalescer.dispatch  per-lane flush — lane dispatch failure
+  serving.coalescer.admit  admission (serving/coalescer.py submit, before
+                           any queue state is touched) — the
+                           abusive-tenant storm journeys stall/fail
+                           requests AT admission to stress the
+                           weighted-fair queue under chaos
 
 Actions: ``device_error`` / ``oom`` raise errors that
 ``robustness.is_device_error`` recognizes (they carry ``device_error =

@@ -121,7 +121,10 @@ class Explorer:
         -> blocking finalize() (same contract as object_vector_search_async's
         `done`) or None => the caller uses the direct path. Only the
         single-local-shard layout coalesces: multi-shard/remote fan-out
-        already runs per-shard batches on the pool."""
+        already runs per-shard batches on the pool. The tenant identity is
+        resolved HERE (explicit X-Tenant-Id riding the contextvar, else
+        the queried class name) so the coalescer's weighted-fair
+        admission accounts the request to the right budget."""
         co = self.coalescer
         if co is None:
             return None
@@ -130,7 +133,8 @@ class Explorer:
             co.record_bypass("multi_shard")
             return None
         return co.submit(shard, vecs, k, flt=flt,
-                         include_vector=include_vector)
+                         include_vector=include_vector,
+                         tenant=robustness.effective_tenant(idx.class_name))
 
     # -- vector resolution (near_params_vector.go) ---------------------------
 
