@@ -62,49 +62,15 @@ def log(msg):
 
 
 # --- roofline model (VERDICT r4 item 2) ------------------------------------
-# Peaks are the public v5e datasheet figures. The CPU entry is a *nominal*
-# single-socket estimate (AVX2+FMA ~96 GFLOP/s/core, ~25 GB/s DRAM) so the
-# cpu-backend artifact rows carry the same fields; cpu mfu_pct is a proxy,
-# not a claim.
-PEAKS = {
-    "tpu-v5e": {"tflops": 197.0, "hbm_gbs": 819.0,
-                "note": "v5e peaks: 197 bf16 TFLOP/s MXU, 819 GB/s HBM"},
-    "cpu": {"tflops": 0.096 * (os.cpu_count() or 1), "hbm_gbs": 25.0,
-            "note": (f"nominal CPU peaks ({os.cpu_count() or 1} core(s) x "
-                     "96 GFLOP/s AVX2+FMA, 25 GB/s DRAM) — proxy only")},
-}
+# The model lives in the SHARED cost-model module now
+# (weaviate_tpu/monitoring/costmodel.py) so the serving path's per-dispatch
+# attribution and these offline rows compute identical numbers from
+# identical formulas; the old bench-local PEAKS/_roofline are these
+# aliases. tests/test_bench_roofline.py pins the math through them.
+from weaviate_tpu.monitoring import costmodel  # noqa: E402
 
-
-def _roofline(qps, n, dim, batch, bytes_per_row, backend="tpu-v5e"):
-    """Achieved-vs-peak roofline fields for one flat-scan row.
-
-    FLOPs are the *useful* distance math (2·B·N·D per batch — the matmul at
-    the heart of every scan tier), not implementation FLOPs, so MFU is
-    comparable across tiers (PQ's reconstruction-as-matmul does more
-    hardware FLOPs to serve the same 2·B·N·D of distance work). Bytes are
-    the store bytes actually read from HBM per batch (queries/LUTs are
-    noise at these shapes). Regime = which peak the achieved intensity
-    pins: the scan reads each store row once per query batch, so
-    arithmetic intensity is 2·B/bytes_per_elem — batch size decides the
-    regime (the design lever BASELINE.md's batch-first serving exploits)."""
-    peak = PEAKS.get(backend, PEAKS["cpu"])
-    flops_per_batch = 2.0 * batch * n * dim
-    bytes_per_batch = float(n) * bytes_per_row
-    batches_per_s = qps / batch
-    tflops = flops_per_batch * batches_per_s / 1e12
-    gbs = bytes_per_batch * batches_per_s / 1e9
-    ai = flops_per_batch / bytes_per_batch
-    ridge = peak["tflops"] * 1e12 / (peak["hbm_gbs"] * 1e9)
-    return {
-        "tflops": round(tflops, 3),
-        "hbm_gbs": round(gbs, 2),
-        "mfu_pct": round(100.0 * tflops / peak["tflops"], 2),
-        "bw_pct": round(100.0 * gbs / peak["hbm_gbs"], 2),
-        "arith_intensity_flops_per_byte": round(ai, 1),
-        "ridge_flops_per_byte": round(ridge, 1),
-        "regime": "compute-bound" if ai >= ridge else "hbm-bandwidth-bound",
-        "peaks": peak["note"],
-    }
+PEAKS = costmodel.PEAKS
+_roofline = costmodel.roofline_from_qps
 
 
 # --- perf regression gate (VERDICT r4 item 2) ------------------------------
@@ -608,23 +574,20 @@ def _bm25_row(n_docs: int) -> dict:
             row[f"qps_{label}_device_batch"] = round(
                 len(qs) / (time.perf_counter() - t0), 1)
             assert not any(isinstance(r, Exception) for r in res)
-        st = engine.last_batch_stats
-        # st must be the ZIPF sweep's own dispatch (the last one timed): a
-        # host-path fallback clears it, so a stale shape can never pair
-        # with host QPS into a fabricated device roofline
-        if st and st["u"] and st["q"] == len(qsets["8term_zipf"]):
-            # matmul roofline of the last batched sweep: flops 2·Q·U·n_pad,
-            # HBM traffic = the [U, n_pad] f32 row matrix read once
+        bshape = engine.last_batch_shape
+        # the shape must be the ZIPF sweep's own dispatch (the last one
+        # timed): a host-path fallback clears it, so a stale shape can
+        # never pair with host QPS into a fabricated device roofline. The
+        # matmul flops/bytes model lives in the shared costmodel
+        # (DispatchShape built by inverted/bm25_device.py).
+        if bshape is not None and bshape.dim \
+                and bshape.batch == len(qsets["8term_zipf"]):
             import jax as _jax
 
-            bknd = "tpu-v5e" if _jax.default_backend() in ("tpu", "axon") \
-                else "cpu"
-            # flops = 2 * n_pad * sum(q_slice*u_slice): a multi-slice sweep
-            # does NOT multiply every query by every slice's units
-            row["roofline_device_batch"] = _roofline(
-                row["qps_8term_zipf_device_batch"], st["n_pad"],
-                st["qu"] / st["q"], st["q"], st["u"] * 4, bknd)
-            row["device_batch_shape"] = st
+            bknd = costmodel.backend_for_platform(_jax.default_backend())
+            row["roofline_device_batch"] = bshape.roofline_at_qps(
+                row["qps_8term_zipf_device_batch"], bknd)
+            row["device_batch_shape"] = bshape.describe()
         shard.bm25_device = None
         app.shutdown()
     finally:
@@ -1817,6 +1780,10 @@ def run_serving_bench(args, rng):
             base = app.coalescer.stats() if app.coalescer is not None else None
             if app.tracer is not None:
                 app.tracer.clear()  # phase stats cover the counted window only
+            if app.perf_window is not None:
+                # same discipline for the perf-attribution window: the
+                # roofline/duty-cycle row fields cover the counted window
+                app.perf_window.clear()
             counting.set()
             t0 = time.perf_counter()
             time.sleep(args.serve_seconds)
@@ -1868,6 +1835,23 @@ def run_serving_bench(args, rng):
             phases = _trace_phase_breakdown(app.tracer)
             if phases is not None:
                 row["trace_phases"] = phases
+            if app.perf_window is not None:
+                # the shared-costmodel window summary (monitoring/perf.py):
+                # roofline + duty cycle + per-stage shares of the
+                # host-overhead ledger — the before/after baseline the
+                # ROADMAP item-1/2/3 PRs measure their win against.
+                # Coverage is FULL (every dispatch feeds the window;
+                # trace sampling only thins trace_phases above).
+                ps = app.perf_window.summary()
+                if ps.get("roofline"):
+                    row["roofline"] = ps["roofline"]
+                if ps.get("roofline_device_busy"):
+                    row["roofline_device_busy"] = ps["roofline_device_busy"]
+                row["duty_cycle"] = ps.get("duty_cycle")
+                row["phase_share"] = {
+                    p: v.get("share_of_wall")
+                    for p, v in ps.get("phases", {}).items()}
+                row["perf_tiers"] = ps.get("tiers")
             log(f"  coalesce={'on' if coalesce_on else 'off'}: {row}")
             return row
         finally:

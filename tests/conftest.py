@@ -38,6 +38,29 @@ if _slow_log_path:
     _logging.getLogger("weaviate_tpu.slowquery").addHandler(_h)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """CI artifact: dump the perf-attribution window summaries of the Apps
+    this session ran (monitoring/perf.py stashes each window's final
+    summary at unconfigure) — ci_check.sh sets PERF_SUMMARY_FILE under
+    CI_ARTIFACT_DIR and the workflow uploads it in ci-failure-logs, so a
+    red run's bundle carries the duty-cycle/roofline/ledger picture."""
+    path = os.environ.get("PERF_SUMMARY_FILE")
+    if not path:
+        return
+    try:
+        import json as _json
+
+        from weaviate_tpu.monitoring import perf as _perf
+
+        summaries = _perf.recent_summaries()
+        if summaries:
+            with open(path, "w") as f:
+                _json.dump({"exit_status": int(exitstatus),
+                            "windows": summaries}, f, indent=1)
+    except Exception:  # noqa: BLE001 — artifact dump must not fail the run
+        pass
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,635 @@
+"""Continuous device-performance attribution (monitoring/costmodel.py +
+monitoring/perf.py) and its wiring.
+
+The acceptance-critical invariants pinned here:
+
+  1. ATTRIBUTION IDENTITY — per-rider flops/bytes are integer telescoping
+     splits, so when every rider of a coalesced dispatch is sampled they
+     sum BIT-EXACTLY to the dispatch totals (the cost-model twin of the
+     PR-3 device-time identity).
+  2. DUTY-CYCLE MATH — the busy integrator computes the interval UNION
+     (overlaps merged, window trimmed) on synthetic interval sets.
+  3. DISABLED = ZERO PERF WORK — with TRACING_ENABLED unset, the serving
+     path constructs no DispatchShape and never touches the PerfWindow
+     (spy-asserted the same way as the tracing spy).
+  4. EXPOSITION — /debug/perf serves the window summary end to end and
+     /metrics carries the rolling roofline/duty gauges.
+
+Plus: cost-model tier formulas, the shared-costmodel BM25 batch shape,
+the front-door gate sheds surfaced in coalescer stats, and the
+signal/atexit device-trace teardown.
+"""
+
+import json
+import threading
+import urllib.request
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config, load_config
+from weaviate_tpu.monitoring import costmodel, perf, tracing
+from weaviate_tpu.serving import robustness
+from weaviate_tpu.usecases.traverser import GetParams
+
+N, DIM, K = 400, 16, 5
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    yield
+    tracing.configure(None)
+    perf.configure(None)
+
+
+def _mk_app(tmp_path, tracing_on=True, coalesce=True, window_ms=200.0,
+            n=N, pq=False):
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.server import App
+
+    cfg = Config()
+    cfg.coalescer.enabled = coalesce
+    cfg.coalescer.window_ms = window_ms
+    cfg.tracing.enabled = tracing_on
+    cfg.tracing.sample_rate = 1.0
+    cfg.tracing.slow_query_threshold_ms = 0.0
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    cls = {"class": "Pf", "vectorIndexType": "hnsw_tpu",
+           "vectorIndexConfig": {"distance": "l2-squared"},
+           "properties": [{"name": "tag", "dataType": ["text"]}]}
+    if pq:
+        cls["vectorIndexConfig"]["pq"] = {
+            "enabled": True, "segments": 4, "centroids": 16}
+    app.schema.add_class(cls)
+    rng = np.random.default_rng(11)
+    vecs = rng.integers(-8, 8, (n, DIM)).astype(np.float32)
+    idx = app.db.get_index("Pf")
+    idx.put_batch([
+        StorObj(class_name="Pf", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"tag": "even" if i % 2 == 0 else "odd"},
+                vector=vecs[i])
+        for i in range(n)])
+    return app, idx, vecs
+
+
+def _walk(span):
+    yield span
+    for c in span.get("children", []):
+        yield from _walk(c)
+
+
+def _dispatch_spans(trace_dicts):
+    return [s for tr in trace_dicts for s in _walk(tr["root"])
+            if s["name"] == "dispatch"]
+
+
+# -- cost model ---------------------------------------------------------------
+
+def test_split_exact_sums_bit_exactly():
+    for total, rows in [(0, [1, 2]), (7, [1, 1, 1]),
+                        (2 * 21 * 50_000 * 64, [1] * 21),
+                        (123456789, [3, 7, 11, 2]),
+                        (10**15, [5, 9, 2, 200])]:
+        parts = costmodel.split_exact(total, rows, sum(rows))
+        assert sum(parts) == total
+        assert all(isinstance(p, int) for p in parts)
+    # partial coverage (unsampled riders): parts stay proportional and
+    # never exceed the total
+    parts = costmodel.split_exact(1000, [1, 1], 4)
+    assert sum(parts) == 500
+
+
+def test_dispatch_shape_tier_formulas():
+    # exact f32 scan: flops 2·B·N·D, bytes N·4D
+    s = costmodel.DispatchShape(costmodel.TIER_EXACT, n=1000, dim=64,
+                                batch=8, bytes_per_row=64 * 4, k=10)
+    assert s.flops() == 2 * 8 * 1000 * 64
+    assert s.bytes() == 1000 * 64 * 4
+    # pq codes: same useful flops, M bytes per row
+    s = costmodel.DispatchShape(costmodel.TIER_PQ_CODES, n=1000, dim=64,
+                                batch=8, bytes_per_row=32, k=10)
+    assert s.bytes() == 1000 * 32
+    # bm25 matmul: n=n_pad, dim=U, batch=Q, bytes U·n_pad·4
+    s = costmodel.DispatchShape(costmodel.TIER_BM25_MATMUL, n=4096,
+                                dim=16, batch=64, bytes_per_row=16 * 4)
+    assert s.flops() == 2 * 64 * 4096 * 16
+    assert s.bytes() == 4096 * 16 * 4
+
+
+def test_shape_ledger_and_hop():
+    s = costmodel.DispatchShape(costmodel.TIER_EXACT, n=10, dim=4,
+                                batch=1, bytes_per_row=16)
+    assert s.ledger() == {}          # nothing measured yet
+    assert s.hop_ms() == -1.0
+    s.enqueue_ms = 1.0
+    s.device_ms = 3.0
+    s.finalize_ms = 5.0
+    s.hydrate_ms = 2.0
+    assert s.hop_ms() == pytest.approx(2.0)
+    led = s.ledger()
+    assert led == {"enqueue": 1.0, "device": 3.0,
+                   "gather_hop": pytest.approx(2.0), "hydrate": 2.0}
+
+
+def test_roofline_time_and_qps_forms_agree():
+    # 1 batch/s of (B=256, N=1e5, D=128, f32): the QPS form at qps=256
+    # equals the time form over 1 second of the same work
+    f = 2.0 * 256 * 100_000 * 128
+    b = 100_000 * 512
+    a = costmodel.roofline(f, b, 1.0, "tpu-v5e")
+    q = costmodel.roofline_from_qps(256.0, 100_000, 128, 256, 512, "tpu-v5e")
+    assert a == q
+
+
+# -- duty cycle ---------------------------------------------------------------
+
+def test_duty_cycle_union_math():
+    d = perf.DutyCycle(window_s=100.0)
+    # disjoint: [0,1] + [2,3] = 2 busy over observed 10s
+    d.record(0.0, 1.0)
+    d.record(2.0, 3.0)
+    assert d.value(now=10.0) == pytest.approx(0.2)
+    # overlap merged: [2.5, 4] adds only 1s (2.5-3 already covered)
+    d.record(2.5, 4.0)
+    assert d.value(now=10.0) == pytest.approx(0.3)
+    # containment adds nothing
+    d.record(2.6, 3.9)
+    assert d.value(now=10.0) == pytest.approx(0.3)
+
+
+def test_duty_cycle_window_trim_and_saturation():
+    d = perf.DutyCycle(window_s=5.0)
+    d.record(0.0, 4.0)
+    # at t=4 observed span is 4s, busy 4s -> 1.0
+    assert d.value(now=4.0) == pytest.approx(1.0)
+    # at t=20 the interval (attributed at its end, t=4) left the window
+    assert d.value(now=20.0) == 0.0
+
+
+def test_duty_cycle_empty():
+    assert perf.DutyCycle(10.0).value(now=5.0) == 0.0
+
+
+# -- the perf window (unit) ---------------------------------------------------
+
+def _stamped_shape(device_ms=4.0, wall_ms=10.0, **kw):
+    s = costmodel.DispatchShape(
+        kw.pop("tier", costmodel.TIER_EXACT), n=kw.pop("n", 50_000),
+        dim=kw.pop("dim", 64), batch=kw.pop("batch", 16),
+        bytes_per_row=kw.pop("bytes_per_row", 256), k=10)
+    s.enqueue_ms = 1.0
+    s.device_ms = device_ms
+    s.finalize_ms = device_ms + 1.5
+    s.hydrate_ms = 2.0
+    import time
+
+    t = time.perf_counter()
+    s.t_start = t - wall_ms / 1000.0
+    s.t_fetch = t - 0.001
+    s.t_end = t
+    s.t_fetch_mono = time.monotonic()
+    return s
+
+
+def test_perf_window_summary_and_clear():
+    w = perf.PerfWindow(window_s=60.0, backend="tpu-v5e")
+    for _ in range(4):
+        w.record_dispatch(_stamped_shape(), rows=16)
+    w.note_phase("queue_wait", 1.2)
+    w.note_phase("scatter", 0.3)
+    s = w.summary()
+    assert s["dispatches"] == 4
+    assert s["rows"] == 64
+    assert 0.0 < s["duty_cycle"] <= 1.0
+    assert s["tiers"] == {costmodel.TIER_EXACT: 4}
+    assert set(s["phases"]) >= {"enqueue", "device", "gather_hop",
+                                "hydrate", "queue_wait", "scatter"}
+    shares = [v["share_of_wall"] for v in s["phases"].values()]
+    assert all(sh is not None for sh in shares)
+    assert sum(shares) == pytest.approx(1.0, abs=0.01)
+    # both roofline forms present and consistent with the cost model
+    assert s["roofline"]["mfu_pct"] > 0.0
+    assert s["roofline_device_busy"]["mfu_pct"] > 0.0
+    w.clear()
+    s2 = w.summary()
+    assert s2["dispatches"] == 0 and s2["duty_cycle"] == 0.0
+    assert s2["dispatches_lifetime"] == 4  # lifetime survives clear
+
+
+def test_perf_window_gauges(tmp_path):
+    from weaviate_tpu.monitoring import noop_metrics
+
+    m = noop_metrics()
+    w = perf.PerfWindow(window_s=60.0, metrics=m, backend="tpu-v5e")
+    w.record_dispatch(_stamped_shape(), rows=16)
+    text = m.expose().decode()
+    assert "weaviate_device_mfu_pct" in text
+    assert "weaviate_device_duty_cycle" in text
+    assert "weaviate_perf_phase_share" in text
+
+
+def test_duty_interval_anchored_at_fetch_not_record_time():
+    """Two concurrent dispatches whose in-flight windows fully overlap
+    must not double-count duty just because their HYDRATE times differ:
+    the interval is anchored at the monotonic fetch stamp, not at the
+    (hydration-delayed) record call."""
+    import time
+
+    w = perf.PerfWindow(window_s=60.0, backend="tpu-v5e")
+    fetch_mono = time.monotonic() - 0.05  # both fetched 50ms ago
+    for _ in range(2):
+        s = costmodel.DispatchShape(costmodel.TIER_EXACT, n=1000, dim=16,
+                                    batch=4, bytes_per_row=64)
+        t = time.perf_counter()
+        s.t_start, s.t_fetch, s.t_end = t - 0.010, t, t + 0.001
+        s.device_ms = 10.0
+        s.t_fetch_mono = fetch_mono
+        w.record_dispatch(s)  # second record is "after a slow hydrate"
+    busy = w.summary()["device_busy_s"]
+    assert busy == pytest.approx(0.010, abs=0.004)  # union, not 0.020
+
+
+def test_gather_empty_shard_records_zero_cost(tmp_path):
+    """An allowList whose docs are absent from this shard runs no device
+    work — the perf shape must credit neither phantom flops/bytes nor a
+    phantom duty-cycle interval (a multi-shard filtered workload must not
+    read near-1.0 duty while the device is idle)."""
+    from weaviate_tpu.storage.bitmap import Bitmap
+
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False)
+    try:
+        vidx = idx.single_local_shard().vector_index
+        absent = Bitmap(np.array([10**9], dtype=np.uint64))
+        ids, dists = vidx.search_by_vectors(vecs[:1], K, absent)
+        assert ids.shape[1] == 0
+        shape = vidx.pop_dispatch_shape()
+        assert shape is not None and shape.tier == costmodel.TIER_GATHER
+        assert shape.n == 0 and shape.flops() == 0 and shape.bytes() == 0
+        assert shape.t_fetch == 0.0  # no device call ran
+        w = perf.PerfWindow(window_s=60.0, backend="tpu-v5e")
+        w.record_dispatch(shape, rows=1)
+        s = w.summary()
+        assert s["duty_cycle"] == 0.0 and s["device_busy_s"] == 0.0
+    finally:
+        app.shutdown()
+
+
+def test_sigterm_teardown_honors_sig_ign(monkeypatch):
+    """A process that deliberately ignored SIGTERM must not be killed by
+    the teardown chain: stop the capture, swallow the signal."""
+    import signal
+
+    from weaviate_tpu.monitoring import profiling
+
+    killed = []
+    monkeypatch.setattr(profiling.os, "kill",
+                        lambda *a: killed.append(a))
+    monkeypatch.setitem(profiling._teardown_state, "prev_sigterm",
+                        signal.SIG_IGN)
+    profiling._sigterm_teardown(signal.SIGTERM, None)
+    assert killed == []
+
+
+def test_teardown_signal_half_retries_after_thread_failure(monkeypatch):
+    """A first install off the main thread must not latch the signal half
+    closed — a later main-thread call still arms the SIGTERM handler."""
+    import signal
+
+    from weaviate_tpu.monitoring import profiling
+
+    monkeypatch.setitem(profiling._teardown_state, "signal_installed", False)
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(profiling.install_trace_teardown()))
+        t.start(); t.join()
+        assert got == [False]  # signal.signal refuses off the main thread
+        assert profiling._teardown_state["signal_installed"] is False
+        if threading.current_thread() is threading.main_thread():
+            assert profiling.install_trace_teardown() is True
+            assert profiling._teardown_state["signal_installed"] is True
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_per_dispatch_mfu_divides_by_wall_not_fetch():
+    """A dispatch whose result was already resident fetches in ~0 ms; the
+    blocked-fetch time is a LOWER bound on device time, so the roofline
+    fact must divide by the dispatch's enqueue->fetch wall — dividing by
+    the fetch would fabricate absurd >100% MFU (seen live: 418%)."""
+    import time
+
+    tracing.configure(tracing.Tracer())
+    try:
+        tr = tracing.Tracer().start_request("test", "q")
+        shape = costmodel.DispatchShape(
+            costmodel.TIER_EXACT, n=2000, dim=32, batch=14,
+            bytes_per_row=128, k=5)
+        shape.enqueue_ms = 800.0
+        shape.device_ms = 0.002      # result was resident: ~instant fetch
+        shape.finalize_ms = 0.2
+        t = time.perf_counter()
+        shape.t_start = t - 0.850
+        shape.t_end = t
+        rec = tracing.DispatchRecord([(tr.root, 14, 0.0)], owned=True,
+                                     actual_rows=14)
+        rec.phase("device_search", 0.2)
+        rec.attach_shape(shape)
+        rec.finish()
+        d = [s for s in tr.root.children if s.name == "dispatch"][0]
+        expect = costmodel.roofline(
+            shape.flops(), shape.bytes(),
+            d.attrs["dispatch_wall_ms"] / 1000.0)["mfu_pct"]
+        assert d.attrs["mfu_pct"] == expect
+        assert d.attrs["mfu_pct"] < 1.0  # honest: most of the wall is host
+    finally:
+        tracing.configure(None)
+
+
+# -- serving-path integration -------------------------------------------------
+
+def test_rider_flops_bytes_sum_bit_exact(tmp_path):
+    """Coalesced dispatch: every rider's integer flops/bytes attribution
+    sums EXACTLY to the dispatch totals (acceptance criterion)."""
+    app, idx, vecs = _mk_app(tmp_path)
+    try:
+        n_req = 10
+        barrier = threading.Barrier(n_req)
+
+        def run(i):
+            with tracing.request("test", f"q{i}"):
+                barrier.wait()
+                app.traverser.get_class(GetParams(
+                    class_name="Pf",
+                    near_vector={"vector": (vecs[i] + 0.5).tolist()},
+                    limit=K))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        by_dispatch: dict = {}
+        for d in _dispatch_spans(app.tracer.snapshot()):
+            by_dispatch.setdefault(d["attrs"]["dispatch_id"], []).append(
+                d["attrs"])
+        assert by_dispatch
+        coalesced = [v for v in by_dispatch.values() if len(v) > 1]
+        assert coalesced, "requests never shared a dispatch"
+        for riders in by_dispatch.values():
+            a0 = riders[0]
+            assert a0["tier"] == costmodel.TIER_EXACT
+            # the dispatch's analytic totals match the cost model at the
+            # dispatch's actual rows
+            assert a0["dispatch_flops"] == 2 * a0["actual_rows"] * \
+                a0["n_live"] * a0["dim"]
+            # BIT-exact: integer sums, no approx
+            assert sum(r["flops"] for r in riders) == a0["dispatch_flops"]
+            assert sum(r["bytes"] for r in riders) == a0["dispatch_bytes"]
+            assert all(isinstance(r["flops"], int) for r in riders)
+    finally:
+        app.shutdown()
+
+
+def test_dispatch_span_carries_roofline_and_ledger(tmp_path):
+    app, idx, vecs = _mk_app(tmp_path)
+    try:
+        with tracing.request("test", "q"):
+            app.traverser.get_class(GetParams(
+                class_name="Pf",
+                near_vector={"vector": (vecs[0] + 0.5).tolist()}, limit=K))
+        d = _dispatch_spans(app.tracer.snapshot())
+        assert len(d) == 1
+        a = d[0]["attrs"]
+        assert a["tier"] == costmodel.TIER_EXACT
+        assert a["n_live"] == N and a["dim"] == DIM
+        assert a["mfu_pct"] >= 0.0 and a["hbm_bw_pct"] >= 0.0
+        assert a["regime"] in ("compute-bound", "hbm-bandwidth-bound")
+        led = a["ledger_ms"]
+        assert {"enqueue", "device", "gather_hop", "hydrate"} <= set(led)
+        assert all(v >= 0.0 for v in led.values())
+        # the window saw the dispatch too (full coverage)
+        s = perf.get_window().summary()
+        assert s["dispatches"] >= 1
+        assert s["duty_cycle"] > 0.0
+    finally:
+        app.shutdown()
+
+
+def test_pq_tiers_report_their_bytes(tmp_path):
+    """The PQ-rescore tier's cost model reads the bf16 copy (2·D per
+    row), pinned through a real compressed dispatch."""
+    app, idx, vecs = _mk_app(tmp_path, pq=True, n=512)
+    try:
+        vidx = idx.single_local_shard().vector_index
+        assert vidx.compressed
+        with tracing.request("test", "q"):
+            app.traverser.get_class(GetParams(
+                class_name="Pf",
+                near_vector={"vector": (vecs[0] + 0.5).tolist()}, limit=K))
+        a = _dispatch_spans(app.tracer.snapshot())[0]["attrs"]
+        assert a["tier"] == costmodel.TIER_PQ_RESCORE
+        assert a["dispatch_bytes"] == a["n_live"] * 2 * DIM
+    finally:
+        app.shutdown()
+
+
+def test_disabled_serving_path_constructs_no_perf_objects(tmp_path,
+                                                          monkeypatch):
+    """TRACING_ENABLED unset: no DispatchShape is built, the PerfWindow is
+    never touched — direct AND coalesced paths (the zero-cost contract,
+    same spy style as the tracing test)."""
+    app, idx, vecs = _mk_app(tmp_path, tracing_on=False)
+    calls = []
+
+    def spy(name):
+        def boom(*a, **kw):
+            calls.append(name)
+            raise AssertionError(f"perf.{name} touched while disabled")
+        return boom
+
+    monkeypatch.setattr(costmodel, "DispatchShape", spy("DispatchShape"))
+    monkeypatch.setattr(perf.PerfWindow, "record_dispatch",
+                        spy("PerfWindow.record_dispatch"))
+    monkeypatch.setattr(perf.PerfWindow, "note_phase",
+                        spy("PerfWindow.note_phase"))
+    try:
+        assert app.perf_window is None
+        assert perf.get_window() is None
+        # coalesced lane
+        res = app.traverser.get_class(GetParams(
+            class_name="Pf",
+            near_vector={"vector": (vecs[0] + 0.5).tolist()}, limit=K))
+        assert len(res) == K
+        # direct path (oversize batched group bypasses the coalescer)
+        out = app.traverser.get_class_batched([
+            GetParams(class_name="Pf",
+                      near_vector={"vector": (vecs[i] + 0.5).tolist()},
+                      limit=K)
+            for i in range(20)])
+        assert not any(isinstance(r, Exception) for r in out)
+        assert calls == []
+    finally:
+        app.shutdown()
+
+
+# -- exposition ---------------------------------------------------------------
+
+def test_debug_perf_endpoint_and_metrics(tmp_path):
+    from weaviate_tpu.server import App, RestServer
+
+    app, idx, vecs = _mk_app(tmp_path)
+    srv = RestServer(app, port=0)
+    srv.start()
+    try:
+        with tracing.request("test", "q"):
+            app.traverser.get_class(GetParams(
+                class_name="Pf",
+                near_vector={"vector": (vecs[0] + 0.5).tolist()}, limit=K))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/perf", timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["enabled"] is True
+        assert body["dispatches"] >= 1
+        assert 0.0 <= body["duty_cycle"] <= 1.0
+        assert "phases" in body and "device" in body["phases"]
+        assert body["phases"]["device"]["p99_ms"] >= 0.0
+        assert body["tiers"].get(costmodel.TIER_EXACT, 0) >= 1
+        # rolling gauges ride the same scrape as everything else
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "weaviate_device_mfu_pct" in text
+        assert "weaviate_device_hbm_bw_pct" in text
+        assert "weaviate_device_duty_cycle" in text
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_debug_perf_disabled_reports_disabled(tmp_path):
+    from weaviate_tpu.server import App, RestServer
+
+    app, idx, vecs = _mk_app(tmp_path, tracing_on=False)
+    srv = RestServer(app, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/perf", timeout=30) as r:
+            assert json.loads(r.read()) == {"enabled": False}
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_final_summary_stashed_for_ci_artifact(tmp_path):
+    app, idx, vecs = _mk_app(tmp_path)
+    with tracing.request("test", "q"):
+        app.traverser.get_class(GetParams(
+            class_name="Pf",
+            near_vector={"vector": (vecs[0] + 0.5).tolist()}, limit=K))
+    app.shutdown()
+    assert any(s.get("dispatches_lifetime", 0) >= 1
+               for s in perf.recent_summaries())
+
+
+# -- satellites ---------------------------------------------------------------
+
+def test_gate_sheds_surface_in_coalescer_stats(tmp_path):
+    """ROADMAP item-4 follow-up: the front-door concurrency gate's
+    refusals show up in coalescer.stats() and on the gate-level metric."""
+    from weaviate_tpu.monitoring import noop_metrics
+
+    m = noop_metrics()
+    gate = robustness.configure_tenant_gate(
+        robustness.TenantConcurrencyGate(1, metrics=m))
+    app = None
+    try:
+        assert gate.enter("tA")
+        assert not gate.enter("tA")   # over budget -> shed, counted
+        assert not gate.enter("tA")
+        gate.leave("tA")
+        st = gate.stats()
+        assert st["shed_total"] == 2 and st["shed"] == {"tA": 2}
+        assert st["in_flight_total"] == 0
+        # the coalescer's operator view includes the gate section
+        app, idx, vecs = _mk_app(tmp_path, tracing_on=False)
+        co_stats = app.coalescer.stats()
+        assert co_stats["tenant_gate"]["shed_total"] == 2
+        assert "weaviate_tenant_gate_shed_total 2.0" in m.expose().decode()
+    finally:
+        robustness.unconfigure_tenant_gate(gate)
+        if app is not None:
+            app.shutdown()
+
+
+def test_gate_shed_tenant_keys_bounded():
+    gate = robustness.TenantConcurrencyGate(1)
+    gate._SHED_KEYS_MAX = 4  # type: ignore[misc]
+    for i in range(10):
+        assert gate.enter(f"t{i}")
+        assert not gate.enter(f"t{i}")  # over ITS budget -> shed
+        gate.leave(f"t{i}")
+    st = gate.stats()
+    assert len(st["shed"]) <= 5  # 4 tenant keys + "other"
+    assert st["shed_total"] == 10
+    assert st["shed"].get("other", 0) >= 6
+
+
+def test_bm25_batch_shape_uses_costmodel():
+    from weaviate_tpu.inverted.bm25_device import DeviceBM25
+
+    eng = DeviceBM25.__new__(DeviceBM25)
+    eng.last_batch_shape = costmodel.DispatchShape(
+        costmodel.TIER_BM25_MATMUL, n=4096, dim=10.0, batch=96,
+        bytes_per_row=40, k=10,
+        extra={"q": 96, "u": 10, "n_pad": 4096, "slices": 1, "qu": 960})
+    st = eng.last_batch_stats
+    assert st["q"] == 96 and st["n_pad"] == 4096 and st["u"] == 10
+    assert st["tier"] == costmodel.TIER_BM25_MATMUL
+    r = eng.last_batch_shape.roofline_at_qps(960.0, "cpu")
+    assert r == costmodel.roofline_from_qps(960.0, 4096, 10.0, 96, 40, "cpu")
+
+
+def test_device_trace_teardown_stops_capture(monkeypatch):
+    """The r05 wedge fix: an active capture is stopped by the emergency
+    teardown exactly once, from any of atexit / SIGTERM / finally."""
+    from weaviate_tpu.monitoring import profiling
+
+    stopped = []
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stopped.append(1))
+    with profiling._teardown_lock:
+        profiling._teardown_state["active"] = True
+    assert profiling.stop_active_trace() is True
+    assert profiling.stop_active_trace() is False  # idempotent
+    assert stopped == [1]
+
+
+def test_trace_teardown_install_registers_sigterm_chain():
+    import signal
+
+    from weaviate_tpu.monitoring import profiling
+
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        # idempotent; in the main test thread installation succeeds
+        assert profiling.install_trace_teardown() in (True, False)
+        profiling.install_trace_teardown()
+        if threading.current_thread() is threading.main_thread():
+            assert signal.getsignal(signal.SIGTERM) is \
+                profiling._sigterm_teardown
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_perf_window_s_config_parses():
+    cfg = load_config({"TRACING_ENABLED": "true", "PERF_WINDOW_S": "12.5"})
+    assert cfg.tracing.perf_window_s == 12.5
+    with pytest.raises(Exception):
+        load_config({"TRACING_ENABLED": "true", "PERF_WINDOW_S": "0"})

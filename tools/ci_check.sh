@@ -21,6 +21,11 @@ if [ -n "$art" ]; then
     # so a red run's artifact carries the span trees (tenant tags included)
     # alongside the pytest log
     export SLOW_QUERY_LOG_FILE="${SLOW_QUERY_LOG_FILE:-$art/slowquery.jsonl}"
+    # ...and the /debug/perf window summaries of every App the suite ran
+    # (monitoring/perf.py final-summary stash; conftest.py dumps it at
+    # session end) — a red run's artifact then carries the duty-cycle /
+    # roofline / phase-ledger picture alongside the span trees
+    export PERF_SUMMARY_FILE="${PERF_SUMMARY_FILE:-$art/debug_perf.json}"
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="

@@ -177,6 +177,10 @@ class TracingConfig:
     sample_rate: float = 1.0      # fraction of requests traced (0..1)
     ring_size: int = 256          # completed traces kept for /debug/traces
     slow_query_threshold_ms: float = 1000.0  # <=0 disables the slow log
+    # rolling window of the perf-attribution plane (monitoring/perf.py):
+    # /debug/perf summaries, duty cycle, and the roofline gauges aggregate
+    # over this many trailing seconds. Rides TRACING_ENABLED.
+    perf_window_s: float = 60.0
 
 
 @dataclass
@@ -337,6 +341,8 @@ class Config:
             raise ConfigError("TRACING_SAMPLE_RATE must be in [0, 1]")
         if self.tracing.ring_size < 1:
             raise ConfigError("TRACING_RING_SIZE must be >= 1")
+        if self.tracing.perf_window_s <= 0:
+            raise ConfigError("PERF_WINDOW_S must be > 0")
         if not (0.0 < self.tenancy.max_queued_rows_fraction <= 1.0):
             raise ConfigError(
                 "TENANT_MAX_QUEUED_ROWS_FRACTION must be in (0, 1]")
@@ -461,6 +467,7 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.tracing.ring_size = _int(e, "TRACING_RING_SIZE", 256)
     cfg.tracing.slow_query_threshold_ms = _float(
         e, "SLOW_QUERY_THRESHOLD_MS", 1000.0)
+    cfg.tracing.perf_window_s = _float(e, "PERF_WINDOW_S", 60.0)
 
     cfg.validate()
     return cfg

@@ -32,7 +32,7 @@ from weaviate_tpu.entities.filters import GeoRange, LocalFilter
 from weaviate_tpu.entities.schema import ClassDef, DataType
 from weaviate_tpu.entities.storobj import StorObj
 from weaviate_tpu.index import new_vector_index
-from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.monitoring import perf, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 # request-lifecycle robustness (stdlib-only module — no import cycle even
 # though serving/coalescer.py imports this file): deadline fail-fast +
@@ -636,12 +636,13 @@ class Shard:
         t0 = time.perf_counter()
         allow = self.build_allow_list(flt)
         t1 = time.perf_counter()
-        if flt is not None:
+        filter_ms = (t1 - t0) * 1000.0 if flt is not None else None
+        if filter_ms is not None:
             if rec is not None:
-                rec.phase("filter", (t1 - t0) * 1000.0)
+                rec.phase("filter", filter_ms)
             if m is not None:
                 m.filtered_vector_filter.labels(cls, self.name).observe(
-                    (t1 - t0) * 1000.0)
+                    filter_ms)
         if allow is not None and len(allow) == 0:
             return [[] for _ in range(q.shape[0])]
         t1 = time.perf_counter()
@@ -651,6 +652,9 @@ class Shard:
             if dispatched is not None:
                 dispatched[0] = True
             lock_wait = self._pop_lock_wait()
+            # widening runs several dispatches; the popped shape (and so
+            # the ledger/roofline facts) describes the LAST round
+            shape = self._pop_dispatch_shape()
             t2 = time.perf_counter()
             # pad the ragged per-row results back to one rectangle so the
             # winners hydrate in ONE batched pass (inf marks absent slots,
@@ -666,7 +670,11 @@ class Shard:
             if rec is not None:
                 rec.phase("device_search", (t2 - t1) * 1000.0)
                 rec.phase("hydrate", (t3 - t2) * 1000.0)
-            self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
+            if shape is not None:
+                if filter_ms is not None:
+                    shape.filter_ms = filter_ms
+                shape.hydrate_ms = (t3 - t2) * 1000.0
+            self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait, shape)
             if m is not None:
                 m.filtered_vector_search.labels(cls, self.name).observe(
                     (t2 - t1) * 1000.0)
@@ -680,13 +688,18 @@ class Shard:
         if dispatched is not None:
             dispatched[0] = True
         lock_wait = self._pop_lock_wait()
+        shape = self._pop_dispatch_shape()
         t2 = time.perf_counter()
         hydrated = self._hydrate_batch(ids, dists, include_vector)
         t3 = time.perf_counter()
         if rec is not None:
             rec.phase("device_search", (t2 - t1) * 1000.0)
             rec.phase("hydrate", (t3 - t2) * 1000.0)
-        self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
+        if shape is not None:
+            if filter_ms is not None:
+                shape.filter_ms = filter_ms
+            shape.hydrate_ms = (t3 - t2) * 1000.0
+        self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait, shape)
         if m is not None:
             m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
             m.filtered_vector_objects.labels(cls, self.name).observe(
@@ -745,8 +758,17 @@ class Shard:
         pop = getattr(self.vector_index, "pop_read_lock_wait", None)
         return pop() if pop is not None else None
 
+    def _pop_dispatch_shape(self):
+        """This thread's last dispatch's costmodel.DispatchShape (None
+        while the tracer is down, or for indexes without the perf plane —
+        hnsw, mesh). Must be popped on the DISPATCHING thread, like the
+        lock wait."""
+        pop = getattr(self.vector_index, "pop_dispatch_shape", None)
+        return pop() if pop is not None else None
+
     def _trace_dispatch_facts(self, rec, rows: int, k: int,
-                              lock_wait_ms: Optional[float] = None) -> None:
+                              lock_wait_ms: Optional[float] = None,
+                              shape=None) -> None:
         """Dispatch-level facts for the trace: the padded width (what the
         jit cache is keyed on — padding waste = 1 - rows/padded), whether
         this (index, padded, k) shape is the first sighting since tracing
@@ -767,6 +789,17 @@ class Shard:
         pw = getattr(vidx, "padded_width", None)
         padded = pw(rows) if pw is not None else rows
         first = tracing.note_shape((id(vidx), int(padded), int(k)))
+        if shape is not None:
+            # perf attribution is FULL-coverage like shape registration:
+            # every dispatch feeds the rolling window (duty cycle, window
+            # roofline, ledger percentiles) even when no rider was sampled
+            # — trace sampling thins /debug/traces, never /debug/perf
+            w = perf.get_window()
+            if w is not None:
+                try:
+                    w.record_dispatch(shape, rows=rows)
+                except Exception:  # noqa: BLE001 — must not break serving
+                    pass
         if rec is not None:
             rec.fact(padded_rows=int(padded), shard=self.name,
                      class_name=self.class_def.name,
@@ -776,6 +809,8 @@ class Shard:
                 rec.fact(snapshot_gen=int(sg))
             if lock_wait_ms is not None:
                 rec.fact(lock_wait_ms=round(float(lock_wait_ms), 3))
+            if shape is not None:
+                rec.attach_shape(shape)
 
     def _search_by_vectors_distance(
         self, q: np.ndarray, target: float, max_limit: int, allow
@@ -889,6 +924,10 @@ class Shard:
                         cause=err)
             raise
         lock_wait = self._pop_lock_wait()
+        # popped HERE, on the dispatching thread (the TLS does not follow
+        # the flusher/pool handoff); the closure carries it to done(),
+        # where finalize() will have stamped the device timings
+        shape = self._pop_dispatch_shape()
 
         def done() -> list[list[SearchResult]]:
             # observe only the time BLOCKED on the device result — wall time
@@ -927,7 +966,12 @@ class Shard:
                 if rec is not None:
                     rec.phase("device_search", (t1 - t0) * 1000.0)
                     rec.phase("hydrate", (t2 - t1) * 1000.0)
-                self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
+                if shape is not None:
+                    if filter_ms is not None:
+                        shape.filter_ms = filter_ms
+                    shape.hydrate_ms = (t2 - t1) * 1000.0
+                self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait,
+                                           shape)
                 if m is not None:
                     m.filtered_vector_search.labels(cls, self.name).observe(
                         (t1 - t0) * 1000.0)
@@ -975,13 +1019,16 @@ class Shard:
             t1 = time.perf_counter()
             ids, dists = self.vector_index.search_by_vectors(q, k)
             lock_wait = self._pop_lock_wait()
+            shape = self._pop_dispatch_shape()
             t2 = time.perf_counter()
             out = self.hydrate_raw_packed(ids, dists)
             t3 = time.perf_counter()
             if rec is not None:
                 rec.phase("device_search", (t2 - t1) * 1000.0)
                 rec.phase("hydrate", (t3 - t2) * 1000.0)
-            self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait)
+            if shape is not None:
+                shape.hydrate_ms = (t3 - t2) * 1000.0
+            self._trace_dispatch_facts(rec, q.shape[0], k, lock_wait, shape)
             if m is not None:
                 m.filtered_vector_search.labels(cls, self.name).observe((t2 - t1) * 1000.0)
                 m.filtered_vector_objects.labels(cls, self.name).observe(

@@ -54,6 +54,10 @@ import numpy as np
 
 from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.interface import AllowList, VectorIndex
+# dispatch-shape recording for the perf-attribution plane: a
+# costmodel.DispatchShape is built per dispatch ONLY while the tracer is
+# up (tracing.get_tracer() gate — the zero-cost-when-disabled contract)
+from weaviate_tpu.monitoring import costmodel, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.ops.distances import DISTANCE_FNS
 # named fault-injection points (testing/faults.py): index.tpu.dispatch /
@@ -137,6 +141,24 @@ def _pack(top: jax.Array, idx: jax.Array) -> jax.Array:
 def _unpack(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     k = packed.shape[1] // 2
     return packed[:, :k].view(np.float32), packed[:, k:]
+
+
+def _fetch_packed(packed_dev, shape=None) -> np.ndarray:
+    """The ONE blocking device->host fetch of a dispatch's finalize. With a
+    perf shape attached (tracer up), stamps the fetch duration as the
+    ledger's `device` stage — what finalize spends blocked on the device —
+    so the gather-hop split (finalize minus fetch) is measurable; without
+    one (disabled path) this is exactly np.asarray."""
+    if shape is None:
+        return np.asarray(packed_dev)
+    t0 = time.perf_counter()
+    out = np.asarray(packed_dev)
+    shape.t_fetch = time.perf_counter()
+    shape.device_ms = (shape.t_fetch - t0) * 1000.0
+    # duty-cycle anchor: the in-flight interval ends HERE, not at the
+    # perf window's record call (hydration runs in between)
+    shape.t_fetch_mono = time.monotonic()
+    return out
 
 
 @functools.partial(
@@ -1789,23 +1811,73 @@ class TpuVectorIndex(VectorIndex):
                      np.zeros((b, 0), dtype=np.float32))
             return lambda: empty
         faults.fire("index.tpu.dispatch")
+        # perf-attribution shape (monitoring/costmodel.py): built ONLY
+        # while the tracer is up — the disabled serving path constructs
+        # nothing here (one comparison; spy-pinned in tests/test_perf.py).
+        # Stamped with the host-overhead ledger as the dispatch executes
+        # and popped by the shard on the dispatching thread
+        # (pop_dispatch_shape, the pop_read_lock_wait idiom).
+        shape = None
+        t_enq0 = 0.0
+        if tracing.get_tracer() is not None:
+            t_enq0 = time.perf_counter()
         q, b = self._prep_queries(vectors)
         k_eff = min(k, snap.live)
         if allow_list is not None and len(allow_list) < self.config.flat_search_cutoff:
-            fin = self._dispatch_small_allow(snap, q, b, k_eff, allow_list)
+            if t_enq0:
+                shape = costmodel.DispatchShape(
+                    costmodel.TIER_GATHER,
+                    n=min(len(allow_list), snap.live), dim=snap.dim,
+                    batch=b, batch_padded=q.shape[0],
+                    bytes_per_row=snap.dim * 4, k=int(k_eff))
+            fin = self._dispatch_small_allow(snap, q, b, k_eff, allow_list,
+                                             shape)
         elif snap.compressed:
-            fin = self._dispatch_full_pq(snap, q, b, k_eff, allow_list)
+            if t_enq0:
+                rescore = (self.config.pq.rescore
+                           and snap.rescore_dev is not None)
+                shape = costmodel.DispatchShape(
+                    costmodel.TIER_PQ_RESCORE if rescore
+                    else costmodel.TIER_PQ_CODES,
+                    n=snap.n, dim=snap.dim, batch=b,
+                    batch_padded=q.shape[0],
+                    # rescore scans the bf16 copy (2·D); codes-only reads
+                    # the uint8 codes (M = segments bytes per row)
+                    bytes_per_row=(2 * snap.dim if rescore
+                                   else snap.pq.segments),
+                    k=int(k_eff))
+            fin = self._dispatch_full_pq(snap, q, b, k_eff, allow_list,
+                                         shape)
         else:
+            if t_enq0:
+                shape = costmodel.DispatchShape(
+                    costmodel.TIER_EXACT, n=snap.n, dim=snap.dim,
+                    batch=b, batch_padded=q.shape[0],
+                    bytes_per_row=snap.dim * snap.store.dtype.itemsize,
+                    k=int(k_eff))
             allow_words = (self._allow_words(snap, allow_list)
                            if allow_list is not None else None)
-            fin = self._dispatch_scan(snap, q, b, k_eff, allow_words)
+            fin = self._dispatch_scan(snap, q, b, k_eff, allow_words,
+                                      shape=shape)
+        if shape is not None:
+            now = time.perf_counter()
+            shape.t_start = t_enq0
+            shape.enqueue_ms = (now - t_enq0) * 1000.0
+            self._read_local.dispatch_shape = shape
         self._track_inflight(1)
         done = [False]
 
         def finalize():
             try:
                 faults.fire("index.tpu.finalize")
-                return fin()
+                if shape is None:
+                    return fin()
+                t0 = time.perf_counter()
+                out = fin()
+                t1 = time.perf_counter()
+                shape.finalize_ms = (t1 - t0) * 1000.0
+                shape.t_end = t1
+                return out
             finally:
                 if not done[0]:  # idempotent: finalize may be retried
                     done[0] = True
@@ -1813,8 +1885,22 @@ class TpuVectorIndex(VectorIndex):
 
         return finalize
 
+    def pop_dispatch_shape(self):
+        """The costmodel.DispatchShape of the CALLING thread's last
+        dispatch (None while the tracer is down); reading clears it. The
+        shard pops it on the dispatching thread — like the lock-wait fact
+        — and attaches it to the trace record / perf window after
+        finalize stamps the device timings (the shape object is shared
+        with the finalize closure, so a pop at enqueue time still
+        observes them)."""
+        s = getattr(self._read_local, "dispatch_shape", None)
+        if s is not None:
+            self._read_local.dispatch_shape = None
+        return s
+
     def _dispatch_scan(self, snap: IndexSnapshot, q: np.ndarray, b: int,
-                       k_eff: int, allow_words, store=None, sq_norms=None):
+                       k_eff: int, allow_words, store=None, sq_norms=None,
+                       shape=None):
         """Full-store scan (fused gmin when eligible, legacy lax.scan kernel
         otherwise) over `store` — the f32 store uncompressed, or the bf16
         rescore copy under PQ-with-rescore (scanning codes first would read
@@ -1844,7 +1930,7 @@ class TpuVectorIndex(VectorIndex):
         def finalize():
             # the ONE deliberate blocking fetch per search dispatch
             # (results packed [B,2k] = a single transfer), outside any lock
-            packed = np.asarray(packed_dev)
+            packed = _fetch_packed(packed_dev, shape)
             top, idx = _unpack(packed)
             top = top[:b]
             idx = idx[:b]
@@ -1854,7 +1940,7 @@ class TpuVectorIndex(VectorIndex):
         return finalize
 
     def _dispatch_full_pq(self, snap: IndexSnapshot, q: np.ndarray, b: int,
-                          k: int, allow_list):
+                          k: int, allow_list, shape=None):
         """Compressed full-store search.
 
         With rescore enabled a full bf16 copy of the rows already lives in
@@ -1877,7 +1963,8 @@ class TpuVectorIndex(VectorIndex):
                            if allow_list is not None else None)
             return self._dispatch_scan(
                 snap, q, b, k, allow_words,
-                store=snap.rescore_dev, sq_norms=snap.rescore_sq_norms)
+                store=snap.rescore_dev, sq_norms=snap.rescore_sq_norms,
+                shape=shape)
         slot_to_doc = snap.slot_to_doc
         # codes-only tier from here: raw ADC distances, no rescoring pass.
         # Fast path: the fused PQ-ADC group-min kernel (ops/pq_gmin.py) —
@@ -1939,7 +2026,7 @@ class TpuVectorIndex(VectorIndex):
         def finalize():
             # the ONE deliberate blocking fetch per PQ search dispatch,
             # outside any lock
-            packed = np.asarray(packed_dev)
+            packed = _fetch_packed(packed_dev, shape)
             top, slots = _unpack(packed)
             top, slots = top[:b], slots[:b]
             # (cosine: the recon path already emits 1 - dot directly)
@@ -1950,7 +2037,8 @@ class TpuVectorIndex(VectorIndex):
         return finalize
 
     def _dispatch_small_allow(self, snap: IndexSnapshot, q: np.ndarray,
-                              b: int, k: int, allow_list: AllowList):
+                              b: int, k: int, allow_list: AllowList,
+                              shape=None):
         """Gather path (flatSearch over allowList, flat_search.go:19): the
         host-side doc->slot resolution binary-searches the snapshot's
         frozen sorted map; the row scoring is one enqueued device call."""
@@ -1959,13 +2047,22 @@ class TpuVectorIndex(VectorIndex):
         docs_sorted, slots_sorted = snap.sorted_doc_slots()
         empty = (np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32))
         if docs_sorted.size == 0:
+            if shape is not None:
+                shape.n = 0  # no device work ran: zero the analytic cost
             return lambda: empty
         pos = np.searchsorted(docs_sorted, allowed_docs)
         pos_c = np.clip(pos, 0, docs_sorted.size - 1)
         hit = docs_sorted[pos_c] == allowed_docs
         slots = slots_sorted[pos_c[hit]].astype(np.int32)
         if slots.size == 0:
+            if shape is not None:
+                shape.n = 0  # no device work ran: zero the analytic cost
             return lambda: empty
+        if shape is not None:
+            # the gather scores only the rows PRESENT in this shard — an
+            # allowList spanning other shards must not credit this
+            # dispatch their flops/bytes
+            shape.n = int(slots.size)
         r = _bucket_rows(slots.size)
         rows = np.full(r, 0, dtype=np.int32)
         rows[: slots.size] = slots
@@ -1987,7 +2084,7 @@ class TpuVectorIndex(VectorIndex):
         def finalize():
             # the ONE deliberate blocking fetch of the gather-path
             # dispatch, outside any lock
-            packed = np.asarray(packed_dev)
+            packed = _fetch_packed(packed_dev, shape)
             top, idx = _unpack(packed)
             top = top[:b]
             idx = idx[:b]

@@ -31,6 +31,7 @@ import numpy as np
 
 from weaviate_tpu.index.interface import AllowList
 from weaviate_tpu.inverted.bm25 import BM25Searcher
+from weaviate_tpu.monitoring import costmodel
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 
 # below this many total postings the host engine wins: one relay round
@@ -75,9 +76,11 @@ class DeviceBM25:
         # the pops or drift the byte accounting
         self._cache_lock = threading.RLock()
         self._jax = None  # lazy import: module import must not init backend
-        # shape of the most recent search_batch dispatch (bench roofline
-        # reads it: flops = 2*q*u*n per matmul, bytes = u*n*4 row traffic)
-        self.last_batch_stats: Optional[dict] = None
+        # shape of the most recent search_batch dispatch as a shared
+        # cost-model shape (monitoring/costmodel.py): bench's keyword
+        # roofline row reads it — flops = 2·Q·U·n_pad per matmul sweep,
+        # HBM traffic = the [U, n_pad] f32 row matrix read once
+        self.last_batch_shape: Optional[costmodel.DispatchShape] = None
 
     # -- plumbing ------------------------------------------------------------
 
@@ -292,7 +295,7 @@ class DeviceBM25:
         batch lane (usecases/traverser.py get_class_batched eligibility)."""
         # cleared on EVERY path that doesn't dispatch: a caller reading
         # stats after a fallback must see None, not a previous batch's shape
-        self.last_batch_stats = None
+        self.last_batch_shape = None
         if limit <= 0:
             return [[] for _ in queries]
         try:
@@ -344,8 +347,25 @@ class DeviceBM25:
             stats["qu"] += len(slice_units) * len(ukeys)
             stats["slices"] += 1
             qi = j
-        self.last_batch_stats = stats
+        # flops = 2 * n_pad * sum(q_slice*u_slice): a multi-slice sweep
+        # does NOT multiply every query by every slice's units, so the
+        # effective per-query unit width is qu/q
+        self.last_batch_shape = costmodel.DispatchShape(
+            costmodel.TIER_BM25_MATMUL,
+            n=stats["n_pad"],
+            dim=stats["qu"] / max(stats["q"], 1),
+            batch=stats["q"],
+            bytes_per_row=stats["u"] * 4,
+            k=int(limit),
+            extra=stats)
         return out
+
+    @property
+    def last_batch_stats(self) -> Optional[dict]:
+        """Flat dict view of the last batch dispatch's shape (the
+        pre-costmodel field name; bench rows and tests read it)."""
+        s = self.last_batch_shape
+        return None if s is None else s.describe()
 
     def _matmul_slice(self, per_query_units, ukeys, n_pad, gen, limit,
                       jnp, bm25_scan):

@@ -334,6 +334,46 @@ class Metrics:
             "weaviate_coalescer_queue_depth)",
             ("tenant",))
 
+        # continuous device-performance attribution (monitoring/perf.py):
+        # rolling-window roofline gauges + the host-overhead ledger's
+        # per-dispatch phase shares. Registered once here (the coalescer
+        # pattern); the perf window only touches them inside try/except.
+        self.device_mfu = g(
+            "weaviate_device_mfu_pct",
+            "achieved model FLOPs utilization over the rolling perf "
+            "window, percent of platform peak (wall-clock form — the "
+            "serving-level number; the device-busy form is in "
+            "/debug/perf)")
+        self.device_hbm_bw = g(
+            "weaviate_device_hbm_bw_pct",
+            "achieved HBM bandwidth over the rolling perf window, "
+            "percent of platform peak")
+        self.device_duty_cycle = g(
+            "weaviate_device_duty_cycle",
+            "fraction of wall-clock with an in-flight device dispatch "
+            "(enqueue->fetch intervals, overlap-merged) — low duty at "
+            "high kernel MFU = the orchestration gap")
+        self.perf_phase_share = Histogram(
+            "weaviate_perf_phase_share",
+            "per-dispatch share of the host-overhead ledger "
+            "(filter/enqueue/device/gather_hop/hydrate) each stage took",
+            ("phase",), registry=r,
+            buckets=(0.01, 0.02, 0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.9, 1.0))
+
+        # front-door tenant concurrency gate (serving/robustness.py
+        # TenantConcurrencyGate): aggregate occupancy + refusals. Per-shed
+        # tenant attribution already rides weaviate_tenant_requests_shed_
+        # total{reason="concurrency"}; these are the label-free gate-level
+        # twins an operator alerts on (ROADMAP item 4 follow-up).
+        self.tenant_gate_inflight = g(
+            "weaviate_tenant_gate_inflight",
+            "requests currently holding a tenant-gate concurrency slot, "
+            "summed over tenants")
+        self.tenant_gate_shed = c(
+            "weaviate_tenant_gate_shed_total",
+            "requests refused at the front-door tenant concurrency gate "
+            "(also counted per tenant/reason in the shed vecs)")
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

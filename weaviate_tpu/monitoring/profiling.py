@@ -112,6 +112,89 @@ class TraceBusyError(RuntimeError):
     """A device trace is already being captured (maps to HTTP 409)."""
 
 
+# -- signal/atexit-safe capture teardown --------------------------------------
+#
+# The r05 chip session wedged when a profiling process was killed
+# mid-device-op: jax.profiler.start_trace without its stop_trace leaves the
+# device-side profiling session armed, and the NEXT process to touch the
+# chip inherits a wedged relay (BENCH_TPU_r05_manual.json note). The
+# in-function try/finally already covers exceptions; this covers the exits
+# that skip finally blocks — SIGTERM's default handler and interpreter
+# teardown — by stopping any active capture from an atexit hook and a
+# chaining SIGTERM handler.
+
+_teardown_state = {"active": False, "atexit_installed": False,
+                   "signal_installed": False, "prev_sigterm": None}
+_teardown_lock = threading.Lock()
+
+
+def stop_active_trace() -> bool:
+    """Stop the active device-trace capture if one is running. Idempotent
+    and exception-proof — safe from atexit, a signal handler, or the
+    capture's own finally. -> True when a capture was actually stopped."""
+    with _teardown_lock:
+        if not _teardown_state["active"]:
+            return False
+        _teardown_state["active"] = False
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+        return True
+    except Exception:  # noqa: BLE001 — teardown must never raise
+        return False
+
+
+def _sigterm_teardown(signum, frame):
+    stop_active_trace()
+    prev = _teardown_state["prev_sigterm"]
+    import signal as _signal
+
+    if prev is _signal.SIG_IGN:
+        # the process had deliberately ignored SIGTERM before the
+        # teardown was installed — honor that: stop the capture, swallow
+        # the signal (re-delivering would turn an ignored signal fatal)
+        return
+    if callable(prev):
+        prev(signum, frame)
+    else:
+        # restore the default disposition and re-deliver, so the process
+        # still dies with the SIGTERM exit status the supervisor expects
+        _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_trace_teardown() -> bool:
+    """Arm the atexit + SIGTERM teardown for device-trace captures. Called
+    from App startup (likely the main thread — only the main thread may
+    install signal handlers; elsewhere the atexit hook still arms and the
+    call reports False for the signal half). Idempotent; the signal half
+    latches only on SUCCESS, so a first call off the main thread does not
+    forfeit a later main-thread install."""
+    import atexit
+    import signal as _signal
+
+    with _teardown_lock:
+        if _teardown_state["signal_installed"]:
+            return True
+        if not _teardown_state["atexit_installed"]:
+            _teardown_state["atexit_installed"] = True
+            atexit.register(stop_active_trace)
+    try:
+        prev = _signal.getsignal(_signal.SIGTERM)
+        if prev is _sigterm_teardown:  # foreign reinstall of our handler
+            prev = None
+        _signal.signal(_signal.SIGTERM, _sigterm_teardown)
+        with _teardown_lock:
+            _teardown_state["prev_sigterm"] = prev
+            _teardown_state["signal_installed"] = True
+        return True
+    except (ValueError, OSError):
+        # not the main thread (a REST handler racing App init) — atexit
+        # still protects normal exits; a later main-thread call retries
+        return False
+
+
 def device_trace(data_path: str, seconds: float = 3.0) -> str:
     """Capture a JAX device trace for ?seconds — the TPU twin of pprof's
     execution trace (the reference's /debug/pprof/trace). Records XLA op
@@ -134,11 +217,18 @@ def device_trace(data_path: str, seconds: float = 3.0) -> str:
         # not merge into one tensorboard/perfetto session
         out_dir = tempfile.mkdtemp(
             prefix=time.strftime("%Y%m%d-%H%M%S-"), dir=root)
+        # arm the emergency teardown BEFORE starting: a SIGTERM landing
+        # between start_trace and the finally must still stop the capture
+        # (atexit for normal exits; the chaining SIGTERM handler when one
+        # could be installed — see install_trace_teardown)
+        install_trace_teardown()
+        with _teardown_lock:
+            _teardown_state["active"] = True
         jax.profiler.start_trace(out_dir)
         try:
             time.sleep(max(0.0, min(float(seconds), 60.0)))
         finally:
-            jax.profiler.stop_trace()
+            stop_active_trace()
         files = sorted(
             os.path.relpath(p, out_dir)
             for p in glob.glob(os.path.join(out_dir, "**"), recursive=True)

@@ -67,6 +67,8 @@ import uuid
 from collections import deque
 from typing import Any, Iterator, Optional
 
+from weaviate_tpu.monitoring import costmodel
+
 _SLOW_LOG = logging.getLogger("weaviate_tpu.slowquery")
 
 # one traceparent shape only: version 00, 32-hex trace id, 16-hex parent id
@@ -222,7 +224,8 @@ class DispatchRecord:
     pay" stays answerable separately.
     """
 
-    __slots__ = ("riders", "owned", "attrs", "phases", "_finished")
+    __slots__ = ("riders", "owned", "attrs", "phases", "ledger_entries",
+                 "_finished")
 
     def __init__(self, riders: list[tuple[Span, int, float]],
                  owned: bool = True, **attrs):
@@ -231,6 +234,12 @@ class DispatchRecord:
         self.attrs: dict[str, Any] = {"dispatch_id": next(_dispatch_seq)}
         self.attrs.update(attrs)
         self.phases: list[tuple[str, float]] = []
+        # host-overhead ledger (monitoring/perf.py stages): finer than the
+        # attribution phases — enqueue / device fetch / gather hop — and
+        # kept SEPARATE from `phases` so the attribution identity (rider
+        # phase shares sum to the dispatch span) is untouched by ledger
+        # stages that overlap the device_search interval
+        self.ledger_entries: list[tuple[str, float]] = []
         self._finished = False
 
     def phase(self, name: str, ms: float) -> None:
@@ -241,6 +250,27 @@ class DispatchRecord:
 
     def fact(self, **kw) -> None:
         self.attrs.update(kw)
+
+    def attach_shape(self, shape) -> None:
+        """Fold a costmodel.DispatchShape's analytic facts + host-overhead
+        ledger into this record (db/shard.py calls it right after the
+        dispatch's phases land, before finish()). The roofline facts
+        themselves are computed at finish()."""
+        self.attrs.update(tier=shape.tier, n_live=shape.n,
+                          dim=shape.dim, flops=shape.flops(),
+                          bytes=shape.bytes())
+        if shape.t_end > shape.t_start:
+            # the dispatch's enqueue->fetch wall: the per-dispatch roofline
+            # denominator. The blocked-fetch time is only a LOWER bound on
+            # device time (a result that landed while the host was doing
+            # enqueue/compile work fetches in ~0 ms), so dividing by it
+            # can fabricate >100% MFU; the wall form is an honest
+            # serving-level number (kernel-level lives in /debug/perf's
+            # device-busy aggregate)
+            self.attrs["dispatch_wall_ms"] = round(
+                (shape.t_end - shape.t_start) * 1000.0, 3)
+        for name, ms in shape.ledger().items():
+            self.ledger_entries.append((name, ms))
 
     def finish(self) -> None:
         """Split this dispatch across its riders' traces. Idempotent, and
@@ -256,20 +286,55 @@ class DispatchRecord:
         if padded > 0:
             self.attrs["padding_waste"] = round(
                 max(0.0, 1.0 - rows_total / padded), 4)
+        # roofline facts (costmodel): the dispatch's analytic work over its
+        # enqueue->fetch WALL — the serving-level per-dispatch utilization.
+        # Deliberately NOT over the blocked-fetch time: that is a lower
+        # bound on device time (a dispatch overlapping host work fetches
+        # in ~0 ms and would read as >100% MFU); kernel-level utilization
+        # comes from /debug/perf's device-busy aggregate instead.
+        flops = self.attrs.get("flops")
+        ledger = dict(self.ledger_entries)
+        if flops:
+            dev_ms = self.attrs.get("dispatch_wall_ms") or device_ms
+            if dev_ms > 0.0:
+                rf = costmodel.roofline(
+                    flops, self.attrs.get("bytes", 0), dev_ms / 1000.0)
+                self.attrs.update(
+                    mfu_pct=rf["mfu_pct"], hbm_bw_pct=rf["bw_pct"],
+                    arith_intensity=rf["arith_intensity_flops_per_byte"],
+                    regime=rf["regime"])
+        if ledger:
+            self.attrs["ledger_ms"] = {
+                k: round(v, 3) for k, v in ledger.items()}
+        # per-rider flops/bytes: telescoping integer split, so when every
+        # rider is sampled the parts sum BIT-EXACTLY to the dispatch
+        # totals (the flops/bytes twin of the device-time identity)
+        rider_rows = [r for _, r, _ in self.riders]
+        rider_flops = (costmodel.split_exact(flops, rider_rows, rows_total)
+                       if flops else None)
+        rider_bytes = (costmodel.split_exact(
+            self.attrs.get("bytes", 0), rider_rows, rows_total)
+            if flops else None)
         t = _tracer
         m = t.metrics if t is not None else None
-        for span, rows, wait_ms in self.riders:
+        for i, (span, rows, wait_ms) in enumerate(self.riders):
             share = rows / rows_total
+            attrs = {
+                **self.attrs,
+                "rows": rows,
+                "share": round(share, 6),
+                "queue_wait_ms": round(wait_ms, 3),
+                "device_ms": device_ms * share,
+                "dispatch_device_ms": device_ms,
+                "dispatch_total_ms": total_ms,
+            }
+            if rider_flops is not None:
+                attrs["flops"] = rider_flops[i]
+                attrs["bytes"] = rider_bytes[i]
+                attrs["dispatch_flops"] = flops
+                attrs["dispatch_bytes"] = self.attrs.get("bytes", 0)
             d = span.child_done("dispatch", duration_ms=total_ms * share,
-                                attrs={
-                                    **self.attrs,
-                                    "rows": rows,
-                                    "share": round(share, 6),
-                                    "queue_wait_ms": round(wait_ms, 3),
-                                    "device_ms": device_ms * share,
-                                    "dispatch_device_ms": device_ms,
-                                    "dispatch_total_ms": total_ms,
-                                })
+                                attrs=attrs)
             for nm, ms in self.phases:
                 d.child_done(nm, duration_ms=ms * share)
             if m is not None:

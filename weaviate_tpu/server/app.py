@@ -53,15 +53,32 @@ class App:
         # on the serving path is a one-comparison no-op.
         tc = self.config.tracing
         if tc.enabled:
-            from weaviate_tpu.monitoring import tracing
+            from weaviate_tpu.monitoring import perf, tracing
 
             self.tracer = tracing.configure(tracing.Tracer(
                 sample_rate=tc.sample_rate,
                 ring_size=tc.ring_size,
                 slow_ms=tc.slow_query_threshold_ms,
                 metrics=self.metrics))
+            # continuous device-performance attribution (monitoring/
+            # perf.py): rides the tracer's enablement — the perf window is
+            # fed by every dispatch's cost-model shape, which the index
+            # only builds while the tracer is up (one zero-cost contract
+            # for both planes). /debug/perf + the rolling roofline gauges.
+            self.perf_window = perf.configure(perf.PerfWindow(
+                window_s=tc.perf_window_s,
+                metrics=self.metrics,
+                sample_hint=tc.sample_rate))
         else:
             self.tracer = None
+            self.perf_window = None
+        # a SIGTERM mid device-trace capture must still stop the JAX
+        # profiler (the r05 wedge): install the signal/atexit teardown
+        # from the main thread while we are likely on it — REST handler
+        # threads cannot install signal handlers themselves
+        from weaviate_tpu.monitoring import profiling
+
+        profiling.install_trace_teardown()
 
         # request-lifecycle robustness (serving/robustness.py): shed/
         # deadline counters bind to this App's metrics; the device circuit
@@ -185,7 +202,8 @@ class App:
         # breaker (the frontends check it before any per-request work)
         if tn.max_concurrent_requests > 0:
             self.tenant_gate = robustness.configure_tenant_gate(
-                robustness.TenantConcurrencyGate(tn.max_concurrent_requests))
+                robustness.TenantConcurrencyGate(tn.max_concurrent_requests,
+                                                 metrics=self.metrics))
         else:
             self.tenant_gate = None
         if cc.enabled:
@@ -297,6 +315,10 @@ class App:
 
             # clear only if still ours: a newer App's tracer survives
             tracing.unconfigure(self.tracer)
+        if self.perf_window is not None:
+            from weaviate_tpu.monitoring import perf
+
+            perf.unconfigure(self.perf_window)
         # robustness globals: same still-ours discipline as the tracer
         from weaviate_tpu.serving import robustness
 

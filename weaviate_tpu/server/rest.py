@@ -105,6 +105,10 @@ for _m, _p, _n in [
     # authorizer as the pprof surface below: span trees name classes and
     # filters and are not for anonymous remote clients
     ("GET", r"/debug/traces", "debug_traces"),
+    # rolling perf-attribution window (monitoring/perf.py): roofline,
+    # duty cycle, host-overhead ledger percentiles — same authorizer as
+    # pprof (it names classes and exposes serving internals)
+    ("GET", r"/debug/perf", "debug_perf"),
     # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
     ("GET", r"/debug/pprof/?", "pprof_index"),
     ("GET", r"/debug/pprof/profile", "pprof_profile"),
@@ -207,9 +211,9 @@ class Handler(BaseHTTPRequestHandler):
     # serving path, and tracing /debug/traces would feed the ring with
     # reads of itself
     _UNTRACED = frozenset({
-        "live", "ready", "openid", "metrics", "debug_traces", "pprof_index",
-        "pprof_profile", "pprof_trace", "pprof_goroutine", "pprof_heap",
-        "pprof_cmdline",
+        "live", "ready", "openid", "metrics", "debug_traces", "debug_perf",
+        "pprof_index", "pprof_profile", "pprof_trace", "pprof_goroutine",
+        "pprof_heap", "pprof_cmdline",
     })
 
     def _request_timeout_ms(self, route: str) -> float:
@@ -352,6 +356,15 @@ class Handler(BaseHTTPRequestHandler):
             traces = traces[-limit:]
         self._reply(200, {"enabled": True, "count": len(traces),
                           "traces": traces})
+
+    def h_debug_perf(self):
+        from weaviate_tpu.monitoring import perf
+
+        w = perf.get_window()
+        if w is None:
+            self._reply(200, {"enabled": False})
+            return
+        self._reply(200, {"enabled": True, **w.summary()})
 
     # -- profiling (monitoring/profiling.py; pprof surface) ------------------
 

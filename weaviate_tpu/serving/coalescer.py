@@ -133,7 +133,7 @@ import numpy as np
 # to module scope (PR 1 pattern): failure paths must not die on an import.
 from weaviate_tpu.db.shard import filter_signature
 from weaviate_tpu.index.tpu import _B_BUCKETS
-from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.monitoring import perf, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.serving import robustness
 from weaviate_tpu.testing import faults
@@ -1042,6 +1042,18 @@ class QueryCoalescer:
                     m.coalescer_wait.observe((now - w.enqueued_at) * 1000.0)
             except Exception:  # noqa: BLE001 — metrics must not break serving
                 pass
+        pw = perf.get_window()
+        if pw is not None:
+            # queue_wait feeds the host-overhead ledger window per admitted
+            # request — full coverage, independent of trace sampling (the
+            # perf window exists only while the tracer is up, so the
+            # disabled path is the one comparison above)
+            try:
+                for w in lane.items:
+                    pw.note_phase("queue_wait",
+                                  (now - w.enqueued_at) * 1000.0)
+            except Exception:  # noqa: BLE001 — must not break serving
+                pass
 
     def _resolve_lane(self, lane: _Lane, res) -> None:
         """Scatter [rows] result lists back to the lane's waiters. No k
@@ -1049,6 +1061,8 @@ class QueryCoalescer:
         waiter here asked for exactly the k the dispatch ran at."""
         if not self._mark_settled(lane):
             return  # reaper/failure path won the race; results discarded
+        pw = perf.get_window()
+        scatter_t0 = time.perf_counter() if pw is not None else 0.0
         pos = 0
         try:
             for w in lane.items:
@@ -1063,6 +1077,13 @@ class QueryCoalescer:
                     w.error = RuntimeError(
                         "coalescer failed to scatter batch results")
                     w.event.set()
+        if pw is not None:
+            # the ledger's final stage: result scatter back to the waiters
+            try:
+                pw.note_phase(
+                    "scatter", (time.perf_counter() - scatter_t0) * 1000.0)
+            except Exception:  # noqa: BLE001 — must not break serving
+                pass
         now = time.monotonic()
         with self._lock:
             self._dispatches += 1
@@ -1125,9 +1146,16 @@ class QueryCoalescer:
     # -- introspection / lifecycle -------------------------------------------
 
     def stats(self) -> dict:
+        # the front-door concurrency gate sheds BEFORE admission ever sees
+        # the request; its refusals belong in the same operator view as the
+        # queue's (the ROADMAP item-4 follow-up) — read through the
+        # process-wide global, like the serving paths do
+        gate = robustness.get_tenant_gate()
+        gate_stats = gate.stats() if gate is not None else None
         with self._lock:
             d = self._dispatches
             return {
+                "tenant_gate": gate_stats,
                 "dispatches": d,
                 "requests": self._dispatched_requests,
                 "rows": self._dispatched_rows,

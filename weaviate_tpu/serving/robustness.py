@@ -253,18 +253,38 @@ class TenantConcurrencyGate:
     resolves its class-name tenant too deep for a front-door check).
     """
 
-    def __init__(self, max_concurrent: int):
+    # per-tenant shed counters kept at most this many distinct keys; a
+    # storm of invented tenant ids overflows into the "other" bucket (the
+    # TenantLabeler discipline, without the traffic-ranking machinery)
+    _SHED_KEYS_MAX = 256
+
+    def __init__(self, max_concurrent: int, metrics=None):
         self.max_concurrent = max(int(max_concurrent), 1)
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._counts: dict[str, int] = {}
+        self._inflight_total = 0
+        self._shed_total = 0
+        self._shed: dict[str, int] = {}
 
     def enter(self, tenant: str) -> bool:
         with self._lock:
             c = self._counts.get(tenant, 0)
             if c >= self.max_concurrent:
+                # refusal accounting lives ON the gate (coalescer.stats()
+                # surfaces it; the caller still counts the per-tenant shed
+                # vecs) — ROADMAP item-4 follow-up
+                self._shed_total += 1
+                key = (tenant if tenant in self._shed
+                       or len(self._shed) < self._SHED_KEYS_MAX else "other")
+                self._shed[key] = self._shed.get(key, 0) + 1
+                self._gate_metrics(shed=True)
                 return False
             self._counts[tenant] = c + 1
-            return True
+            self._inflight_total += 1
+            total = self._inflight_total
+        self._set_inflight_gauge(total)
+        return True
 
     def leave(self, tenant: str) -> None:
         with self._lock:
@@ -275,10 +295,40 @@ class TenantConcurrencyGate:
                 self._counts.pop(tenant, None)
             else:
                 self._counts[tenant] = c
+            self._inflight_total = max(self._inflight_total - 1, 0)
+            total = self._inflight_total
+        self._set_inflight_gauge(total)
+
+    def _gate_metrics(self, shed: bool = False) -> None:
+        m = self.metrics
+        if m is not None and shed:
+            try:
+                m.tenant_gate_shed.inc()
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+
+    def _set_inflight_gauge(self, total: int) -> None:
+        m = self.metrics
+        if m is not None:
+            try:
+                m.tenant_gate_inflight.set(total)
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
 
     def in_flight(self, tenant: str) -> int:
         with self._lock:
             return self._counts.get(tenant, 0)
+
+    def stats(self) -> dict:
+        """The gate's operator view (surfaced in coalescer.stats())."""
+        with self._lock:
+            return {
+                "max_concurrent": self.max_concurrent,
+                "in_flight_total": self._inflight_total,
+                "tenants_in_flight": len(self._counts),
+                "shed_total": self._shed_total,
+                "shed": dict(self._shed),
+            }
 
 
 _tenant_gate: Optional[TenantConcurrencyGate] = None
