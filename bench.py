@@ -1718,6 +1718,15 @@ def run_serving_bench(args, rng):
             os.environ.get("BENCH_TRACE_SAMPLE_RATE", 0.1))
         cfg.tracing.ring_size = 4096
         cfg.tracing.slow_query_threshold_ms = 0.0  # no slow-log noise
+        # shadow recall auditor (monitoring/quality.py): audit a sample of
+        # the live serving traffic against the exact host plane so the row
+        # carries an ONLINE recall estimate next to the bench's own
+        # sampled-reply recall — the acceptance cross-check is that the
+        # two agree within ±0.01. Sampled (default 10%) and strictly
+        # subordinate (drop-not-queue, one worker), so the auditor itself
+        # stays out of the measurement. BENCH_AUDIT_SAMPLE_RATE=0 disables.
+        cfg.quality.audit_sample_rate = float(
+            os.environ.get("BENCH_AUDIT_SAMPLE_RATE", 0.1))
         data_dir = tempfile.mkdtemp(prefix="benchserve")
         app = srv = None
         try:
@@ -1784,6 +1793,16 @@ def run_serving_bench(args, rng):
                 # same discipline for the perf-attribution window: the
                 # roofline/duty-cycle row fields cover the counted window
                 app.perf_window.clear()
+            base_audits = None
+            if app.quality_auditor is not None:
+                # ...and for the quality window: drain the still-queued
+                # warmup audits FIRST (clear alone would let them score
+                # into the counted window milliseconds later), then reset;
+                # outcome counters are lifetime, so snapshot them for the
+                # row's window-only deltas
+                app.quality_auditor.drain(timeout_s=15.0)
+                app.quality_auditor.clear()
+                base_audits = app.quality_auditor.summary().get("audits", {})
             counting.set()
             t0 = time.perf_counter()
             time.sleep(args.serve_seconds)
@@ -1835,6 +1854,22 @@ def run_serving_bench(args, rng):
             phases = _trace_phase_breakdown(app.tracer)
             if phases is not None:
                 row["trace_phases"] = phases
+            if app.quality_auditor is not None:
+                # the shadow auditor's online recall over the counted
+                # window, cross-checked against the bench's own sampled-
+                # reply recall above (the two must agree within ±0.01 —
+                # they measure the same serving path two different ways)
+                app.quality_auditor.drain(timeout_s=15.0)
+                qs = app.quality_auditor.summary()
+                row["online_recall"] = qs.get("online_recall")
+                # window-only outcome deltas (counters are lifetime)
+                row["online_audits"] = {
+                    k: v - (base_audits or {}).get(k, 0)
+                    for k, v in qs.get("audits", {}).items()}
+                if row["online_recall"] is not None \
+                        and row.get("recall@10") is not None:
+                    row["online_recall_delta"] = round(abs(
+                        row["online_recall"] - row["recall@10"]), 4)
             if app.perf_window is not None:
                 # the shared-costmodel window summary (monitoring/perf.py):
                 # roofline + duty cycle + per-stage shares of the

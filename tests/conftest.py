@@ -39,26 +39,31 @@ if _slow_log_path:
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """CI artifact: dump the perf-attribution window summaries of the Apps
-    this session ran (monitoring/perf.py stashes each window's final
-    summary at unconfigure) — ci_check.sh sets PERF_SUMMARY_FILE under
-    CI_ARTIFACT_DIR and the workflow uploads it in ci-failure-logs, so a
-    red run's bundle carries the duty-cycle/roofline/ledger picture."""
-    path = os.environ.get("PERF_SUMMARY_FILE")
-    if not path:
-        return
-    try:
-        import json as _json
+    """CI artifact: dump the perf-attribution window summaries AND the
+    shadow-recall-auditor summaries of the Apps this session ran
+    (monitoring/perf.py and monitoring/quality.py each stash final
+    summaries at unconfigure) — ci_check.sh sets PERF_SUMMARY_FILE /
+    QUALITY_SUMMARY_FILE under CI_ARTIFACT_DIR and the workflow uploads
+    both in ci-failure-logs, so a red run's bundle carries the
+    duty-cycle/roofline/ledger picture and the recall picture."""
+    import importlib
+    import json as _json
 
-        from weaviate_tpu.monitoring import perf as _perf
-
-        summaries = _perf.recent_summaries()
-        if summaries:
-            with open(path, "w") as f:
-                _json.dump({"exit_status": int(exitstatus),
-                            "windows": summaries}, f, indent=1)
-    except Exception:  # noqa: BLE001 — artifact dump must not fail the run
-        pass
+    for env_key, module, doc_key in (
+            ("PERF_SUMMARY_FILE", "perf", "windows"),
+            ("QUALITY_SUMMARY_FILE", "quality", "audits")):
+        path = os.environ.get(env_key)
+        if not path:
+            continue
+        try:
+            mod = importlib.import_module(f"weaviate_tpu.monitoring.{module}")
+            summaries = mod.recent_summaries()
+            if summaries:
+                with open(path, "w") as f:
+                    _json.dump({"exit_status": int(exitstatus),
+                                doc_key: summaries}, f, indent=1)
+        except Exception:  # noqa: BLE001 — artifact dump must not fail the run
+            pass
 
 
 @pytest.fixture

@@ -631,6 +631,128 @@ def test_jgl010_non_labels_calls_and_foreign_scope_pass():
     assert "JGL010" in codes(bad, SERVING)
 
 
+# -- JGL011: unguarded background-thread run-loop -----------------------------
+
+
+def test_jgl011_unguarded_runloop_fires_for_name_and_method_targets():
+    src = (
+        "import threading\n"
+        "class Auditor:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run, daemon=True).start()\n"
+        "    def _run(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            self._audit_once()\n"
+        "def start_monitor(check):\n"
+        "    def loop():\n"
+        "        while True:\n"
+        "            check()\n"
+        "    threading.Thread(target=loop, daemon=True).start()\n"
+    )
+    assert codes(src, SERVING).count("JGL011") == 2
+    # package-wide scope: cold modules spawn daemons too
+    assert codes(src, COLD).count("JGL011") == 2
+
+
+def test_jgl011_guarded_runloops_pass():
+    src = (
+        "import threading\n"
+        "class Auditor:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run, daemon=True).start()\n"
+        "        threading.Thread(target=self._sup, daemon=True).start()\n"
+        "    def _run(self):\n"                 # while: try/except idiom
+        "        while not self._stop.is_set():\n"
+        "            try:\n"
+        "                self._audit_once()\n"
+        "            except Exception:\n"
+        "                continue\n"
+        "    def _sup(self):\n"                 # guarded-supervisor idiom
+        "        try:\n"
+        "            while True:\n"
+        "                self._tick()\n"
+        "        except Exception:\n"
+        "            pass\n"
+    )
+    assert "JGL011" not in codes(src, SERVING)
+
+
+def test_jgl011_non_target_loops_and_foreign_scope_pass():
+    # an unguarded loop in a function NEVER handed to a Thread is not a
+    # run-loop; deep attribute targets (another object's method) are
+    # skipped; files outside weaviate_tpu/ are out of scope
+    src = (
+        "import threading\n"
+        "def crunch(items):\n"
+        "    while items:\n"
+        "        items.pop()\n"
+        "class Srv:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self.httpd.serve_forever).start()\n"
+    )
+    assert "JGL011" not in codes(src, SERVING)
+    bad = (
+        "import threading\n"
+        "def loop():\n"
+        "    while True:\n"
+        "        tick()\n"
+        "threading.Thread(target=loop).start()\n"
+    )
+    assert "JGL011" in codes(bad, SERVING)
+    assert "JGL011" not in codes(bad, "tools/chip_watch.py")
+
+
+def test_jgl011_runloop_inside_match_case_is_audited():
+    src = (
+        "import threading\n"
+        "def loop(mode):\n"
+        "    match mode:\n"
+        "        case 'poll':\n"
+        "            while True:\n"
+        "                tick()\n"
+        "threading.Thread(target=loop).start()\n"
+    )
+    assert "JGL011" in codes(src, SERVING)
+    guarded = src.replace(
+        "            while True:\n"
+        "                tick()\n",
+        "            while True:\n"
+        "                try:\n"
+        "                    tick()\n"
+        "                except Exception:\n"
+        "                    continue\n")
+    assert "JGL011" not in codes(guarded, SERVING)
+
+
+def test_jgl011_only_outermost_loops_audited():
+    # a guarded outer loop owns its inner loops: the inner `for` needs no
+    # guard of its own (the outer try/except already bounds the blast
+    # radius to one iteration)
+    src = (
+        "import threading\n"
+        "def loop(batches):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            for b in batches:\n"
+        "                handle(b)\n"
+        "        except Exception:\n"
+        "            continue\n"
+        "threading.Thread(target=loop).start()\n"
+    )
+    assert "JGL011" not in codes(src, SERVING)
+
+
+def test_jgl011_clean_repo():
+    """The shipped tree's own daemons (disk monitor, compaction cycle,
+    gossip, coalescer flusher, quality audit workers) are all guarded —
+    the rule lands with a clean baseline and must stay that way."""
+    import tools.graftlint.engine as engine
+
+    findings = engine.analyze_tree(
+        os.path.join(REPO, "weaviate_tpu"), root=REPO)
+    assert [f for f in findings if f.code == "JGL011"] == []
+
+
 # -- suppressions (JGL000) ----------------------------------------------------
 
 def test_suppression_with_reason_silences_finding():

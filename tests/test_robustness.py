@@ -252,6 +252,59 @@ def test_host_fallback_parity_with_device(tmp_path):
         app.shutdown()
 
 
+def test_host_plane_parity_after_pq_recompress_and_compact(tmp_path):
+    """The host plane is the quality auditor's ground truth (monitoring/
+    quality.py) as well as the breaker's fallback: it must stay exact
+    through a declarative PQ re-compress and through delete+compact.
+    Integer vectors are bf16-exact, so the PQ-rescore device tier and the
+    f32 host plane return identical answers on tie-free queries."""
+    app, idx, vecs = _mk_app(tmp_path, coalesce=False)
+    try:
+        shard = idx.single_local_shard()
+        vidx = shard.vector_index
+        queries = np.stack(_tie_free_queries(vecs, 6))
+
+        def assert_parity():
+            dev_ids, dev_d = vidx.search_by_vectors(queries, K)
+            host_ids, host_d = vidx.search_by_vectors_host(queries, K)
+            np.testing.assert_array_equal(dev_ids, host_ids)
+            np.testing.assert_array_equal(dev_d, host_d)
+            # ...and the pinned audit entry agrees with the live one
+            snap = vidx._snap
+            pin_ids, pin_d = vidx.search_by_vectors_host_pinned(
+                snap, queries, K)
+            np.testing.assert_array_equal(host_ids, pin_ids)
+            np.testing.assert_array_equal(host_d, pin_d)
+
+        assert_parity()
+        # declarative PQ compress (the config-update trigger): the device
+        # tier flips to pq_rescore_bf16; the host plane keeps serving the
+        # full-precision rows (host_vecs under PQ)
+        cfg = vidx.config
+        cfg.pq.enabled = True
+        cfg.pq.segments = 4
+        cfg.pq.centroids = 16
+        vidx.compress()
+        assert vidx.compressed
+        assert_parity()
+        # deletes + compact: slots rebuild wholesale (fresh allow token,
+        # re-encoded codes); both planes must track the surviving docs
+        for uid in range(1, 30):
+            shard.delete_object(str(uuidlib.UUID(int=uid)))
+        vidx.compact()
+        assert len(vidx) == N - 29
+        assert_parity()
+        # filtered parity survives the rebuild too
+        allow = shard.build_allow_list(LocalFilter.from_dict({
+            "path": ["tag"], "operator": "Equal", "valueText": "even"}))
+        dev_ids, dev_d = vidx.search_by_vectors(queries, K, allow)
+        host_ids, host_d = vidx.search_by_vectors_host(queries, K, allow)
+        np.testing.assert_array_equal(dev_ids, host_ids)
+        np.testing.assert_array_equal(dev_d, host_d)
+    finally:
+        app.shutdown()
+
+
 # -- journey: device error mid-coalesced-dispatch -> breaker -> recovery ------
 
 

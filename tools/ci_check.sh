@@ -26,6 +26,11 @@ if [ -n "$art" ]; then
     # session end) — a red run's artifact then carries the duty-cycle /
     # roofline / phase-ledger picture alongside the span trees
     export PERF_SUMMARY_FILE="${PERF_SUMMARY_FILE:-$art/debug_perf.json}"
+    # ...and the shadow-recall-auditor summaries (monitoring/quality.py
+    # final-summary stash, dumped by conftest.py alongside the perf
+    # windows) — the online recall/RBO/distance-error picture of every
+    # audited App the suite ran
+    export QUALITY_SUMMARY_FILE="${QUALITY_SUMMARY_FILE:-$art/debug_quality.json}"
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="

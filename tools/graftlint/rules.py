@@ -26,6 +26,11 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
           distinct value (10k tenants = 10k series); route identities
           through a bounded mapper (metrics.TenantLabeler) or a fixed
           enum instead
+  JGL011  unguarded background-thread run-loop (a loop in a
+          threading.Thread target with no exception guard) — one
+          surprise exception then kills the daemon silently; a dead
+          audit thread reads as recall=perfect, a dead flusher as an
+          empty queue
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
@@ -39,7 +44,9 @@ gates weaviate_tpu/serving/ + weaviate_tpu/db/ (the request path whose
 every wait must be bounded by a deadline or a liveness cap —
 serving/robustness.py); JGL010 gates all of weaviate_tpu/ (every
 monitoring/metrics.py call site — labels are registered in one place but
-observed everywhere). JGL001
+observed everywhere); JGL011 gates all of weaviate_tpu/ too (daemon
+threads are spawned from every layer — monitors, compaction cycles,
+gossip, the coalescer flusher, the quality auditor). JGL001
 additionally skips boundary functions whose JOB is host materialization —
 that allowlist lives here, in one place, so reviewers see every waiver.
 
@@ -165,6 +172,12 @@ RULE_DOCS = {
               ".labels(...) call site mints one Prometheus series per "
               "distinct value; pass a bounded variable (route identities "
               "through metrics.TenantLabeler or a fixed enum)",
+    "JGL011": "unguarded background-thread run-loop — a loop inside a "
+              "threading.Thread target with no try/except anywhere in or "
+              "around it dies silently on the first surprise exception "
+              "(a dead audit thread reads as recall=perfect); wrap the "
+              "loop body in try/except (log + continue) or the loop in a "
+              "guarded supervisor",
     "JGL999": "file does not parse",
 }
 
@@ -173,12 +186,24 @@ RULE_DOCS = {
 # and ONE dynamic value anywhere unbounds the series set
 JGL010_PREFIXES = ("weaviate_tpu/",)
 
+# JGL011 scope: the whole package — daemon threads are spawned from every
+# layer (monitors, compaction cycles, gossip, the coalescer flusher, the
+# quality audit workers), and any of them dying silently inverts a signal
+JGL011_PREFIXES = ("weaviate_tpu/",)
+
 
 def in_metric_label_scope(rel_path: str) -> bool:
     """JGL010 scope check (same interior-boundary matching as is_hot)."""
     rp = rel_path.replace("\\", "/")
     return any(rp == p or rp.startswith(p) or f"/{p}" in rp
                for p in JGL010_PREFIXES)
+
+
+def in_thread_runloop_scope(rel_path: str) -> bool:
+    """JGL011 scope check (same interior-boundary matching as is_hot)."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL011_PREFIXES)
 
 
 def in_span_scope(rel_path: str) -> bool:
@@ -261,6 +286,29 @@ class ModuleIndex:
         # module-level ContextVars: their zero-arg .get() is a lookup, not
         # a blocking wait — JGL009 must not flag it
         self.contextvars: set[str] = set()
+        # names of functions handed to threading.Thread(target=...) — bare
+        # names and `self.<attr>` forms — anywhere in the module; these
+        # are the run-loop candidates JGL011 audits. Deeper attribute
+        # chains (self.httpd.serve_forever) point outside this module and
+        # are skipped (under-approximation on purpose).
+        self.thread_targets: set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (dotted(node.func) or "") not in ("threading.Thread",
+                                                 "Thread"):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                t = dotted(kw.value)
+                if t is None:
+                    continue
+                parts = t.split(".")
+                if len(parts) == 1:
+                    self.thread_targets.add(parts[0])
+                elif len(parts) == 2 and parts[0] == "self":
+                    self.thread_targets.add(parts[1])
         for node in tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if _jit_decorated(node):
@@ -311,6 +359,7 @@ class RuleWalker(ast.NodeVisitor):
         self.lock_fetch_scope = in_lock_fetch_scope(rel_path)
         self.unbounded_wait_scope = in_unbounded_wait_scope(rel_path)
         self.metric_label_scope = in_metric_label_scope(rel_path)
+        self.thread_runloop_scope = in_thread_runloop_scope(rel_path)
         self.mod = mod
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
@@ -377,6 +426,7 @@ class RuleWalker(ast.NodeVisitor):
                 d for d in node.args.kw_defaults if d is not None]:
             self.visit(default)
         self.scope.append(node.name)
+        self._check_thread_runloop(node)
         self.fn_depth += 1
         jitted = _jit_decorated(node)
         if jitted:
@@ -507,6 +557,75 @@ class RuleWalker(ast.NodeVisitor):
         self._check_unbounded_wait(node)
         self._check_dynamic_label(node)
         self.generic_visit(node)
+
+    # -- JGL011: unguarded background-thread run-loop --
+
+    def _check_thread_runloop(self, fn) -> None:
+        """A function handed to threading.Thread(target=...) is a daemon's
+        whole life: an exception that escapes any loop in it kills the
+        thread SILENTLY (no caller observes the future), and the signal
+        the thread fed inverts — a dead audit worker reads as
+        recall=perfect, a dead monitor as disk=healthy. Each OUTERMOST
+        loop in the target must be exception-guarded: an enclosing
+        try/except, or a try/except somewhere inside the loop body (the
+        `while: try/except` idiom). Nested loops inside a guarded outer
+        loop are the guard's problem, not this rule's."""
+        if not self.thread_runloop_scope \
+                or fn.name not in self.mod.thread_targets:
+            return
+        self._scan_runloop_stmts(fn.body, False)
+
+    def _scan_runloop_stmts(self, stmts, guarded: bool) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+                if not guarded and not self._loop_has_guard(st):
+                    self.emit(
+                        "JGL011", st,
+                        "run-loop in a threading.Thread target with no "
+                        "exception guard — the first surprise exception "
+                        "kills the thread silently and its signal reads "
+                        "as healthy; wrap the loop body in try/except "
+                        "(log + continue) or the loop itself in a "
+                        "guarded supervisor")
+                continue  # outermost loops only
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested defs run on their own thread/lifecycle
+            if isinstance(st, ast.Try):
+                self._scan_runloop_stmts(st.body,
+                                         guarded or bool(st.handlers))
+                for h in st.handlers:
+                    self._scan_runloop_stmts(h.body, guarded)
+                self._scan_runloop_stmts(st.orelse, guarded)
+                self._scan_runloop_stmts(st.finalbody, guarded)
+                continue
+            if isinstance(st, ast.Match):
+                # match holds statements under cases[i].body, not .body —
+                # a run-loop inside a case must not silently escape audit
+                for case in st.cases:
+                    self._scan_runloop_stmts(case.body, guarded)
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                blk = getattr(st, attr, None)
+                if blk:
+                    self._scan_runloop_stmts(blk, guarded)
+
+    @staticmethod
+    def _loop_has_guard(loop) -> bool:
+        """Any try-with-except inside the loop (nested defs excluded —
+        their bodies run elsewhere). Approximate on purpose: a try that
+        covers only part of the body still counts; what matters is that
+        the author THOUGHT about thread survival at all."""
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Try) and n.handlers:
+                return True
+            stack.extend(ast.iter_child_nodes(n))
+        return False
 
     # -- JGL010: dynamically-constructed metric label value --
 

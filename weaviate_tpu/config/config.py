@@ -204,6 +204,38 @@ class RobustnessConfig:
 
 
 @dataclass
+class QualityConfig:
+    """Online quality observability (monitoring/quality.py). TPU
+    extension: a shadow recall auditor re-executes a sampled fraction of
+    completed live searches against the exact host plane (snapshot-
+    generation-pinned) and reports recall@k / rank-biased overlap /
+    distance error into ``GET /debug/quality`` and bounded-label gauges.
+    Disabled (sample rate 0, the default) => no auditor object anywhere
+    on the serving path (the module global stays None; every capture
+    point is a one-comparison no-op)."""
+
+    # fraction of completed live searches shadow-audited (0..1); 0 = off
+    audit_sample_rate: float = 0.0
+    # background audit worker threads (hard concurrency budget); the
+    # pending queue is bounded to the same number — overflow DROPS the
+    # sample (counted), never queues behind live load
+    audit_concurrency: int = 1
+    # query rows audited per sampled dispatch (a wide coalesced batch
+    # audits a uniform row subset)
+    audit_max_rows: int = 64
+    # per-audit budget for the host-plane scan; the scan streams row
+    # chunks and abandons the audit when over (counted). <= 0 = unbounded
+    audit_deadline_ms: float = 1000.0
+    # rolling QualityWindow horizon for /debug/quality and the gauges
+    window_s: float = 300.0
+    # per-tier EWMA recall below this fires the degradation alert
+    alert_threshold: float = 0.95
+    # audited dispatches of a tier before its EWMA may alert (a cold
+    # EWMA over two samples is noise, not a regression)
+    alert_min_samples: int = 20
+
+
+@dataclass
 class TenancyConfig:
     """Multi-tenant fairness (serving/coalescer.py weighted-fair
     admission + monitoring/metrics.py bounded tenant labels). TPU
@@ -293,6 +325,7 @@ class Config:
     tracing: TracingConfig = field(default_factory=TracingConfig)
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
+    quality: QualityConfig = field(default_factory=QualityConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -356,6 +389,18 @@ class Config:
                 raise ConfigError(
                     f"TENANT_WEIGHTS entry {t!r}={w!r} must have a "
                     "nonempty tenant and weight > 0")
+        if not (0.0 <= self.quality.audit_sample_rate <= 1.0):
+            raise ConfigError("RECALL_AUDIT_SAMPLE_RATE must be in [0, 1]")
+        if self.quality.audit_concurrency < 1:
+            raise ConfigError("RECALL_AUDIT_CONCURRENCY must be >= 1")
+        if self.quality.audit_max_rows < 1:
+            raise ConfigError("RECALL_AUDIT_MAX_ROWS must be >= 1")
+        if self.quality.window_s <= 0:
+            raise ConfigError("QUALITY_WINDOW_S must be > 0")
+        if not (0.0 <= self.quality.alert_threshold <= 1.0):
+            raise ConfigError("RECALL_ALERT_THRESHOLD must be in [0, 1]")
+        if self.quality.alert_min_samples < 1:
+            raise ConfigError("RECALL_ALERT_MIN_SAMPLES must be >= 1")
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -461,6 +506,15 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.tenancy.metrics_top_k = _int(e, "TENANT_METRICS_TOP_K", 10)
     cfg.tenancy.max_concurrent_requests = _int(
         e, "TENANT_MAX_CONCURRENT_REQUESTS", 0)
+
+    cfg.quality.audit_sample_rate = _float(e, "RECALL_AUDIT_SAMPLE_RATE", 0.0)
+    cfg.quality.audit_concurrency = _int(e, "RECALL_AUDIT_CONCURRENCY", 1)
+    cfg.quality.audit_max_rows = _int(e, "RECALL_AUDIT_MAX_ROWS", 64)
+    cfg.quality.audit_deadline_ms = _float(
+        e, "RECALL_AUDIT_DEADLINE_MS", 1000.0)
+    cfg.quality.window_s = _float(e, "QUALITY_WINDOW_S", 300.0)
+    cfg.quality.alert_threshold = _float(e, "RECALL_ALERT_THRESHOLD", 0.95)
+    cfg.quality.alert_min_samples = _int(e, "RECALL_ALERT_MIN_SAMPLES", 20)
 
     cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
     cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)

@@ -32,7 +32,7 @@ from weaviate_tpu.entities.filters import GeoRange, LocalFilter
 from weaviate_tpu.entities.schema import ClassDef, DataType
 from weaviate_tpu.entities.storobj import StorObj
 from weaviate_tpu.index import new_vector_index
-from weaviate_tpu.monitoring import perf, tracing
+from weaviate_tpu.monitoring import perf, quality, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 # request-lifecycle robustness (stdlib-only module — no import cycle even
 # though serving/coalescer.py imports this file): deadline fail-fast +
@@ -145,6 +145,10 @@ def _uuid_bytes(u: str) -> bytes:
 
 
 class Shard:
+    # allowList-cache LRU capacity (build_allow_list; surfaced by
+    # debug_health so /debug/index can report occupancy vs the bound)
+    _ALLOW_CACHE_CAP = 16
+
     def __init__(
         self,
         name: str,
@@ -521,7 +525,8 @@ class Shard:
         allow = self.searcher.doc_ids(flt)
         if self._locked_gen() == gen:
             tenant = robustness.effective_tenant(self.class_def.name) or ""
-            if len(self._allow_cache) >= 16:  # small LRU: hot filters are few
+            # small LRU: hot filters are few
+            if len(self._allow_cache) >= self._ALLOW_CACHE_CAP:
                 try:
                     self._allow_cache.pop(self._allow_evict_key(tenant))
                 except (StopIteration, KeyError, IndexError, RuntimeError,
@@ -655,6 +660,9 @@ class Shard:
             # widening runs several dispatches; the popped shape (and so
             # the ledger/roofline facts) describes the LAST round
             shape = self._pop_dispatch_shape()
+            # target-distance rounds are ragged re-dispatches of the same
+            # rows — not a representative recall sample; drop the pin
+            self._pop_audit_snap()
             t2 = time.perf_counter()
             # pad the ragged per-row results back to one rectangle so the
             # winners hydrate in ONE batched pass (inf marks absent slots,
@@ -689,6 +697,7 @@ class Shard:
             dispatched[0] = True
         lock_wait = self._pop_lock_wait()
         shape = self._pop_dispatch_shape()
+        self._maybe_audit(self._pop_audit_snap(), q, k, allow, ids, dists)
         t2 = time.perf_counter()
         hydrated = self._hydrate_batch(ids, dists, include_vector)
         t3 = time.perf_counter()
@@ -750,6 +759,33 @@ class Shard:
             dists[dists > float(target_distance)] = np.inf
         tracing.annotate_current("host_fallback", reason)
         return self._hydrate_batch(ids, dists, include_vector)
+
+    def _pop_audit_snap(self):
+        """The pinned IndexSnapshot this thread's last dispatch read —
+        None unless an auditor was configured at dispatch time. Popped
+        UNCONDITIONALLY (a TLS getattr, the _pop_lock_wait cost class) so
+        an auditor torn down between dispatch and finalize cannot leave a
+        stale pin for a LATER request to pop — that would audit query B
+        against query A's snapshot. Must run on the DISPATCHING thread,
+        like the lock wait and the dispatch shape."""
+        pop = getattr(self.vector_index, "pop_audit_snapshot", None)
+        return pop() if pop is not None else None
+
+    def _maybe_audit(self, snap, q, k: int, allow, ids, dists) -> None:
+        """Shadow-recall sample capture at finalize: offer this completed
+        live search (its snapshot pinned at dispatch) to the auditor's
+        sampler. Strictly subordinate — sampling, row budgets, and
+        drop-not-queue admission all live in the auditor; an auditing
+        failure must never break serving."""
+        aud = quality.get_auditor()
+        if aud is None or snap is None:
+            return
+        try:
+            aud.maybe_capture(self.vector_index, snap, q, k, allow, ids,
+                              dists, class_name=self.class_def.name,
+                              shard=self.name)
+        except Exception:  # noqa: BLE001 — auditing must never break serving
+            pass
 
     def _pop_lock_wait(self) -> Optional[float]:
         """ms this thread's last snapshot read waited on the index write
@@ -928,6 +964,9 @@ class Shard:
         # the flusher/pool handoff); the closure carries it to done(),
         # where finalize() will have stamped the device timings
         shape = self._pop_dispatch_shape()
+        # audit-snapshot pin: same thread-handoff rule — popped at
+        # dispatch, carried into done() where the live answer exists
+        audit_snap = self._pop_audit_snap()
 
         def done() -> list[list[SearchResult]]:
             # observe only the time BLOCKED on the device result — wall time
@@ -960,6 +999,7 @@ class Shard:
                     # async device work (hnsw/mesh take the sync path), so
                     # a finalize() success IS a device success
                     self._record_device_success(br)
+                self._maybe_audit(audit_snap, q, k, allow, ids, dists)
                 t1 = time.perf_counter()
                 hydrated = self._hydrate_batch(ids, dists, include_vector)
                 t2 = time.perf_counter()
@@ -986,6 +1026,25 @@ class Shard:
                     rec.finish()
 
         return done
+
+    def debug_health(self) -> dict:
+        """Per-shard introspection for ``GET /debug/index``: object count,
+        allowList-cache occupancy, and the vector index's health snapshot
+        (index/tpu.py health(); indexes without the API — hnsw, mesh —
+        report just their type). Lock-free racy reads — introspection,
+        not an invariant."""
+        out = {
+            "objects": self.object_count(),
+            "status": self.status,
+            "allow_cache": {"entries": len(self._allow_cache),
+                            "capacity": self._ALLOW_CACHE_CAP},
+        }
+        vh = getattr(self.vector_index, "health", None)
+        out["vector_index"] = vh() if vh is not None else {
+            "type": type(self.vector_index).__name__,
+            "live": len(self.vector_index),
+        }
+        return out
 
     def raw_plane_ready(self) -> bool:
         """Cheap pre-check for the raw serving lane, BEFORE any device work:
@@ -1020,6 +1079,8 @@ class Shard:
             ids, dists = self.vector_index.search_by_vectors(q, k)
             lock_wait = self._pop_lock_wait()
             shape = self._pop_dispatch_shape()
+            self._maybe_audit(self._pop_audit_snap(), q, k, None, ids,
+                              dists)
             t2 = time.perf_counter()
             out = self.hydrate_raw_packed(ids, dists)
             t3 = time.perf_counter()

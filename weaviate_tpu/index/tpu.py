@@ -58,6 +58,11 @@ from weaviate_tpu.index.interface import AllowList, VectorIndex
 # costmodel.DispatchShape is built per dispatch ONLY while the tracer is
 # up (tracing.get_tracer() gate — the zero-cost-when-disabled contract)
 from weaviate_tpu.monitoring import costmodel, tracing
+# shadow recall auditing (monitoring/quality.py): the dispatch snapshot is
+# pinned in TLS ONLY while an auditor is configured (one comparison,
+# nothing constructed — the tracer's zero-cost contract), so the audit
+# compares against the exact index state the live answer saw
+from weaviate_tpu.monitoring import quality
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.ops.distances import DISTANCE_FNS
 # named fault-injection points (testing/faults.py): index.tpu.dispatch /
@@ -1573,6 +1578,12 @@ class TpuVectorIndex(VectorIndex):
         cls, shard = self._metric_labels()
         m.vector_index_tombstones.labels(cls, shard).set(self.n - self.live)
         m.vector_index_size.labels(cls, shard).set(self.capacity)
+        # cheap always-on health gauges (the /debug/index satellites):
+        # stamped here on the write path, so quality reporting needs
+        # neither tracing nor auditing enabled
+        m.vector_index_live.labels(cls, shard).set(self.live)
+        m.index_tombstone_fraction.labels(cls, shard).set(
+            (self.n - self.live) / self.n if self.n > 0 else 0.0)
         if self.dim:
             m.vector_dimensions.labels(cls, shard).set(self.live * self.dim)
             if self.compressed and self._pq is not None:
@@ -1864,6 +1875,14 @@ class TpuVectorIndex(VectorIndex):
             shape.t_start = t_enq0
             shape.enqueue_ms = (now - t_enq0) * 1000.0
             self._read_local.dispatch_shape = shape
+        # shadow-audit snapshot pin (monitoring/quality.py): record which
+        # snapshot THIS dispatch read so a sampled audit re-executes
+        # against the same index state — writers publishing between
+        # enqueue and finalize must not skew the comparison. TLS holds at
+        # most one snapshot per serving thread; gated so the disabled
+        # path stores nothing (one comparison, the tracer contract).
+        if quality.get_auditor() is not None:
+            self._read_local.audit_snap = snap
         self._track_inflight(1)
         done = [False]
 
@@ -1897,6 +1916,31 @@ class TpuVectorIndex(VectorIndex):
         if s is not None:
             self._read_local.dispatch_shape = None
         return s
+
+    def pop_audit_snapshot(self) -> Optional[IndexSnapshot]:
+        """The IndexSnapshot the CALLING thread's last dispatch read (None
+        unless an auditor was configured at dispatch time); reading clears
+        it. Popped by the shard on the dispatching thread — the
+        pop_read_lock_wait idiom — and handed to the quality auditor so
+        the shadow re-execution is generation-pinned."""
+        s = getattr(self._read_local, "audit_snap", None)
+        if s is not None:
+            self._read_local.audit_snap = None
+        return s
+
+    def dispatch_tier(self, snap: IndexSnapshot, allow_list=None) -> str:
+        """The costmodel TIER_* a dispatch on `snap` with `allow_list`
+        takes — the same branching as _dispatch_search, exposed so the
+        quality auditor labels its bounded-cardinality gauges without a
+        tracer-built DispatchShape."""
+        if allow_list is not None \
+                and len(allow_list) < self.config.flat_search_cutoff:
+            return costmodel.TIER_GATHER
+        if snap.compressed:
+            if self.config.pq.rescore and snap.rescore_dev is not None:
+                return costmodel.TIER_PQ_RESCORE
+            return costmodel.TIER_PQ_CODES
+        return costmodel.TIER_EXACT
 
     def _dispatch_scan(self, snap: IndexSnapshot, q: np.ndarray, b: int,
                        k_eff: int, allow_words, store=None, sq_norms=None,
@@ -2096,20 +2140,14 @@ class TpuVectorIndex(VectorIndex):
 
     # -- host fallback plane (serving/robustness.py circuit breaker) ---------
 
-    def _host_fallback_rows(
+    def host_rows(
             self, snap: IndexSnapshot) -> tuple[np.ndarray, np.ndarray]:
-        """Host f32 ([n, D] rows, [n] row sq-norms) of the snapshot's
-        live region for the breaker's fallback plane, built ONCE per
-        snapshot generation and cached: the fallback pays one bulk
-        transfer + one norms pass when the breaker first opens, not per
-        degraded query — this path exists precisely for sustained load on
-        the slowest plane. Under PQ the full-precision rows already live
-        host-side (host_vecs); only the norms are derived. (A device too
-        far gone even to read HBM makes the fetch raise; the caller then
-        surfaces the original dispatch error.)"""
-        cached = self._host_rows_cache
-        if cached is not None and cached[0] == snap.gen:
-            return cached[1], cached[2]
+        """Host f32 ([n, D] rows, [n] row sq-norms) of `snap`'s occupied
+        region — one bulk device->host transfer + one norms pass, no
+        caching (callers own their policy: the breaker caches per live
+        generation in _host_fallback_rows, the quality auditor keeps its
+        own snapshot-pinned cache). Under PQ the full-precision rows
+        already live host-side (host_vecs); only the norms are derived."""
         if snap.compressed and snap.host_vecs is not None:
             rows = snap.host_vecs[: snap.n]  # a view — no extra memory
         else:
@@ -2117,6 +2155,20 @@ class TpuVectorIndex(VectorIndex):
                 np.float32, copy=False)
         # einsum: the norms pass must not transiently duplicate the rows
         sq = np.einsum("ij,ij->i", rows, rows, dtype=np.float32)
+        return rows, sq
+
+    def _host_fallback_rows(
+            self, snap: IndexSnapshot) -> tuple[np.ndarray, np.ndarray]:
+        """host_rows built ONCE per snapshot generation and cached: the
+        breaker's fallback pays one bulk transfer + one norms pass when it
+        first opens, not per degraded query — this path exists precisely
+        for sustained load on the slowest plane. (A device too far gone
+        even to read HBM makes the fetch raise; the caller then surfaces
+        the original dispatch error.)"""
+        cached = self._host_rows_cache
+        if cached is not None and cached[0] == snap.gen:
+            return cached[1], cached[2]
+        rows, sq = self.host_rows(snap)
         self._host_rows_cache = (snap.gen, rows, sq)
         return rows, sq
 
@@ -2139,6 +2191,52 @@ class TpuVectorIndex(VectorIndex):
         dists, inf-padded absent slots); selection is exact, so recall can
         only go UP while degraded — latency and throughput pay instead."""
         snap = self._read_snapshot()
+        if snap.n == 0 or snap.live == 0:
+            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
+            return (np.zeros((b, 0), np.uint64),
+                    np.zeros((b, 0), np.float32))
+        rows, row_sq = self._host_fallback_rows(snap)
+        return self._host_search_snap(snap, vectors, k, allow_list,
+                                      rows, row_sq)
+
+    def search_by_vectors_host_pinned(
+        self, snap: IndexSnapshot, vectors: np.ndarray, k: int,
+        allow_list: Optional[AllowList] = None,
+        rows: Optional[np.ndarray] = None,
+        sq_norms: Optional[np.ndarray] = None,
+        deadline: Optional[float] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """The quality auditor's host-plane entry (monitoring/quality.py):
+        exact brute-force kNN over a CALLER-PINNED snapshot — the exact
+        index state the audited live dispatch read, so deletes or
+        compression published in between cannot skew the comparison.
+        Bypasses _read_snapshot (no flush, no lock, no read-your-writes)
+        and the breaker's fallback cache (callers pass their own `rows`).
+        `deadline` (time.monotonic seconds) bounds the scan: row chunks
+        are checked against it and quality.AuditDeadlineExceeded aborts
+        an over-budget audit — audits are subordinate to everything."""
+        if snap.n == 0 or snap.live == 0:
+            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
+            return (np.zeros((b, 0), np.uint64),
+                    np.zeros((b, 0), np.float32))
+        if rows is None:
+            rows, sq_norms = self.host_rows(snap)
+        return self._host_search_snap(snap, vectors, k, allow_list,
+                                      rows, sq_norms, deadline)
+
+    # rows per host-scan chunk: bounds the work between deadline checks
+    # (and the [B, chunk, D] broadcast of the non-matmul metrics)
+    _HOST_SCAN_CHUNK = 65536
+
+    def _host_search_snap(
+        self, snap: IndexSnapshot, vectors: np.ndarray, k: int,
+        allow_list: Optional[AllowList], rows: np.ndarray,
+        row_sq: np.ndarray, deadline: Optional[float] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Shared exact host scan over a snapshot's materialized rows.
+        Distances stream in row chunks (output-column splits — bit-
+        identical to the one-shot matmul, since the reduction runs over
+        the full dim either way) with a deadline check per chunk."""
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
@@ -2150,7 +2248,6 @@ class TpuVectorIndex(VectorIndex):
             norms = np.linalg.norm(q, axis=1, keepdims=True)
             norms[norms == 0] = 1.0
             q = q / norms
-        rows, row_sq = self._host_fallback_rows(snap)
         live = ~snap.host_tombs[: snap.n]
         if allow_list is not None:
             from weaviate_tpu.storage.bitmap import Bitmap, allowed_mask
@@ -2164,27 +2261,30 @@ class TpuVectorIndex(VectorIndex):
         n_live = int(live.sum())
         if n_live == 0:
             return empty
-        if self.metric == vi.DISTANCE_L2:
-            qx = q @ rows.T
-            d = np.maximum(
-                (q ** 2).sum(1)[:, None] - 2.0 * qx + row_sq[None, :], 0.0)
-        elif self.metric == vi.DISTANCE_DOT:
-            d = -(q @ rows.T)
-        elif self.metric == vi.DISTANCE_COSINE:
-            d = 1.0 - q @ rows.T  # rows are insert-normalized
-        else:
-            # manhattan/hamming have no matmul form: stream row chunks so
-            # the [B, chunk, D] broadcast stays bounded
-            d = np.empty((b, snap.n), np.float32)
-            for s in range(0, snap.n, 4096):
-                blk = rows[s: s + 4096]
-                if self.metric == vi.DISTANCE_MANHATTAN:
-                    d[:, s: s + blk.shape[0]] = np.abs(
-                        q[:, None, :] - blk[None, :, :]).sum(-1)
-                else:  # hamming
-                    d[:, s: s + blk.shape[0]] = (
-                        q[:, None, :] != blk[None, :, :]).sum(-1)
-        d = d.astype(np.float32, copy=False)
+        q_sq = (q ** 2).sum(1)[:, None] if self.metric == vi.DISTANCE_L2 \
+            else None
+        d = np.empty((b, snap.n), np.float32)
+        chunk = 4096 if self.metric in (vi.DISTANCE_MANHATTAN,
+                                        vi.DISTANCE_HAMMING) \
+            else self._HOST_SCAN_CHUNK
+        for s in range(0, snap.n, chunk):
+            if deadline is not None and time.monotonic() > deadline:
+                raise quality.AuditDeadlineExceeded(
+                    f"host scan over audit budget at row {s}/{snap.n}")
+            blk = rows[s: s + chunk]
+            e = s + blk.shape[0]
+            if self.metric == vi.DISTANCE_L2:
+                qx = q @ blk.T
+                d[:, s:e] = np.maximum(
+                    q_sq - 2.0 * qx + row_sq[s:e][None, :], 0.0)
+            elif self.metric == vi.DISTANCE_DOT:
+                d[:, s:e] = -(q @ blk.T)
+            elif self.metric == vi.DISTANCE_COSINE:
+                d[:, s:e] = 1.0 - q @ blk.T  # rows are insert-normalized
+            elif self.metric == vi.DISTANCE_MANHATTAN:
+                d[:, s:e] = np.abs(q[:, None, :] - blk[None, :, :]).sum(-1)
+            else:  # hamming
+                d[:, s:e] = (q[:, None, :] != blk[None, :, :]).sum(-1)
         d[:, ~live] = np.inf
         kk = min(max(int(k), 1), n_live)
         part = np.argpartition(d, kk - 1, axis=1)[:, :kk]
@@ -2194,6 +2294,55 @@ class TpuVectorIndex(VectorIndex):
         top = np.take_along_axis(pd, order, axis=1)
         ids = np.where(np.isinf(top), -1, snap.slot_to_doc[idx])
         return ids.astype(np.uint64), top.astype(np.float32)
+
+    def health(self) -> dict:
+        """Per-index introspection for ``GET /debug/index`` (server/
+        rest.py): live/tombstone accounting, snapshot + staged generation
+        lag, PQ family state, host-fallback-cache residency. Lock-free by
+        design — fields are read racily and may be mutually one mutation
+        apart (introspection, not an invariant); nothing here touches the
+        device."""
+        snap = self._snap
+        n, live = self.n, self.live
+        tombs = max(n - live, 0)
+        cache = self._host_rows_cache
+        out = {
+            "type": "hnsw_tpu",
+            "metric": self.metric,
+            "dim": self.dim,
+            "capacity": self.capacity,
+            "slots": n,
+            "live": live,
+            "tombstones": tombs,
+            "tombstone_fraction": round(tombs / n, 4) if n > 0 else 0.0,
+            "pending_adds": len(self._pending),
+            "pending_tombstones": len(self._pending_tombs),
+            "snapshot_gen": snap.gen if snap is not None else 0,
+            "staged_gen": self._staged_gen,
+            "published_gen": self._published_gen,
+            # staged writes not yet visible to lock-free readers (the
+            # read-your-writes flush debt the next read pays)
+            "staged_lag": max(self._staged_gen - self._published_gen, 0),
+            "compressed": self.compressed,
+            "pq": None,
+            # a resident copy is a full f32 store materialization held for
+            # the breaker's fallback plane (or a recent degraded window)
+            "host_fallback_cache": {
+                "resident": cache is not None,
+                "gen": cache[0] if cache is not None else None,
+            },
+        }
+        pq = self._pq
+        if self.compressed and pq is not None:
+            out["pq"] = {
+                "segments": getattr(pq, "segments", None),
+                "centroids": getattr(pq, "centroids", None),
+                "rotation": bool(getattr(pq, "rotation", False)),
+                "rescore": bool(self.config.pq.rescore
+                                and self._rescore_dev is not None),
+                "code_dtype": str(getattr(pq, "code_dtype", "")),
+            }
+        return out
 
     def search_by_vector(
         self, vector: np.ndarray, k: int, allow_list: Optional[AllowList] = None
@@ -2314,9 +2463,20 @@ class TpuVectorIndex(VectorIndex):
             self._store = self._sq_norms = self._tombs = None
             self._slot_to_doc = np.zeros(0, dtype=np.int64)
             self._host_tombs = np.zeros(0, dtype=bool)
-            for d, v in zip(docs.tolist(), vecs):
-                self._stage_add(int(d), v, log=False)
-            self._flush_pending()
+            # suppress the declarative compress trigger for the rebuild:
+            # config.pq.enabled is true for ANY compressed index (compress
+            # sets it), so _flush_pending would otherwise re-FIT a fresh
+            # codebook mid-rebuild — changing the codes the re-encode
+            # below is contracted to preserve, and leaving _store None
+            # for it (the auditor's ground-truth parity test caught this)
+            prev_restoring = self._restoring
+            self._restoring = True
+            try:
+                for d, v in zip(docs.tolist(), vecs):
+                    self._stage_add(int(d), v, log=False)
+                self._flush_pending()
+            finally:
+                self._restoring = prev_restoring
             if was_compressed and self.n > 0:
                 fresh = np.asarray(self._store[: self.n], dtype=np.float32)  # graftlint: disable=JGL008 compact is a stop-the-world rebuild: the lock must cover it and the materialized store IS the rebuild's input
                 self._enable_pq(pq, fresh, save=False)

@@ -374,6 +374,48 @@ class Metrics:
             "requests refused at the front-door tenant concurrency gate "
             "(also counted per tenant/reason in the shed vecs)")
 
+        # online quality observability (monitoring/quality.py): the shadow
+        # recall auditor's rolling estimates + audit accounting. Tier label
+        # values come from the costmodel TIER_* enum (bounded; JGL010-
+        # clean); the auditor only touches these inside try/except.
+        self.recall_at_k = g(
+            "weaviate_recall_at_k",
+            "EWMA recall@k of shadow-audited live searches vs the exact "
+            "host plane, per dispatch tier (1.0 = every audited answer "
+            "was exact)", ("tier",))
+        self.distance_relerr = g(
+            "weaviate_distance_relerr",
+            "mean rank-aligned relative distance error of shadow-audited "
+            "live searches vs the exact host plane, per dispatch tier",
+            ("tier",))
+        self.quality_audits = c(
+            "weaviate_quality_audits_total",
+            "shadow recall audits by outcome (ok / shed = dropped under "
+            "the drop-not-queue budget / deadline = host scan over its "
+            "audit budget / error)", ("outcome",))
+        self.quality_audit_lag = h(
+            "weaviate_quality_audit_lag_ms",
+            "time between a sampled dispatch's finalize and its audit "
+            "completing (how stale the recall estimate runs)")
+        self.quality_degraded = c(
+            "weaviate_quality_degraded_total",
+            "degradation alerts: a tier's EWMA recall crossed below "
+            "RECALL_ALERT_THRESHOLD (one increment per transition; the "
+            "log line is rate-limited separately)", ("tier",))
+
+        # cheap always-on index health (stamped on the write path by
+        # index/tpu.py _update_index_gauges — independent of tracing and
+        # auditing, so /debug/index and /metrics report health even with
+        # both planes disabled)
+        self.vector_index_live = g(
+            "weaviate_vector_index_live_count", "live (non-tombstoned) "
+            "vectors per shard", ("class_name", "shard_name"))
+        self.index_tombstone_fraction = g(
+            "weaviate_index_tombstone_fraction",
+            "tombstoned fraction of the shard's occupied slots — creeping "
+            "growth after deletes is the compaction-debt signal",
+            ("class_name", "shard_name"))
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

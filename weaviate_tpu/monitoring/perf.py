@@ -29,6 +29,12 @@ Lifecycle mirrors the tracer (monitoring/tracing.py): a process-wide
 module global installed by App when TRACING_ENABLED is set, None
 otherwise — every serving-path entry point is then a one-comparison
 no-op and constructs nothing (spy-pinned in tests/test_perf.py).
+
+The QUALITY twin of this window lives in monitoring/quality.py: the
+shadow recall auditor measures what the serving path ANSWERS (recall,
+rank overlap, distance error at ``GET /debug/quality``) the way this
+window measures what it COSTS — same rolling-window idiom, same
+zero-cost-disabled lifecycle, same authorizer.
 """
 
 from __future__ import annotations

@@ -72,6 +72,26 @@ class App:
         else:
             self.tracer = None
             self.perf_window = None
+        # online quality observability (monitoring/quality.py): the shadow
+        # recall auditor is its own module global with the same lifecycle
+        # discipline as the tracer/perf window — sample rate 0 (the
+        # default) leaves the global None and every capture point on the
+        # serving path a one-comparison no-op that constructs nothing.
+        qc = self.config.quality
+        if qc.audit_sample_rate > 0.0:
+            from weaviate_tpu.monitoring import quality
+
+            self.quality_auditor = quality.configure(quality.QualityAuditor(
+                sample_rate=qc.audit_sample_rate,
+                concurrency=qc.audit_concurrency,
+                max_rows=qc.audit_max_rows,
+                deadline_ms=qc.audit_deadline_ms,
+                window_s=qc.window_s,
+                alert_threshold=qc.alert_threshold,
+                alert_min_samples=qc.alert_min_samples,
+                metrics=self.metrics))
+        else:
+            self.quality_auditor = None
         # a SIGTERM mid device-trace capture must still stop the JAX
         # profiler (the r05 wedge): install the signal/atexit teardown
         # from the main thread while we are likely on it — REST handler
@@ -319,6 +339,12 @@ class App:
             from weaviate_tpu.monitoring import perf
 
             perf.unconfigure(self.perf_window)
+        if self.quality_auditor is not None:
+            from weaviate_tpu.monitoring import quality
+
+            # same still-ours discipline; also stops the audit workers
+            # and stashes the final summary for the CI artifact dump
+            quality.unconfigure(self.quality_auditor)
         # robustness globals: same still-ours discipline as the tracer
         from weaviate_tpu.serving import robustness
 

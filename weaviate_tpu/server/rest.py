@@ -109,6 +109,16 @@ for _m, _p, _n in [
     # duty cycle, host-overhead ledger percentiles — same authorizer as
     # pprof (it names classes and exposes serving internals)
     ("GET", r"/debug/perf", "debug_perf"),
+    # shadow recall auditor window (monitoring/quality.py): online
+    # recall/RBO/distance-error estimates per tier + audit accounting —
+    # the quality twin of /debug/perf, same authorizer
+    ("GET", r"/debug/quality", "debug_quality"),
+    # per-index/shard health introspection (index/tpu.py health()):
+    # tombstone fractions, snapshot/staged generations, PQ state,
+    # cache residency — same authorizer (it names classes)
+    ("GET", r"/debug/index", "debug_index"),
+    # the debug surface's index page: every /debug endpoint, one line each
+    ("GET", r"/debug/?", "debug_root"),
     # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
     ("GET", r"/debug/pprof/?", "pprof_index"),
     ("GET", r"/debug/pprof/profile", "pprof_profile"),
@@ -212,6 +222,7 @@ class Handler(BaseHTTPRequestHandler):
     # reads of itself
     _UNTRACED = frozenset({
         "live", "ready", "openid", "metrics", "debug_traces", "debug_perf",
+        "debug_quality", "debug_index", "debug_root",
         "pprof_index", "pprof_profile", "pprof_trace", "pprof_goroutine",
         "pprof_heap", "pprof_cmdline",
     })
@@ -365,6 +376,50 @@ class Handler(BaseHTTPRequestHandler):
             self._reply(200, {"enabled": False})
             return
         self._reply(200, {"enabled": True, **w.summary()})
+
+    def h_debug_quality(self):
+        from weaviate_tpu.monitoring import quality
+
+        a = quality.get_auditor()
+        if a is None:
+            self._reply(200, {"enabled": False})
+            return
+        self._reply(200, {"enabled": True, **a.summary()})
+
+    def h_debug_index(self):
+        out = {}
+        # snapshot the live registries before iterating (db.py's own
+        # defensive idiom): concurrent class/shard creation must not 500
+        # a health endpoint with a dict-changed-size error
+        for cls, idx in list(self.app.db.indexes.items()):
+            out[cls] = {name: shard.debug_health()
+                        for name, shard in list(idx.shards.items())}
+        self._reply(200, {"indexes": out})
+
+    def h_debug_root(self):
+        """The /debug index page: every debug endpoint with a one-line
+        description (same authorizer as all of them)."""
+        self._reply(200, {"endpoints": {
+            "/debug/traces": "completed request traces ring (span trees "
+                             "with device-time attribution; "
+                             "TRACING_ENABLED)",
+            "/debug/perf": "rolling device-performance window: roofline, "
+                           "duty cycle, host-overhead ledger percentiles "
+                           "(rides TRACING_ENABLED)",
+            "/debug/quality": "shadow recall auditor window: online "
+                              "recall/RBO/distance-error per tier, audit "
+                              "accounting (RECALL_AUDIT_SAMPLE_RATE > 0)",
+            "/debug/index": "per-index/shard health: live/tombstone "
+                            "counts, snapshot + staged generations, PQ "
+                            "state, cache residency (always on)",
+            "/debug/pprof/": "profiling surface index",
+            "/debug/pprof/profile": "sampled CPU profile "
+                                    "(?seconds=N&hz=N)",
+            "/debug/pprof/trace": "JAX device trace capture (?seconds=N)",
+            "/debug/pprof/goroutine": "all-thread stack dump",
+            "/debug/pprof/heap": "heap allocation summary (?limit=N)",
+            "/debug/pprof/cmdline": "process command line",
+        }})
 
     # -- profiling (monitoring/profiling.py; pprof surface) ------------------
 
