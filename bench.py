@@ -727,8 +727,12 @@ def _grpc_e2e(rng, n=50_000):
     pool.shutdown(wait=False)
     client.close()
     srv.stop()
+    # the ledger's byte picture of the imported corpus (captured before
+    # shutdown unconfigures it): the insert row's capacity baseline
+    mem_block = (app.memory_ledger.bench_block()
+                 if getattr(app, "memory_ledger", None) is not None else None)
     app.shutdown()
-    return {
+    out = {
         "n": n, "batch": 256, "p50_ms": round(p50 * 1000, 1),
         "qps_e2e": round(256 / p50, 1),
         "qps_concurrent8": round(conc_qps, 1), "complete_replies": ok,
@@ -736,6 +740,9 @@ def _grpc_e2e(rng, n=50_000):
         "objs_per_s": round(n / import_s, 1),
         "raw_lane": raw_lane,
     }
+    if mem_block is not None:
+        out["memory"] = mem_block
+    return out
 
 
 # pre-run image of the matrix's LIVE (non-stale) rows, captured at the
@@ -1887,6 +1894,12 @@ def run_serving_bench(args, rng):
                     p: v.get("share_of_wall")
                     for p, v in ps.get("phases", {}).items()}
                 row["perf_tiers"] = ps.get("tiers")
+            if getattr(app, "memory_ledger", None) is not None:
+                # the byte ledger's compact block (monitoring/memory.py):
+                # device/host footprint, headroom, ingest rate, COW costs
+                # — the capacity baseline the ROADMAP item-1/2/3 sizing
+                # changes regress against
+                row["memory"] = app.memory_ledger.bench_block()
             log(f"  coalesce={'on' if coalesce_on else 'off'}: {row}")
             return row
         finally:

@@ -51,7 +51,8 @@ def pytest_sessionfinish(session, exitstatus):
 
     for env_key, module, doc_key in (
             ("PERF_SUMMARY_FILE", "perf", "windows"),
-            ("QUALITY_SUMMARY_FILE", "quality", "audits")):
+            ("QUALITY_SUMMARY_FILE", "quality", "audits"),
+            ("MEMORY_SUMMARY_FILE", "memory", "ledgers")):
         path = os.environ.get(env_key)
         if not path:
             continue

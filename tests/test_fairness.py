@@ -341,8 +341,17 @@ def test_tenant_tag_propagates_rest_to_trace_to_slow_log(tmp_path, caplog):
         spans = list(walk(mine[-1]["root"]))
         assert any(s.get("attrs", {}).get("tenant") == "tenant-42"
                    for s in spans)
-        lines = [r.getMessage() for r in caplog.records
-                 if r.name == "weaviate_tpu.slowquery"]
+        # the slow log is emitted by Tracer.finish on the HANDLER thread
+        # AFTER the ring append (and possibly after the response was
+        # read), so the record can trail the snapshot() above — poll
+        # briefly instead of racing it
+        deadline = time.monotonic() + 5.0
+        lines: list = []
+        while not lines and time.monotonic() < deadline:
+            lines = [r.getMessage() for r in caplog.records
+                     if r.name == "weaviate_tpu.slowquery"]
+            if not lines:
+                time.sleep(0.02)
         assert lines
         docs = [json.loads(ln) for ln in lines]
         assert any(d["root"].get("attrs", {}).get("tenant") == "tenant-42"

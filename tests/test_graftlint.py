@@ -1007,3 +1007,79 @@ def test_partial_target_does_not_report_out_of_scope_entries_stale(tmp_path):
     r = _cli(str(ops), "--root", str(tmp_path),
              "--baseline", str(base), "--strict-baseline")
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- JGL012: unaccounted HBM allocation ---------------------------------------
+
+INDEX = "weaviate_tpu/index/fake_index.py"  # inside the JGL012 scope
+
+
+def test_jgl012_device_alloc_without_stamp_fires():
+    src = (
+        "import jax, jax.numpy as jnp\n"
+        "class Idx:\n"
+        "    def _grow(self, cap):\n"
+        "        self._store = jax.device_put(jnp.zeros((cap, 8)))\n"
+        "        self._tombs = _grow_1d(self._tombs, cap, False)\n"
+    )
+    assert codes(src, INDEX).count("JGL012") == 2
+
+
+def test_jgl012_stamped_method_and_publish_pass():
+    src = (
+        "import jax, jax.numpy as jnp\n"
+        "class Idx:\n"
+        "    def _grow(self, cap):\n"
+        "        self._store = jax.device_put(jnp.zeros((cap, 8)))\n"
+        "        self._stamp_memory()\n"
+        "    def _flush(self):\n"
+        "        self._tombs = _set_tombs(self._tombs)\n"
+        "        self._publish_snapshot()\n"
+    )
+    assert "JGL012" not in codes(src, INDEX)
+
+
+def test_jgl012_tuple_target_and_none_teardown():
+    src = (
+        "class Idx:\n"
+        "    def _write(self, c):\n"
+        "        self._store, self._sq_norms = mesh_insert_step(c)\n"
+        "    def drop(self):\n"
+        "        self._store = self._sq_norms = None\n"  # constant: exempt
+    )
+    assert codes(src, INDEX).count("JGL012") == 2
+
+
+def test_jgl012_out_of_scope_and_non_snapshot_fields_pass():
+    src = (
+        "import jax, jax.numpy as jnp\n"
+        "class Idx:\n"
+        "    def _grow(self, cap):\n"
+        "        self._store = jax.device_put(jnp.zeros((cap, 8)))\n"
+        "        self._scratch = jnp.zeros((cap,))\n"  # not a snapshot field
+    )
+    # ops/ is outside the index scope: no findings at all
+    assert "JGL012" not in codes(src, HOT)
+    # in scope, only the snapshot field fires
+    assert codes(src, INDEX).count("JGL012") == 1
+
+
+def test_jgl012_repo_index_layer_is_clean():
+    import subprocess as _sp
+
+    r = _sp.run([sys.executable, "-m", "tools.graftlint",
+                 "weaviate_tpu/index"], capture_output=True, text=True,
+                cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_jgl012_annotated_assignment_fires_too():
+    src = (
+        "import jax, jax.numpy as jnp\n"
+        "class Idx:\n"
+        "    def _grow(self, cap):\n"
+        "        self._store: jax.Array = jax.device_put(jnp.zeros((cap,)))\n"
+    )
+    assert codes(src, INDEX).count("JGL012") == 1
+    stamped = src + "        self._stamp_memory()\n"
+    assert "JGL012" not in codes(stamped, INDEX)

@@ -31,6 +31,11 @@ if [ -n "$art" ]; then
     # windows) — the online recall/RBO/distance-error picture of every
     # audited App the suite ran
     export QUALITY_SUMMARY_FILE="${QUALITY_SUMMARY_FILE:-$art/debug_quality.json}"
+    # ...and the memory-ledger summaries (monitoring/memory.py final-
+    # summary stash, dumped by conftest.py alongside the perf/quality
+    # windows) — the device/host/disk byte picture + exhaustion forecast
+    # of every App the suite ran
+    export MEMORY_SUMMARY_FILE="${MEMORY_SUMMARY_FILE:-$art/debug_memory.json}"
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="

@@ -31,6 +31,11 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
           surprise exception then kills the daemon silently; a dead
           audit thread reads as recall=perfect, a dead flusher as an
           empty queue
+  JGL012  unaccounted HBM allocation (a call result — jnp.asarray /
+          jax.device_put / a kernel output — bound to a snapshot/slab
+          field in index/ from a method that never stamps the memory
+          ledger) — buffers the ledger cannot see make /debug/memory's
+          exhaustion forecast a lie
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
@@ -178,6 +183,12 @@ RULE_DOCS = {
               "(a dead audit thread reads as recall=perfect); wrap the "
               "loop body in try/except (log + continue) or the loop in a "
               "guarded supervisor",
+    "JGL012": "unaccounted HBM allocation — a device-buffer-creating call "
+              "bound to a snapshot/slab field must flow through the "
+              "ledger-registered builder: the enclosing method must call "
+              "_stamp_memory()/_publish_snapshot() (monitoring/memory.py) "
+              "so /debug/memory's bytes and exhaustion forecast stay "
+              "truthful, or carry a justified suppression",
     "JGL999": "file does not parse",
 }
 
@@ -190,6 +201,23 @@ JGL010_PREFIXES = ("weaviate_tpu/",)
 # layer (monitors, compaction cycles, gossip, the coalescer flusher, the
 # quality audit workers), and any of them dying silently inverts a signal
 JGL011_PREFIXES = ("weaviate_tpu/",)
+
+# JGL012 scope: the index layer, where HBM-resident snapshot/slab buffers
+# are born — an allocation bound to one of these fields from a method
+# that never stamps the memory ledger is a byte the capacity forecast
+# cannot see (an unaccounted buffer reads as headroom that isn't there)
+JGL012_PREFIXES = ("weaviate_tpu/index/",)
+
+# the snapshot/slab fields that hold device buffers (index/tpu.py
+# IndexSnapshot fields + index/mesh.py slab fields)
+SNAPSHOT_FIELDS = frozenset({
+    "_store", "_sq_norms", "_tombs", "_codes", "_recon_norms",
+    "_rescore_dev", "_rescore_sq_norms", "_zero_words",
+})
+
+# calls that route an allocation through the ledger: the per-class
+# stamping hook, or snapshot publication (which stamps as its last step)
+LEDGER_STAMP_CALLS = frozenset({"_stamp_memory", "_publish_snapshot"})
 
 
 def in_metric_label_scope(rel_path: str) -> bool:
@@ -204,6 +232,13 @@ def in_thread_runloop_scope(rel_path: str) -> bool:
     rp = rel_path.replace("\\", "/")
     return any(rp == p or rp.startswith(p) or f"/{p}" in rp
                for p in JGL011_PREFIXES)
+
+
+def in_snapshot_ledger_scope(rel_path: str) -> bool:
+    """JGL012 scope check (same interior-boundary matching as is_hot)."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL012_PREFIXES)
 
 
 def in_span_scope(rel_path: str) -> bool:
@@ -360,7 +395,11 @@ class RuleWalker(ast.NodeVisitor):
         self.unbounded_wait_scope = in_unbounded_wait_scope(rel_path)
         self.metric_label_scope = in_metric_label_scope(rel_path)
         self.thread_runloop_scope = in_thread_runloop_scope(rel_path)
+        self.snapshot_ledger_scope = in_snapshot_ledger_scope(rel_path)
         self.mod = mod
+        # JGL012 state: per enclosing function, does it lexically call a
+        # ledger stamping hook (_stamp_memory / _publish_snapshot)?
+        self._stamp_fns: list[bool] = []
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
         self.fn_depth = 0
@@ -427,6 +466,7 @@ class RuleWalker(ast.NodeVisitor):
             self.visit(default)
         self.scope.append(node.name)
         self._check_thread_runloop(node)
+        self._stamp_fns.append(self._fn_calls_stamp(node))
         self.fn_depth += 1
         jitted = _jit_decorated(node)
         if jitted:
@@ -453,6 +493,7 @@ class RuleWalker(ast.NodeVisitor):
         if jitted:
             self.jit_depth -= 1
         self.fn_depth -= 1
+        self._stamp_fns.pop()
         self.scope.pop()
 
     visit_FunctionDef = _visit_fn
@@ -810,8 +851,64 @@ class RuleWalker(ast.NodeVisitor):
             for t in node.targets:
                 self._check_leak_target(t)
         self._check_registry_mutation_target(node)
+        self._check_unledgered_alloc(node)
         self._track_assign(node)
         self.generic_visit(node)
+
+    # -- JGL012: unaccounted HBM allocation --
+
+    @staticmethod
+    def _fn_calls_stamp(fn) -> bool:
+        """Does this function lexically call a ledger stamping hook?
+        A stamp in a nested closure still counts (the closure runs as
+        part of the method's mutation flow) — approximate on purpose."""
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    dotted(f) or "").split(".")[-1]
+                if name in LEDGER_STAMP_CALLS:
+                    return True
+        return False
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        """Annotated assignments bind values too: `self._store: Array =
+        device_put(...)` must not escape the JGL012 audit."""
+        if node.value is not None:
+            self._check_unledgered_alloc(node)
+        self.generic_visit(node)
+
+    def _check_unledgered_alloc(self, node) -> None:
+        """A call result (jnp.asarray / jax.device_put / a write-kernel
+        output — any Call: kernels are calls) bound to a snapshot/slab
+        field must come from a method that stamps the memory ledger;
+        otherwise the allocation is HBM the capacity forecast cannot
+        see. Constants (field = None teardown) are exempt."""
+        if not self.snapshot_ledger_scope or self.fn_depth == 0:
+            return
+        if not isinstance(node.value, ast.Call):
+            return
+        if self._stamp_fns and self._stamp_fns[-1]:
+            return
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        flat: list = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                flat.extend(t.elts)
+            else:
+                flat.append(t)
+        for t in flat:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self" and t.attr in SNAPSHOT_FIELDS:
+                self.emit(
+                    "JGL012", t,
+                    f"device buffer bound to snapshot field `self.{t.attr}` "
+                    "in a method that never stamps the memory ledger — an "
+                    "unaccounted HBM allocation makes /debug/memory's "
+                    "headroom and exhaustion forecast lie; call "
+                    "self._stamp_memory() (or publish a snapshot) in this "
+                    "method, or suppress with a written justification")
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         if self.jit_depth:

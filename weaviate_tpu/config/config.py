@@ -236,6 +236,29 @@ class QualityConfig:
 
 
 @dataclass
+class MemoryLedgerConfig:
+    """Memory & capacity observability (monitoring/memory.py). TPU
+    extension: an always-on device/host/disk byte ledger stamped
+    analytically at every index-snapshot publish (zero device syncs),
+    write-path lifecycle instrumentation, and a time-to-exhaustion
+    forecast with fire-once headroom alerts at ``GET /debug/memory``.
+    Disabled => no ledger object anywhere on the write path (the module
+    global stays None; every stamping entry point is a one-comparison
+    no-op)."""
+
+    ledger_enabled: bool = True
+    # rolling window for write-phase percentiles / COW peaks / forecast
+    window_s: float = 300.0
+    # headroom percentage below which a scope fires its exhaustion alert
+    headroom_alert_pct: float = 10.0
+    # per-device HBM budget override; 0 = autodetect from the backend's
+    # memory_stats()['bytes_limit'] (0 when the backend reports none)
+    device_budget_bytes: int = 0
+    # host RAM budget override; 0 = autodetect from /proc/meminfo MemTotal
+    host_budget_bytes: int = 0
+
+
+@dataclass
 class TenancyConfig:
     """Multi-tenant fairness (serving/coalescer.py weighted-fair
     admission + monitoring/metrics.py bounded tenant labels). TPU
@@ -326,6 +349,7 @@ class Config:
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     quality: QualityConfig = field(default_factory=QualityConfig)
+    memory: MemoryLedgerConfig = field(default_factory=MemoryLedgerConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -401,6 +425,14 @@ class Config:
             raise ConfigError("RECALL_ALERT_THRESHOLD must be in [0, 1]")
         if self.quality.alert_min_samples < 1:
             raise ConfigError("RECALL_ALERT_MIN_SAMPLES must be >= 1")
+        if self.memory.window_s <= 0:
+            raise ConfigError("MEMORY_LEDGER_WINDOW_S must be > 0")
+        if not (0.0 <= self.memory.headroom_alert_pct <= 100.0):
+            raise ConfigError("MEMORY_HEADROOM_ALERT_PCT must be 0..100")
+        if self.memory.device_budget_bytes < 0:
+            raise ConfigError("MEMORY_DEVICE_BUDGET_BYTES must be >= 0")
+        if self.memory.host_budget_bytes < 0:
+            raise ConfigError("MEMORY_HOST_BUDGET_BYTES must be >= 0")
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -515,6 +547,14 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.quality.window_s = _float(e, "QUALITY_WINDOW_S", 300.0)
     cfg.quality.alert_threshold = _float(e, "RECALL_ALERT_THRESHOLD", 0.95)
     cfg.quality.alert_min_samples = _int(e, "RECALL_ALERT_MIN_SAMPLES", 20)
+
+    cfg.memory.ledger_enabled = _bool(e, "MEMORY_LEDGER_ENABLED", True)
+    cfg.memory.window_s = _float(e, "MEMORY_LEDGER_WINDOW_S", 300.0)
+    cfg.memory.headroom_alert_pct = _float(
+        e, "MEMORY_HEADROOM_ALERT_PCT", 10.0)
+    cfg.memory.device_budget_bytes = _int(
+        e, "MEMORY_DEVICE_BUDGET_BYTES", 0)
+    cfg.memory.host_budget_bytes = _int(e, "MEMORY_HOST_BUDGET_BYTES", 0)
 
     cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
     cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)

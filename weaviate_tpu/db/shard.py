@@ -32,7 +32,7 @@ from weaviate_tpu.entities.filters import GeoRange, LocalFilter
 from weaviate_tpu.entities.schema import ClassDef, DataType
 from weaviate_tpu.entities.storobj import StorObj
 from weaviate_tpu.index import new_vector_index
-from weaviate_tpu.monitoring import perf, quality, tracing
+from weaviate_tpu.monitoring import memory, perf, quality, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 # request-lifecycle robustness (stdlib-only module — no import cycle even
 # though serving/coalescer.py imports this file): deadline fail-fast +
@@ -193,6 +193,12 @@ class Shard:
         self._write_gen = 0
         self._allow_cache: dict[str, tuple[int, Bitmap, str]] = {}
         self._lock = threading.RLock()
+        # memory providers (monitoring/memory.py): the allowList cache's
+        # host byte weight and the packed device filter words cached on
+        # its bitmaps become /debug/memory components, sized by the same
+        # helpers debug_health() reports
+        memory.register_host_provider(self, memory.shard_host_components)
+        memory.register_device_provider(self, memory.shard_device_components)
 
     # -- geo props (propertyspecific/ + vector/geo) --------------------------
 
@@ -1036,8 +1042,18 @@ class Shard:
         out = {
             "objects": self.object_count(),
             "status": self.status,
+            # byte sizes come from the ledger's shared sizing helpers
+            # (monitoring/memory.py) — the SAME functions /debug/memory's
+            # host providers call, so the two endpoints can never disagree
             "allow_cache": {"entries": len(self._allow_cache),
-                            "capacity": self._ALLOW_CACHE_CAP},
+                            "capacity": self._ALLOW_CACHE_CAP,
+                            "bytes": memory.allow_cache_bytes(self),
+                            "device_words_bytes":
+                                memory.allow_words_device_bytes(self)},
+            "host_fallback_cache_bytes": memory.host_rows_cache_bytes(
+                self.vector_index),
+            "auditor_rows_bytes": memory.auditor_rows_bytes(
+                quality.get_auditor(), self.vector_index),
         }
         vh = getattr(self.vector_index, "health", None)
         out["vector_index"] = vh() if vh is not None else {

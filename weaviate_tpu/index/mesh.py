@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -46,6 +47,10 @@ import numpy as np
 from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.interface import AllowList, VectorIndex
 from weaviate_tpu.index.tpu import VectorLog, _bucket_b, _bucket_rows
+# memory ledger (monitoring/memory.py): per-device slab components are
+# stamped analytically at every buffer mutation; unconfigured => one
+# comparison, nothing constructed
+from weaviate_tpu.monitoring import memory
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.parallel.mesh_search import (
     _MESH_SCAN_CHUNK,
@@ -143,6 +148,9 @@ class MeshVectorIndex(VectorIndex):
         self._pqg_cb = None
         self._gmin_validated: set = set()     # shapes that served correctly
         self._gmin_shape_broken: set = set()  # shapes Mosaic rejected
+        # host-memory provider (monitoring/memory.py): slot map, PQ host
+        # rows, and staged rows become /debug/memory host components
+        memory.register_host_provider(self, memory.index_host_components)
         self._log = (
             VectorLog(os.path.join(shard_path, "vector.log")) if persist else None
         )
@@ -182,6 +190,32 @@ class MeshVectorIndex(VectorIndex):
     def post_startup(self) -> None:
         self._flush_pending()
 
+    # -- memory ledger stamping (monitoring/memory.py) -----------------------
+
+    def _memory_components(self) -> dict:
+        """Analytic byte sizes of the mesh slab buffers (global totals of
+        the sharded arrays; the ledger divides by ``ndev`` for per-chip
+        headroom). Zero syncs; equals the arrays' ``nbytes`` exactly."""
+        comps: dict = {}
+        for name, arr in (("store", self._store),
+                          ("sq_norms", self._sq_norms),
+                          ("tombs", self._tombs),
+                          ("pq_codes", self._codes),
+                          ("recon_norms", self._recon_norms),
+                          ("allow_words", self._zero_words)):
+            b = memory.array_bytes(arr)
+            if b:
+                comps[name] = b
+        return comps
+
+    def _stamp_memory(self) -> None:
+        """The JGL012-registered stamping hook: every method that binds a
+        device buffer to a slab field flows through here."""
+        led = memory.get_ledger()
+        if led is not None:
+            led.stamp_device(self, self._memory_components(),
+                             ndev=self.n_dev)
+
     # -- device plumbing -----------------------------------------------------
 
     def _init_device(self, dim: int) -> None:
@@ -202,6 +236,7 @@ class MeshVectorIndex(VectorIndex):
                 jnp.zeros((cap, self._pq.segments), self._pq.code_dtype), sh2)
             self._recon_norms = jax.device_put(jnp.zeros((cap,), jnp.float32), sh1)
             self._host_vecs = np.zeros((cap, dim), np.float32)
+        self._stamp_memory()
 
     def _grow(self, needed_per_shard: int) -> None:
         new_loc = self.n_loc
@@ -241,6 +276,12 @@ class MeshVectorIndex(VectorIndex):
             (r // old_loc) * new_loc + (r % old_loc) for r in self._pending_tombs
         ]
         self.n_loc = new_loc
+        led = memory.get_ledger()
+        if led is not None:
+            led.note_write_shape(
+                ("mesh_grow", self.n_dev, new_loc, self.dim or 0,
+                 self.compressed))
+        self._stamp_memory()
 
     # -- staging -------------------------------------------------------------
 
@@ -342,18 +383,32 @@ class MeshVectorIndex(VectorIndex):
         return out
 
     def _flush_pending(self) -> None:
+        led = memory.get_ledger()
         if self._pending:
+            t0 = time.perf_counter()
             rows = np.stack(list(self._pending.values()))
             docs = np.array(list(self._pending.keys()), dtype=np.int64)
             self._write_balanced(docs, rows)
             self._pending.clear()
+            if led is not None:
+                led.note_write(
+                    "add", "flush", (time.perf_counter() - t0) * 1000.0,
+                    rows=rows.shape[0],
+                    bytes_moved=rows.shape[0] * (self.dim or 0) * 4)
         if self._pending_tombs:
+            t0 = time.perf_counter()
             idx = np.array(self._pending_tombs, dtype=np.int32)
             pad = _bucket_rows(len(idx))
             padded = np.full(pad, -1, dtype=np.int32)
             padded[: len(idx)] = idx
             self._tombs = mesh_delete_step(self._tombs, jnp.asarray(padded), self.mesh)
+            if led is not None:
+                led.note_write(
+                    "delete", "apply_tombstones",
+                    (time.perf_counter() - t0) * 1000.0,
+                    rows=len(self._pending_tombs))
             self._pending_tombs.clear()
+            self._stamp_memory()
         # declarative pq.enabled compresses once enough data exists to fit
         # codebooks (same trigger as the single-chip index)
         if (
@@ -447,6 +502,7 @@ class MeshVectorIndex(VectorIndex):
                 if self.compressed:
                     self._host_vecs[grows] = rows[taken[s]]
                 self._counts[s] += take
+        self._stamp_memory()
 
     # -- product quantization (mesh twin of index/tpu.py compression) --------
 
@@ -491,6 +547,7 @@ class MeshVectorIndex(VectorIndex):
         memory move, mesh-shaped); the full-precision rows move to host RAM
         so compact()'s log rewrite never re-persists bf16-rounded data
         (tpu.py _host_vecs parity)."""
+        t0 = time.perf_counter()
         codes = pq.encode(host)                       # [cap, M]
         norms = pq.recon_sq_norms(codes).astype(np.float32)
         self._pq = pq
@@ -506,6 +563,12 @@ class MeshVectorIndex(VectorIndex):
         self.compressed = True
         if save and self._pq_path:
             pq.save(self._pq_path)
+        led = memory.get_ledger()
+        if led is not None:
+            led.note_write(
+                "compress", "compress", (time.perf_counter() - t0) * 1000.0,
+                rows=self.live, bytes_moved=memory.array_bytes(self._codes))
+        self._stamp_memory()
 
     # -- VectorIndex ---------------------------------------------------------
 
@@ -871,6 +934,7 @@ class MeshVectorIndex(VectorIndex):
             total = int(self._counts.sum())
             if len(self._doc_to_row) == total:
                 return
+            t_compact0 = time.perf_counter()
             rows = np.array(sorted(self._doc_to_row.values()), dtype=np.int64)
             docs = self._slot_to_doc[rows]
             # compressed mode rewrites the log from the f32 host copy — the
@@ -896,6 +960,12 @@ class MeshVectorIndex(VectorIndex):
                 self.add_batch(docs, store_host)
             finally:
                 self._restoring = False
+            led = memory.get_ledger()
+            if led is not None:
+                led.note_write(
+                    "compact", "compact",
+                    (time.perf_counter() - t_compact0) * 1000.0,
+                    rows=self.live)
 
     def drop(self) -> None:
         with self._lock:
@@ -907,6 +977,7 @@ class MeshVectorIndex(VectorIndex):
                     pass
                 self._log = None
             self._store = self._sq_norms = self._tombs = None
+            self._zero_words = None  # sharded device words must free too
             self._codes = self._recon_norms = None
             self._host_vecs = None
             self._pq = None
@@ -924,6 +995,7 @@ class MeshVectorIndex(VectorIndex):
             self._doc_to_row.clear()
             self._pending.clear()
             self._pending_tombs.clear()
+            self._stamp_memory()  # zero this index's device components
 
     def shutdown(self) -> None:
         with self._lock:

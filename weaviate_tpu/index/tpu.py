@@ -58,6 +58,11 @@ from weaviate_tpu.index.interface import AllowList, VectorIndex
 # costmodel.DispatchShape is built per dispatch ONLY while the tracer is
 # up (tracing.get_tracer() gate — the zero-cost-when-disabled contract)
 from weaviate_tpu.monitoring import costmodel, tracing
+# memory ledger (monitoring/memory.py): device components are stamped
+# analytically (shapes x dtypes, zero syncs) at snapshot publish and at
+# every buffer-mutating method; unconfigured => one comparison, nothing
+# constructed. Search dispatches never touch it.
+from weaviate_tpu.monitoring import memory
 # shadow recall auditing (monitoring/quality.py): the dispatch snapshot is
 # pinned in TLS ONLY while an auditor is configured (one comparison,
 # nothing constructed — the tracer's zero-cost contract), so the audit
@@ -999,6 +1004,9 @@ class TpuVectorIndex(VectorIndex):
         self._snap_gen = 0
         self._staged_gen = 0
         self._published_gen = -1
+        # monotonic stamp of the OLDEST staged-but-unpublished mutation
+        # (ledger staged-publish lag; None = nothing staged / ledger off)
+        self._staged_t0: Optional[float] = None
         self._read_local = threading.local()  # per-thread last lock wait
         self._inflight = 0                    # dispatches between enqueue
         self._inflight_lock = threading.Lock()  # ...and finalize
@@ -1047,6 +1055,11 @@ class TpuVectorIndex(VectorIndex):
         # small-shape success must not vouch for a larger VMEM footprint
         self._gmin_validated: set = set()
         self._gmin_shape_broken: set = set()  # keys Mosaic rejected
+        # host-memory provider (monitoring/memory.py): the slot/tombstone
+        # mirrors, PQ host rows, staged rows, and the breaker's fallback
+        # cache become /debug/memory host components. Weakref-held — the
+        # registry never outlives the index.
+        memory.register_host_provider(self, memory.index_host_components)
         self._log = VectorLog(os.path.join(shard_path, "vector.log")) if persist else None
         if self._log is not None:
             self._restore()
@@ -1110,6 +1123,7 @@ class TpuVectorIndex(VectorIndex):
         self._tombs = jax.device_put(jnp.zeros((self.capacity,), jnp.bool_), dev)
         self._slot_to_doc = np.full(self.capacity, -1, dtype=np.int64)
         self._host_tombs = np.zeros(self.capacity, dtype=bool)
+        self._stamp_memory()
 
     def _ensure_capacity(self, needed: int) -> None:
         if self._store is None and self._codes is None:
@@ -1141,6 +1155,11 @@ class TpuVectorIndex(VectorIndex):
             ht[: self.capacity] = self._host_tombs
             self._host_tombs = ht
             self.capacity = cap
+            led = memory.get_ledger()
+            if led is not None:
+                led.note_write_shape(
+                    ("grow", cap, self.dim or 0, self.compressed))
+            self._stamp_memory()
 
     def _write_block(self, rows: np.ndarray, start: int) -> None:
         """Land [count, D] float32 rows at slots [start, start+count) in
@@ -1182,6 +1201,11 @@ class TpuVectorIndex(VectorIndex):
             off += take
         if self.compressed:
             self._host_vecs[start : start + count] = rows
+        led = memory.get_ledger()
+        if led is not None:
+            led.note_write_shape(
+                ("write_rows", self.capacity, self.dim, self.compressed))
+        self._stamp_memory()
 
     def _stage_add(self, doc_id: int, vector: np.ndarray, log: bool = True) -> None:
         vector = np.asarray(vector, dtype=np.float32)
@@ -1196,6 +1220,7 @@ class TpuVectorIndex(VectorIndex):
         # gen bump AFTER validation: a rejected add must not dirty the
         # published snapshot and push the next reader onto the locked path
         self._staged_gen += 1
+        self._mark_staged()
         old = self._doc_to_slot.pop(doc_id, None)
         if old is not None:
             self._pending_tombs.append(old)
@@ -1247,6 +1272,7 @@ class TpuVectorIndex(VectorIndex):
         self._flush_pending()  # earlier staged singles keep their slots
         count = len(ids64)
         self._staged_gen += 1
+        self._mark_staged()
         self._ensure_capacity(self.n + count)
         self._cow_host_state()
         self._write_block(np.ascontiguousarray(vecs), self.n)
@@ -1264,12 +1290,14 @@ class TpuVectorIndex(VectorIndex):
                 del self._pending[doc_id]
                 self.live -= 1
                 self._staged_gen += 1
+                self._mark_staged()
                 if log and self._log is not None:
                     self._log.append_delete(doc_id)
             return
         self._pending_tombs.append(slot)
         self.live -= 1
         self._staged_gen += 1
+        self._mark_staged()
         if log and self._log is not None:
             self._log.append_delete(doc_id)
 
@@ -1279,13 +1307,21 @@ class TpuVectorIndex(VectorIndex):
         snap = self._snap
         if snap is None:
             return
+        copied = 0
         if snap.slot_to_doc is self._slot_to_doc:
             self._slot_to_doc = self._slot_to_doc.copy()
+            copied += int(self._slot_to_doc.nbytes)
         if snap.host_tombs is self._host_tombs:
             self._host_tombs = self._host_tombs.copy()
+            copied += int(self._host_tombs.nbytes)
+        if copied:
+            led = memory.get_ledger()
+            if led is not None:
+                led.note_cow(copied)
 
     def _flush_pending(self) -> None:
         flushed = bool(self._pending or self._pending_tombs)
+        led = memory.get_ledger()
         if flushed:
             self._cow_host_state()
         if self._pending:
@@ -1294,6 +1330,11 @@ class TpuVectorIndex(VectorIndex):
             docs = np.array(list(self._pending.keys()), dtype=np.int64)
             count = rows.shape[0]
             self._ensure_capacity(self.n + count)
+            if led is not None:
+                # the non-donating write pass transiently holds BOTH the
+                # old and new buffer generations (the snapshot-isolation
+                # trade) — record the per-flush peak
+                led.note_cow(0, transient_peak=self._write_transient_bytes())
             # chunked writes pad the tail; capacity is padded in _CHUNK
             # multiples beyond need so padding only lands in unused slots
             self._write_block(rows, self.n)
@@ -1303,6 +1344,10 @@ class TpuVectorIndex(VectorIndex):
             self.n += count
             self._pending.clear()
             self._obs_index("add", "flush", t0, ops=count)
+            if led is not None:
+                led.note_write(
+                    "add", "flush", (time.perf_counter() - t0) * 1000.0,
+                    rows=count, bytes_moved=count * (self.dim or 0) * 4)
         if self._pending_tombs:
             t0 = time.perf_counter()
             idx = np.array(self._pending_tombs, dtype=np.int32)
@@ -1313,6 +1358,12 @@ class TpuVectorIndex(VectorIndex):
             self._host_tombs[idx] = True
             self._obs_index("delete", "apply_tombstones", t0,
                             ops=len(self._pending_tombs))
+            if led is not None:
+                led.note_write(
+                    "delete", "apply_tombstones",
+                    (time.perf_counter() - t0) * 1000.0,
+                    rows=len(self._pending_tombs))
+                led.note_write_shape(("set_tombstones", self.capacity, pad))
             self._pending_tombs.clear()
         if flushed:
             # gauges refresh only when state changed: _flush_pending runs at
@@ -1350,6 +1401,53 @@ class TpuVectorIndex(VectorIndex):
                     "declared pq config is invalid (%s); auto-disabling "
                     "compression for this index", e)
 
+    # -- memory ledger stamping (monitoring/memory.py) -----------------------
+
+    def _memory_components(self) -> dict:
+        """Analytic byte sizes of every device buffer this index holds —
+        shapes x dtypes only (zero syncs); each value equals the buffer's
+        ``nbytes`` exactly. The bounded component names are the
+        memory.DEVICE_COMPONENTS taxonomy."""
+        comps: dict = {}
+        for name, arr in (("store", self._store),
+                          ("sq_norms", self._sq_norms),
+                          ("tombs", self._tombs),
+                          ("pq_codes", self._codes),
+                          ("recon_norms", self._recon_norms),
+                          ("rescore_store", self._rescore_dev),
+                          ("rescore_sq_norms", self._rescore_sq_norms)):
+            b = memory.array_bytes(arr)
+            if b:
+                comps[name] = b
+        return comps
+
+    def _stamp_memory(self) -> None:
+        """Stamp the ledger with this index's current device components
+        (the JGL012-registered snapshot-builder hook: every method that
+        binds a device buffer to a snapshot field flows through here or
+        through _publish_snapshot). One comparison when unconfigured."""
+        led = memory.get_ledger()
+        if led is not None:
+            led.stamp_device(self, self._memory_components())
+
+    def _mark_staged(self) -> None:
+        """Record the first staged-but-unpublished mutation's time so
+        publication can report the staged-generation lag."""
+        if self._staged_t0 is None and memory.get_ledger() is not None:
+            self._staged_t0 = time.perf_counter()
+
+    def _write_transient_bytes(self) -> int:
+        """Device bytes transiently DOUBLED by one non-donating write
+        pass: the replaced buffer generations stay alive (pinned by
+        snapshots / the functional update) while the new ones build."""
+        if self.compressed:
+            return (memory.array_bytes(self._codes)
+                    + memory.array_bytes(self._recon_norms)
+                    + memory.array_bytes(self._rescore_dev)
+                    + memory.array_bytes(self._rescore_sq_norms))
+        return (memory.array_bytes(self._store)
+                + memory.array_bytes(self._sq_norms))
+
     # -- snapshot publication / lock-free reads ------------------------------
 
     def _publish_snapshot(self) -> None:
@@ -1364,6 +1462,12 @@ class TpuVectorIndex(VectorIndex):
         if m is not None:
             cls, shard = self._metric_labels()
             m.index_snapshot_gen.labels(cls, shard).set(self._snap_gen)
+        self._stamp_memory()
+        led = memory.get_ledger()
+        if led is not None and self._staged_t0 is not None:
+            led.note_publish(
+                (time.perf_counter() - self._staged_t0) * 1000.0)
+        self._staged_t0 = None
 
     def _read_snapshot(self) -> IndexSnapshot:
         """The snapshot a search dispatches on. Fast path: one reference
@@ -1455,6 +1559,7 @@ class TpuVectorIndex(VectorIndex):
         self._enable_pq(pq, vecs, save=True)
 
     def _enable_pq(self, pq, vecs_n: np.ndarray, save: bool) -> None:
+        t0 = time.perf_counter()
         codes = pq.encode(vecs_n)  # [n, M]
         full = np.zeros((self.capacity, pq.segments), dtype=pq.code_dtype)
         full[: self.n] = codes
@@ -1498,6 +1603,12 @@ class TpuVectorIndex(VectorIndex):
         if save and self._log is not None:
             pq.save(self._pq_path)
         self._staged_gen += 1
+        self._mark_staged()
+        led = memory.get_ledger()
+        if led is not None:
+            led.note_write(
+                "compress", "compress", (time.perf_counter() - t0) * 1000.0,
+                rows=self.n, bytes_moved=memory.array_bytes(self._codes))
         self._publish_snapshot()
 
     # -- VectorIndex ---------------------------------------------------------
@@ -1540,6 +1651,7 @@ class TpuVectorIndex(VectorIndex):
             t0 = time.perf_counter()
             count = vectors.shape[0]
             self._staged_gen += 1
+            self._mark_staged()
             self._ensure_capacity(self.n + count + _CHUNK)
             self._cow_host_state()
             self._write_block(vectors, self.n)
@@ -1549,6 +1661,12 @@ class TpuVectorIndex(VectorIndex):
             self.n += count
             self.live += count
             self._obs_index("add", "device_write", t0, ops=count)
+            led = memory.get_ledger()
+            if led is not None:
+                led.note_write(
+                    "add", "device_write",
+                    (time.perf_counter() - t0) * 1000.0,
+                    rows=count, bytes_moved=count * self.dim * 4)
             self._update_index_gauges()
             self._maybe_declared_compress()
             self._publish_snapshot()
@@ -2326,10 +2444,19 @@ class TpuVectorIndex(VectorIndex):
             "compressed": self.compressed,
             "pq": None,
             # a resident copy is a full f32 store materialization held for
-            # the breaker's fallback plane (or a recent degraded window)
+            # the breaker's fallback plane (or a recent degraded window);
+            # bytes come from the ledger's shared sizing helper so this
+            # surface and /debug/memory can never disagree
             "host_fallback_cache": {
                 "resident": cache is not None,
                 "gen": cache[0] if cache is not None else None,
+                "bytes": memory.host_rows_cache_bytes(self),
+            },
+            # the device/host byte picture of THIS index, from the same
+            # analytic accounting the ledger stamps (monitoring/memory.py)
+            "memory": {
+                "device_components": self._memory_components(),
+                "host_components": memory.index_host_components(self),
             },
         }
         pq = self._pq
@@ -2434,6 +2561,7 @@ class TpuVectorIndex(VectorIndex):
             live_slots = np.array(sorted(self._doc_to_slot.values()), dtype=np.int64)
             if live_slots.size == self.n:
                 return
+            t_compact0 = time.perf_counter()
             if self.compressed:
                 store_host = self._host_vecs[: self.n]
             else:
@@ -2480,6 +2608,12 @@ class TpuVectorIndex(VectorIndex):
             if was_compressed and self.n > 0:
                 fresh = np.asarray(self._store[: self.n], dtype=np.float32)  # graftlint: disable=JGL008 compact is a stop-the-world rebuild: the lock must cover it and the materialized store IS the rebuild's input
                 self._enable_pq(pq, fresh, save=False)
+            led = memory.get_ledger()
+            if led is not None:
+                led.note_write(
+                    "compact", "compact",
+                    (time.perf_counter() - t_compact0) * 1000.0,
+                    rows=self.live)
 
     def drop(self) -> None:
         with self._lock:

@@ -416,6 +416,52 @@ class Metrics:
             "growth after deletes is the compaction-debt signal",
             ("class_name", "shard_name"))
 
+        # memory & capacity observability (monitoring/memory.py): the
+        # device/host/disk byte ledger's bounded component gauges + the
+        # write-path lifecycle + exhaustion alerting. Component label
+        # values come from the memory.DEVICE_COMPONENTS/HOST_COMPONENTS/
+        # DISK_COMPONENTS taxonomies (bounded; foreign names fold into
+        # "other" — JGL010-clean); the ledger only touches these inside
+        # try/except.
+        self.device_bytes = g(
+            "weaviate_device_bytes",
+            "HBM bytes the ledger accounts per buffer component "
+            "(analytic shape x dtype at snapshot publish — equals the "
+            "buffers' nbytes exactly; zero device syncs)", ("component",))
+        self.host_bytes = g(
+            "weaviate_host_bytes",
+            "host RAM bytes the ledger accounts per consumer component "
+            "(slot/tombstone mirrors, PQ host rows, staged rows, breaker "
+            "fallback rows, auditor rows, allowList cache)", ("component",))
+        self.disk_bytes = g(
+            "weaviate_disk_bytes",
+            "data-volume bytes (used/free) so device/host/disk capacity "
+            "read from one dashboard", ("component",))
+        self.memory_headroom = g(
+            "weaviate_memory_headroom_pct",
+            "remaining capacity percentage per scope (device HBM vs the "
+            "backend's bytes_limit, host vs MemTotal, disk vs the data "
+            "volume) — the number the exhaustion alert thresholds",
+            ("scope",))
+        self.write_flush = h(
+            "weaviate_write_flush_ms",
+            "write-path flush/device-write durations (staged rows landing "
+            "on device, COW copy included)")
+        self.cow_copy_bytes = c(
+            "weaviate_cow_copy_bytes_total",
+            "host bytes duplicated by copy-on-write so a published "
+            "snapshot's pinned arrays are never mutated under a reader")
+        self.memory_alerts = c(
+            "weaviate_memory_exhaustion_alerts_total",
+            "memory-headroom degradation alerts per scope (one increment "
+            "per below-threshold transition; the log line is rate-limited "
+            "separately)", ("scope",))
+        self.memory_drift = g(
+            "weaviate_memory_ledger_drift_bytes",
+            "allocator-reported bytes_in_use minus the ledger's analytic "
+            "per-device total where the backend provides memory_stats() — "
+            "a cross-check gauge, never the primary accounting", ("scope",))
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

@@ -372,6 +372,12 @@ class QualityAuditor:
         # audits never touch the breaker's fallback cache
         self._rows_cache: dict = {}
         self._degraded_last_log: dict[str, float] = {}
+        # host-memory provider (monitoring/memory.py): the audit rows
+        # cache — full-precision store copies — becomes a /debug/memory
+        # host component, sized by the same helper /debug/index uses
+        from weaviate_tpu.monitoring import memory
+
+        memory.register_host_provider(self, memory.auditor_host_components)
         self._threads: list[threading.Thread] = []
         if start_workers:
             for i in range(self.concurrency):

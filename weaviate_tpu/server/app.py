@@ -92,6 +92,26 @@ class App:
                 metrics=self.metrics))
         else:
             self.quality_auditor = None
+        # memory & capacity observability (monitoring/memory.py): the
+        # device/host/disk byte ledger is ALWAYS-ON by default (unlike the
+        # tracer it costs nothing on the search path — stamps ride the
+        # write path only), installed before the DB so restore-time
+        # flushes are accounted; same module-global lifecycle discipline.
+        mc = self.config.memory
+        if mc.ledger_enabled:
+            from weaviate_tpu.monitoring import memory as memledger
+
+            self.memory_ledger = memledger.configure(memledger.MemoryLedger(
+                metrics=self.metrics,
+                window_s=mc.window_s,
+                headroom_alert_pct=mc.headroom_alert_pct,
+                device_budget_bytes=mc.device_budget_bytes,
+                host_budget_bytes=mc.host_budget_bytes))
+            # the data volume backs the ledger's disk scope, so device/
+            # host/disk capacity read from one /debug/memory page
+            self.memory_ledger.set_disk_path(path)
+        else:
+            self.memory_ledger = None
         # a SIGTERM mid device-trace capture must still stop the JAX
         # profiler (the r05 wedge): install the signal/atexit teardown
         # from the main thread while we are likely on it — REST handler
@@ -345,6 +365,12 @@ class App:
             # same still-ours discipline; also stops the audit workers
             # and stashes the final summary for the CI artifact dump
             quality.unconfigure(self.quality_auditor)
+        if self.memory_ledger is not None:
+            from weaviate_tpu.monitoring import memory as memledger
+
+            # still-ours discipline; stashes the final summary for the
+            # debug_memory.json CI artifact
+            memledger.unconfigure(self.memory_ledger)
         # robustness globals: same still-ours discipline as the tracer
         from weaviate_tpu.serving import robustness
 

@@ -117,6 +117,9 @@ for _m, _p, _n in [
     # tombstone fractions, snapshot/staged generations, PQ state,
     # cache residency — same authorizer (it names classes)
     ("GET", r"/debug/index", "debug_index"),
+    # device/host/disk byte ledger (monitoring/memory.py): per-component
+    # bytes, write-path lifecycle, exhaustion forecast — same authorizer
+    ("GET", r"/debug/memory", "debug_memory"),
     # the debug surface's index page: every /debug endpoint, one line each
     ("GET", r"/debug/?", "debug_root"),
     # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
@@ -222,7 +225,7 @@ class Handler(BaseHTTPRequestHandler):
     # reads of itself
     _UNTRACED = frozenset({
         "live", "ready", "openid", "metrics", "debug_traces", "debug_perf",
-        "debug_quality", "debug_index", "debug_root",
+        "debug_quality", "debug_index", "debug_memory", "debug_root",
         "pprof_index", "pprof_profile", "pprof_trace", "pprof_goroutine",
         "pprof_heap", "pprof_cmdline",
     })
@@ -386,6 +389,15 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"enabled": True, **a.summary()})
 
+    def h_debug_memory(self):
+        from weaviate_tpu.monitoring import memory
+
+        led = memory.get_ledger()
+        if led is None:
+            self._reply(200, {"enabled": False})
+            return
+        self._reply(200, {"enabled": True, **led.summary()})
+
     def h_debug_index(self):
         out = {}
         # snapshot the live registries before iterating (db.py's own
@@ -412,6 +424,10 @@ class Handler(BaseHTTPRequestHandler):
             "/debug/index": "per-index/shard health: live/tombstone "
                             "counts, snapshot + staged generations, PQ "
                             "state, cache residency (always on)",
+            "/debug/memory": "device/host/disk byte ledger: per-component "
+                             "bytes, write-path lifecycle, COW costs, "
+                             "exhaustion forecast + headroom alerts "
+                             "(MEMORY_LEDGER_ENABLED, default on)",
             "/debug/pprof/": "profiling surface index",
             "/debug/pprof/profile": "sampled CPU profile "
                                     "(?seconds=N&hz=N)",
