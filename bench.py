@@ -1023,6 +1023,18 @@ def _probe_device(timeout_s: Optional[int] = None) -> None:
     log(f"FATAL: TPU device unreachable ({detail}); refusing to hang — "
         "this is an infrastructure failure, not a benchmark result (rc=3)")
     _restore_live_rows()
+    # preserve the evidence before dying: whatever perf/quality/memory
+    # window state (or post-App recent_summaries stashes) this process
+    # still holds goes into one incident bundle — the post-mortem
+    # BENCH_r02-r05 never left behind (ROADMAP standing chore). Never
+    # blocks the exit: emergency_dump is exception-proof by contract.
+    from weaviate_tpu.monitoring import incidents as _incidents
+
+    bundle = _incidents.emergency_dump(
+        "unreachable device at bench probe (rc=3)",
+        detail={"probe_detail": detail, "timeout_s": timeout_s})
+    if bundle:
+        log(f"incident bundle preserved: {bundle}")
     raise SystemExit(3)
 
 
@@ -1177,6 +1189,10 @@ def run_overload_bench(args, rng):
     cfg.coalescer.max_queued_rows = max_rows
     cfg.coalescer.wait_timeout_s = max(deadline_ms / 1000.0 * 4, 2.0)
     cfg.robustness.breaker_reset_ms = 250.0
+    # incident bundles must OUTLIVE the bench's throwaway data dir (the
+    # finally rmtree's it): route them to the driver's INCIDENT_DIR, else
+    # beside the bench artifacts
+    cfg.incidents.dir = os.environ.get("INCIDENT_DIR") or "./incidents"
     if fault_spec:
         cfg.robustness.fault_injection = fault_spec
         cfg.robustness.fault_injection_seed = 17
@@ -1303,6 +1319,12 @@ def run_overload_bench(args, rng):
             "row": out_row,
         }))
     finally:
+        # the storm's evidence bundle rides out BEFORE App.shutdown
+        # unconfigures the planes: journal tail (sheds, breaker flaps,
+        # injected faults), /debug/slo burn state, perf/memory windows
+        from weaviate_tpu.monitoring import incidents as _incidents
+
+        _incidents.emergency_dump("overload storm bench complete")
         if srv is not None:
             srv.stop()
         if app is not None:
@@ -1404,6 +1426,8 @@ def run_fairness_bench(args, rng):
     # fraction bite — the abusive tenant's head-of-line dispatch is then
     # a few rows, not a full direct-path-width batch
     cfg.coalescer.max_request_rows = max(int(max_rows * fraction), 2)
+    # bundles must outlive the throwaway data dir (the overload twin)
+    cfg.incidents.dir = os.environ.get("INCIDENT_DIR") or "./incidents"
     if fault_spec:
         cfg.robustness.fault_injection = fault_spec
         cfg.robustness.fault_injection_seed = 23
@@ -1666,6 +1690,10 @@ def run_fairness_bench(args, rng):
             "row": out_row,
         }))
     finally:
+        # fairness-storm twin of the overload dump above
+        from weaviate_tpu.monitoring import incidents as _incidents
+
+        _incidents.emergency_dump("fairness storm bench complete")
         if srv is not None:
             srv.stop()
         if app is not None:

@@ -52,7 +52,8 @@ def pytest_sessionfinish(session, exitstatus):
     for env_key, module, doc_key in (
             ("PERF_SUMMARY_FILE", "perf", "windows"),
             ("QUALITY_SUMMARY_FILE", "quality", "audits"),
-            ("MEMORY_SUMMARY_FILE", "memory", "ledgers")):
+            ("MEMORY_SUMMARY_FILE", "memory", "ledgers"),
+            ("INCIDENTS_SUMMARY_FILE", "incidents", "journals")):
         path = os.environ.get(env_key)
         if not path:
             continue

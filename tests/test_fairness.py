@@ -714,7 +714,16 @@ def test_probe_device_failure_restores_then_exits_rc3(tmp_path, monkeypatch):
     monkeypatch.setitem(sys.modules, "jax", fake_jax)
     monkeypatch.setattr(subprocess, "run", lambda *a, **k: (_ for _ in ()).throw(
         subprocess.TimeoutExpired(cmd="probe", timeout=1)))
+    # the rc=3 exit also dumps an incident bundle (PR-10 satellite):
+    # route it into the test tmp dir, not the checkout's cwd
+    inc_dir = tmp_path / "incidents"
+    monkeypatch.setenv("INCIDENT_DIR", str(inc_dir))
     with pytest.raises(SystemExit) as ei:
         bench._probe_device(timeout_s=1)
     assert ei.value.code == 3
     assert json.loads(mfile.read_text())["row_tpu"] == live
+    bundles = list(inc_dir.glob("incident-*.json"))
+    assert len(bundles) == 1  # the dying session preserved its evidence
+    doc = json.loads(bundles[0].read_text())
+    assert doc["incident"]["class"] == "bench"
+    assert "unreachable device" in doc["incident"]["reason"]

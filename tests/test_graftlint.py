@@ -1083,3 +1083,73 @@ def test_jgl012_annotated_assignment_fires_too():
     assert codes(src, INDEX).count("JGL012") == 1
     stamped = src + "        self._stamp_memory()\n"
     assert "JGL012" not in codes(stamped, INDEX)
+
+
+# -- JGL013: ops-journal event kinds must be registered literals --------------
+
+def test_jgl013_dynamic_kind_fires():
+    src = (
+        "from weaviate_tpu.monitoring import incidents\n"
+        "def f(reason):\n"
+        "    incidents.emit(f'shed_{reason}', scope='q')\n"
+        "    incidents.emit('breaker_' + reason)\n"
+        "    incidents.emit(reason)\n"
+    )
+    assert codes(src, COLD).count("JGL013") == 3
+
+
+def test_jgl013_unregistered_literal_fires():
+    src = (
+        "from weaviate_tpu.monitoring import incidents\n"
+        "def f():\n"
+        "    incidents.emit('totally_new_kind', scope='x')\n"
+    )
+    assert codes(src, COLD).count("JGL013") == 1
+
+
+def test_jgl013_registered_literals_pass_dotted_and_bare():
+    src = (
+        "from weaviate_tpu.monitoring import incidents\n"
+        "from weaviate_tpu.monitoring.incidents import emit as jemit\n"
+        "def f():\n"
+        "    incidents.emit('shed_burst', scope='queue_full')\n"
+        "    incidents.emit(kind='breaker_open')\n"
+        "    jemit('jit_compile', scope='dispatch')\n"
+    )
+    assert "JGL013" not in codes(src, COLD)
+
+
+def test_jgl013_missing_kind_fires_and_exempt_module_passes():
+    src = (
+        "from weaviate_tpu.monitoring import incidents\n"
+        "def f():\n"
+        "    incidents.emit(scope='x')\n"
+    )
+    assert codes(src, COLD).count("JGL013") == 1
+    # inside the journal module itself the rule stays silent (its own
+    # emit implementation and internal re-emissions own the taxonomy)
+    assert "JGL013" not in codes(
+        src, "weaviate_tpu/monitoring/incidents.py")
+
+
+def test_jgl013_unrelated_emit_calls_pass():
+    # a logging handler's emit (or any foreign .emit) must not be flagged:
+    # only the incidents module's emit is in scope
+    src = (
+        "import logging\n"
+        "def f(handler, record, kind):\n"
+        "    handler.emit(record)\n"
+        "    logging.Handler().emit(kind)\n"
+    )
+    assert "JGL013" not in codes(src, COLD)
+
+
+def test_jgl013_taxonomy_mirror_matches_runtime():
+    """The rules.py mirror and the runtime taxonomy must be the SAME set
+    — drift would let a registered kind fail lint or an unregistered one
+    pass it. (The runtime import is safe here: tier-1 runs with JAX on
+    CPU and incidents.py imports only the stdlib.)"""
+    from tools.graftlint import rules as _rules
+    from weaviate_tpu.monitoring import incidents as _incidents
+
+    assert _rules.JOURNAL_EVENT_KINDS == frozenset(_incidents.EVENT_KINDS)

@@ -1,0 +1,822 @@
+"""Incident flight recorder + SLO burn-rate engine (monitoring/incidents.py).
+
+Covers the full incident journey — seeded fault-injection device-error
+storm -> breaker OPEN -> exactly one bundle on disk carrying all four
+plane summaries + the journal tail — plus the unit surface: bounded
+journal ring + burst coalescing + foreign-kind fold, SLO burn math /
+fire-once / recovery re-arm / per-tenant overrides, recorder rate
+limiting + disk-budget pruning, the SIGTERM/atexit teardown chain, the
+disabled-mode zero-construction spy, the /debug/incidents + /debug/slo +
+/metrics e2e, and config parsing/validation.
+"""
+
+import json
+import os
+import queue as stdqueue
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.config import Config
+from weaviate_tpu.config.config import ConfigError, load_config
+from weaviate_tpu.entities.storobj import StorObj
+from weaviate_tpu.monitoring import incidents
+from weaviate_tpu.monitoring.metrics import noop_metrics
+from weaviate_tpu.serving import robustness
+from weaviate_tpu.testing import faults
+from weaviate_tpu.usecases.traverser import GetParams
+
+N, DIM, K = 300, 16, 5
+
+
+@pytest.fixture(autouse=True)
+def _clean_incident_globals():
+    """Isolate the module globals: an App another test file forgot to
+    shut down must not leak its journal/engine/recorder into the
+    None-assertions here (and ours must not leak out)."""
+    saved = (incidents._journal, incidents._engine, incidents._recorder)
+    incidents._journal = incidents._engine = incidents._recorder = None
+    yield
+    incidents._journal, incidents._engine, incidents._recorder = saved
+
+
+# -- the ops-event journal ----------------------------------------------------
+
+
+def test_journal_bounded_ring_folds_foreign_kinds():
+    j = incidents.OpsJournal(size=4)
+    for i in range(10):
+        j.emit("breaker_open", scope=f"s{i}")  # non-burst kind: appends
+    tail = j.tail()
+    assert len(tail) == 4  # bounded ring
+    assert [e["scope"] for e in tail] == ["s6", "s7", "s8", "s9"]
+    j.emit("no_such_kind", scope="x")
+    assert j.tail()[-1]["kind"] == "other"
+    counts = j.counts()
+    assert counts["breaker_open"] == 10 and counts["other"] == 1
+
+
+def test_journal_burst_coalescing_and_window_expiry():
+    j = incidents.OpsJournal(size=64, burst_window_s=0.05)
+    for _ in range(500):
+        j.emit("shed_burst", scope="queue_full")
+    j.emit("shed_burst", scope="tenant_budget")  # distinct scope: own entry
+    tail = j.tail()
+    qf = [e for e in tail if e.get("scope") == "queue_full"]
+    assert len(qf) == 1 and qf[0]["count"] == 500
+    assert len([e for e in tail if e.get("scope") == "tenant_budget"]) == 1
+    # a storm cannot wipe low-frequency events out of the ring
+    j.emit("breaker_open", scope="device")
+    for _ in range(1000):
+        j.emit("shed_burst", scope="queue_full")
+    assert any(e["kind"] == "breaker_open" for e in j.tail())
+    # after the burst window passes, a NEW entry starts
+    time.sleep(0.06)
+    j.emit("shed_burst", scope="queue_full")
+    assert len([e for e in j.tail()
+                if e.get("scope") == "queue_full"]) == 2
+    assert j.counts()["shed_burst"] == 1502
+
+
+def test_journal_burst_entry_evicted_from_ring_restarts():
+    """An ongoing burst whose coalesced entry was pushed out of the ring
+    must start a NEW ring entry — not keep counting into the evicted
+    dict, invisible to tail() for the rest of the storm."""
+    j = incidents.OpsJournal(size=4, burst_window_s=60.0)
+    j.emit("shed_burst", scope="queue_full")
+    for i in range(4):  # evicts the burst entry
+        j.emit("breaker_open", scope=f"s{i}")
+    assert not any(e["kind"] == "shed_burst" for e in j.tail())
+    j.emit("shed_burst", scope="queue_full")  # the storm continues
+    qf = [e for e in j.tail() if e["kind"] == "shed_burst"]
+    assert len(qf) == 1 and qf[0]["count"] == 1
+    assert j.counts()["shed_burst"] == 2
+
+
+def test_module_emit_is_noop_and_guarded_when_unconfigured():
+    assert incidents.get_journal() is None
+    incidents.emit("breaker_open", scope="x")  # must not raise
+    incidents.note_request("ok", 1.0)
+    assert incidents.trigger("manual") is False
+
+
+# -- the SLO engine -----------------------------------------------------------
+
+
+def _engine(**kw):
+    kw.setdefault("availability_target", 0.9)  # budget 0.1
+    kw.setdefault("min_events", 10)
+    return incidents.SloEngine(**kw)
+
+
+def test_slo_burn_math_and_budget_remaining():
+    e = _engine()
+    for _ in range(15):
+        e.note("ok", 1.0)
+    for _ in range(5):
+        e.note("shed", 1.0)
+    doc = e.summary()
+    avail = doc["slos"][0]
+    # bad fraction 5/20 = 0.25; budget 0.1 -> burn 2.5x on both windows
+    assert avail["windows"]["5m"]["burn_rate"] == pytest.approx(2.5)
+    assert avail["windows"]["1h"]["burn_rate"] == pytest.approx(2.5)
+    # budget spent = 2.5 -> remaining clamps at 0
+    assert avail["budget_remaining_1h"] == 0.0
+    assert doc["requests_total"] == 20
+    assert doc["outcomes"] == {"ok": 15, "shed": 5}
+
+
+def test_slo_min_events_gate_and_client_outcomes_spend_nothing():
+    e = _engine(min_events=50)
+    for _ in range(20):
+        e.note("error", 1.0)
+    assert e.summary()["slos"][0]["windows"]["5m"]["burn_rate"] is None
+    e2 = _engine()
+    for _ in range(20):
+        e2.note("client", 1.0)  # 4xx family: total, never budget
+    s = e2.summary()["slos"][0]
+    assert s["windows"]["5m"]["requests"] == 20
+    assert s["windows"]["5m"]["burn_rate"] == 0.0
+
+
+def test_slo_alert_fires_once_journals_and_recovers(tmp_path):
+    j = incidents.OpsJournal(size=64)
+    rec = incidents.FlightRecorder(str(tmp_path / "inc"), rate_limit_s=0.0)
+    incidents.configure(journal=j, engine=None, recorder=rec)
+    try:
+        e = _engine(fast_burn_threshold=2.0, slow_burn_threshold=100.0)
+        for _ in range(10):
+            e.note("error", 1.0)  # burn 10x >= 2.0 -> alert
+        e.summary()
+        s = e.summary()["slos"][0]
+        assert s["alerting"] is True and s["alerts_fired"] == 1
+        kinds = [ev["kind"] for ev in j.tail()]
+        assert kinds.count("slo_burn") == 1  # fire-once per transition
+        # sustained burn does not re-fire
+        for _ in range(10):
+            e.note("error", 1.0)
+        e.summary()
+        assert [ev["kind"] for ev in j.tail()].count("slo_burn") == 1
+        # recovery: flood with oks until under threshold, then re-arm
+        for _ in range(500):
+            e.note("ok", 1.0)
+        s = e.summary()["slos"][0]
+        assert s["alerting"] is False
+        assert any(ev["kind"] == "slo_recovered" for ev in j.tail())
+    finally:
+        incidents.unconfigure(journal=j, recorder=rec)
+
+
+def test_slo_latency_objective_judges_completed_requests():
+    e = incidents.SloEngine(availability_target=0.999,
+                            latency_p99_ms=100.0, min_events=10)
+    for _ in range(18):
+        e.note("ok", 10.0)
+    for _ in range(2):
+        e.note("ok", 500.0)  # over target
+    e.note("shed", 10_000.0)  # sheds never count against latency
+    doc = e.summary()
+    lat = [s for s in doc["slos"] if s["slo"] == "latency_p99"][0]
+    assert lat["latency_target_ms"] == 100.0
+    assert lat["windows"]["5m"]["requests"] == 20  # shed excluded
+    # slow fraction 2/20 = 0.1 over a 0.01 budget -> burn 10x
+    assert lat["windows"]["5m"]["burn_rate"] == pytest.approx(10.0)
+
+
+def test_slo_per_tenant_override_counts_only_its_tenant():
+    e = incidents.SloEngine(availability_target=0.999, min_events=5,
+                            tenant_targets={"gold": 0.9})
+    for _ in range(10):
+        e.note("ok", 1.0, tenant="gold")
+    for _ in range(10):
+        e.note("shed", 1.0, tenant="bronze")
+    doc = e.summary()
+    gold = [s for s in doc["slos"] if s["slo"] == "availability:gold"][0]
+    assert gold["tenant"] == "gold"
+    assert gold["windows"]["5m"]["requests"] == 10
+    assert gold["windows"]["5m"]["burn_rate"] == 0.0
+    # the global SLO saw everything
+    glob = [s for s in doc["slos"] if s["slo"] == "availability"][0]
+    assert glob["windows"]["5m"]["requests"] == 20
+
+
+def test_slo_gauges_stay_bounded_under_1k_tenants():
+    """1000 distinct tenants' traffic must not mint per-tenant SLO
+    series: only the config-declared overrides (plus the defaults) may
+    appear in the exposition."""
+    m = noop_metrics()
+    e = incidents.SloEngine(availability_target=0.99, latency_p99_ms=50.0,
+                            min_events=1,
+                            tenant_targets={"gold": 0.999, "silver": 0.99},
+                            metrics=m)
+    for i in range(1000):
+        e.note("ok", 1.0, tenant=f"t{i}")
+    e.summary()  # forces evaluation + gauge publication
+    text = m.expose().decode()
+    series = [ln for ln in text.splitlines()
+              if ln.startswith("weaviate_slo_burn_rate{")]
+    slos = {ln.split('slo="')[1].split('"')[0] for ln in series}
+    assert slos <= {"availability", "latency_p99",
+                    "availability:gold", "availability:silver"}
+    assert len(series) <= 4 * 2  # each slo x {5m, 1h}
+
+
+# -- the flight recorder ------------------------------------------------------
+
+
+def test_recorder_rate_limit_per_class_and_force(tmp_path):
+    rec = incidents.FlightRecorder(str(tmp_path), rate_limit_s=60.0)
+    p1 = rec.dump_now("breaker_open", reason="first")
+    assert p1 is not None and os.path.exists(p1)
+    assert rec.dump_now("breaker_open", reason="limited") is None
+    # a different class has its own bucket
+    assert rec.dump_now("memory_exhaustion", reason="other") is not None
+    # force (teardown/manual) is exempt
+    assert rec.dump_now("breaker_open", reason="forced",
+                        force=True) is not None
+    st = rec.stats()
+    assert st["dumped"] == 3 and st["rate_limited"] == 1
+
+
+def test_recorder_unadmitted_capture_does_not_silence_class(
+        tmp_path, monkeypatch):
+    """A dropped (queue-full) or failed capture must leave its incident
+    class un-stamped: the next trigger retries instead of being
+    rate-limited for the whole window with no bundle on disk."""
+    rec = incidents.FlightRecorder(str(tmp_path), rate_limit_s=300.0)
+    # (a) a failed synchronous write (e.g. ENOSPC) does not stamp
+
+    def boom(bundle):
+        raise OSError("enospc")
+    monkeypatch.setattr(rec, "_write", boom)
+    assert rec.dump_now("breaker_open") is None
+    monkeypatch.undo()
+    p = rec.dump_now("breaker_open")
+    assert p is not None and os.path.exists(p)
+    # (b) queue full with the worker wedged: the trigger drops un-stamped
+    monkeypatch.setattr(rec, "_ensure_worker", lambda: None)
+    while True:
+        try:
+            rec._queue.put_nowait(("manual", "fill", None))
+        except stdqueue.Full:
+            break
+    assert rec.trigger("memory_exhaustion") is False
+    while True:
+        try:
+            rec._queue.get_nowait()
+        except stdqueue.Empty:
+            break
+    assert rec.trigger("memory_exhaustion") is True
+
+
+def test_recorder_worker_capture_failure_rearms_class(tmp_path, monkeypatch):
+    """An admitted async capture whose write fails re-arms its class so a
+    later trigger can still preserve the incident."""
+    rec = incidents.FlightRecorder(str(tmp_path), rate_limit_s=300.0)
+    calls = []
+
+    def boom(bundle):
+        calls.append(1)
+        raise OSError("enospc")
+    monkeypatch.setattr(rec, "_write", boom)
+    assert rec.trigger("breaker_open") is True
+    deadline = time.monotonic() + 5.0
+    while not calls and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert calls, "worker never attempted the capture"
+    monkeypatch.undo()
+    # the un-stamp lands just after the failed write; poll until re-armed
+    deadline = time.monotonic() + 5.0
+    admitted = False
+    while time.monotonic() < deadline:
+        if rec.trigger("breaker_open"):
+            admitted = True
+            break
+        time.sleep(0.02)
+    assert admitted
+    deadline = time.monotonic() + 5.0
+    while not rec.index() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert rec.index() and rec.index()[0]["class"] == "breaker_open"
+
+
+def test_bundle_names_unique_across_recorders_sharing_a_dir(tmp_path):
+    """Several recorders (CI runs many Apps per process) sharing one
+    INCIDENT_DIR within the same second must never compute the same
+    bundle path and overwrite each other's evidence."""
+    a = incidents.FlightRecorder(str(tmp_path), rate_limit_s=0.0)
+    b = incidents.FlightRecorder(str(tmp_path), rate_limit_s=0.0)
+    names = {os.path.basename(a.dump_now("manual", force=True)),
+             os.path.basename(b.dump_now("manual", force=True)),
+             os.path.basename(a.dump_now("manual", force=True))}
+    assert len(names) == 3
+    assert len(a.index()) == 3
+    assert all(e["class"] == "manual" for e in a.index())
+
+
+def test_recorder_disk_budget_prunes_oldest_keeps_newest(tmp_path):
+    rec = incidents.FlightRecorder(str(tmp_path), rate_limit_s=0.0,
+                                   max_bytes=1)  # smaller than one bundle
+    paths = []
+    for i in range(4):
+        p = rec.dump_now("manual", reason=f"b{i}", force=True)
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.01)
+    left = rec.index()
+    # the budget is below one bundle: only the just-written one survives
+    assert len(left) == 1
+    assert left[0]["file"] == os.path.basename(paths[-1])
+
+
+def test_bundle_sections_guarded_and_time_consistent(tmp_path):
+    j = incidents.OpsJournal(size=16)
+    j.emit("breaker_open", scope="device")
+    e = _engine()
+    e.note("ok", 1.0)
+    rec = incidents.FlightRecorder(str(tmp_path), journal=j, engine=e)
+    rec.add_stats_provider("coalescer", lambda: {"lanes": 3})
+    rec.add_stats_provider("broken", lambda: 1 / 0)
+    rec.set_config_fingerprint({"sha256_16": "abc", "knobs": {}})
+    t0 = time.time()
+    path = rec.dump_now("manual", reason="unit", force=True)
+    bundle = json.load(open(path))
+    assert bundle["incident"]["class"] == "manual"
+    assert abs(bundle["incident"]["ts_unix"] - t0) < 5.0
+    assert bundle["config"]["sha256_16"] == "abc"
+    assert any(ev["kind"] == "breaker_open"
+               for ev in bundle["journal"]["tail"])
+    assert bundle["slo"]["requests_total"] == 1
+    assert bundle["coalescer"]["lanes"] == 3
+    # one broken provider costs its section, never the bundle
+    assert "error" in bundle["broken"]
+    for name in ("journal", "slo", "coalescer"):
+        assert abs(bundle[name]["captured_unix"]
+                   - bundle["incident"]["ts_unix"]) < 5.0
+
+
+def test_breaker_open_emits_and_triggers(tmp_path):
+    j = incidents.OpsJournal(size=32)
+    rec = incidents.FlightRecorder(str(tmp_path), journal=j,
+                                   rate_limit_s=300.0)
+    incidents.configure(journal=j, recorder=rec)
+    try:
+        br = robustness.CircuitBreaker(failure_threshold=2,
+                                       reset_timeout_s=0.05)
+        br.record_failure(RuntimeError("x"))
+        br.record_failure(RuntimeError("x"))
+        assert br.state() == robustness.STATE_OPEN
+        kinds = [ev["kind"] for ev in j.tail()]
+        assert "breaker_open" in kinds
+        # the async capture lands on disk
+        deadline = time.monotonic() + 5.0
+        while not rec.index() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(rec.index()) == 1
+        assert rec.index()[0]["class"] == "breaker_open"
+        # half-open + close journal too
+        time.sleep(0.06)
+        assert br.allow()
+        br.record_success()
+        kinds = [ev["kind"] for ev in j.tail()]
+        assert "breaker_half_open" in kinds and "breaker_closed" in kinds
+    finally:
+        incidents.unconfigure(journal=j, recorder=rec)
+
+
+def test_grpc_batch_search_classifies_internal_errors(monkeypatch):
+    """A failure inside the batch path spends availability budget like the
+    Search twin — a batch-only outage must not be invisible to the SLO."""
+    import grpc
+
+    from weaviate_tpu.grpcapi import weaviate_pb2 as pb
+    from weaviate_tpu.server.grpc_server import SearchServicer
+
+    eng = incidents.SloEngine()
+    incidents.configure(engine=eng)
+    try:
+        class DummyApp:
+            config = Config()
+        sv = SearchServicer(DummyApp())
+
+        def boom(request, start):
+            raise RuntimeError("batch lane died")
+        monkeypatch.setattr(sv, "_batch_search", boom)
+
+        class Abort(Exception):
+            pass
+
+        class Ctx:
+            code = None
+
+            def invocation_metadata(self):
+                return ()
+
+            def time_remaining(self):
+                return None
+
+            def set_trailing_metadata(self, md):
+                pass
+
+            def abort(self, code, msg):
+                self.code = code
+                raise Abort(msg)
+
+        ctx = Ctx()
+        with pytest.raises(Abort):
+            sv.BatchSearch(pb.BatchSearchRequest(
+                requests=[pb.SearchRequest(class_name="C", limit=1)]), ctx)
+        assert ctx.code == grpc.StatusCode.INTERNAL
+        assert eng.summary()["outcomes"] == {"error": 1}
+
+        # an invalid-tenant abort counts as "client" like the REST twin
+        class BadTenantCtx(Ctx):
+            def invocation_metadata(self):
+                return (("x-tenant-id", "no spaces allowed"),)
+
+        for rpc, req in ((sv.Search, pb.SearchRequest()),
+                         (sv.BatchSearch, pb.BatchSearchRequest())):
+            ctx2 = BadTenantCtx()
+            with pytest.raises(Abort):
+                rpc(req, ctx2)
+            assert ctx2.code == grpc.StatusCode.INVALID_ARGUMENT
+        assert eng.summary()["outcomes"] == {"error": 1, "client": 2}
+    finally:
+        incidents.unconfigure(engine=eng)
+
+
+# -- disabled mode: the zero-construction spy ---------------------------------
+
+
+def test_disabled_serving_path_constructs_nothing(tmp_path, monkeypatch):
+    built = []
+    for name in ("OpsJournal", "SloEngine", "FlightRecorder"):
+        orig = getattr(incidents, name)
+
+        def make(orig=orig, name=name):
+            class Spy(orig):
+                def __init__(self, *a, **kw):
+                    built.append(name)
+                    super().__init__(*a, **kw)
+            return Spy
+        monkeypatch.setattr(incidents, name, make())
+    from weaviate_tpu.server import App
+
+    cfg = Config()
+    cfg.incidents.enabled = False
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    try:
+        assert app.ops_journal is None and app.slo_engine is None \
+            and app.flight_recorder is None
+        assert incidents.get_journal() is None
+        app.schema.add_class({
+            "class": "Inc", "vectorIndexType": "hnsw_tpu",
+            "vectorIndexConfig": {"distance": "l2-squared"},
+            "properties": [{"name": "tag", "dataType": ["text"]}]})
+        rng = np.random.default_rng(7)
+        vecs = rng.integers(-8, 8, (64, DIM)).astype(np.float32)
+        idx = app.db.get_index("Inc")
+        idx.put_batch([
+            StorObj(class_name="Inc", uuid=str(uuidlib.UUID(int=i + 1)),
+                    properties={"tag": "t"}, vector=vecs[i])
+            for i in range(len(vecs))])
+        r = app.traverser.get_class(GetParams(
+            class_name="Inc", near_vector={"vector": vecs[0].tolist()},
+            limit=K))
+        assert len(r) == K
+        assert built == []
+    finally:
+        app.shutdown()
+
+
+# -- the full incident journey (acceptance e2e) -------------------------------
+
+
+def _mk_incident_app(tmp_path, **cfg_kw):
+    from weaviate_tpu.server import App
+
+    cfg = Config()
+    cfg.coalescer.enabled = True
+    cfg.coalescer.window_ms = 20.0
+    cfg.tracing.enabled = True
+    cfg.quality.audit_sample_rate = 1.0
+    cfg.robustness.breaker_failure_threshold = 3
+    cfg.robustness.breaker_reset_ms = 30_000.0  # stays OPEN for the test
+    cfg.incidents.dir = str(tmp_path / "incidents")
+    # disk headroom on a nearly-full CI host must not add a second
+    # bundle class mid-test; 0 disables the memory alerts cleanly
+    cfg.memory.headroom_alert_pct = 0.0
+    for k, v in cfg_kw.items():
+        setattr(cfg.incidents, k, v)
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    app.schema.add_class({
+        "class": "Inc", "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "tag", "dataType": ["text"]}]})
+    rng = np.random.default_rng(23)
+    vecs = rng.integers(-8, 8, (N, DIM)).astype(np.float32)
+    idx = app.db.get_index("Inc")
+    idx.put_batch([
+        StorObj(class_name="Inc", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"tag": "even" if i % 2 == 0 else "odd"},
+                vector=vecs[i])
+        for i in range(N)])
+    return app, idx, vecs
+
+
+def test_device_error_storm_produces_exactly_one_bundle(tmp_path):
+    """The acceptance journey: a seeded device-error storm trips the
+    breaker under a closed loop of concurrent clients -> exactly ONE
+    rate-limited breaker_open bundle whose four plane summaries and
+    journal tail are present and mutually time-consistent."""
+    app, idx, vecs = _mk_incident_app(tmp_path)
+    inj = faults.configure(faults.FaultInjector(seed=7))
+    try:
+        queries = [vecs[i] + 0.5 for i in range(16)]
+        # warm once so audits/perf have a clean dispatch first
+        app.traverser.get_class(GetParams(
+            class_name="Inc", near_vector={"vector": queries[0].tolist()},
+            limit=K))
+        inj.plan("index.tpu.dispatch", "device_error", times=None)
+
+        errs = []
+
+        def run(i):
+            try:
+                app.traverser.get_class(GetParams(
+                    class_name="Inc",
+                    near_vector={"vector": queries[i].tolist()}, limit=K))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(queries))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "request hung"
+        assert errs == []
+        # sequential requests deterministically finish tripping it
+        for _ in range(6):
+            if app.breaker.state() == robustness.STATE_OPEN:
+                break
+            app.traverser.get_class(GetParams(
+                class_name="Inc",
+                near_vector={"vector": queries[2].tolist()}, limit=K))
+        assert app.breaker.state() == robustness.STATE_OPEN
+        # keep serving while OPEN: more fallbacks, more shed-free traffic
+        for i in range(4):
+            app.traverser.get_class(GetParams(
+                class_name="Inc",
+                near_vector={"vector": queries[i].tolist()}, limit=K))
+        # the async capture lands; the storm produced EXACTLY one bundle
+        rec = app.flight_recorder
+        deadline = time.monotonic() + 5.0
+        while not rec.index() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        bundles = [b for b in rec.index() if b["class"] == "breaker_open"]
+        assert len(bundles) == 1
+        assert [b["class"] for b in rec.index()] == ["breaker_open"]
+        path = os.path.join(rec.incident_dir, bundles[0]["file"])
+        bundle = json.load(open(path))
+        # all four plane summaries present...
+        assert "perf" in bundle and "dispatches" in bundle["perf"]
+        assert "quality" in bundle and "audits" in bundle["quality"]
+        assert "memory" in bundle and "device" in bundle["memory"]
+        assert "traces" in bundle and "tail" in bundle["traces"]
+        # ...the journal tail carries the causal chain...
+        kinds = {ev["kind"] for ev in bundle["journal"]["tail"]}
+        assert "fault_injected" in kinds
+        assert "breaker_open" in kinds
+        # ...and every section is time-consistent with the incident stamp
+        t_inc = bundle["incident"]["ts_unix"]
+        for name in ("journal", "perf", "quality", "memory"):
+            assert abs(bundle[name]["captured_unix"] - t_inc) < 10.0
+        # the breaker section recorded the OPEN state the trigger saw
+        assert bundle["breaker"]["state_name"] in ("open", "half_open")
+        # coalescer stats rode in via the App's provider
+        assert "coalescer" in bundle
+    finally:
+        faults.unconfigure(inj)
+        app.shutdown()
+
+
+# -- teardown chaining --------------------------------------------------------
+
+
+def test_sigterm_teardown_dumps_then_preserves_sig_ign(tmp_path, monkeypatch):
+    """stop capture -> dump bundle -> re-deliver: with prev=SIG_IGN the
+    chain still swallows the signal (PR-7 semantics), and a live
+    recorder leaves a forced teardown bundle."""
+    import signal
+
+    from weaviate_tpu.monitoring import profiling
+
+    rec = incidents.FlightRecorder(str(tmp_path), rate_limit_s=300.0)
+    incidents.configure(recorder=rec)
+    profiling.register_teardown_hook(incidents.teardown_dump)
+    monkeypatch.setitem(profiling._teardown_state, "prev_sigterm",
+                        signal.SIG_IGN)
+    try:
+        profiling._sigterm_teardown(signal.SIGTERM, None)  # must not raise
+        idx = rec.index()
+        assert len(idx) == 1 and idx[0]["class"] == "teardown"
+        # forced: a second teardown (atexit after SIGTERM) dumps again
+        profiling._atexit_teardown()
+        assert len(rec.index()) == 2
+    finally:
+        incidents.unconfigure(recorder=rec)
+
+
+def test_clean_shutdown_then_teardown_dumps_nothing(tmp_path):
+    rec = incidents.FlightRecorder(str(tmp_path))
+    incidents.configure(recorder=rec)
+    incidents.unconfigure(recorder=rec)  # the App.shutdown path
+    assert incidents.teardown_dump() is None
+    assert rec.index() == []
+
+
+def test_emergency_dump_without_recorder(tmp_path):
+    assert incidents.get_recorder() is None
+    out = str(tmp_path / "bench-incidents")
+    p = incidents.emergency_dump("unreachable device (rc=3)",
+                                 directory=out,
+                                 detail={"probe": "timeout"})
+    assert p is not None and os.path.dirname(p) == out
+    bundle = json.load(open(p))
+    assert bundle["incident"]["class"] == "bench"
+    assert bundle["incident"]["detail"]["probe"] == "timeout"
+
+
+# -- REST + metrics e2e -------------------------------------------------------
+
+
+def test_debug_endpoints_and_metrics_e2e(tmp_path):
+    from weaviate_tpu.server import App
+    from weaviate_tpu.server.rest import RestServer
+
+    cfg = Config()
+    cfg.incidents.dir = str(tmp_path / "incidents")
+    cfg.incidents.slo_latency_p99_ms = 1000.0
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    srv = RestServer(app, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def get(path):
+        return json.load(urllib.request.urlopen(base + path, timeout=30))
+
+    try:
+        incidents.emit("breaker_open", scope="device")
+        # a served request feeds the SLO engine through the REST hook
+        get("/v1/meta")
+        slo = get("/debug/slo")
+        assert slo["enabled"] is True
+        assert {s["slo"] for s in slo["slos"]} == {"availability",
+                                                   "latency_p99"}
+        assert slo["requests_total"] >= 1
+        inc = get("/debug/incidents")
+        assert inc["enabled"] is True
+        assert any(ev["kind"] == "breaker_open"
+                   for ev in inc["journal"]["tail"])
+        assert inc["bundles"] == []
+        # explicit dump trigger
+        req = urllib.request.Request(base + "/debug/incidents/dump",
+                                     method="POST")
+        dumped = json.load(urllib.request.urlopen(req, timeout=30))
+        assert os.path.exists(dumped["file"])
+        assert get("/debug/incidents")["bundles"][0]["class"] == "manual"
+        # the debug index page lists the new surfaces
+        root = get("/debug/")
+        assert "/debug/incidents" in root["endpoints"]
+        assert "/debug/slo" in root["endpoints"]
+        # metrics exposition: ops events counted, burn gauges present
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=30).read().decode()
+        assert 'weaviate_ops_events_total{kind="breaker_open"}' in text
+        assert "weaviate_slo_burn_rate" in text
+        assert 'weaviate_incident_bundles_total{class="manual"}' in text
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def test_disabled_endpoints_report_disabled(tmp_path):
+    from weaviate_tpu.server import App
+    from weaviate_tpu.server.rest import RestServer
+
+    cfg = Config()
+    cfg.incidents.enabled = False
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    srv = RestServer(app, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        assert json.load(urllib.request.urlopen(
+            base + "/debug/slo", timeout=30))["enabled"] is False
+        assert json.load(urllib.request.urlopen(
+            base + "/debug/incidents", timeout=30))["enabled"] is False
+        req = urllib.request.Request(base + "/debug/incidents/dump",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+# -- ledger integration + CI stash --------------------------------------------
+
+
+def test_incident_dir_is_a_memory_ledger_disk_component(tmp_path):
+    from weaviate_tpu.monitoring import memory as memledger
+
+    led = memledger.MemoryLedger()
+    led.set_disk_path(str(tmp_path))
+    rec = incidents.FlightRecorder(str(tmp_path / "incidents"),
+                                   rate_limit_s=0.0)
+    # hermetic view of the module-level registry: recorders other suite
+    # tests' Apps registered (and that are still referenced) must not
+    # sum into this assertion
+    with memledger._providers_lock:
+        saved = dict(memledger._disk_providers)
+        memledger._disk_providers.clear()
+    try:
+        memledger.register_disk_provider(
+            rec, lambda r: {"incident_bundles": r.dir_bytes()})
+        rec.dump_now("manual", force=True)
+        comps = led.refresh_disk()
+        assert comps["incident_bundles"] == rec.dir_bytes() > 0
+    finally:
+        with memledger._providers_lock:
+            memledger._disk_providers.clear()
+            memledger._disk_providers.update(saved)
+
+
+def test_unconfigure_stashes_journal_for_ci_artifact():
+    j = incidents.OpsJournal(size=8)
+    incidents.configure(journal=j)
+    j.emit("breaker_open", scope="device")
+    incidents.unconfigure(journal=j)
+    stashed = incidents.recent_summaries()
+    assert stashed and stashed[-1]["events_total"] == 1
+    assert stashed[-1]["counts"]["breaker_open"] == 1
+
+
+def test_event_kinds_match_graftlint_mirror():
+    from tools.graftlint import rules as glrules
+
+    assert frozenset(incidents.EVENT_KINDS) == glrules.JOURNAL_EVENT_KINDS
+
+
+# -- config -------------------------------------------------------------------
+
+
+def test_incidents_config_parsing():
+    cfg = load_config({
+        "INCIDENTS_ENABLED": "1",
+        "INCIDENT_JOURNAL_SIZE": "128",
+        "INCIDENT_DIR": "/tmp/inc",
+        "INCIDENT_DIR_MAX_BYTES": "1048576",
+        "INCIDENT_RATE_LIMIT_S": "10",
+        "SLO_AVAILABILITY_TARGET": "0.995",
+        "SLO_LATENCY_P99_MS": "250",
+        "SLO_FAST_BURN_THRESHOLD": "10",
+        "SLO_SLOW_BURN_THRESHOLD": "2",
+        "SLO_MIN_EVENTS": "5",
+        "SLO_TENANT_AVAILABILITY_TARGETS": "gold=0.999,silver=0.99",
+    })
+    ic = cfg.incidents
+    assert ic.enabled and ic.journal_size == 128
+    assert ic.dir == "/tmp/inc" and ic.dir_max_bytes == 1 << 20
+    assert ic.rate_limit_s == 10.0
+    assert ic.slo_availability_target == 0.995
+    assert ic.slo_latency_p99_ms == 250.0
+    assert ic.slo_fast_burn == 10.0 and ic.slo_slow_burn == 2.0
+    assert ic.slo_min_events == 5
+    assert ic.slo_tenant_targets == {"gold": 0.999, "silver": 0.99}
+    assert load_config({"INCIDENTS_ENABLED": "0"}).incidents.enabled is False
+
+
+def test_incidents_config_validation_rejects_bad_values():
+    for env in (
+        {"INCIDENT_JOURNAL_SIZE": "0"},
+        {"INCIDENT_DIR_MAX_BYTES": "-1"},
+        {"INCIDENT_RATE_LIMIT_S": "-1"},
+        {"SLO_AVAILABILITY_TARGET": "1.5"},
+        {"SLO_AVAILABILITY_TARGET": "0"},
+        {"SLO_LATENCY_P99_MS": "-5"},
+        {"SLO_FAST_BURN_THRESHOLD": "0"},
+        {"SLO_MIN_EVENTS": "0"},
+        {"SLO_TENANT_AVAILABILITY_TARGETS": "gold=1.5"},
+        {"SLO_TENANT_AVAILABILITY_TARGETS": "notargets"},
+    ):
+        with pytest.raises(ConfigError):
+            load_config(env)

@@ -36,6 +36,12 @@ if [ -n "$art" ]; then
     # windows) — the device/host/disk byte picture + exhaustion forecast
     # of every App the suite ran
     export MEMORY_SUMMARY_FILE="${MEMORY_SUMMARY_FILE:-$art/debug_memory.json}"
+    # ...and the incident plane (monitoring/incidents.py): every App the
+    # suite runs writes its flight-recorder bundles here (a red breaker
+    # journey leaves its correlated post-mortem in the artifact), and
+    # conftest dumps the final ops-journal summaries beside them
+    export INCIDENT_DIR="${INCIDENT_DIR:-$art/incidents}"
+    export INCIDENTS_SUMMARY_FILE="${INCIDENTS_SUMMARY_FILE:-$art/debug_incidents.json}"
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="

@@ -36,6 +36,12 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
           field in index/ from a method that never stamps the memory
           ledger) — buffers the ledger cannot see make /debug/memory's
           exhaustion forecast a lie
+  JGL013  unregistered/dynamic ops-journal event kind (an incidents.emit
+          call site outside monitoring/incidents.py whose kind argument
+          is not a literal from the registered EVENT_KINDS taxonomy) —
+          a dynamic kind would fold to "other" at runtime (losing its
+          identity in every bundle) and an unregistered literal is a
+          typo the fold would silently swallow
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
@@ -189,8 +195,38 @@ RULE_DOCS = {
               "_stamp_memory()/_publish_snapshot() (monitoring/memory.py) "
               "so /debug/memory's bytes and exhaustion forecast stay "
               "truthful, or carry a justified suppression",
+    "JGL013": "unregistered or dynamically-built ops-journal event kind — "
+              "incidents.emit() call sites outside monitoring/incidents.py "
+              "must pass a literal kind from the registered EVENT_KINDS "
+              "taxonomy (the static twin of the runtime bounded-kind "
+              "fold): a dynamic kind loses its identity in every incident "
+              "bundle, an unregistered literal is a silently-swallowed "
+              "typo; register the kind in incidents.EVENT_KINDS (and the "
+              "JOURNAL_EVENT_KINDS mirror here) or use an existing one",
     "JGL999": "file does not parse",
 }
+
+# JGL013: the registered ops-journal event kinds. A MIRROR of
+# weaviate_tpu/monitoring/incidents.py EVENT_KINDS — graftlint is a pure
+# ast tool and must not import the package it lints; the two sets are
+# pinned equal by tests/test_incidents.py, so drift fails the suite.
+JOURNAL_EVENT_KINDS = frozenset({
+    "breaker_open", "breaker_half_open", "breaker_closed",
+    "shed_burst", "deadline_burst",
+    "quality_degraded", "quality_recovered",
+    "memory_alert", "memory_recovered",
+    "jit_compile", "device_fallback", "flusher_dead",
+    "write_phase", "fault_injected",
+    "slo_burn", "slo_recovered",
+    "incident_dump", "teardown",
+})
+
+# JGL013 scope: everywhere in the package EXCEPT the journal module
+# itself (whose emit() implementation and internal re-emissions own the
+# taxonomy). The kinds are registered in one place but emitted from
+# every plane — the JGL010 shape, applied to event kinds.
+JGL013_PREFIXES = ("weaviate_tpu/",)
+JGL013_EXEMPT_SUFFIX = "monitoring/incidents.py"
 
 # JGL010 scope: the whole package — metric vecs are registered once in
 # monitoring/metrics.py but label values are supplied at every call site,
@@ -239,6 +275,15 @@ def in_snapshot_ledger_scope(rel_path: str) -> bool:
     rp = rel_path.replace("\\", "/")
     return any(rp == p or rp.startswith(p) or f"/{p}" in rp
                for p in JGL012_PREFIXES)
+
+
+def in_journal_kind_scope(rel_path: str) -> bool:
+    """JGL013 scope check: package-wide, minus the journal module."""
+    rp = rel_path.replace("\\", "/")
+    if rp.endswith(JGL013_EXEMPT_SUFFIX):
+        return False
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL013_PREFIXES)
 
 
 def in_span_scope(rel_path: str) -> bool:
@@ -327,6 +372,17 @@ class ModuleIndex:
         # chains (self.httpd.serve_forever) point outside this module and
         # are skipped (under-approximation on purpose).
         self.thread_targets: set[str] = set()
+        # local names bound to the incidents journal's emit() by a
+        # `from ...monitoring.incidents import emit [as X]` — JGL013
+        # audits bare-name calls through these too, so aliasing the
+        # import can't dodge the kind check
+        self.incident_emit_names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and (node.module or "").endswith("monitoring.incidents"):
+                for a in node.names:
+                    if a.name == "emit":
+                        self.incident_emit_names.add(a.asname or "emit")
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -394,6 +450,7 @@ class RuleWalker(ast.NodeVisitor):
         self.lock_fetch_scope = in_lock_fetch_scope(rel_path)
         self.unbounded_wait_scope = in_unbounded_wait_scope(rel_path)
         self.metric_label_scope = in_metric_label_scope(rel_path)
+        self.journal_kind_scope = in_journal_kind_scope(rel_path)
         self.thread_runloop_scope = in_thread_runloop_scope(rel_path)
         self.snapshot_ledger_scope = in_snapshot_ledger_scope(rel_path)
         self.mod = mod
@@ -597,6 +654,7 @@ class RuleWalker(ast.NodeVisitor):
         self._check_lock_fetch(node)
         self._check_unbounded_wait(node)
         self._check_dynamic_label(node)
+        self._check_journal_kind(node)
         self.generic_visit(node)
 
     # -- JGL011: unguarded background-thread run-loop --
@@ -720,6 +778,51 @@ class RuleWalker(ast.NodeVisitor):
                           "value mints a Prometheus series forever; pass a "
                           "bounded value (metrics.TenantLabeler top-K + "
                           "'other', or a fixed enum) instead")
+
+    # -- JGL013: ops-journal event kind must be a registered literal --
+
+    def _is_incident_emit(self, node: ast.Call) -> bool:
+        """Is this call the incidents journal's emit()? Recognized forms:
+        ``incidents.emit(...)`` (any dotted path ending there — the
+        canonical ``from ... import incidents`` spelling), and a bare
+        name bound by ``from ...monitoring.incidents import emit``."""
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id in self.mod.incident_emit_names
+        d = dotted(f) or ""
+        return d == "incidents.emit" or d.endswith(".incidents.emit")
+
+    def _check_journal_kind(self, node: ast.Call) -> None:
+        if not self.journal_kind_scope or not self._is_incident_emit(node):
+            return
+        kind = node.args[0] if node.args else None
+        if kind is None:
+            for kw in node.keywords:
+                if kw.arg == "kind":
+                    kind = kw.value
+                    break
+        if kind is None:
+            self.emit("JGL013", node,
+                      "incidents.emit() with no kind argument — pass a "
+                      "literal kind from the registered EVENT_KINDS "
+                      "taxonomy")
+            return
+        value = _const_str(kind)
+        if value is None:
+            self.emit("JGL013", kind,
+                      "ops-journal event kind built/passed dynamically — "
+                      "a non-literal kind would fold to 'other' at "
+                      "runtime, losing its identity in every incident "
+                      "bundle; pass a literal from the registered "
+                      "EVENT_KINDS taxonomy")
+        elif value not in JOURNAL_EVENT_KINDS:
+            self.emit("JGL013", kind,
+                      f"ops-journal event kind {value!r} is not in the "
+                      "registered EVENT_KINDS taxonomy — the runtime fold "
+                      "would silently swallow it as 'other'; register it "
+                      "in monitoring/incidents.py EVENT_KINDS (and the "
+                      "JOURNAL_EVENT_KINDS mirror in graftlint) or use an "
+                      "existing kind")
 
     # -- JGL009: unbounded blocking wait --
 

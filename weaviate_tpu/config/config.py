@@ -259,6 +259,70 @@ class MemoryLedgerConfig:
 
 
 @dataclass
+class IncidentsConfig:
+    """Incident flight recorder + SLO burn-rate engine (monitoring/
+    incidents.py). TPU extension: a bounded ops-event journal fed by
+    every plane's state transitions, config-declared availability/
+    latency SLOs evaluated into 5m/1h burn rates, and trigger-driven
+    post-mortem bundles (perf/quality/memory/trace/journal state) dumped
+    to ``INCIDENT_DIR``. Disabled => no journal/engine/recorder object
+    anywhere on the serving path (the module globals stay None; every
+    entry point is a one-comparison no-op)."""
+
+    enabled: bool = True
+    # ops-journal ring size (events retained for /debug/incidents and
+    # bundle tails; burst kinds coalesce so a storm is one entry)
+    journal_size: int = 512
+    # bundle directory; "" = <data_path>/incidents
+    dir: str = ""
+    # disk budget for the bundle directory: oldest bundles pruned past
+    # this (accounted in the memory ledger's disk scope). 0 = unbounded.
+    dir_max_bytes: int = 64 * 1024 * 1024
+    # min seconds between bundles of one incident class (teardown/manual
+    # dumps are forced and exempt)
+    rate_limit_s: float = 300.0
+    # availability SLO: the fraction of serving requests that must not
+    # shed/expire/error (bad fraction / (1-target) = burn rate)
+    slo_availability_target: float = 0.999
+    # latency SLO: p99 target in ms over completed requests; 0 disables
+    # the latency objective (there is no universally right target)
+    slo_latency_p99_ms: float = 0.0
+    # burn-rate alert thresholds for the 5m (fast) and 1h (slow) windows
+    # (14.4/3.0: the SRE-workbook pairing — a cliff vs a smolder)
+    slo_fast_burn: float = 14.4
+    slo_slow_burn: float = 3.0
+    # requests a window must hold before its burn rate may alert (a cold
+    # window over two requests is noise, not an incident)
+    slo_min_events: int = 20
+    # "tenantA=0.999,tenantB=0.99" — per-tenant availability overrides;
+    # each adds ONE bounded SLO series (config-sized, never traffic-sized)
+    slo_tenant_targets: dict = field(default_factory=dict)
+
+
+def _tenant_targets(env: Mapping[str, str], key: str) -> dict:
+    """Parse "a=0.999,b=0.99" into {tenant: float target in (0,1)};
+    reject malformed entries at startup, not at the first request."""
+    out: dict = {}
+    for item in _list(env, key):
+        if "=" not in item:
+            raise ConfigError(
+                f"invalid {key} entry {item!r} (want tenant=target)")
+        name, t = item.split("=", 1)
+        name = name.strip()
+        try:
+            target = float(t)
+        except ValueError:
+            raise ConfigError(
+                f"invalid {key} target for {name!r}: {t!r}") from None
+        if not name or not (0.0 < target < 1.0):
+            raise ConfigError(
+                f"invalid {key} entry {item!r} (want nonempty tenant, "
+                "target in (0, 1))")
+        out[name] = target
+    return out
+
+
+@dataclass
 class TenancyConfig:
     """Multi-tenant fairness (serving/coalescer.py weighted-fair
     admission + monitoring/metrics.py bounded tenant labels). TPU
@@ -350,6 +414,7 @@ class Config:
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     quality: QualityConfig = field(default_factory=QualityConfig)
     memory: MemoryLedgerConfig = field(default_factory=MemoryLedgerConfig)
+    incidents: IncidentsConfig = field(default_factory=IncidentsConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -433,6 +498,34 @@ class Config:
             raise ConfigError("MEMORY_DEVICE_BUDGET_BYTES must be >= 0")
         if self.memory.host_budget_bytes < 0:
             raise ConfigError("MEMORY_HOST_BUDGET_BYTES must be >= 0")
+        if self.incidents.journal_size < 1:
+            raise ConfigError("INCIDENT_JOURNAL_SIZE must be >= 1")
+        if self.incidents.dir_max_bytes < 0:
+            raise ConfigError(
+                "INCIDENT_DIR_MAX_BYTES must be >= 0 (0 = unbounded)")
+        if self.incidents.rate_limit_s < 0:
+            raise ConfigError("INCIDENT_RATE_LIMIT_S must be >= 0")
+        if not (0.0 < self.incidents.slo_availability_target < 1.0):
+            raise ConfigError("SLO_AVAILABILITY_TARGET must be in (0, 1)")
+        if self.incidents.slo_latency_p99_ms < 0:
+            raise ConfigError(
+                "SLO_LATENCY_P99_MS must be >= 0 (0 disables)")
+        if self.incidents.slo_fast_burn <= 0 \
+                or self.incidents.slo_slow_burn <= 0:
+            raise ConfigError(
+                "SLO_FAST_BURN_THRESHOLD and SLO_SLOW_BURN_THRESHOLD "
+                "must be > 0")
+        if self.incidents.slo_min_events < 1:
+            raise ConfigError("SLO_MIN_EVENTS must be >= 1")
+        if len(self.incidents.slo_tenant_targets) > 64:
+            raise ConfigError(
+                "SLO_TENANT_AVAILABILITY_TARGETS: at most 64 per-tenant "
+                "overrides (each mints a bounded metric series)")
+        for t, tv in self.incidents.slo_tenant_targets.items():
+            if not t or not (0.0 < tv < 1.0):
+                raise ConfigError(
+                    f"SLO_TENANT_AVAILABILITY_TARGETS entry {t!r}={tv!r} "
+                    "must have a nonempty tenant and target in (0, 1)")
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -555,6 +648,21 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.memory.device_budget_bytes = _int(
         e, "MEMORY_DEVICE_BUDGET_BYTES", 0)
     cfg.memory.host_budget_bytes = _int(e, "MEMORY_HOST_BUDGET_BYTES", 0)
+
+    cfg.incidents.enabled = _bool(e, "INCIDENTS_ENABLED", True)
+    cfg.incidents.journal_size = _int(e, "INCIDENT_JOURNAL_SIZE", 512)
+    cfg.incidents.dir = e.get("INCIDENT_DIR", "")
+    cfg.incidents.dir_max_bytes = _int(
+        e, "INCIDENT_DIR_MAX_BYTES", 64 * 1024 * 1024)
+    cfg.incidents.rate_limit_s = _float(e, "INCIDENT_RATE_LIMIT_S", 300.0)
+    cfg.incidents.slo_availability_target = _float(
+        e, "SLO_AVAILABILITY_TARGET", 0.999)
+    cfg.incidents.slo_latency_p99_ms = _float(e, "SLO_LATENCY_P99_MS", 0.0)
+    cfg.incidents.slo_fast_burn = _float(e, "SLO_FAST_BURN_THRESHOLD", 14.4)
+    cfg.incidents.slo_slow_burn = _float(e, "SLO_SLOW_BURN_THRESHOLD", 3.0)
+    cfg.incidents.slo_min_events = _int(e, "SLO_MIN_EVENTS", 20)
+    cfg.incidents.slo_tenant_targets = _tenant_targets(
+        e, "SLO_TENANT_AVAILABILITY_TARGETS")
 
     cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
     cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)

@@ -32,7 +32,7 @@ from weaviate_tpu.entities.filters import GeoRange, LocalFilter
 from weaviate_tpu.entities.schema import ClassDef, DataType
 from weaviate_tpu.entities.storobj import StorObj
 from weaviate_tpu.index import new_vector_index
-from weaviate_tpu.monitoring import memory, perf, quality, tracing
+from weaviate_tpu.monitoring import incidents, memory, perf, quality, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 # request-lifecycle robustness (stdlib-only module — no import cycle even
 # though serving/coalescer.py imports this file): deadline fail-fast +
@@ -743,6 +743,10 @@ class Shard:
         on a dashboard, not only in tail latency."""
         record_device_fallback("db.shard.search", reason, cause,
                                log=reason != "breaker_open")
+        # journal the degradation (monitoring/incidents.py): burst-
+        # coalesced per reason, so a breaker-open stretch reads as one
+        # counted entry in the incident bundle's tail, not a ring wipe
+        incidents.emit("device_fallback", scope=reason)
         hs = getattr(self.vector_index, "search_by_vectors_host", None)
         if hs is None:  # caller checked; defensive for foreign indexes
             if cause is not None:

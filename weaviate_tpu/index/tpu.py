@@ -63,6 +63,11 @@ from weaviate_tpu.monitoring import costmodel, tracing
 # every buffer-mutating method; unconfigured => one comparison, nothing
 # constructed. Search dispatches never touch it.
 from weaviate_tpu.monitoring import memory
+# ops-event journal (monitoring/incidents.py): write-path compress/compact
+# phases and degraded-kernel fallbacks are journaled so an incident bundle
+# shows what the index was doing around a symptom; unconfigured => one
+# comparison, nothing constructed, emit() is exception-guarded internally
+from weaviate_tpu.monitoring import incidents
 # shadow recall auditing (monitoring/quality.py): the dispatch snapshot is
 # pinned in TLS ONLY while an auditor is configured (one comparison,
 # nothing constructed — the tracer's zero-cost contract), so the audit
@@ -1609,6 +1614,8 @@ class TpuVectorIndex(VectorIndex):
             led.note_write(
                 "compress", "compress", (time.perf_counter() - t0) * 1000.0,
                 rows=self.n, bytes_moved=memory.array_bytes(self._codes))
+        incidents.emit("write_phase", scope="compress", rows=self.n,
+                       ms=round((time.perf_counter() - t0) * 1000.0, 1))
         self._publish_snapshot()
 
     # -- VectorIndex ---------------------------------------------------------
@@ -1725,6 +1732,7 @@ class TpuVectorIndex(VectorIndex):
             return False  # config opt-out, not degradation
         if self._gmin_broken:
             record_device_fallback("index.tpu.gmin", "degraded", log=False)
+            incidents.emit("device_fallback", scope="index.tpu.gmin")
             return False
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return False
@@ -2614,6 +2622,9 @@ class TpuVectorIndex(VectorIndex):
                     "compact", "compact",
                     (time.perf_counter() - t_compact0) * 1000.0,
                     rows=self.live)
+            incidents.emit(
+                "write_phase", scope="compact", rows=self.live,
+                ms=round((time.perf_counter() - t_compact0) * 1000.0, 1))
 
     def drop(self) -> None:
         with self._lock:

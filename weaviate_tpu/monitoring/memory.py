@@ -79,7 +79,7 @@ DEVICE_COMPONENTS = ("store", "sq_norms", "tombs", "pq_codes",
 HOST_COMPONENTS = ("slot_to_doc", "host_tombs", "host_vecs",
                    "pending_rows", "breaker_rows", "auditor_rows",
                    "allow_cache")
-DISK_COMPONENTS = ("used", "free")
+DISK_COMPONENTS = ("used", "free", "incident_bundles")
 OTHER = "other"
 SCOPES = ("device", "host", "disk")
 
@@ -250,6 +250,7 @@ def auditor_host_components(auditor) -> dict:
 _providers_lock = threading.Lock()
 _host_providers: dict = {}    # id(owner) -> (weakref.ref(owner), fn)
 _device_providers: dict = {}  # id(owner) -> (weakref.ref(owner), fn)
+_disk_providers: dict = {}    # id(owner) -> (weakref.ref(owner), fn)
 
 
 def _register(registry: dict, owner, fn: Callable) -> None:
@@ -304,6 +305,14 @@ def register_device_provider(owner, fn: Callable) -> None:
     """Register a DEVICE-memory provider for allocations that live
     outside the snapshot stamping flow (e.g. per-bitmap filter words)."""
     _register(_device_providers, owner, fn)
+
+
+def register_disk_provider(owner, fn: Callable) -> None:
+    """Register a DISK consumer whose bytes should appear as their own
+    component beside used/free (the incident flight recorder's bundle
+    directory — monitoring/incidents.py). Components are informational
+    sub-accounts of ``used``; the scope's budget stays the volume total."""
+    _register(_disk_providers, owner, fn)
 
 
 def host_components() -> dict:
@@ -483,6 +492,9 @@ class MemoryLedger:
         except OSError:
             return {}
         comps = {"used": int(u.used), "free": int(u.free)}
+        # registered disk consumers (the incident-bundle directory): their
+        # bytes are a sub-account of `used`, shown as their own component
+        comps.update(_poll(_disk_providers))
         with self._lock:
             self._disk_cache = comps
             # one budget basis everywhere: the volume's total as reported
@@ -563,6 +575,28 @@ class MemoryLedger:
                     m.memory_alerts.labels(scope).inc()
                 except Exception:  # noqa: BLE001
                     pass
+            if transitioned:
+                # the exhaustion transition is an ops-journal event AND an
+                # incident trigger (monitoring/incidents.py): the bundle
+                # preserves the byte ledger + forecast around the alert —
+                # the post-mortem an HBM-OOM rc=3 never left behind. Lazy
+                # import; one-comparison no-ops when the plane is off.
+                try:
+                    from weaviate_tpu.monitoring import incidents
+
+                    incidents.emit("memory_alert", scope=scope,
+                                   used_bytes=int(used),
+                                   budget_bytes=int(budget),
+                                   headroom_pct=round(headroom_pct, 2))
+                    incidents.trigger(
+                        "memory_exhaustion",
+                        reason=f"memory headroom degraded: scope={scope} "
+                               f"headroom={headroom_pct:.1f}% < "
+                               f"{self.headroom_alert_pct:.1f}%",
+                        detail={"scope": scope, "used_bytes": int(used),
+                                "budget_bytes": int(budget)})
+                except Exception:  # noqa: BLE001 — must not break the write path
+                    pass
             now = time.monotonic()
             last = self._alert_last_log.get(scope)
             if transitioned or last is None \
@@ -582,6 +616,13 @@ class MemoryLedger:
         elif transitioned:
             _LOG.info("memory headroom recovered: scope=%s headroom=%.1f%%",
                       scope, headroom_pct)
+            try:
+                from weaviate_tpu.monitoring import incidents
+
+                incidents.emit("memory_recovered", scope=scope,
+                               headroom_pct=round(headroom_pct, 2))
+            except Exception:  # noqa: BLE001 — must not break the write path
+                pass
 
     def forecast_scope(self, scope: str, used: int, budget: int) -> dict:
         """One scope's forecast: headroom, ingest-rate EWMA, and the

@@ -462,6 +462,36 @@ class Metrics:
             "per-device total where the backend provides memory_stats() — "
             "a cross-check gauge, never the primary accounting", ("scope",))
 
+        # incident flight recorder + SLO burn-rate engine (monitoring/
+        # incidents.py): the ops-event journal's bounded kind counter, the
+        # config-declared SLOs' multi-window burn gauges, and the bundle
+        # counter. Label values are bounded taxonomies (incidents.EVENT_
+        # KINDS / INCIDENT_CLASSES, with foreign values folded to "other";
+        # SLO names are built once at engine init from config) — the
+        # JGL010 discipline, with JGL013 as the journal's static twin;
+        # the incident plane only touches these inside try/except.
+        self.ops_events = c(
+            "weaviate_ops_events_total",
+            "structured ops-journal events by (bounded) kind — breaker "
+            "transitions, shed bursts, quality/memory alerts, jit "
+            "compiles, device fallbacks, SLO burns (monitoring/"
+            "incidents.py)", ("kind",))
+        self.slo_burn_rate = g(
+            "weaviate_slo_burn_rate",
+            "error-budget burn rate per SLO and window (5m fast / 1h "
+            "slow): bad-request fraction over the window divided by the "
+            "SLO's error budget — 1.0 spends budget exactly at the "
+            "sustainable rate", ("slo", "window"))
+        self.slo_budget_remaining = g(
+            "weaviate_slo_error_budget_remaining",
+            "error budget left over the trailing 1h window per SLO "
+            "(1.0 = untouched, 0.0 = the hour's budget is gone)",
+            ("slo",))
+        self.incident_bundles = c(
+            "weaviate_incident_bundles_total",
+            "flight-recorder bundles written to INCIDENT_DIR, by "
+            "(bounded) incident class", ("class",))
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

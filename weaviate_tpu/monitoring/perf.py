@@ -35,6 +35,13 @@ shadow recall auditor measures what the serving path ANSWERS (recall,
 rank overlap, distance error at ``GET /debug/quality``) the way this
 window measures what it COSTS — same rolling-window idiom, same
 zero-cost-disabled lifecycle, same authorizer.
+
+The CAPSTONE consumer is the incident plane (monitoring/incidents.py):
+``summary()`` is captured verbatim into every flight-recorder bundle, so
+a breaker trip or SLO burn preserves the window's duty-cycle/roofline/
+ledger picture at the moment of the incident — and
+``recent_summaries()`` keeps the last windows reachable even after the
+owning App is torn down (the bench's rc=3 emergency dump reads it).
 """
 
 from __future__ import annotations

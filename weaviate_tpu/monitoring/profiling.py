@@ -127,6 +127,32 @@ _teardown_state = {"active": False, "atexit_installed": False,
                    "signal_installed": False, "prev_sigterm": None}
 _teardown_lock = threading.Lock()
 
+# teardown hooks run AFTER the capture stop and BEFORE any signal
+# re-delivery: the incident flight recorder (monitoring/incidents.py)
+# chains its dump here, so a process dying mid-serve leaves a measured
+# post-mortem (stop capture -> dump bundle -> re-deliver). Each hook is
+# exception-guarded — teardown must never raise.
+_teardown_hooks: list = []
+
+
+def register_teardown_hook(fn) -> None:
+    """Add `fn` to the SIGTERM/atexit teardown chain (idempotent per
+    function object). Hooks must be safe to call at any time — they run
+    with the process dying."""
+    with _teardown_lock:
+        if fn not in _teardown_hooks:
+            _teardown_hooks.append(fn)
+
+
+def _run_teardown_hooks() -> None:
+    with _teardown_lock:
+        hooks = list(_teardown_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — teardown must never raise
+            pass
+
 
 def stop_active_trace() -> bool:
     """Stop the active device-trace capture if one is running. Idempotent
@@ -145,8 +171,21 @@ def stop_active_trace() -> bool:
         return False
 
 
-def _sigterm_teardown(signum, frame):
+def _atexit_teardown() -> None:
+    """Normal-exit half of the teardown: stop any active capture, then run
+    the chained hooks (a cleanly shut-down App has already unconfigured
+    its recorder, so its hook no-ops; an App still live at exit dumps)."""
     stop_active_trace()
+    _run_teardown_hooks()
+
+
+def _sigterm_teardown(signum, frame):
+    # stop capture -> dump bundle -> re-deliver: the hooks (the incident
+    # recorder's dump) run after the profiler stop so the bundle never
+    # races an armed device capture, and before re-delivery so the
+    # process's exit status is unchanged
+    stop_active_trace()
+    _run_teardown_hooks()
     prev = _teardown_state["prev_sigterm"]
     import signal as _signal
 
@@ -179,7 +218,7 @@ def install_trace_teardown() -> bool:
             return True
         if not _teardown_state["atexit_installed"]:
             _teardown_state["atexit_installed"] = True
-            atexit.register(stop_active_trace)
+            atexit.register(_atexit_teardown)
     try:
         prev = _signal.getsignal(_signal.SIGTERM)
         if prev is _sigterm_teardown:  # foreign reinstall of our handler

@@ -526,6 +526,25 @@ class QualityAuditor:
                     m.quality_degraded.labels(tier).inc()
                 except Exception:  # noqa: BLE001
                     pass
+            if transitioned:
+                # the degradation transition is an ops-journal event AND an
+                # incident trigger (monitoring/incidents.py): the bundle
+                # preserves the quality window + journal around the drop.
+                # One-comparison no-ops when the plane is off; lazy import
+                # (incidents is deliberately off this module's import path).
+                try:
+                    from weaviate_tpu.monitoring import incidents
+
+                    incidents.emit("quality_degraded", scope=tier,
+                                   ewma_recall=round(ewma, 4),
+                                   threshold=self.alert_threshold)
+                    incidents.trigger(
+                        "quality_degraded",
+                        reason=f"online recall degraded: tier={tier} "
+                               f"ewma={ewma:.4f} < {self.alert_threshold}",
+                        detail={"tier": tier, "ewma_recall": ewma})
+                except Exception:  # noqa: BLE001 — must not break the audit loop
+                    pass
             now = time.monotonic()
             last = self._degraded_last_log.get(tier)
             if last is None or now - last >= DEGRADED_LOG_INTERVAL_S:
@@ -540,6 +559,13 @@ class QualityAuditor:
         elif transitioned:
             _LOG.info("online recall recovered: tier=%s ewma_recall=%.4f",
                       tier, ewma)
+            try:
+                from weaviate_tpu.monitoring import incidents
+
+                incidents.emit("quality_recovered", scope=tier,
+                               ewma_recall=round(ewma, 4))
+            except Exception:  # noqa: BLE001 — must not break the audit loop
+                pass
 
     def _count_metric(self, outcome: str) -> None:
         m = self.metrics

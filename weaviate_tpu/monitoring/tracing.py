@@ -445,7 +445,19 @@ class Tracer:
             if len(self._shapes) >= 8192:  # runaway shape churn backstop
                 self._shapes.clear()
             self._shapes.add(key)
-            return True
+        # a first sighting is (a proxy for) a jit compile — journal it so
+        # an incident bundle shows whether the window around a latency
+        # spike was paying compiles (monitoring/incidents.py; burst-
+        # coalesced, one-comparison no-op when the plane is off). Lazy
+        # import: incidents is off tracing's import path by design.
+        try:
+            from weaviate_tpu.monitoring import incidents
+
+            incidents.emit("jit_compile", scope="dispatch",
+                           padded_rows=int(key[1]), k=int(key[2]))
+        except Exception:  # noqa: BLE001 — observability must not break serving
+            pass
+        return True
 
 
 # -- module state + zero-hop accessors ----------------------------------------

@@ -112,6 +112,51 @@ class App:
             self.memory_ledger.set_disk_path(path)
         else:
             self.memory_ledger = None
+        # incident flight recorder + SLO burn-rate engine (monitoring/
+        # incidents.py): the capstone layer that connects the planes above
+        # — an ops-event journal fed by their state transitions, config-
+        # declared SLOs evaluated into 5m/1h burn rates, and trigger-
+        # driven post-mortem bundles under INCIDENT_DIR. Same module-
+        # global lifecycle discipline; disabled => the globals stay None
+        # and every emit/note_request/trigger is a one-comparison no-op
+        # that constructs nothing (spy-pinned in tests/test_incidents.py).
+        ic = self.config.incidents
+        if ic.enabled:
+            from weaviate_tpu.monitoring import incidents
+            from weaviate_tpu.monitoring import memory as memledger_mod
+
+            self.ops_journal = incidents.OpsJournal(
+                size=ic.journal_size, metrics=self.metrics)
+            self.slo_engine = incidents.SloEngine(
+                availability_target=ic.slo_availability_target,
+                latency_p99_ms=ic.slo_latency_p99_ms,
+                fast_burn_threshold=ic.slo_fast_burn,
+                slow_burn_threshold=ic.slo_slow_burn,
+                min_events=ic.slo_min_events,
+                tenant_targets=ic.slo_tenant_targets,
+                metrics=self.metrics)
+            self.flight_recorder = incidents.FlightRecorder(
+                ic.dir or os.path.join(path, "incidents"),
+                max_bytes=ic.dir_max_bytes,
+                rate_limit_s=ic.rate_limit_s,
+                journal=self.ops_journal,
+                engine=self.slo_engine,
+                metrics=self.metrics)
+            self.flight_recorder.set_config_fingerprint(
+                self._config_fingerprint())
+            # the bundle directory is a disk consumer the capacity plane
+            # should see: registered as the ledger's `incident_bundles`
+            # disk component (weakref provider, PR-9 idiom)
+            memledger_mod.register_disk_provider(
+                self.flight_recorder,
+                lambda rec: {"incident_bundles": rec.dir_bytes()})
+            incidents.configure(journal=self.ops_journal,
+                                engine=self.slo_engine,
+                                recorder=self.flight_recorder)
+        else:
+            self.ops_journal = None
+            self.slo_engine = None
+            self.flight_recorder = None
         # a SIGTERM mid device-trace capture must still stop the JAX
         # profiler (the r05 wedge): install the signal/atexit teardown
         # from the main thread while we are likely on it — REST handler
@@ -119,6 +164,15 @@ class App:
         from weaviate_tpu.monitoring import profiling
 
         profiling.install_trace_teardown()
+        if self.flight_recorder is not None:
+            # chain the flight-recorder dump into the same teardown:
+            # stop capture -> dump bundle -> re-deliver. The hook reads
+            # the LIVE module global, so a cleanly shut-down App (already
+            # unconfigured) dumps nothing at exit, while a process dying
+            # with a live server preserves its evidence.
+            from weaviate_tpu.monitoring import incidents
+
+            profiling.register_teardown_hook(incidents.teardown_dump)
 
         # request-lifecycle robustness (serving/robustness.py): shed/
         # deadline counters bind to this App's metrics; the device circuit
@@ -269,6 +323,16 @@ class App:
         else:
             self.coalescer = None
             self.serving_pool = None
+        if self.flight_recorder is not None:
+            # live serving stats ride into every bundle: the coalescer's
+            # lane/shed/tenant picture and the front-door gate occupancy
+            # (pull callables, each captured under its own guard)
+            if self.coalescer is not None:
+                self.flight_recorder.add_stats_provider(
+                    "coalescer", self.coalescer.stats)
+            if self.tenant_gate is not None:
+                self.flight_recorder.add_stats_provider(
+                    "tenant_gate", self.tenant_gate.stats)
         self.explorer = Explorer(
             self.db, self.schema, modules=self.modules,
             query_limit=self.config.query_defaults_limit,
@@ -325,6 +389,31 @@ class App:
                 logging.getLogger(__name__).info(
                     "filterable backfill rebuilt: %s", rebuilt)
 
+    def _config_fingerprint(self) -> dict:
+        """The serving-relevant config knobs + a short digest, stamped
+        into every incident bundle so a post-mortem knows exactly what
+        configuration produced it. Auth/secrets are deliberately absent."""
+        import dataclasses
+        import hashlib
+        import json as _json
+
+        c = self.config
+        knobs = {
+            "coalescer": dataclasses.asdict(c.coalescer),
+            "tracing": dataclasses.asdict(c.tracing),
+            "robustness": dataclasses.asdict(c.robustness),
+            "tenancy": dataclasses.asdict(c.tenancy),
+            "quality": dataclasses.asdict(c.quality),
+            "memory": dataclasses.asdict(c.memory),
+            "incidents": dataclasses.asdict(c.incidents),
+            "store_dtype": c.store_dtype,
+            "device_mesh_shards": c.device_mesh_shards,
+        }
+        digest = hashlib.sha256(
+            _json.dumps(knobs, sort_keys=True, default=str).encode()
+        ).hexdigest()[:16]
+        return {"sha256_16": digest, "knobs": knobs}
+
     def _store_opts(self) -> dict:
         """LSM tuning from env (PERSISTENCE_MEMTABLES_MAX_SIZE_MB,
         PERSISTENCE_FLUSH_IDLE_MEMTABLES_AFTER — environment.go surface)."""
@@ -371,6 +460,16 @@ class App:
             # still-ours discipline; stashes the final summary for the
             # debug_memory.json CI artifact
             memledger.unconfigure(self.memory_ledger)
+        if self.ops_journal is not None:
+            from weaviate_tpu.monitoring import incidents
+
+            # still-ours discipline; stashes the journal's final summary
+            # for the debug_incidents.json CI artifact and stops the
+            # recorder worker — a cleanly shut-down App then dumps
+            # nothing from the atexit/SIGTERM teardown hook
+            incidents.unconfigure(journal=self.ops_journal,
+                                  engine=self.slo_engine,
+                                  recorder=self.flight_recorder)
         # robustness globals: same still-ours discipline as the tracer
         from weaviate_tpu.serving import robustness
 

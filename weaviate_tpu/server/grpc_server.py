@@ -21,7 +21,7 @@ import numpy as np
 
 from weaviate_tpu.entities.filters import LocalFilter
 from weaviate_tpu.grpcapi import weaviate_pb2 as pb
-from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.monitoring import incidents, tracing
 from weaviate_tpu.serving import robustness
 from weaviate_tpu.server import reply_native
 from weaviate_tpu.usecases.traverser import GetParams
@@ -214,6 +214,15 @@ class SearchServicer:
                   if v > 0]
         return min(bounds) if bounds else 0.0
 
+    @staticmethod
+    def _note_slo(outcome: str, start: float,
+                  tenant: Optional[str] = None) -> None:
+        """SLO accounting (monitoring/incidents.py): the gRPC twin of the
+        REST _dispatch classification — one-comparison no-op when the
+        plane is off, exception-guarded internally."""
+        incidents.note_request(
+            outcome, (time.perf_counter() - start) * 1000.0, tenant)
+
     def _abort_lifecycle(self, context, rid: str, e: BaseException,
                          trace=None) -> None:
         """Map robustness errors to their canonical gRPC codes. Shed
@@ -249,6 +258,9 @@ class SearchServicer:
                 # traceparent echo like every other error reply
                 tenant = robustness.validate_tenant_id(raw_tenant)
             except ValueError as e:
+                # caller-mistake aborts count as "client" like the REST
+                # twin — identical workloads must burn identically
+                self._note_slo("client", start)
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
             if tenant:
@@ -256,6 +268,7 @@ class SearchServicer:
             try:
                 params = params_from_proto(request)
             except Exception as e:
+                self._note_slo("client", start, tenant)
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
             try:
@@ -266,15 +279,21 @@ class SearchServicer:
                     results = self.app.traverser.get_class(params)
             except (robustness.DeadlineExceededError,
                     robustness.OverloadedError) as e:
+                self._note_slo(
+                    "shed" if isinstance(e, robustness.OverloadedError)
+                    else "deadline", start, tenant)
                 self._abort_lifecycle(context, rid, e, trace=tr)
                 return
             except ValueError as e:
+                self._note_slo("client", start, tenant)
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
             except Exception as e:
+                self._note_slo("error", start, tenant)
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")
                 return
+            self._note_slo("ok", start, tenant)
             took = time.perf_counter() - start
             fast = fast_reply_bytes(results, request, took)
             if fast is not None:
@@ -351,6 +370,7 @@ class SearchServicer:
                 # traced + metadata-echoed like the Search twin above
                 tenant = robustness.validate_tenant_id(raw_tenant)
             except ValueError as e:
+                self._note_slo("client", start)
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
             if tenant:
@@ -363,10 +383,24 @@ class SearchServicer:
                         robustness.tenant_scope(tenant), \
                         robustness.deadline_scope(
                             self._timeout_ms(expl_tmo, trans_tmo)):
-                    return self._batch_search(request, start)
+                    reply = self._batch_search(request, start)
+                self._note_slo("ok", start, tenant)
+                return reply
             except (robustness.DeadlineExceededError,
                     robustness.OverloadedError) as e:
+                self._note_slo(
+                    "shed" if isinstance(e, robustness.OverloadedError)
+                    else "deadline", start, tenant)
                 self._abort_lifecycle(context, rid, e, trace=tr)
+            except ValueError as e:
+                self._note_slo("client", start, tenant)
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except Exception as e:
+                # the Search-twin classification: a batch-only outage must
+                # spend availability budget like a single-query one
+                self._note_slo("error", start, tenant)
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
 
     def _batch_search(self, request: pb.BatchSearchRequest, start: float):
         # with the coalescer on, a NARROW batch (up to max_request_rows —

@@ -19,12 +19,13 @@ import json
 import math
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from weaviate_tpu.auth import ForbiddenError, UnauthorizedError
-from weaviate_tpu.monitoring import tracing
+from weaviate_tpu.monitoring import incidents, tracing
 from weaviate_tpu.serving import robustness
 from weaviate_tpu.schema.manager import SchemaError
 from weaviate_tpu.usecases.objects import NotFoundError, ObjectsError
@@ -120,6 +121,14 @@ for _m, _p, _n in [
     # device/host/disk byte ledger (monitoring/memory.py): per-component
     # bytes, write-path lifecycle, exhaustion forecast — same authorizer
     ("GET", r"/debug/memory", "debug_memory"),
+    # incident flight recorder + ops-event journal (monitoring/
+    # incidents.py): recent bundle index + journal tail, and an explicit
+    # dump trigger — same authorizer as pprof (bundles name classes,
+    # tenants, and config)
+    ("GET", r"/debug/incidents", "debug_incidents"),
+    ("POST", r"/debug/incidents/dump", "debug_incidents_dump"),
+    # config-declared SLOs: multi-window burn rates + budget remaining
+    ("GET", r"/debug/slo", "debug_slo"),
     # the debug surface's index page: every /debug endpoint, one line each
     ("GET", r"/debug/?", "debug_root"),
     # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
@@ -226,6 +235,7 @@ class Handler(BaseHTTPRequestHandler):
     _UNTRACED = frozenset({
         "live", "ready", "openid", "metrics", "debug_traces", "debug_perf",
         "debug_quality", "debug_index", "debug_memory", "debug_root",
+        "debug_incidents", "debug_incidents_dump", "debug_slo",
         "pprof_index", "pprof_profile", "pprof_trace", "pprof_goroutine",
         "pprof_heap", "pprof_cmdline",
     })
@@ -291,22 +301,65 @@ class Handler(BaseHTTPRequestHandler):
             # the same plumbing (None => class-name default downstream);
             # the concurrency gate sheds an over-parallel tenant HERE,
             # before the handler does any per-request work.
-            with robustness.tenant_concurrency(tenant), \
-                    robustness.tenant_scope(tenant), \
-                    robustness.deadline_scope(self._request_timeout_ms(name)):
-                if tracing.get_tracer() is None or name in self._UNTRACED:
-                    handler(**mt.groupdict())
-                else:
-                    attrs = {"route": name}
-                    if tenant:
-                        attrs["tenant"] = tenant
-                    with tracing.request(
-                            "rest", f"{self.command} {parsed.path}",
-                            traceparent=self.headers.get("traceparent"),
-                            request_id=self._request_id, **attrs) as tr:
-                        if tr is not None:
-                            self._traceparent = tr.traceparent()
+            # SLO accounting (monitoring/incidents.py): every serving
+            # request's outcome + wall duration feeds the burn-rate
+            # engine under the same taxonomy the shed/deadline counters
+            # use. Plumbing/introspection routes are exempt (they are not
+            # the serving SLO); note_request is a one-comparison no-op
+            # when the plane is off and exception-guarded internally.
+            slo = name not in self._UNTRACED
+            t0 = time.perf_counter() if slo else 0.0
+            try:
+                with robustness.tenant_concurrency(tenant), \
+                        robustness.tenant_scope(tenant), \
+                        robustness.deadline_scope(
+                            self._request_timeout_ms(name)):
+                    if tracing.get_tracer() is None \
+                            or name in self._UNTRACED:
                         handler(**mt.groupdict())
+                    else:
+                        attrs = {"route": name}
+                        if tenant:
+                            attrs["tenant"] = tenant
+                        with tracing.request(
+                                "rest", f"{self.command} {parsed.path}",
+                                traceparent=self.headers.get("traceparent"),
+                                request_id=self._request_id, **attrs) as tr:
+                            if tr is not None:
+                                self._traceparent = tr.traceparent()
+                            handler(**mt.groupdict())
+            except robustness.OverloadedError:
+                if slo:
+                    incidents.note_request(
+                        "shed", (time.perf_counter() - t0) * 1000.0, tenant)
+                raise
+            except robustness.DeadlineExceededError:
+                if slo:
+                    incidents.note_request(
+                        "deadline", (time.perf_counter() - t0) * 1000.0,
+                        tenant)
+                raise
+            except (HTTPError, UnauthorizedError, ForbiddenError,
+                    NotFoundError, ObjectsError, SchemaError, ValueError,
+                    BrokenPipeError):
+                # caller mistakes (4xx family) and client disconnects:
+                # counted toward request totals, never against the
+                # availability error budget
+                if slo:
+                    incidents.note_request(
+                        "client", (time.perf_counter() - t0) * 1000.0,
+                        tenant)
+                raise
+            except Exception:
+                if slo:
+                    incidents.note_request(
+                        "error", (time.perf_counter() - t0) * 1000.0,
+                        tenant)
+                raise
+            else:
+                if slo:
+                    incidents.note_request(
+                        "ok", (time.perf_counter() - t0) * 1000.0, tenant)
         except HTTPError as e:
             self._reply(e.status, _err_body(e.message))
         except UnauthorizedError as e:
@@ -398,6 +451,49 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"enabled": True, **led.summary()})
 
+    def h_debug_incidents(self):
+        """Recent bundle index + journal tail (monitoring/incidents.py)."""
+        rec = incidents.get_recorder()
+        journal = incidents.get_journal()
+        if rec is None and journal is None:
+            self._reply(200, {"enabled": False})
+            return
+        out: dict = {"enabled": True}
+        if rec is not None:
+            out["recorder"] = rec.stats()
+            out["bundles"] = rec.index()
+        if journal is not None:
+            try:
+                limit = int(self.query.get("limit", 128) or 128)
+            except ValueError:
+                limit = 128
+            out["journal"] = {"counts": journal.counts(),
+                              "tail": journal.tail(limit)}
+        self._reply(200, out)
+
+    def h_debug_incidents_dump(self):
+        """Explicit bundle trigger (synchronous, rate-limit-exempt: an
+        operator asking for a dump should get one)."""
+        rec = incidents.get_recorder()
+        if rec is None:
+            self._reply(503, _err_body(
+                "incident recorder disabled (INCIDENTS_ENABLED)"))
+            return
+        path = rec.dump_now(
+            "manual", reason="explicit POST /debug/incidents/dump",
+            force=True)
+        if path is None:
+            self._reply(500, _err_body("bundle capture failed"))
+            return
+        self._reply(200, {"file": path})
+
+    def h_debug_slo(self):
+        eng = incidents.get_engine()
+        if eng is None:
+            self._reply(200, {"enabled": False})
+            return
+        self._reply(200, {"enabled": True, **eng.summary()})
+
     def h_debug_index(self):
         out = {}
         # snapshot the live registries before iterating (db.py's own
@@ -428,6 +524,14 @@ class Handler(BaseHTTPRequestHandler):
                              "bytes, write-path lifecycle, COW costs, "
                              "exhaustion forecast + headroom alerts "
                              "(MEMORY_LEDGER_ENABLED, default on)",
+            "/debug/incidents": "incident flight recorder: recent bundle "
+                                "index + ops-event journal tail "
+                                "(INCIDENTS_ENABLED, default on)",
+            "/debug/incidents/dump": "POST: capture a bundle now "
+                                     "(rate-limit-exempt)",
+            "/debug/slo": "config-declared SLOs: 5m/1h burn rates, error "
+                          "budget remaining, alert state "
+                          "(SLO_AVAILABILITY_TARGET / SLO_LATENCY_P99_MS)",
             "/debug/pprof/": "profiling surface index",
             "/debug/pprof/profile": "sampled CPU profile "
                                     "(?seconds=N&hz=N)",

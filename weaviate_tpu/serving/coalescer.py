@@ -133,7 +133,7 @@ import numpy as np
 # to module scope (PR 1 pattern): failure paths must not die on an import.
 from weaviate_tpu.db.shard import filter_signature
 from weaviate_tpu.index.tpu import _B_BUCKETS
-from weaviate_tpu.monitoring import perf, tracing
+from weaviate_tpu.monitoring import incidents, perf, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.serving import robustness
 from weaviate_tpu.testing import faults
@@ -417,6 +417,18 @@ class QueryCoalescer:
             # counted as "shutdown", not as a liveness incident.
             with self._lock:
                 closed_now = self._closed
+            if not closed_now:
+                # a DEAD flusher (not a clean shutdown) is an incident:
+                # journal it (burst-coalesced — every admission attempt
+                # lands here while it stays dead) and fire the flight
+                # recorder so the thread's last state is preserved. Both
+                # are one-comparison no-ops when the plane is off and
+                # exception-guarded internally (monitoring/incidents.py).
+                incidents.emit("flusher_dead", scope="serving.coalescer")
+                incidents.trigger(
+                    "flusher_dead",
+                    reason="coalescer flush thread died; admissions "
+                           "bypassing to the direct path")
             self.record_bypass("shutdown" if closed_now else "flusher_dead")
             return None
         d = robustness.current_deadline()

@@ -505,6 +505,35 @@ class CircuitBreaker:
                 m.breaker_transitions.labels(_STATE_NAMES[state]).inc()
             except Exception:  # noqa: BLE001 — metrics must not break serving
                 pass
+        if state != prev:
+            # ops-event journal + flight recorder (monitoring/incidents.py):
+            # a transition is exactly the fire-once semantics the journal
+            # wants, and OPEN is THE canonical incident trigger. Lazy
+            # import keeps this module stdlib-only at import time; both
+            # entries are one-comparison no-ops when the plane is off and
+            # exception-guarded internally. The journal/recorder locks
+            # never take the breaker lock, so emitting under it is safe.
+            try:
+                from weaviate_tpu.monitoring import incidents
+
+                cause = f"{type(err).__name__}: {err}" if err is not None \
+                    else ""
+                if state == STATE_OPEN:
+                    incidents.emit("breaker_open", scope=self.name,
+                                   consecutive=self._consecutive,
+                                   error=cause)
+                    incidents.trigger(
+                        "breaker_open",
+                        reason=f"{self.name} breaker tripped OPEN after "
+                               f"{self._consecutive} consecutive device "
+                               "failure(s)",
+                        detail={"error": cause})
+                elif state == STATE_HALF_OPEN:
+                    incidents.emit("breaker_half_open", scope=self.name)
+                else:
+                    incidents.emit("breaker_closed", scope=self.name)
+            except Exception:  # noqa: BLE001 — observability must not break serving
+                pass
 
     def _publish_state(self) -> None:
         m = self.metrics
@@ -579,6 +608,19 @@ def count_shed(reason: str) -> None:
             m.requests_shed.labels(reason).inc()
         except Exception:  # noqa: BLE001 — metrics must not break serving
             pass
+    # journal the shed (monitoring/incidents.py): this is the one
+    # chokepoint every shed reason funnels through (queue_full /
+    # deadline_unreachable / tenant_budget / tenant_concurrency), so the
+    # journal sees every burst; the burst-coalescing ring folds a storm
+    # into one counted entry per reason. Lazy import keeps this module
+    # stdlib-only at import time; emit() is a one-comparison no-op when
+    # the plane is off and exception-guarded internally.
+    try:
+        from weaviate_tpu.monitoring import incidents
+
+        incidents.emit("shed_burst", scope=reason)
+    except Exception:  # noqa: BLE001 — observability must not break serving
+        pass
 
 
 def count_deadline(where: str) -> None:
@@ -588,3 +630,10 @@ def count_deadline(where: str) -> None:
             m.deadline_expired.labels(where).inc()
         except Exception:  # noqa: BLE001 — metrics must not break serving
             pass
+    # deadline-miss chokepoint, same contract as the shed journal above
+    try:
+        from weaviate_tpu.monitoring import incidents
+
+        incidents.emit("deadline_burst", scope=where)
+    except Exception:  # noqa: BLE001 — observability must not break serving
+        pass

@@ -167,6 +167,20 @@ class FaultInjector:
         if act is None:
             return
         action, stall_s = act
+        # journal the injection (monitoring/incidents.py): a seeded storm's
+        # firings then appear in the incident bundle's journal tail next to
+        # the breaker/shed events they caused — the fault matrix becomes
+        # legible post-mortem. Burst-coalesced per point; one-comparison
+        # no-op when the plane is off; lazy import keeps this module's
+        # zero-dependency import contract.
+        try:
+            from weaviate_tpu.monitoring import incidents
+
+            incidents.emit("fault_injected", scope=point,
+                           action=action if isinstance(action, str)
+                           else "callable")
+        except Exception:  # noqa: BLE001 — injection bookkeeping must not mask the fault
+            pass
         if callable(action):
             action(point)
         elif action == "stall":
