@@ -1082,6 +1082,13 @@ def _parse_args(argv=None):
                         "Optional chaos via BENCH_FAIRNESS_FAULTS (a "
                         "FAULT_INJECTION spec, e.g. "
                         "'serving.coalescer.admit:stall:times=inf:p=0.05')")
+    p.add_argument("--controllers", choices=("on", "off", "both"),
+                   default="off",
+                   help="self-tuning control plane (serving/controller.py) "
+                        "state for the --overload / --tenants storm "
+                        "modes: on/off apply to the run; `both` measures "
+                        "adaptive vs static under the same storm and "
+                        "writes the comparison into the bench_matrix row")
     p.add_argument("--zipf", type=float, nargs="?", const=1.1, default=None,
                    help="skew the light tenants' traffic zipf(a) across "
                         "tenant ids (default a=1.1 when given bare) "
@@ -1152,18 +1159,89 @@ def run_overload_bench(args, rng):
     p99-within-deadline into the bench_matrix `overload_{cpu,tpu}` row.
     BENCH_OVERLOAD_FAULTS (a FAULT_INJECTION spec) adds a deterministic
     device-fault storm on top, exercising the breaker + host fallback
-    under load."""
-    import shutil
-    import tempfile
-    import threading
-    import uuid as uuidlib
+    under load.
 
+    --controllers on|off|both toggles the self-tuning control plane
+    (serving/controller.py) for the run; `both` measures one run per
+    mode against identical config/data and writes the adaptive-vs-static
+    comparison into the row — the brownout ladder + adaptive budgets
+    must beat (or shed strictly earlier than) the static knobs under the
+    same storm. The shadow auditor rides along in both modes so the
+    recall-guarded budget controller has its signal and the row carries
+    proof the online recall EWMA never crossed the configured floor."""
+    n, dim = args.serve_n, args.serve_dim
+    clients = args.overload
+    deadline_ms = float(os.environ.get("BENCH_OVERLOAD_DEADLINE_MS", 75.0))
+    max_rows = int(os.environ.get("BENCH_OVERLOAD_MAX_QUEUED_ROWS", 64))
+    fault_spec = os.environ.get("BENCH_OVERLOAD_FAULTS", "")
+    modes = {"on": [True], "off": [False],
+             "both": [False, True]}[args.controllers]
+    log(f"overload bench: n={n} dim={dim} clients={clients} "
+        f"deadline={deadline_ms}ms max_queued_rows={max_rows} "
+        f"faults={fault_spec or 'none'} controllers={args.controllers}")
     import jax
 
     if os.environ.get("BENCH_BACKEND") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     else:
         _probe_device()
+    vecs = make_data(n, dim, rng)
+    pool_q = vecs[rng.integers(0, n, 256)] + 0.05 * rng.standard_normal(
+        (256, dim), dtype=np.float32)
+    rows = {}
+    for controllers_on in modes:
+        key = "on" if controllers_on else "off"
+        log(f"  overload run: controllers {key}")
+        rows[key] = _overload_once(
+            args, vecs, pool_q, n, dim, clients, deadline_ms,
+            max_rows, fault_spec, controllers_on)
+    # the matrix row leads with the static (off) run when both were
+    # measured (back-compat with the PR-5 row shape); the adaptive
+    # run and the comparison ride alongside
+    row = dict(rows.get("off") or rows["on"])
+    row["controllers"] = args.controllers
+    if "on" in rows and "off" in rows:
+        on, off = rows["on"], rows["off"]
+        row["controllers_on"] = on
+        row["adaptive_vs_static"] = {
+            "goodput_qps": [off["goodput_qps"], on["goodput_qps"]],
+            "p99_within_deadline_ms": [
+                off["p99_within_deadline_ms"],
+                on["p99_within_deadline_ms"]],
+            "shed_rate": [off["shed_rate"], on["shed_rate"]],
+            "deadline_miss_rate": [off["deadline_miss_rate"],
+                                   on["deadline_miss_rate"]],
+        }
+    log(f"  overload: {row}")
+    plat = jax.devices()[0].platform
+    backend = "tpu-v5e" if plat in ("tpu", "axon") else "cpu"
+    suffix = "cpu" if backend == "cpu" else "tpu"
+    out_row = {"backend": backend, "round": 6,
+               "date": time.strftime("%Y-%m-%d"), **row}
+    _merge_matrix({f"overload_{suffix}": out_row})
+    print(json.dumps({
+        "metric": (
+            f"closed-loop goodput under overload ({clients} clients, "
+            f"deadline {deadline_ms:.0f}ms, queue cap {max_rows} rows, "
+            f"n={n}, d={dim}, backend {backend}, controllers "
+            f"{args.controllers})"),
+        "value": row["goodput_qps"],
+        "unit": "qps-within-deadline",
+        "vs_baseline": 0,
+        "row": out_row,
+    }))
+    _gate_exit()
+
+
+def _overload_once(args, vecs, pool_q, n, dim, clients, deadline_ms,
+                   max_rows, fault_spec, controllers_on):
+    """One measured overload run (fresh App/server/data dir per mode so
+    the controllers-on/off comparison shares nothing but the host)."""
+    import shutil
+    import tempfile
+    import threading
+    import uuid as uuidlib
+
     import grpc
 
     from weaviate_tpu.config import Config
@@ -1172,23 +1250,23 @@ def run_overload_bench(args, rng):
     from weaviate_tpu.server import App
     from weaviate_tpu.server.grpc_server import GrpcServer, SearchClient
 
-    n, dim = args.serve_n, args.serve_dim
-    clients = args.overload
-    deadline_ms = float(os.environ.get("BENCH_OVERLOAD_DEADLINE_MS", 75.0))
-    max_rows = int(os.environ.get("BENCH_OVERLOAD_MAX_QUEUED_ROWS", 64))
-    fault_spec = os.environ.get("BENCH_OVERLOAD_FAULTS", "")
-    log(f"overload bench: n={n} dim={dim} clients={clients} "
-        f"deadline={deadline_ms}ms max_queued_rows={max_rows} "
-        f"faults={fault_spec or 'none'}")
-    vecs = make_data(n, dim, rng)
-    pool_q = vecs[rng.integers(0, n, 256)] + 0.05 * rng.standard_normal(
-        (256, dim), dtype=np.float32)
-
     cfg = Config()
     cfg.coalescer.enabled = True
     cfg.coalescer.max_queued_rows = max_rows
     cfg.coalescer.wait_timeout_s = max(deadline_ms / 1000.0 * 4, 2.0)
     cfg.robustness.breaker_reset_ms = 250.0
+    # the shadow auditor rides in BOTH modes (identical observability
+    # cost either way): it is the recall-guard signal for the budget
+    # controller, and the row proves the floor held
+    cfg.quality.audit_sample_rate = float(
+        os.environ.get("BENCH_AUDIT_SAMPLE_RATE", 0.15))
+    cfg.quality.alert_min_samples = 5
+    if controllers_on:
+        cfg.controller.enabled = True
+        cfg.controller.tick_s = float(
+            os.environ.get("BENCH_CONTROLLER_TICK_S", 0.25))
+        cfg.controller.hold_ticks = 2
+        cfg.controller.recall_min_samples = 5
     # incident bundles must OUTLIVE the bench's throwaway data dir (the
     # finally rmtree's it): route them to the driver's INCIDENT_DIR, else
     # beside the bench artifacts
@@ -1301,36 +1379,41 @@ def run_overload_bench(args, rng):
             "breaker_state": (app.breaker.state()
                               if app.breaker is not None else None),
         }
-        log(f"  overload: {row}")
-        plat = jax.devices()[0].platform
-        backend = "tpu-v5e" if plat in ("tpu", "axon") else "cpu"
-        suffix = "cpu" if backend == "cpu" else "tpu"
-        out_row = {"backend": backend, "round": 6,
-                   "date": time.strftime("%Y-%m-%d"), **row}
-        _merge_matrix({f"overload_{suffix}": out_row})
-        print(json.dumps({
-            "metric": (
-                f"closed-loop goodput under overload ({clients} clients, "
-                f"deadline {deadline_ms:.0f}ms, queue cap {max_rows} rows, "
-                f"n={n}, d={dim}, backend {backend})"),
-            "value": row["goodput_qps"],
-            "unit": "qps-within-deadline",
-            "vs_baseline": 0,
-            "row": out_row,
-        }))
+        if app.quality_auditor is not None:
+            # recall-floor proof: the budget controller steers the PQ
+            # candidate cap against this EWMA — the row records it never
+            # crossed the configured floor during the storm
+            app.quality_auditor.drain(timeout_s=10.0)
+            ewmas = app.quality_auditor.tier_ewmas()
+            vals = [ew for ew, cnt in ewmas.values() if cnt > 0]
+            row["online_recall_ewma_min"] = (round(min(vals), 4)
+                                             if vals else None)
+            row["recall_floor"] = cfg.controller.recall_floor
+        if app.control_plane is not None:
+            cs = app.control_plane.summary()
+            row["controller"] = {
+                "brownout_stage": cs["controllers"]["brownout"]["stage"],
+                "rescore_r_cap":
+                    cs["controllers"]["budget"]["rescore_r_cap"],
+                "actuations": cs["actuations"],
+                "recent_actuations": cs["recent_actuations"][-8:],
+            }
+        return row
     finally:
-        # the storm's evidence bundle rides out BEFORE App.shutdown
+        # this run's evidence bundle rides out BEFORE App.shutdown
         # unconfigures the planes: journal tail (sheds, breaker flaps,
-        # injected faults), /debug/slo burn state, perf/memory windows
+        # injected faults, controller actuations), /debug/slo burn
+        # state, perf/memory windows — one bundle per measured mode
         from weaviate_tpu.monitoring import incidents as _incidents
 
-        _incidents.emergency_dump("overload storm bench complete")
+        _incidents.emergency_dump(
+            "overload storm run complete (controllers "
+            f"{'on' if controllers_on else 'off'})")
         if srv is not None:
             srv.stop()
         if app is not None:
             app.shutdown()
         shutil.rmtree(data_dir, ignore_errors=True)
-    _gate_exit()
 
 
 def run_fairness_bench(args, rng):
@@ -1428,6 +1511,25 @@ def run_fairness_bench(args, rng):
     cfg.coalescer.max_request_rows = max(int(max_rows * fraction), 2)
     # bundles must outlive the throwaway data dir (the overload twin)
     cfg.incidents.dir = os.environ.get("INCIDENT_DIR") or "./incidents"
+    # --controllers on: the self-tuning control plane runs for the WHOLE
+    # bench (both phases); `both` keeps the App static and engages a
+    # plane only for the extra storm re-run below, so the on/off storms
+    # share one data import and one solo baseline
+    cfg.controller.tick_s = float(
+        os.environ.get("BENCH_CONTROLLER_TICK_S", 0.25))
+    cfg.controller.hold_ticks = 2
+    # per-tenant rate quota (controller 4) — the one controller BUILT
+    # for an abusive tenant: the front-door gate caps its concurrency
+    # but not its request rate, so its refusal churn and its admitted
+    # dispatches still tax the box. A 4 QPS quota sits under the
+    # abuser's gate-limited throughput (≈8 QPS on the 2-core CPU host)
+    # and far over a light tenant's storm rate (≈1.6 QPS) — the quota
+    # binds ONLY the abuser, shedding `tenant_rate` cheaply before any
+    # queue state with Retry-After = time-to-next-token
+    cfg.controller.tenant_rate_qps = float(
+        os.environ.get("BENCH_TENANT_RATE_QPS", 4.0))
+    if args.controllers == "on":
+        cfg.controller.enabled = True
     if fault_spec:
         cfg.robustness.fault_injection = fault_spec
         cfg.robustness.fault_injection_seed = 23
@@ -1622,6 +1724,28 @@ def run_fairness_bench(args, rng):
         log(f"  solo: { {t: v['p99_ms'] for t, v in sorted(solo.items())} }")
         log("  phase 2: + abusive tenant storm...")
         storm = run_phase(with_abuser=True)
+        # snapshot the server-side counters NOW: they are cumulative, and
+        # the static row's shed / server_tenants keys must not absorb the
+        # controllers-on phase 3 traffic (tenant_rate sheds are impossible
+        # without the plane — leaking them poisons the comparison)
+        co_stats = app.coalescer.stats() if app.coalescer is not None else {}
+        storm_on = plane_summary = None
+        if args.controllers == "both":
+            # adaptive-vs-static storm: engage a control plane against
+            # the SAME App (same coalescer, same data, same solo
+            # baseline) and re-run the storm; unconfigure reverts every
+            # knob afterward, so nothing leaks into the row merge
+            log("  phase 3: abusive storm again, controllers ON...")
+            from weaviate_tpu.serving import controller as _ctl
+
+            plane = _ctl.configure(_ctl.ControlPlane(
+                config=cfg.controller, coalescer=app.coalescer,
+                metrics=app.metrics, tenant_weights=cfg.tenancy.weights))
+            try:
+                storm_on = run_phase(with_abuser=True)
+                plane_summary = plane.summary()
+            finally:
+                _ctl.unconfigure(plane)
 
         # the isolation gate: per light tenant with enough samples (a
         # zipf tail tenant with a handful of requests has no meaningful
@@ -1642,7 +1766,6 @@ def run_fairness_bench(args, rng):
         worst_ratio = max(ratios.values()) if ratios else None
         worst_shed = max(light_shed.values()) if light_shed else None
         abuse_row = storm.get(ABUSER, {})
-        co_stats = app.coalescer.stats() if app.coalescer is not None else {}
         isolation_pass = (
             hung == 0 and worst_ratio is not None
             and worst_ratio <= 2.0
@@ -1663,10 +1786,36 @@ def run_fairness_bench(args, rng):
             "abusive_shed_rate": abuse_row.get("shed_rate"),
             "abusive_goodput_qps": abuse_row.get("goodput_qps"),
             "isolation_pass_2x_p99_5pct_shed": isolation_pass,
+            "controllers": args.controllers,
             "solo": solo, "storm": storm,
             "server_tenants": co_stats.get("tenants"),
             "shed": co_stats.get("shed"),
         }
+        if storm_on is not None:
+            on_ratios = {}
+            for t in light:
+                s, st = solo.get(t), storm_on.get(t)
+                if not s or not st or s["p99_ms"] is None \
+                        or st["p99_ms"] is None \
+                        or min(s["requests"], st["requests"]) < MIN_SAMPLES:
+                    continue
+                on_ratios[t] = round(st["p99_ms"] / max(s["p99_ms"], 1e-6),
+                                     2)
+            row["storm_controllers_on"] = storm_on
+            row["controllers_on"] = {
+                "light_p99_worst_ratio_vs_solo":
+                    max(on_ratios.values()) if on_ratios else None,
+                "light_p99_ratios": on_ratios,
+                "abusive_shed_rate":
+                    storm_on.get(ABUSER, {}).get("shed_rate"),
+                "hung_requests":
+                    sum(v.get("hung", 0) for v in storm_on.values()),
+                "brownout_stage_final": (plane_summary["controllers"]
+                                         ["brownout"]["stage"]
+                                         if plane_summary else None),
+                "actuations": (plane_summary["actuations"]
+                               if plane_summary else None),
+            }
         log(f"  fairness: worst light p99 ratio {worst_ratio} "
             f"(bound 2.0), worst light shed {worst_shed} (bound 0.05), "
             f"abusive shed {abuse_row.get('shed_rate')}, hung {hung} -> "

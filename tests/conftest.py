@@ -50,15 +50,21 @@ def pytest_sessionfinish(session, exitstatus):
     import json as _json
 
     for env_key, module, doc_key in (
-            ("PERF_SUMMARY_FILE", "perf", "windows"),
-            ("QUALITY_SUMMARY_FILE", "quality", "audits"),
-            ("MEMORY_SUMMARY_FILE", "memory", "ledgers"),
-            ("INCIDENTS_SUMMARY_FILE", "incidents", "journals")):
+            ("PERF_SUMMARY_FILE", "weaviate_tpu.monitoring.perf",
+             "windows"),
+            ("QUALITY_SUMMARY_FILE", "weaviate_tpu.monitoring.quality",
+             "audits"),
+            ("MEMORY_SUMMARY_FILE", "weaviate_tpu.monitoring.memory",
+             "ledgers"),
+            ("INCIDENTS_SUMMARY_FILE", "weaviate_tpu.monitoring.incidents",
+             "journals"),
+            ("CONTROL_SUMMARY_FILE", "weaviate_tpu.serving.controller",
+             "planes")):
         path = os.environ.get(env_key)
         if not path:
             continue
         try:
-            mod = importlib.import_module(f"weaviate_tpu.monitoring.{module}")
+            mod = importlib.import_module(module)
             summaries = mod.recent_summaries()
             if summaries:
                 with open(path, "w") as f:

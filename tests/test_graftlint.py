@@ -1153,3 +1153,64 @@ def test_jgl013_taxonomy_mirror_matches_runtime():
     from weaviate_tpu.monitoring import incidents as _incidents
 
     assert _rules.JOURNAL_EVENT_KINDS == frozenset(_incidents.EVENT_KINDS)
+
+
+# -- JGL014: controller-owned knobs actuate only in controller.py -------------
+
+def test_jgl014_knob_setter_calls_fire_outside_controller():
+    src = (
+        "def f(tracer, auditor, coalescer, plane):\n"
+        "    tracer.set_sample_rate(0.0)\n"
+        "    auditor.set_sample_rate(0.5)\n"
+        "    coalescer.set_pipeline_depth(2)\n"
+        "    plane._set_knob('admission_margin', 2.0, 'x')\n"
+    )
+    assert codes(src, COLD).count("JGL014") == 4
+
+
+def test_jgl014_knob_field_writes_fire_outside_controller():
+    src = (
+        "def f(plane, co):\n"
+        "    plane.rescore_r_cap = 32\n"
+        "    co.admission_margin = 2.0\n"
+        "    plane._knobs['rate_scale'] = (0.5, 0.0)\n"
+        "    co.tenant_cap_scale: float = 0.5\n"
+        "    plane.brownout_stage += 1\n"
+    )
+    assert codes(src, COLD).count("JGL014") == 5
+
+
+def test_jgl014_bare_annotation_is_a_declaration_not_a_write():
+    # `co.admission_margin: float` binds nothing — only an AnnAssign
+    # WITH a value actuates a knob
+    src = (
+        "def f(co):\n"
+        "    co.admission_margin: float\n"
+    )
+    assert "JGL014" not in codes(src, COLD)
+
+
+def test_jgl014_self_writes_and_unrelated_attrs_pass():
+    # an object's own constructor/defaults (self-writes) stay legal, and
+    # fields outside the knob set are not this rule's business
+    src = (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.admission_margin = 1.0\n"
+        "        self._knobs = {}\n"
+        "        self.sample_rate = 1.0\n"
+        "    def g(self, other):\n"
+        "        other.window_s = 5.0\n"
+        "        other.unrelated = 1\n"
+    )
+    assert "JGL014" not in codes(src, COLD)
+
+
+def test_jgl014_controller_module_is_exempt():
+    src = (
+        "def _actuate(plane, tracer):\n"
+        "    plane._knobs['admission_margin'] = (2.0, 0.0)\n"
+        "    tracer.set_sample_rate(0.0)\n"
+    )
+    assert "JGL014" not in codes(src, "weaviate_tpu/serving/controller.py")
+    assert codes(src, COLD).count("JGL014") == 2

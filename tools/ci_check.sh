@@ -42,6 +42,11 @@ if [ -n "$art" ]; then
     # conftest dumps the final ops-journal summaries beside them
     export INCIDENT_DIR="${INCIDENT_DIR:-$art/incidents}"
     export INCIDENTS_SUMMARY_FILE="${INCIDENTS_SUMMARY_FILE:-$art/debug_incidents.json}"
+    # ...and the control-plane summaries (serving/controller.py final-
+    # summary stash, dumped by conftest.py beside the other planes) —
+    # which knobs the controllers were holding, the brownout stage, and
+    # the recent actuations of every plane the suite ran
+    export CONTROL_SUMMARY_FILE="${CONTROL_SUMMARY_FILE:-$art/debug_control.json}"
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="

@@ -42,6 +42,12 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
           a dynamic kind would fold to "other" at runtime (losing its
           identity in every bundle) and an unregistered literal is a
           typo the fold would silently swallow
+  JGL014  controller-owned knob actuated outside the control plane's
+          clamped actuate helper (a call to a knob setter —
+          set_knob/set_sample_rate/set_pipeline_depth — or a non-self
+          write to a controller knob field, anywhere but serving/
+          controller.py) — an unclamped, unjournaled, unleased write
+          bypasses every fail-static guarantee the control plane makes
 
 Scope model: the ISSUE's hot modules (ops/, index/tpu.py, index/mesh.py,
 compress/pq.py, inverted/bm25_device.py, parallel/mesh_search.py) gate
@@ -203,6 +209,12 @@ RULE_DOCS = {
               "bundle, an unregistered literal is a silently-swallowed "
               "typo; register the kind in incidents.EVENT_KINDS (and the "
               "JOURNAL_EVENT_KINDS mirror here) or use an existing one",
+    "JGL014": "controller-owned knob actuated outside serving/"
+              "controller.py's clamped actuate helper — knob writes "
+              "must ride ControlPlane._set_knob (clamped, leased, "
+              "journaled) or the controller's own object actuations; a "
+              "direct setter call or knob-field write elsewhere bypasses "
+              "the clamp, the journal, and the fail-static revert",
     "JGL999": "file does not parse",
 }
 
@@ -219,6 +231,7 @@ JOURNAL_EVENT_KINDS = frozenset({
     "write_phase", "fault_injected",
     "slo_burn", "slo_recovered",
     "incident_dump", "teardown",
+    "controller_actuation", "controller_brownout", "controller_revert",
 })
 
 # JGL013 scope: everywhere in the package EXCEPT the journal module
@@ -227,6 +240,29 @@ JOURNAL_EVENT_KINDS = frozenset({
 # every plane — the JGL010 shape, applied to event kinds.
 JGL013_PREFIXES = ("weaviate_tpu/",)
 JGL013_EXEMPT_SUFFIX = "monitoring/incidents.py"
+
+# JGL014 scope: everywhere in the package EXCEPT the control plane
+# itself (serving/controller.py owns the clamped actuate helper and the
+# object actuations it makes). Knob setters are defined on the objects
+# they steer (tracing.Tracer.set_sample_rate, QualityAuditor.
+# set_sample_rate, QueryCoalescer.set_pipeline_depth) but may be CALLED
+# only by the controller — anywhere else, the write bypasses the clamp,
+# the actuation journal, and the fail-static revert/lease machinery.
+JGL014_PREFIXES = ("weaviate_tpu/",)
+JGL014_EXEMPT_SUFFIX = "serving/controller.py"
+
+# the knob setter methods only the control plane may call
+CONTROLLER_KNOB_SETTERS = frozenset({
+    "_set_knob", "set_sample_rate", "set_pipeline_depth",
+})
+
+# controller-owned knob FIELDS: distinctly-named attributes of the
+# plane's store/consumers that nothing outside controller.py may assign
+# (self-writes are the owner's constructor/defaults and stay legal)
+CONTROLLER_KNOB_FIELDS = frozenset({
+    "admission_margin", "tenant_cap_scale", "retry_after_scale",
+    "rescore_r_cap", "rate_scale", "brownout_stage", "_knobs",
+})
 
 # JGL010 scope: the whole package — metric vecs are registered once in
 # monitoring/metrics.py but label values are supplied at every call site,
@@ -284,6 +320,15 @@ def in_journal_kind_scope(rel_path: str) -> bool:
         return False
     return any(rp == p or rp.startswith(p) or f"/{p}" in rp
                for p in JGL013_PREFIXES)
+
+
+def in_controller_knob_scope(rel_path: str) -> bool:
+    """JGL014 scope check: package-wide, minus the control plane."""
+    rp = rel_path.replace("\\", "/")
+    if rp.endswith(JGL014_EXEMPT_SUFFIX):
+        return False
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL014_PREFIXES)
 
 
 def in_span_scope(rel_path: str) -> bool:
@@ -451,6 +496,7 @@ class RuleWalker(ast.NodeVisitor):
         self.unbounded_wait_scope = in_unbounded_wait_scope(rel_path)
         self.metric_label_scope = in_metric_label_scope(rel_path)
         self.journal_kind_scope = in_journal_kind_scope(rel_path)
+        self.controller_knob_scope = in_controller_knob_scope(rel_path)
         self.thread_runloop_scope = in_thread_runloop_scope(rel_path)
         self.snapshot_ledger_scope = in_snapshot_ledger_scope(rel_path)
         self.mod = mod
@@ -655,6 +701,7 @@ class RuleWalker(ast.NodeVisitor):
         self._check_unbounded_wait(node)
         self._check_dynamic_label(node)
         self._check_journal_kind(node)
+        self._check_knob_setter_call(node)
         self.generic_visit(node)
 
     # -- JGL011: unguarded background-thread run-loop --
@@ -824,6 +871,54 @@ class RuleWalker(ast.NodeVisitor):
                       "JOURNAL_EVENT_KINDS mirror in graftlint) or use an "
                       "existing kind")
 
+    # -- JGL014: controller-owned knob actuated outside controller.py --
+
+    def _check_knob_setter_call(self, node: ast.Call) -> None:
+        """A call to a knob setter (X.set_knob / X.set_sample_rate /
+        X.set_pipeline_depth) anywhere but serving/controller.py: the
+        setters exist FOR the control plane — any other caller bypasses
+        the clamp, the actuation journal, and the fail-static revert."""
+        if not self.controller_knob_scope or self.fn_depth == 0:
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in CONTROLLER_KNOB_SETTERS:
+            self.emit(
+                "JGL014", node,
+                f"`.{f.attr}()` is a controller-owned knob setter — only "
+                "serving/controller.py's clamped actuate path may call "
+                "it; route the change through the control plane (or make "
+                "it a constructor default)")
+
+    def _check_knob_write(self, targets) -> None:
+        """A non-self assignment to a controller knob field (margin/
+        scale/cap fields, or the plane's `_knobs` store itself) outside
+        controller.py is an unclamped, unjournaled, unleased actuation."""
+        if not self.controller_knob_scope or self.fn_depth == 0:
+            return
+        flat: list = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                flat.extend(t.elts)
+            else:
+                flat.append(t)
+        for t in flat:
+            # plane._knobs[...] = v reaches the store through a Subscript
+            base = t.value if isinstance(t, ast.Subscript) else t
+            if not isinstance(base, ast.Attribute):
+                continue
+            if base.attr not in CONTROLLER_KNOB_FIELDS:
+                continue
+            owner = base.value
+            if isinstance(owner, ast.Name) and owner.id == "self":
+                continue  # the owner's own constructor/defaults
+            self.emit(
+                "JGL014", base,
+                f"write to controller-owned knob field `.{base.attr}` "
+                "outside serving/controller.py — knob actuations must "
+                "ride ControlPlane._set_knob (clamped, leased, "
+                "journaled); a direct write bypasses the fail-static "
+                "revert")
+
     # -- JGL009: unbounded blocking wait --
 
     def _check_unbounded_wait(self, node: ast.Call) -> None:
@@ -955,6 +1050,7 @@ class RuleWalker(ast.NodeVisitor):
                 self._check_leak_target(t)
         self._check_registry_mutation_target(node)
         self._check_unledgered_alloc(node)
+        self._check_knob_write(node.targets)
         self._track_assign(node)
         self.generic_visit(node)
 
@@ -979,6 +1075,9 @@ class RuleWalker(ast.NodeVisitor):
         device_put(...)` must not escape the JGL012 audit."""
         if node.value is not None:
             self._check_unledgered_alloc(node)
+            # a value-less AnnAssign declares, it does not write — only an
+            # actual binding can actuate a controller-owned knob
+            self._check_knob_write([node.target])
         self.generic_visit(node)
 
     def _check_unledgered_alloc(self, node) -> None:
@@ -1017,6 +1116,7 @@ class RuleWalker(ast.NodeVisitor):
         if self.jit_depth:
             self._check_leak_target(node.target)
         self._check_registry_mutation_target(node)
+        self._check_knob_write([node.target])
         self.generic_visit(node)
 
     def _check_leak_target(self, t: ast.expr) -> None:

@@ -3,7 +3,9 @@ from weaviate_tpu.config.config import (
     AuthzConfig,
     Config,
     ConfigError,
+    ControllerConfig,
     load_config,
 )
 
-__all__ = ["Config", "AuthConfig", "AuthzConfig", "ConfigError", "load_config"]
+__all__ = ["Config", "AuthConfig", "AuthzConfig", "ConfigError",
+           "ControllerConfig", "load_config"]

@@ -299,6 +299,62 @@ class IncidentsConfig:
     slo_tenant_targets: dict = field(default_factory=dict)
 
 
+@dataclass
+class ControllerConfig:
+    """Self-tuning degradation control plane (serving/controller.py).
+    TPU extension: four clamped sense->decide->actuate->journal
+    controllers on one supervised tick thread — burn-rate brownout
+    (SLO burn -> a staged degradation ladder), a recall-guarded PQ
+    candidate budget (the shadow auditor's recall EWMA -> the fast-scan
+    ``rescore_r`` cap), coalescer window/pipeline-depth steering (the
+    perf window's duty-cycle/queue-wait split), and per-tenant
+    token-bucket rate quotas. Disabled (the default) => no plane object
+    anywhere (the module global stays None; every knob reader on the
+    serving path is a one-comparison no-op returning its configured
+    default)."""
+
+    enabled: bool = False           # CONTROL_PLANE_ENABLED
+    # seconds between control ticks; knob leases expire at ~8 ticks, so
+    # a stalled thread fail-statics in bounded time
+    tick_s: float = 1.0
+    # consecutive qualifying ticks before a held actuation applies (and
+    # before the brownout ladder steps DOWN) — the hysteresis that keeps
+    # a square-wave signal from flapping the knobs
+    hold_ticks: int = 3
+    # per-controller kill switches (the whole plane gates on `enabled`)
+    brownout_enabled: bool = True   # CONTROLLER_BROWNOUT_ENABLED
+    budget_enabled: bool = True    # CONTROLLER_BUDGET_ENABLED
+    lanes_enabled: bool = True     # CONTROLLER_LANES_ENABLED
+    # brownout: burn thresholds the ladder reacts to (defaults mirror
+    # the SLO engine's alert pair) and the per-stage knob values
+    fast_burn_threshold: float = 14.4
+    slow_burn_threshold: float = 3.0
+    brownout_margin: float = 2.0       # stage 1: admission-estimate x
+    brownout_cap_scale: float = 0.5    # stage 2: tenant row cap x
+    brownout_retry_scale: float = 2.0  # stage 2: Retry-After hints x
+    brownout_rate_scale: float = 0.5   # stage 2: rate-quota refill x
+    # recall-guarded budget: the EWMA floor the bench/acceptance pins,
+    # the slack that must exist before a cut, the margin that forces an
+    # immediate back-off, and the per-tier sample count before acting
+    recall_floor: float = 0.98
+    recall_slack: float = 0.015
+    recall_backoff_margin: float = 0.005
+    recall_min_samples: int = 8
+    # lane steering: the clamp band for the coalescer flush window, the
+    # pipeline-depth ceiling, and the duty-cycle hysteresis bands
+    window_min_ms: float = 0.5
+    window_max_ms: float = 6.0
+    depth_max: int = 2
+    duty_hi: float = 0.85
+    duty_lo: float = 0.3
+    # per-tenant token-bucket rate quotas: base QPS (x the tenant's DRR
+    # weight); 0 = quota off. Enforced at coalescer admission while the
+    # control plane is enabled, shedding `tenant_rate` with
+    # Retry-After = time-to-next-token.
+    tenant_rate_qps: float = 0.0   # TENANT_RATE_QPS
+    tenant_rate_burst_s: float = 2.0  # TENANT_RATE_BURST_S
+
+
 def _tenant_targets(env: Mapping[str, str], key: str) -> dict:
     """Parse "a=0.999,b=0.99" into {tenant: float target in (0,1)};
     reject malformed entries at startup, not at the first request."""
@@ -415,6 +471,7 @@ class Config:
     quality: QualityConfig = field(default_factory=QualityConfig)
     memory: MemoryLedgerConfig = field(default_factory=MemoryLedgerConfig)
     incidents: IncidentsConfig = field(default_factory=IncidentsConfig)
+    controller: ControllerConfig = field(default_factory=ControllerConfig)
 
     def validate(self) -> None:
         self.auth.validate()
@@ -526,6 +583,47 @@ class Config:
                 raise ConfigError(
                     f"SLO_TENANT_AVAILABILITY_TARGETS entry {t!r}={tv!r} "
                     "must have a nonempty tenant and target in (0, 1)")
+        ctl = self.controller
+        if ctl.tick_s <= 0:
+            raise ConfigError("CONTROLLER_TICK_S must be > 0")
+        if ctl.hold_ticks < 1:
+            raise ConfigError("CONTROLLER_HOLD_TICKS must be >= 1")
+        if ctl.fast_burn_threshold <= 0 or ctl.slow_burn_threshold <= 0:
+            raise ConfigError(
+                "CONTROLLER_FAST_BURN and CONTROLLER_SLOW_BURN must be > 0")
+        if ctl.brownout_margin < 1.0:
+            raise ConfigError(
+                "CONTROLLER_BROWNOUT_MARGIN must be >= 1 (1 = no "
+                "tightening)")
+        if not (0.0 < ctl.brownout_cap_scale <= 1.0) \
+                or not (0.0 < ctl.brownout_rate_scale <= 1.0):
+            raise ConfigError(
+                "CONTROLLER_BROWNOUT_CAP_SCALE and "
+                "CONTROLLER_BROWNOUT_RATE_SCALE must be in (0, 1]")
+        if ctl.brownout_retry_scale < 1.0:
+            raise ConfigError(
+                "CONTROLLER_BROWNOUT_RETRY_SCALE must be >= 1")
+        if not (0.0 < ctl.recall_floor < 1.0):
+            raise ConfigError("CONTROLLER_RECALL_FLOOR must be in (0, 1)")
+        if ctl.recall_slack <= 0 or ctl.recall_backoff_margin < 0:
+            raise ConfigError(
+                "CONTROLLER_RECALL_SLACK must be > 0 and "
+                "CONTROLLER_RECALL_BACKOFF_MARGIN >= 0")
+        if ctl.recall_min_samples < 1:
+            raise ConfigError("CONTROLLER_RECALL_MIN_SAMPLES must be >= 1")
+        if not (0.0 < ctl.window_min_ms <= ctl.window_max_ms):
+            raise ConfigError(
+                "CONTROLLER_WINDOW_MIN_MS must be in (0, "
+                "CONTROLLER_WINDOW_MAX_MS]")
+        if ctl.depth_max < 1:
+            raise ConfigError("CONTROLLER_DEPTH_MAX must be >= 1")
+        if not (0.0 < ctl.duty_lo < ctl.duty_hi <= 1.0):
+            raise ConfigError(
+                "CONTROLLER_DUTY_LO/HI must satisfy 0 < lo < hi <= 1")
+        if ctl.tenant_rate_qps < 0:
+            raise ConfigError("TENANT_RATE_QPS must be >= 0 (0 disables)")
+        if ctl.tenant_rate_burst_s <= 0:
+            raise ConfigError("TENANT_RATE_BURST_S must be > 0")
 
 
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
@@ -663,6 +761,46 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.incidents.slo_min_events = _int(e, "SLO_MIN_EVENTS", 20)
     cfg.incidents.slo_tenant_targets = _tenant_targets(
         e, "SLO_TENANT_AVAILABILITY_TARGETS")
+
+    cfg.controller.enabled = _bool(e, "CONTROL_PLANE_ENABLED")
+    cfg.controller.tick_s = _float(e, "CONTROLLER_TICK_S", 1.0)
+    cfg.controller.hold_ticks = _int(e, "CONTROLLER_HOLD_TICKS", 3)
+    cfg.controller.brownout_enabled = _bool(
+        e, "CONTROLLER_BROWNOUT_ENABLED", True)
+    cfg.controller.budget_enabled = _bool(
+        e, "CONTROLLER_BUDGET_ENABLED", True)
+    cfg.controller.lanes_enabled = _bool(
+        e, "CONTROLLER_LANES_ENABLED", True)
+    cfg.controller.fast_burn_threshold = _float(
+        e, "CONTROLLER_FAST_BURN", 14.4)
+    cfg.controller.slow_burn_threshold = _float(
+        e, "CONTROLLER_SLOW_BURN", 3.0)
+    cfg.controller.brownout_margin = _float(
+        e, "CONTROLLER_BROWNOUT_MARGIN", 2.0)
+    cfg.controller.brownout_cap_scale = _float(
+        e, "CONTROLLER_BROWNOUT_CAP_SCALE", 0.5)
+    cfg.controller.brownout_retry_scale = _float(
+        e, "CONTROLLER_BROWNOUT_RETRY_SCALE", 2.0)
+    cfg.controller.brownout_rate_scale = _float(
+        e, "CONTROLLER_BROWNOUT_RATE_SCALE", 0.5)
+    cfg.controller.recall_floor = _float(
+        e, "CONTROLLER_RECALL_FLOOR", 0.98)
+    cfg.controller.recall_slack = _float(
+        e, "CONTROLLER_RECALL_SLACK", 0.015)
+    cfg.controller.recall_backoff_margin = _float(
+        e, "CONTROLLER_RECALL_BACKOFF_MARGIN", 0.005)
+    cfg.controller.recall_min_samples = _int(
+        e, "CONTROLLER_RECALL_MIN_SAMPLES", 8)
+    cfg.controller.window_min_ms = _float(
+        e, "CONTROLLER_WINDOW_MIN_MS", 0.5)
+    cfg.controller.window_max_ms = _float(
+        e, "CONTROLLER_WINDOW_MAX_MS", 6.0)
+    cfg.controller.depth_max = _int(e, "CONTROLLER_DEPTH_MAX", 2)
+    cfg.controller.duty_hi = _float(e, "CONTROLLER_DUTY_HI", 0.85)
+    cfg.controller.duty_lo = _float(e, "CONTROLLER_DUTY_LO", 0.3)
+    cfg.controller.tenant_rate_qps = _float(e, "TENANT_RATE_QPS", 0.0)
+    cfg.controller.tenant_rate_burst_s = _float(
+        e, "TENANT_RATE_BURST_S", 2.0)
 
     cfg.tracing.enabled = _bool(e, "TRACING_ENABLED")
     cfg.tracing.sample_rate = _float(e, "TRACING_SAMPLE_RATE", 1.0)

@@ -75,6 +75,13 @@ from weaviate_tpu.monitoring import incidents
 from weaviate_tpu.monitoring import quality
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.ops.distances import DISTANCE_FNS
+# self-tuning control plane (serving/controller.py): the recall-guarded
+# budget controller caps the PQ fast-scan candidate depth (_rescore_r)
+# against the shadow auditor's live recall EWMA — cap values come only
+# from jit buckets so shapes stay cached; unconfigured => one
+# comparison, the static default. controller imports nothing from the
+# index layer, so no cycle.
+from weaviate_tpu.serving import controller
 # named fault-injection points (testing/faults.py): index.tpu.dispatch /
 # index.tpu.finalize / index.tpu.alloc — one-comparison no-ops unless a
 # harness is configured
@@ -1865,13 +1872,26 @@ class TpuVectorIndex(VectorIndex):
 
     def _rescore_r(self, k: int, n: int) -> int:
         """Fast-scan candidate depth: 0 disables (exactTopK config or
-        non-matmul metrics); otherwise 4k clamped to [32, 128] — selection
-        errors of the single-pass scan sit well within 4k candidates."""
+        non-matmul metrics); otherwise 4k clamped to [32, r_max] —
+        selection errors of the single-pass scan sit well within 4k
+        candidates. r_max is 128 statically; the control plane's
+        recall-guarded budget controller (serving/controller.py) may
+        lower it bucket-by-bucket while the shadow auditor's recall EWMA
+        holds measured slack over the configured floor — the cap is
+        clamped, jit-bucket-snapped, and lapses back to 128 when the
+        controller stalls or dies."""
         if getattr(self.config, "exact_topk", False):
             return 0
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return 0
-        r = int(min(max(4 * k, 32), 128, max(n, 1)))
+        r_max = controller.rescore_r_cap(128)
+        if r_max < 2 * k:
+            # a cap below this query's slack threshold would zero r and
+            # force the full-precision exact scan — strictly MORE device
+            # work than the static path; the budget controller may only
+            # cut, so queries too deep for the cap keep the static max
+            r_max = 128
+        r = int(min(max(4 * k, 32), r_max, max(n, 1)))
         # no candidate slack over k => the fast pass would pick the FINAL set
         # at reduced precision; fall back to the HIGHEST-precision scan
         return r if r >= 2 * k else 0
@@ -2149,6 +2169,17 @@ class TpuVectorIndex(VectorIndex):
             # store; deeper per chunk when the store fits fewer chunks).
             nchunks_eff = max(1, -(-snap.n // _SCAN_CHUNK))
             pool_target = pqc.rescore_limit or 1024
+            r_cap = controller.rescore_r_cap(128)
+            if r_cap < 128:
+                # the budget controller's cap scales the codes-tier
+                # candidate pool too (the ISSUE's per-chunk budget): cap
+                # values are bucketed, so the derived r_chunk set stays
+                # bounded and jit shapes stay cached; the floor keeps
+                # the pool's own recall guarantee without ever RAISING
+                # a configured rescore_limit below 512 (the controller
+                # may only cut work)
+                pool_target = max(int(pool_target * r_cap / 128),
+                                  min(512, pool_target))
             r_chunk = min(
                 max(2 * k, -(-pool_target // nchunks_eff), 64), 256, snap.n
             )

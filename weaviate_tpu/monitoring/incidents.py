@@ -94,6 +94,9 @@ EVENT_KINDS = (
     "write_phase", "fault_injected",
     "slo_burn", "slo_recovered",
     "incident_dump", "teardown",
+    # the control plane (serving/controller.py): every knob actuation,
+    # brownout-ladder stage transition, and fail-static revert
+    "controller_actuation", "controller_brownout", "controller_revert",
 )
 OTHER = "other"
 
@@ -103,6 +106,9 @@ OTHER = "other"
 BURST_KINDS = frozenset({
     "shed_burst", "deadline_burst", "jit_compile", "device_fallback",
     "write_phase", "fault_injected", "flusher_dead",
+    # a controller re-actuating one knob every tick under a sustained
+    # signal must read as one counted entry per (kind, knob), not a wipe
+    "controller_actuation",
 })
 BURST_WINDOW_S = 5.0
 
@@ -442,6 +448,26 @@ class SloEngine:
             return None
         spent = (bad / total) / slo.budget
         return round(min(max(1.0 - spent, 0.0), 1.0), 4)
+
+    def burn_rates(self) -> tuple:
+        """(max fast burn, max slow burn) across the AVAILABILITY SLOs —
+        the control plane's brownout sensor (serving/controller.py). A
+        cold window (under min_events) contributes None; both None when
+        nothing qualifies. Per-tenant overrides are deliberately
+        included: one tenant's SLO burning is a real burn."""
+        now = time.monotonic()
+        fast_max = slow_max = None
+        with self._lock:
+            for slo in self._slos:
+                if slo.kind != "availability":
+                    continue
+                fast = self._burn(slo, FAST_WINDOW_S, now)
+                slow = self._burn(slo, SLOW_WINDOW_S, now)
+                if fast is not None and (fast_max is None or fast > fast_max):
+                    fast_max = fast
+                if slow is not None and (slow_max is None or slow > slow_max):
+                    slow_max = slow
+        return fast_max, slow_max
 
     # -- introspection --------------------------------------------------------
 

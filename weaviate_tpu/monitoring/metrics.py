@@ -492,6 +492,24 @@ class Metrics:
             "flight-recorder bundles written to INCIDENT_DIR, by "
             "(bounded) incident class", ("class",))
 
+        # self-tuning control plane (serving/controller.py): knob names
+        # and controller names are FIXED sets (controller.KNOB_NAMES /
+        # the four controllers) — bounded by construction, the JGL010
+        # discipline; all writes ride the tick thread inside try/except.
+        self.controller_brownout_stage = g(
+            "weaviate_controller_brownout_stage",
+            "current brownout-ladder stage (0 = normal serving, 1 = "
+            "tightened admission margins, 2 = shrunken tenant budgets + "
+            "scaled Retry-After, 3 = optional work paused)")
+        self.controller_knob = g(
+            "weaviate_controller_knob",
+            "current value of each controller-actuated serving knob "
+            "(equals its configured default while unactuated)", ("knob",))
+        self.controller_actuations = c(
+            "weaviate_controller_actuations_total",
+            "knob actuations applied, per controller (brownout / budget "
+            "/ lanes / rate)", ("controller",))
+
         # device-dispatch degradation (graftlint JGL004): every path that
         # silently falls back from the TPU to a host engine counts here, so
         # a fleet serving at CPU speed is visible on a dashboard instead of

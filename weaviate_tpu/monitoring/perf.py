@@ -284,6 +284,23 @@ class PerfWindow:
 
     # -- introspection -------------------------------------------------------
 
+    def control_signals(self) -> dict:
+        """The cheap per-tick sensor read for the control plane's lane
+        controller (serving/controller.py): duty cycle, mean queue wait,
+        and the dispatch count over the window — means only, no
+        percentile sorts, so a 1 Hz tick costs O(window samples) adds
+        under the lock and nothing else."""
+        now = time.monotonic()
+        with self._lock:
+            self._evict(now)
+            qw = self._phase.get("queue_wait")
+            qw_mean = (sum(ms for _, ms in qw) / len(qw)) if qw else 0.0
+            return {
+                "duty_cycle": round(self._duty_locked(now), 4),
+                "queue_wait_mean_ms": round(qw_mean, 3),
+                "dispatches": len(self._entries),
+            }
+
     def clear(self) -> None:
         """Reset the window (bench measurement slices)."""
         with self._lock:

@@ -218,6 +218,14 @@ class QualityWindow:
             self._ewma_n[tier] = n
             return ew, n
 
+    def tier_ewmas(self) -> dict:
+        """{tier: (recall EWMA, samples folded)} — the control plane's
+        recall sensor (serving/controller.py steers the PQ candidate
+        budget against it)."""
+        with self._lock:
+            return {t: (ew, self._ewma_n.get(t, 0))
+                    for t, ew in self._ewma.items()}
+
     def set_degraded(self, tier: str, degraded: bool) -> bool:
         """-> True when this call TRANSITIONED the tier's state."""
         with self._lock:
@@ -576,6 +584,18 @@ class QualityAuditor:
                 pass
 
     # -- introspection / lifecycle -------------------------------------------
+
+    def set_sample_rate(self, rate: float) -> None:
+        """Adjust the capture sampling gate (clamped to [0, 1]). The
+        control plane's brownout stage 3 pauses auditing with 0 and
+        restores the configured rate on recovery/revert — workers stay
+        up, the gate is what moves (serving/controller.py is the ONLY
+        caller outside tests; graftlint JGL014 pins that)."""
+        self.sample_rate = min(max(float(rate), 0.0), 1.0)
+
+    def tier_ewmas(self) -> dict:
+        """{tier: (recall EWMA, samples)} — see QualityWindow.tier_ewmas."""
+        return self.window.tier_ewmas()
 
     def summary(self) -> dict:
         out = self.window.summary()

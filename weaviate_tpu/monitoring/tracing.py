@@ -372,6 +372,15 @@ class Tracer:
         self._shapes: set = set()
         self._shapes_lock = threading.Lock()
 
+    def set_sample_rate(self, rate: float) -> None:
+        """Adjust the trace sampling gate (clamped to [0, 1]). The
+        control plane's brownout stage 3 pauses sampling with 0 and
+        restores the configured rate on recovery/revert; /debug/perf
+        coverage is unaffected (the shard feeds every dispatch while the
+        tracer is up, independent of sampling). serving/controller.py is
+        the only caller outside tests (graftlint JGL014)."""
+        self.sample_rate = min(max(float(rate), 0.0), 1.0)
+
     # -- request lifecycle ---------------------------------------------------
 
     def start_request(self, kind: str, name: str,

@@ -323,6 +323,26 @@ class App:
         else:
             self.coalescer = None
             self.serving_pool = None
+        # self-tuning degradation control plane (serving/controller.py):
+        # the layer that ACTS on the observability stack — burn-rate
+        # brownout, the recall-guarded candidate budget, coalescer
+        # window/depth steering, tenant rate quotas. Module-global
+        # lifecycle like the tracer; disabled (the default) => the
+        # global stays None and every knob reader on the serving path is
+        # a one-comparison no-op that constructs nothing (spy-pinned in
+        # tests/test_controller.py). Wired AFTER the coalescer so the
+        # plane captures its configured defaults.
+        ctl = self.config.controller
+        if ctl.enabled:
+            from weaviate_tpu.serving import controller as control
+
+            self.control_plane = control.configure(control.ControlPlane(
+                config=ctl,
+                coalescer=self.coalescer,
+                metrics=self.metrics,
+                tenant_weights=tn.weights))
+        else:
+            self.control_plane = None
         if self.flight_recorder is not None:
             # live serving stats ride into every bundle: the coalescer's
             # lane/shed/tenant picture and the front-door gate occupancy
@@ -333,6 +353,13 @@ class App:
             if self.tenant_gate is not None:
                 self.flight_recorder.add_stats_provider(
                     "tenant_gate", self.tenant_gate.stats)
+            if self.control_plane is not None:
+                # every bundle carries the control plane's knob/ladder
+                # picture: a post-mortem must show what the controllers
+                # were DOING around the incident, not just what the
+                # sensors saw
+                self.flight_recorder.add_stats_provider(
+                    "controllers", self.control_plane.summary)
         self.explorer = Explorer(
             self.db, self.schema, modules=self.modules,
             query_limit=self.config.query_defaults_limit,
@@ -406,6 +433,7 @@ class App:
             "quality": dataclasses.asdict(c.quality),
             "memory": dataclasses.asdict(c.memory),
             "incidents": dataclasses.asdict(c.incidents),
+            "controller": dataclasses.asdict(c.controller),
             "store_dtype": c.store_dtype,
             "device_mesh_shards": c.device_mesh_shards,
         }
@@ -434,7 +462,16 @@ class App:
         }
 
     def shutdown(self) -> None:
-        # first: queued coalescer waiters must wake (with a shutdown error
+        # the control plane goes FIRST: unconfigure stops the tick
+        # thread and reverts every actuated knob to its configured
+        # default while the objects it steered (coalescer, tracer,
+        # auditor) are still alive — a shut-down App leaves no knob
+        # residue behind (still-ours discipline like the tracer)
+        if self.control_plane is not None:
+            from weaviate_tpu.serving import controller as control
+
+            control.unconfigure(self.control_plane)
+        # queued coalescer waiters must wake (with a shutdown error
         # that sends their serving threads to the direct path) before the
         # shards they would dispatch to go away
         if self.coalescer is not None:

@@ -129,6 +129,10 @@ for _m, _p, _n in [
     ("POST", r"/debug/incidents/dump", "debug_incidents_dump"),
     # config-declared SLOs: multi-window burn rates + budget remaining
     ("GET", r"/debug/slo", "debug_slo"),
+    # self-tuning control plane (serving/controller.py): per-controller
+    # state, knob values vs configured defaults, brownout-ladder stage,
+    # recent actuations — same authorizer (it names tenants and config)
+    ("GET", r"/debug/controllers", "debug_controllers"),
     # the debug surface's index page: every /debug endpoint, one line each
     ("GET", r"/debug/?", "debug_root"),
     # always-mounted profiling surface (configure_api.go:25 net/http/pprof)
@@ -236,6 +240,7 @@ class Handler(BaseHTTPRequestHandler):
         "live", "ready", "openid", "metrics", "debug_traces", "debug_perf",
         "debug_quality", "debug_index", "debug_memory", "debug_root",
         "debug_incidents", "debug_incidents_dump", "debug_slo",
+        "debug_controllers",
         "pprof_index", "pprof_profile", "pprof_trace", "pprof_goroutine",
         "pprof_heap", "pprof_cmdline",
     })
@@ -494,6 +499,18 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"enabled": True, **eng.summary()})
 
+    def h_debug_controllers(self):
+        """Control-plane state (serving/controller.py): per-controller
+        sense/decide state, every knob's current value vs its configured
+        default, the brownout-ladder stage, and recent actuations."""
+        from weaviate_tpu.serving import controller
+
+        p = controller.get_plane()
+        if p is None:
+            self._reply(200, {"enabled": False})
+            return
+        self._reply(200, {"enabled": True, **p.summary()})
+
     def h_debug_index(self):
         out = {}
         # snapshot the live registries before iterating (db.py's own
@@ -532,6 +549,10 @@ class Handler(BaseHTTPRequestHandler):
             "/debug/slo": "config-declared SLOs: 5m/1h burn rates, error "
                           "budget remaining, alert state "
                           "(SLO_AVAILABILITY_TARGET / SLO_LATENCY_P99_MS)",
+            "/debug/controllers": "self-tuning control plane: brownout "
+                                  "ladder stage, knob values vs "
+                                  "configured defaults, recent "
+                                  "actuations (CONTROL_PLANE_ENABLED)",
             "/debug/pprof/": "profiling surface index",
             "/debug/pprof/profile": "sampled CPU profile "
                                     "(?seconds=N&hz=N)",

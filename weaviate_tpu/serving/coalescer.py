@@ -135,7 +135,12 @@ from weaviate_tpu.db.shard import filter_signature
 from weaviate_tpu.index.tpu import _B_BUCKETS
 from weaviate_tpu.monitoring import incidents, perf, tracing
 from weaviate_tpu.monitoring.metrics import record_device_fallback
-from weaviate_tpu.serving import robustness
+# the self-tuning control plane (serving/controller.py): admission reads
+# its leased knobs — flush window, admission margin, tenant-cap scale,
+# Retry-After scale, tenant rate quotas — each a one-comparison no-op
+# while the plane is off. controller never imports this module back
+# (it receives the coalescer object at App wiring), so no cycle.
+from weaviate_tpu.serving import controller, robustness
 from weaviate_tpu.testing import faults
 
 
@@ -351,6 +356,10 @@ class QueryCoalescer:
         # estimate divides by it, or shedding would over-fire by depth x
         # exactly under the load it protects.
         self._depth = max(int(pipeline_depth), 1)
+        # pipeline-depth decrements can't forcibly reclaim a busy permit:
+        # set_pipeline_depth records a deficit that _release_lane consumes
+        # (the next lane completions simply don't give their slots back)
+        self._depth_deficit = 0
         self._ewma_rows_per_s = 0.0
         # blocking per-lane work (finalize+hydration, sync filtered search)
         # runs on this pool; the flush thread only admits/enqueues, capped
@@ -368,6 +377,13 @@ class QueryCoalescer:
         self._dispatch_pool = ThreadPoolExecutor(
             max_workers=max(int(pipeline_depth), 1) + 2,
             thread_name_prefix="coalescer-dispatch")
+        # front-door sheds (the tenant concurrency gate) hint with this
+        # coalescer's per-tenant drain estimate instead of a constant.
+        # The bound method is captured ONCE: `self.retry_hint` mints a
+        # new object per access, and shutdown's still-ours clearing
+        # compares by identity
+        self._retry_hint_fn = self.retry_hint
+        robustness.set_retry_hint_provider(self._retry_hint_fn)
         self._thread = threading.Thread(
             target=self._run, name="query-coalescer", daemon=True)
         self._thread.start()
@@ -431,6 +447,19 @@ class QueryCoalescer:
                            "bypassing to the direct path")
             self.record_bypass("shutdown" if closed_now else "flusher_dead")
             return None
+        # tenant rate quota (serving/controller.py token buckets —
+        # TENANT_RATE_QPS x DRR weight): the PR-6 row budget bounds
+        # OCCUPANCY, this bounds request RATE. Checked before any queue
+        # state is touched; Retry-After = time-to-next-token, scaled up
+        # while the brownout ladder is engaged. One comparison when the
+        # control plane is off.
+        ra_rate = controller.take_rate_token(tenant)
+        if ra_rate is not None:
+            self._record_shed("tenant_rate", tenant)
+            raise robustness.OverloadedError(
+                f"tenant {tenant!r} over its request-rate quota "
+                "(TENANT_RATE_QPS)",
+                retry_after_s=ra_rate * controller.retry_after_scale())
         d = robustness.current_deadline()
         # tenant first in the key: a lane belongs to one tenant (fair
         # drain + exact accounting); dim is part of the key so a
@@ -441,7 +470,12 @@ class QueryCoalescer:
                sig, bool(include_vector), int(q.shape[1]))
         cold = False
         shed_reason: Optional[str] = None
-        retry_after = 0.1
+        # cold-start fallback hint (no resolved dispatch yet => no drain
+        # EWMA anywhere): a few flush windows is the only drain clock the
+        # server has — every warmer path below replaces it with a
+        # measured estimate
+        retry_after = max(self.window_s * 4.0, 0.05)
+        eff_cap = self._tenant_row_cap
         with self._cv:
             closed = self._closed
             if not closed and sig:
@@ -481,15 +515,28 @@ class QueryCoalescer:
                 global_est = (
                     self._queued_rows / (self._ewma_rows_per_s * self._depth)
                     if self._ewma_rows_per_s > 0.0 else None)
+                # control-plane knobs (one comparison each when off): the
+                # brownout ladder inflates the wait estimate (shed
+                # earlier) and shrinks the per-tenant cap under burn
+                eff_cap = self._tenant_row_cap
+                cap_scale = controller.tenant_cap_scale()
+                if cap_scale != 1.0:
+                    # never below one admissible request — a scaled cap
+                    # must not deadlock a tenant the configured cap admits
+                    eff_cap = max(int(eff_cap * cap_scale),
+                                  self.max_request_rows)
                 if self._queued_rows + rows > self.max_queued_rows:
                     shed_reason = "queue_full"
-                    retry_after = global_est if global_est is not None else 0.1
-                elif (st.rows + rows > self._tenant_row_cap
+                    if global_est is not None:
+                        retry_after = global_est
+                elif (st.rows + rows > eff_cap
                       and self._pipeline_rows_total > st.rows):
                     shed_reason = "tenant_budget"
-                    retry_after = est_wait if est_wait is not None else 0.1
+                    if est_wait is not None:
+                        retry_after = est_wait
                 elif (d is not None and est_wait is not None
-                      and est_wait > max(d.remaining_s(), 0.0)):
+                      and est_wait * controller.admission_margin()
+                      > max(d.remaining_s(), 0.0)):
                     shed_reason = "deadline_unreachable"
                     retry_after = est_wait
             if not closed and not cold and shed_reason is None:
@@ -511,9 +558,16 @@ class QueryCoalescer:
                     lane = None
                     wake = True
                 if lane is None:
+                    # flush window: controller-steered (leased knob,
+                    # clamped to the configured band; the configured
+                    # default while the plane is off/stale). Read at lane
+                    # creation so an actuation applies from the NEXT lane
+                    # — in-flight lanes keep the deadline they promised.
                     lane = _Lane(key, shard, flt, int(k),
                                  bool(include_vector),
-                                 time.monotonic() + self.window_s,
+                                 time.monotonic()
+                                 + controller.coalescer_window_s(
+                                     self.window_s),
                                  tenant=tenant,
                                  tenant_label=self._tenant_label(tenant))
                     self._lanes[key] = lane
@@ -554,11 +608,13 @@ class QueryCoalescer:
                 st_now = self._tenants.get(tenant)
                 detail = (f"tenant {tenant!r}: "
                           f"{st_now.rows if st_now is not None else 0} "
-                          f"rows in system, tenant cap "
-                          f"{self._tenant_row_cap}")
+                          f"rows in system, tenant cap {eff_cap}")
+            # the hint scales up while the brownout ladder is engaged —
+            # under burn, backing clients off harder IS the actuation
             raise robustness.OverloadedError(
                 f"query admission queue overloaded ({shed_reason}: "
-                f"{detail})", retry_after_s=retry_after)
+                f"{detail})",
+                retry_after_s=retry_after * controller.retry_after_scale())
         # outside the lock: the tenant tag lands on the rider's trace at
         # admission (the slow-query log's join key), and the per-tenant
         # admitted-request counter moves through the bounded labeler
@@ -898,12 +954,67 @@ class QueryCoalescer:
         return True
 
     def _release_lane(self, lane: _Lane) -> None:
-        """Give the lane's in-flight slot back exactly once."""
+        """Give the lane's in-flight slot back exactly once. A pending
+        pipeline-depth decrement (set_pipeline_depth) consumes the slot
+        instead of returning it — depth shrinks as lanes complete, never
+        by forcing an in-flight dispatch."""
         with self._lock:
             if lane.released:
                 return
             lane.released = True
+            if self._depth_deficit > 0:
+                self._depth_deficit -= 1
+                return
         self._inflight.release()
+
+    def set_pipeline_depth(self, depth: int) -> int:
+        """Adjust the in-flight lane cap at runtime (the control plane's
+        lane controller; serving/controller.py is the only caller
+        outside tests — graftlint JGL014). Increases release permits
+        immediately; decreases queue a deficit that completing lanes
+        absorb. -> the depth now in effect for the shed estimator."""
+        depth = max(int(depth), 1)
+        to_release = 0
+        with self._lock:
+            delta = depth - self._depth
+            self._depth = depth
+            if delta > 0:
+                consumed = min(self._depth_deficit, delta)
+                self._depth_deficit -= consumed
+                to_release = delta - consumed
+            elif delta < 0:
+                self._depth_deficit += -delta
+        for _ in range(to_release):
+            self._inflight.release()
+        return depth
+
+    def retry_hint(self, tenant: Optional[str]) -> Optional[float]:
+        """Estimated seconds until `tenant` could be served again — the
+        Retry-After basis for front-door sheds
+        (robustness.drain_retry_hint). Two drain clocks, whichever is
+        slower: the tenant's own in-system backlog at ITS drain rate
+        (a gate slot frees when one of its own requests finishes), and
+        the SHARED queue backlog at the global rate — a gate-capped
+        tenant holds almost no rows of its own, so under congestion the
+        shared clock is the honest one; hinting from the tenant clock
+        alone told a storm's abuser "retry in 50 ms" while every request
+        was taking 500, and the refusal churn starved the light tenants.
+        None while nothing has resolved yet (the caller keeps its
+        cold-start default)."""
+        with self._lock:
+            st = self._tenants.get(tenant or "")
+            t_rate = (st.ewma_rows_per_s
+                      if st is not None and st.ewma_rows_per_s > 0.0
+                      else self._ewma_rows_per_s)
+            rows = st.rows if st is not None else 0
+            g_rate = self._ewma_rows_per_s
+            queued = self._queued_rows
+            depth = self._depth
+        if t_rate <= 0.0 and g_rate <= 0.0:
+            return None
+        own = (max(rows, 1.0) / (t_rate * depth)) if t_rate > 0.0 else 0.0
+        shared = (queued / (g_rate * depth)) if g_rate > 0.0 else 0.0
+        return max(own, shared, 0.01)
 
     def _prune_expired(self, lane: _Lane) -> bool:
         """Fail the lane's deadline-expired waiters fast (they must not
@@ -1179,6 +1290,8 @@ class QueryCoalescer:
                 "shed": dict(self._shed),
                 "ewma_rows_per_s": self._ewma_rows_per_s,
                 "tenant_row_cap": self._tenant_row_cap,
+                "pipeline_depth": self._depth,
+                "pipeline_depth_deficit": self._depth_deficit,
                 "tenants": {
                     t: {"rows_in_system": s.rows, "weight": s.weight,
                         "shed": dict(s.shed),
@@ -1188,6 +1301,7 @@ class QueryCoalescer:
             }
 
     def shutdown(self) -> None:
+        robustness.clear_retry_hint_provider(self._retry_hint_fn)
         with self._cv:
             if self._closed:
                 return

@@ -333,6 +333,43 @@ class TenantConcurrencyGate:
 
 _tenant_gate: Optional[TenantConcurrencyGate] = None
 
+# drain-rate Retry-After hints (PR-11 satellite): the coalescer registers
+# its per-tenant drain-rate estimator here so FRONT-DOOR sheds (the
+# concurrency gate below) hint with the tenant's measured queue-drain
+# time instead of a fixed constant — a protocol-conformant abuser then
+# backs off proportionally to how backed up it actually is.
+_retry_hint_provider = None
+
+
+def set_retry_hint_provider(fn) -> None:
+    """Install the per-tenant drain estimator (fn(tenant) -> seconds or
+    None). The coalescer owns it; None-clearing goes through
+    clear_retry_hint_provider (still-ours discipline)."""
+    global _retry_hint_provider
+    _retry_hint_provider = fn
+
+
+def clear_retry_hint_provider(fn) -> None:
+    global _retry_hint_provider
+    if _retry_hint_provider is fn:
+        _retry_hint_provider = None
+
+
+def drain_retry_hint(tenant: Optional[str], default: float) -> float:
+    """Retry-After for `tenant` from the registered drain estimator,
+    clamped to a sane band; `default` when no estimator (or no signal
+    yet) — never raises (a broken estimator must not break a shed)."""
+    fn = _retry_hint_provider
+    if fn is None:
+        return default
+    try:
+        h = fn(tenant)
+    except Exception:  # noqa: BLE001 — a shed path must always produce a hint
+        return default
+    if h is None:
+        return default
+    return min(max(float(h), 0.05), 30.0)
+
 
 def configure_tenant_gate(
         gate: Optional[TenantConcurrencyGate]
@@ -366,13 +403,20 @@ def tenant_concurrency(tenant: Optional[str]) -> Iterator[None]:
     if not gate.enter(tenant):
         count_shed("tenant_concurrency")
         count_tenant_shed(tenant, "concurrency")
-        # a deliberately GENEROUS hint: the tenant is over its PARALLELISM
-        # budget, so a slot only frees when one of its own in-flight
-        # requests finishes — fast retries from its other connections
-        # would just burn frontend CPU on more refusals
+        # the hint is the tenant's MEASURED queue-drain estimate when the
+        # coalescer has one (a slot frees when one of the tenant's own
+        # in-flight requests finishes — its drain rate is the right
+        # clock); the 1 s fallback stays deliberately generous for the
+        # cold case, because fast retries from its other connections
+        # would just burn frontend CPU on more refusals. The 0.25 s floor
+        # covers the gate-specific blind spot: a tenant whose slots are
+        # held by DIRECT-path requests puts no rows in the coalescer, so
+        # an idle queue would hint the generic 0.05 s shed floor against
+        # slots that free on a request-duration cadence
         raise OverloadedError(
             f"tenant {tenant!r} exceeds its concurrent-request budget "
-            f"({gate.max_concurrent})", retry_after_s=1.0)
+            f"({gate.max_concurrent})",
+            retry_after_s=max(drain_retry_hint(tenant, 1.0), 0.25))
     try:
         yield
     finally:

@@ -26,6 +26,13 @@ Injection points (the fault matrix; see docs/robustness.md):
                            abusive-tenant storm journeys stall/fail
                            requests AT admission to stress the
                            weighted-fair queue under chaos
+  serving.controller.tick  the control plane's tick loop (serving/
+                           controller.py _run) — `die` kills the
+                           controller thread (its finally must revert
+                           every actuated knob to its configured
+                           default: fail-static), `stall` freezes it
+                           (module-read knob leases must lapse to
+                           defaults); either way serving never degrades
 
 Actions: ``device_error`` / ``oom`` raise errors that
 ``robustness.is_device_error`` recognizes (they carry ``device_error =
