@@ -536,7 +536,15 @@ def test_metrics_registered_and_observed(tmp_path):
         app.traverser.get_class(GetParams(
             class_name="Co", near_vector={"vector": vecs[0].tolist()},
             limit=K))
+        # the waiter wakes at result SCATTER, a few statements before the
+        # dispatch thread books the lane and observes these histograms —
+        # wait for the observation to land instead of racing it
+        deadline = time.monotonic() + 5.0
         text = app.metrics.expose().decode()
+        while "weaviate_coalescer_batch_requests_count 1.0" not in text \
+                and time.monotonic() < deadline:
+            time.sleep(0.001)
+            text = app.metrics.expose().decode()
         assert "weaviate_coalescer_batch_requests_count 1.0" in text
         assert "weaviate_coalescer_batch_rows_count 1.0" in text
         assert "weaviate_coalescer_wait_ms_count 1.0" in text

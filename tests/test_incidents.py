@@ -284,25 +284,33 @@ def test_recorder_worker_capture_failure_rearms_class(tmp_path, monkeypatch):
         calls.append(1)
         raise OSError("enospc")
     monkeypatch.setattr(rec, "_write", boom)
-    assert rec.trigger("breaker_open") is True
-    deadline = time.monotonic() + 5.0
-    while not calls and time.monotonic() < deadline:
-        time.sleep(0.02)
-    assert calls, "worker never attempted the capture"
-    monkeypatch.undo()
-    # the un-stamp lands just after the failed write; poll until re-armed
-    deadline = time.monotonic() + 5.0
-    admitted = False
-    while time.monotonic() < deadline:
-        if rec.trigger("breaker_open"):
-            admitted = True
-            break
-        time.sleep(0.02)
-    assert admitted
-    deadline = time.monotonic() + 5.0
-    while not rec.index() and time.monotonic() < deadline:
-        time.sleep(0.02)
-    assert rec.index() and rec.index()[0]["class"] == "breaker_open"
+    try:
+        assert rec.trigger("breaker_open") is True
+        deadline = time.monotonic() + 5.0
+        while not calls and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert calls, "worker never attempted the capture"
+        monkeypatch.undo()
+        # the un-stamp lands just after the failed write; poll until
+        # re-armed
+        deadline = time.monotonic() + 5.0
+        admitted = False
+        while time.monotonic() < deadline:
+            if rec.trigger("breaker_open"):
+                admitted = True
+                break
+            time.sleep(0.02)
+        assert admitted
+        deadline = time.monotonic() + 5.0
+        while not rec.index() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rec.index() and rec.index()[0]["class"] == "breaker_open"
+    finally:
+        # trigger() started the capture worker: a directly-constructed
+        # recorder must stop it the way App shutdown's unconfigure does,
+        # or the thread outlives the test (the graftsan leak detector
+        # flagged exactly this)
+        rec.shutdown()
 
 
 def test_bundle_names_unique_across_recorders_sharing_a_dir(tmp_path):

@@ -1,0 +1,601 @@
+"""graftsan runtime sanitizer tests (weaviate_tpu/testing/sanitizers.py).
+
+Covers the three sanitizers against seeded bugs (an AB/BA deadlock shape,
+a hierarchy inversion, a sync hidden behind a helper, a deliberately
+leaked worker), the zero-cost disabled contract through a real served
+search (the tracing spy idiom), GRAFTSAN config parsing, the
+Condition-wait bookkeeping the coalescer depends on, and the
+tools/graftsan CLI (hierarchy validation — the tier-1 form of
+`--check-hierarchy` — and report rendering).
+
+Tests that need an INSTALLED sanitizer swap their private instance into
+the module global and restore the session's (if any) in finally — the
+still-ours discipline keeps a GRAFTSAN=1 CI run and a bare local run both
+green.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid as uuidlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.testing import sanitizers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, DIM, K = 64, 8, 3
+
+
+def _mk_app(tmp_path):
+    from weaviate_tpu.config import Config
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.server import App
+
+    cfg = Config()
+    cfg.coalescer.enabled = True
+    cfg.coalescer.window_ms = 10.0
+    app = App(config=cfg, data_path=str(tmp_path / "data"))
+    app.schema.add_class({
+        "class": "Sa", "vectorIndexType": "hnsw_tpu",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "tag", "dataType": ["text"]}],
+    })
+    rng = np.random.default_rng(7)
+    vecs = rng.integers(-8, 8, (N, DIM)).astype(np.float32)
+    idx = app.db.get_index("Sa")
+    idx.put_batch([
+        StorObj(class_name="Sa", uuid=str(uuidlib.UUID(int=i + 1)),
+                properties={"tag": "t"}, vector=vecs[i])
+        for i in range(N)])
+    return app, idx, vecs
+
+
+def _swap_in(san):
+    """Install `san` as the module global; -> the previous one (None when
+    the suite runs without GRAFTSAN)."""
+    prev = sanitizers.get_sanitizer()
+    if prev is not None:
+        sanitizers.unconfigure(prev)
+    sanitizers.configure(san)
+    return prev
+
+
+def _swap_back(san, prev):
+    sanitizers.unconfigure(san)
+    if prev is not None:
+        sanitizers.configure(prev)
+
+
+# -- GRAFTSAN config parsing --------------------------------------------------
+
+def test_parse_graftsan_values():
+    off = sanitizers.parse_graftsan
+    assert off(None) == frozenset()
+    assert off("") == frozenset()
+    assert off("0") == frozenset()
+    assert off("false") == frozenset()
+    assert off("1") == sanitizers.ALL_SANITIZERS
+    assert off("true") == sanitizers.ALL_SANITIZERS
+    assert off("all") == sanitizers.ALL_SANITIZERS
+    assert off("lock") == frozenset({"lock"})
+    assert off("lock, sync") == frozenset({"lock", "sync"})
+    assert off("THREADS") == frozenset({"threads"})
+
+
+def test_parse_graftsan_rejects_typos():
+    # a typo'd sanitizer name must not silently enable nothing
+    with pytest.raises(ValueError):
+        sanitizers.parse_graftsan("lok")
+    with pytest.raises(ValueError):
+        sanitizers.parse_graftsan("lock,sink")
+
+
+# -- lock-order sanitizer -----------------------------------------------------
+
+def test_ab_ba_cycle_detected_with_both_stacks():
+    """The classic potential deadlock: thread 1 nests A->B, thread 2 nests
+    B->A. Neither schedule actually deadlocks here (they run
+    sequentially) — the WITNESS still reports it, with both acquisition
+    stacks."""
+    san = sanitizers.GraftSan(frozenset({"lock"}), hierarchy={})
+    a = san.wrap_lock(threading.Lock(), "t.A")
+    b = san.wrap_lock(threading.Lock(), "t.B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (order_ab, order_ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    vs = [v for v in san.violations() if v.kind == "lock-order-cycle"]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.key == ("lock-order-cycle", "t.A", "t.B")
+    assert "t.A" in v.message and "t.B" in v.message
+    # both stacks rendered, each pointing into this test
+    assert len(v.stacks) == 2
+    assert all("test_sanitizers.py" in s for s in v.stacks)
+    assert "order_ba" in v.stacks[0] and "order_ab" in v.stacks[1]
+
+
+def test_hierarchy_violation_vs_clean_ordering():
+    hier = {"t.outer": {"level": 10, "no_fetch_under": False},
+            "t.inner": {"level": 20, "no_fetch_under": False}}
+    san = sanitizers.GraftSan(frozenset({"lock"}), hierarchy=hier)
+    outer = san.wrap_lock(threading.Lock(), "t.outer")
+    inner = san.wrap_lock(threading.Lock(), "t.inner")
+    # the documented nesting: outer (level 10) then inner (level 20)
+    with outer:
+        with inner:
+            pass
+    assert san.violations() == []
+    # the inversion: acquiring the outer lock while holding the inner one
+    with inner:
+        with outer:
+            pass
+    vs = [v for v in san.violations() if v.kind == "hierarchy"]
+    assert len(vs) == 1
+    assert vs[0].key == ("hierarchy", "t.inner", "t.outer")
+    assert "level" in vs[0].message
+    assert len(vs[0].stacks) == 2  # holding-stack + acquiring-stack
+
+
+def test_reentrant_rlock_and_same_name_locks_are_not_findings():
+    """An RLock re-acquire is not an ordering edge, and two same-named
+    locks (two shards' "db.shard") held together have no defined order to
+    violate."""
+    hier = {"t.idx": {"level": 30, "no_fetch_under": False}}
+    san = sanitizers.GraftSan(frozenset({"lock"}), hierarchy=hier)
+    r = san.wrap_lock(threading.RLock(), "t.idx")
+    s1 = san.wrap_lock(threading.Lock(), "t.shard")
+    s2 = san.wrap_lock(threading.Lock(), "t.shard")
+    with r:
+        with r:  # re-entrant
+            pass
+    with s1:
+        with s2:  # same-name pair: no self-edge, no cycle
+            pass
+    with s2:
+        with s1:
+            pass
+    assert san.violations() == []
+
+
+def test_condition_wait_keeps_held_bookkeeping_exact():
+    """threading.Condition over a registered lock (the coalescer's _cv
+    shape): wait() releases and reacquires through the proxy, so the
+    held-lock stack must be empty during the wait and restored after."""
+    san = sanitizers.GraftSan(frozenset({"lock"}), hierarchy={})
+    lk = san.wrap_lock(threading.Lock(), "t.cv")
+    cv = threading.Condition(lk)
+    seen_during_wait = []
+    ready = threading.Event()
+
+    def producer():
+        ready.wait(2.0)
+        # while the consumer sits in wait() it must hold NOTHING
+        seen_during_wait.append(tuple(san.held_lock_names()))
+        with cv:
+            cv.notify_all()
+
+    held_after_wake = []
+
+    def consumer():
+        with cv:
+            ready.set()
+            cv.wait(timeout=2.0)
+            held_after_wake.append(tuple(san.held_lock_names()))
+
+    t1 = threading.Thread(target=consumer)
+    t2 = threading.Thread(target=producer)
+    t1.start()
+    t2.start()
+    t1.join(3.0)
+    t2.join(3.0)
+    assert held_after_wake == [("t.cv",)]
+    assert san.held_lock_names() == []  # this thread never held it
+    assert san.violations() == []
+
+
+# -- device-sync sanitizer ----------------------------------------------------
+
+def test_sync_under_lock_caught_through_a_helper_call():
+    """The runtime twin of the interprocedural JGL008: np.asarray on a jax
+    array inside a helper, called under a no_fetch_under lock — lexical
+    analysis of the caller sees nothing; the patched fetch point does."""
+    import jax.numpy as jnp
+
+    hier = {"t.idx": {"level": 30, "no_fetch_under": True}}
+    san = sanitizers.GraftSan(
+        frozenset({"lock", "sync"}), hierarchy=hier, baseline=[])
+    prev = _swap_in(san)
+    try:
+        lk = san.wrap_lock(threading.RLock(), "t.idx")
+        dev = jnp.ones((4,), jnp.float32)
+
+        def helper_fetch():
+            return np.asarray(dev)  # the hidden sync
+
+        with lk:
+            out = helper_fetch()
+        assert out.shape == (4,)
+        vs = [v for v in san.violations() if v.kind == "sync-under-lock"]
+        assert len(vs) == 1
+        assert vs[0].key == ("sync-under-lock", "t.idx", "helper_fetch")
+        assert "np.asarray" in vs[0].message
+        # ...and the same fetch OUTSIDE the lock is clean
+        helper_fetch()
+        assert len([v for v in san.violations()
+                    if v.kind == "sync-under-lock"]) == 1
+    finally:
+        _swap_back(san, prev)
+
+
+def test_block_until_ready_under_lock_caught():
+    import jax
+    import jax.numpy as jnp
+
+    hier = {"t.idx": {"level": 30, "no_fetch_under": True}}
+    san = sanitizers.GraftSan(
+        frozenset({"lock", "sync"}), hierarchy=hier, baseline=[])
+    prev = _swap_in(san)
+    try:
+        lk = san.wrap_lock(threading.Lock(), "t.idx")
+        dev = jnp.ones((4,), jnp.float32)
+        with lk:
+            jax.block_until_ready(dev)
+        assert [v for v in san.violations()
+                if v.kind == "sync-under-lock"]
+    finally:
+        _swap_back(san, prev)
+
+
+def test_sync_only_mode_still_proxies_locks_and_fires():
+    """GRAFTSAN=sync without lock must still catch a sync under a held
+    lock: the proxy's held-lock bookkeeping is what check_fetch reads, so
+    sync-only wraps locks too (order-graph/hierarchy reporting stays
+    off) — a subset the docstring advertises must not silently witness
+    nothing and report green."""
+    import jax.numpy as jnp
+
+    hier = {"t.idx": {"level": 30, "no_fetch_under": True},
+            "t.other": {"level": 10, "no_fetch_under": False}}
+    san = sanitizers.GraftSan(
+        frozenset({"sync"}), hierarchy=hier, baseline=[])
+    prev = _swap_in(san)
+    try:
+        lk = san.wrap_lock(threading.Lock(), "t.idx")
+        other = san.wrap_lock(threading.Lock(), "t.other")
+        assert isinstance(lk, sanitizers._SanLock)
+        dev = jnp.ones((4,), jnp.float32)
+
+        def sync_only_fetch():
+            return np.asarray(dev)
+
+        with lk:
+            sync_only_fetch()
+        vs = [v for v in san.violations() if v.kind == "sync-under-lock"]
+        assert len(vs) == 1
+        assert vs[0].key == ("sync-under-lock", "t.idx", "sync_only_fetch")
+        # ...but the lock-order witnesses stay gated off: a hierarchy
+        # inversion reports nothing in sync-only mode
+        with lk:
+            with other:
+                pass
+        assert [v for v in san.violations()
+                if v.kind in ("hierarchy", "lock-order-cycle")] == []
+    finally:
+        _swap_back(san, prev)
+
+
+def test_named_fetch_point_reports_once_keyed_on_the_caller():
+    """One _fetch_packed under a no_fetch_under lock is ONE violation,
+    keyed on the caller's site: the named point checks once and
+    suppresses its internal np.asarray, so a single baseline entry can
+    waive a justified path (and a real finding is not double noise)."""
+    import jax.numpy as jnp
+
+    from weaviate_tpu.index import tpu as tpu_mod
+
+    hier = {"t.idx": {"level": 30, "no_fetch_under": True}}
+    san = sanitizers.GraftSan(
+        frozenset({"lock", "sync"}), hierarchy=hier, baseline=[])
+    prev = _swap_in(san)
+    try:
+        lk = san.wrap_lock(threading.RLock(), "t.idx")
+        dev = jnp.ones((4,), jnp.float32)
+
+        def finalize_under_lock():
+            return tpu_mod._fetch_packed(dev)
+
+        with lk:
+            out = finalize_under_lock()
+        assert out.shape == (4,)
+        vs = [v for v in san.violations() if v.kind == "sync-under-lock"]
+        assert [v.key for v in vs] == [
+            ("sync-under-lock", "t.idx", "finalize_under_lock")]
+        assert "_fetch_packed" in vs[0].message
+    finally:
+        _swap_back(san, prev)
+
+
+def test_sync_baseline_waives_by_key_and_prefix():
+    san = sanitizers.GraftSan(
+        frozenset({"lock"}), hierarchy={}, baseline=[
+            {"kind": "sync-under-lock",
+             "key": ["sync-under-lock", "t.idx", "helper"],
+             "justification": "seeded"},
+            {"kind": "thread-leak", "key": ["thread-leak", "w"],
+             "justification": "prefix-waived"},
+        ])
+    san._report("sync-under-lock", ("sync-under-lock", "t.idx", "helper"),
+                "m", [])
+    san._report("thread-leak", ("thread-leak", "w", "12345"), "m", [])
+    san._report("thread-leak", ("thread-leak", "other", "9"), "m", [])
+    assert [v.key[1] for v in san.violations()] == ["other"]
+    assert len(san.violations(baselined=True)) == 3
+
+
+# -- thread-leak sanitizer ----------------------------------------------------
+
+def test_thread_leak_fires_on_deliberately_leaked_worker():
+    san = sanitizers.GraftSan(frozenset({"threads"}), hierarchy={})
+    before = san.thread_snapshot()
+    stop = threading.Event()
+    # a watched serving-plane daemon AND an anonymous non-daemon thread
+    t1 = threading.Thread(target=stop.wait, name="quality-audit-leak",
+                          daemon=True)
+    t2 = threading.Thread(target=stop.wait, name="leaky-worker",
+                          daemon=False)
+    t1.start()
+    t2.start()
+    try:
+        leaked = san.leaked_threads(before, grace_s=0.2)
+        assert {t.name for t in leaked} == {"quality-audit-leak",
+                                            "leaky-worker"}
+        vs = [v for v in san.violations() if v.kind == "thread-leak"]
+        assert {v.key[1] for v in vs} == {"quality-audit-leak",
+                                          "leaky-worker"}
+    finally:
+        stop.set()
+        t1.join(2.0)
+        t2.join(2.0)
+
+
+def test_thread_snapshot_holds_thread_objects_not_idents():
+    """The snapshot compares Thread OBJECTS: pthread ids are recycled by
+    the OS, so an ident-keyed snapshot lets a thread that exits mid-test
+    donate its ident to a freshly leaked one and mask the leak."""
+    snap = sanitizers.GraftSan.thread_snapshot()
+    assert snap and all(isinstance(t, threading.Thread) for t in snap)
+    assert threading.current_thread() in snap
+
+
+def test_thread_leak_ignores_stopped_and_preexisting_threads():
+    san = sanitizers.GraftSan(frozenset({"threads"}), hierarchy={})
+    stop = threading.Event()
+    pre = threading.Thread(target=stop.wait, name="quality-audit-pre",
+                           daemon=True)
+    pre.start()
+    try:
+        before = san.thread_snapshot()
+        # a worker that exits within the grace window is not a leak
+        quick = threading.Thread(target=lambda: time.sleep(0.05),
+                                 name="quality-audit-quick", daemon=True)
+        quick.start()
+        assert san.leaked_threads(before, grace_s=2.0) == []
+        assert san.violations() == []
+    finally:
+        stop.set()
+        pre.join(2.0)
+
+
+# -- zero-cost disabled contract ----------------------------------------------
+
+def test_disabled_serving_path_constructs_nothing(tmp_path, monkeypatch):
+    """GRAFTSAN unset: a real served search (coalesced lane end to end)
+    must construct no GraftSan and no lock proxy, and every fetch point
+    stays the pristine library callable — spied by replacing the classes
+    any enabled path would have to touch (the tracing spy idiom)."""
+    import jax
+
+    from weaviate_tpu.usecases.traverser import GetParams
+
+    prev = sanitizers.get_sanitizer()
+    if prev is not None:
+        sanitizers.unconfigure(prev)
+    try:
+        assert sanitizers.get_sanitizer() is None
+        # unconfigure removed the fetch-point patches: the originals are
+        # back (their modules are numpy/jax, not this one)
+        assert sanitizers._patched is None
+        assert "sanitizers" not in (np.asarray.__module__ or "")
+        assert "sanitizers" not in (jax.block_until_ready.__module__ or "")
+
+        def boom(name):
+            def ctor(*a, **kw):
+                raise AssertionError(f"sanitizers.{name} constructed "
+                                     "while disabled")
+            return ctor
+
+        monkeypatch.setattr(sanitizers, "GraftSan", boom("GraftSan"))
+        monkeypatch.setattr(sanitizers, "_SanLock", boom("_SanLock"))
+        app, idx, vecs = _mk_app(tmp_path)
+        try:
+            res = app.traverser.get_class(GetParams(
+                class_name="Sa",
+                near_vector={"vector": (vecs[0] + 0.5).tolist()},
+                limit=K))
+            assert len(res) == K
+            # register_lock passed the raw lock through untouched
+            shard = idx.single_local_shard()
+            assert type(shard.vector_index._lock) \
+                is type(threading.RLock())  # noqa: E721 — exact type IS the contract
+            assert type(app.coalescer._lock) \
+                is type(threading.Lock())  # noqa: E721
+        finally:
+            app.shutdown()
+    finally:
+        if prev is not None:
+            sanitizers.configure(prev)
+
+
+def test_enabled_wraps_registered_locks(tmp_path):
+    """GRAFTSAN up: the same App construction registers its serving locks
+    with the witness (the one-call shims in index/db/serving)."""
+    san = sanitizers.GraftSan(sanitizers.ALL_SANITIZERS)
+    prev = _swap_in(san)
+    try:
+        app, idx, vecs = _mk_app(tmp_path)
+        try:
+            shard = idx.single_local_shard()
+            assert isinstance(shard.vector_index._lock,
+                              sanitizers._SanLock)
+            assert isinstance(app.coalescer._lock, sanitizers._SanLock)
+            assert san.locks_registered["index.tpu"] >= 1
+            assert san.locks_registered["db.shard"] >= 1
+            assert san.locks_registered["serving.coalescer"] >= 1
+        finally:
+            app.shutdown()
+    finally:
+        _swap_back(san, prev)
+
+
+# -- report + CLI -------------------------------------------------------------
+
+def test_report_shape_and_render(tmp_path):
+    san = sanitizers.GraftSan(frozenset({"lock"}), hierarchy={})
+    a = san.wrap_lock(threading.Lock(), "t.A")
+    b = san.wrap_lock(threading.Lock(), "t.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    doc = san.report()
+    assert doc["locks_registered"] == {"t.A": 1, "t.B": 1}
+    assert ["t.A", "t.B"] in doc["order_edges"]
+    assert doc["violations"] and not doc["violations"][0]["baselined"]
+    path = tmp_path / "report.json"
+    path.write_text(json.dumps(doc))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftsan", "--report", str(path)],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1  # unbaselined violation -> red
+    assert "lock-order-cycle" in out.stdout
+    assert "edge: t.A -> t.B" in out.stdout
+
+
+def test_cli_check_hierarchy_is_green_on_the_repo():
+    """The tier-1 form of the gate: the committed lock_hierarchy.json and
+    the package's register_lock call sites agree, and the runtime
+    baseline is well-formed."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftsan", "--check-hierarchy"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "agree" in out.stdout
+
+
+def test_cli_check_hierarchy_catches_drift(tmp_path):
+    # an entry nothing registers -> documentation drift -> red
+    table = json.load(open(os.path.join(
+        REPO, "tools", "graftsan", "lock_hierarchy.json")))
+    table["locks"].append({"name": "index.phantom", "level": 99})
+    p = tmp_path / "h.json"
+    p.write_text(json.dumps(table))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftsan", "--check-hierarchy",
+         "--hierarchy", str(p)],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1
+    assert "index.phantom" in out.stderr
+
+
+def test_cli_usage_error():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftsan"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 2
+
+
+def test_load_hierarchy_rejects_malformed_tables(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"locks": [{"name": "a", "level": "x"}]}))
+    with pytest.raises(ValueError):
+        sanitizers.load_hierarchy(str(p))
+    p.write_text(json.dumps({"locks": [{"name": "a", "level": 1},
+                                       {"name": "a", "level": 2}]}))
+    with pytest.raises(ValueError):
+        sanitizers.load_hierarchy(str(p))
+
+
+def test_fixture_scoped_violation_fails_the_session(tmp_path):
+    """A violation first witnessed during MODULE-scoped fixture setup runs
+    before the per-test guard's mark, so no test fails for it — and
+    first-seen dedup hides in-test repeats of the same key too. The
+    conftest sessionfinish escape hatch must fail the otherwise-green
+    session (else the shape ships invisibly: the CI report artifact only
+    uploads on failure)."""
+    workdir = tmp_path / "suite"
+    workdir.mkdir()
+    with open(os.path.join(REPO, "tests", "conftest.py")) as f:
+        (workdir / "conftest.py").write_text(f.read())
+    (workdir / "test_escape.py").write_text(
+        "import threading\n"
+        "import pytest\n"
+        "from weaviate_tpu.testing import sanitizers\n"
+        "\n"
+        "@pytest.fixture(scope='module')\n"
+        "def seeded_ab_ba():\n"
+        "    san = sanitizers.get_sanitizer()\n"
+        "    a = san.wrap_lock(threading.Lock(), 'fixture.A')\n"
+        "    b = san.wrap_lock(threading.Lock(), 'fixture.B')\n"
+        "    def ab():\n"
+        "        with a:\n"
+        "            with b:\n"
+        "                pass\n"
+        "    def ba():\n"
+        "        with b:\n"
+        "            with a:\n"
+        "                pass\n"
+        "    for fn in (ab, ba):\n"
+        "        t = threading.Thread(target=fn)\n"
+        "        t.start()\n"
+        "        t.join()\n"
+        "    yield\n"
+        "\n"
+        "def test_rides_the_fixture(seeded_ab_ba):\n"
+        "    pass\n")
+    env = {k: v for k, v in os.environ.items() if k not in (
+        # the inner session must not clobber the OUTER run's CI artifacts
+        "GRAFTSAN_REPORT_FILE", "PERF_SUMMARY_FILE", "QUALITY_SUMMARY_FILE",
+        "MEMORY_SUMMARY_FILE", "INCIDENTS_SUMMARY_FILE",
+        "CONTROL_SUMMARY_FILE", "SLOW_QUERY_LOG_FILE")}
+    env["GRAFTSAN"] = "lock"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", str(workdir), "-q",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, cwd=str(workdir), env=env,
+        timeout=300)
+    assert out.returncode != 0, out.stdout + out.stderr
+    assert "witnessed outside any test body" in out.stderr
+    assert "lock-order-cycle" in out.stderr
+    # the test itself stayed green — only the session-level check failed
+    assert "1 passed" in out.stdout

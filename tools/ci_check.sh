@@ -47,6 +47,12 @@ if [ -n "$art" ]; then
     # which knobs the controllers were holding, the brownout stage, and
     # the recent actuations of every plane the suite ran
     export CONTROL_SUMMARY_FILE="${CONTROL_SUMMARY_FILE:-$art/debug_control.json}"
+    # ...and the graftsan runtime-sanitizer report (weaviate_tpu/testing/
+    # sanitizers.py; conftest dumps it at session end): the witnessed
+    # lock-acquisition-order edges, device-sync assertions, and every
+    # violation with both stacks — render with
+    # `python -m tools.graftsan --report <file>`
+    export GRAFTSAN_REPORT_FILE="${GRAFTSAN_REPORT_FILE:-$art/graftsan-report.json}"
 fi
 
 echo "== graftlint (TPU hot-path rules, strict baseline ratchet) =="
@@ -64,6 +70,15 @@ if ! python -m tools.graftlint weaviate_tpu $strict_flag 2>&1 \
     fail=1
 fi
 [ -z "$art" ] && rm -f "$gl_log"
+
+echo "== graftsan (lock-hierarchy table vs register_lock registry) =="
+# the machine-readable docs/concurrency.md hierarchy table must agree with
+# the sanitizer registry the package actually builds (pure-ast scan, no JAX)
+if ! python -m tools.graftsan --check-hierarchy; then
+    echo "ci_check: graftsan hierarchy validation FAILED — update" \
+         "tools/graftsan/lock_hierarchy.json or the register_lock shims" >&2
+    fail=1
+fi
 
 echo "== ruff (pycodestyle/pyflakes/bugbear subset from pyproject.toml) =="
 if command -v ruff >/dev/null 2>&1; then
@@ -97,12 +112,16 @@ if [ "$fail" -ne 0 ]; then
     exit "$fail"
 fi
 
-echo "== tier-1 tests (ROADMAP.md verify command) =="
+echo "== tier-1 tests (ROADMAP.md verify command, GRAFTSAN=${GRAFTSAN:-1}) =="
+# the runtime concurrency sanitizers run under the whole tier-1 suite by
+# default (lock-order witness, device-sync assertions, thread-leak
+# detection — docs/sanitizers.md); GRAFTSAN=0 opts out for local triage
 # per-run mktemp log locally (no clashes between users / concurrent runs);
 # a stable, kept path under CI_ARTIFACT_DIR in CI (uploaded on failure)
 t1_log="${art:+$art/_t1.log}"
 t1_log="${t1_log:-$(mktemp)}"
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+timeout -k 10 870 env JAX_PLATFORMS=cpu GRAFTSAN="${GRAFTSAN:-1}" \
+    python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
     2>&1 | tee "$t1_log"
 rc=${PIPESTATUS[0]}

@@ -14,12 +14,16 @@ Every rule has a code, a one-line fix-it in its message, and a scope:
   JGL007  span leak (a trace span opened in serving/db code without a
           structural close: neither a `with` nor a close in `finally`)
   JGL008  blocking device fetch under a held lock (np.asarray /
-          .block_until_ready() on a device value lexically inside a
-          `with <lock>:` block) — the read-path serialization the
-          snapshot-isolated dispatch plane removed
+          .block_until_ready() on a device value inside a
+          `with <lock>:` block — lexically, or one call deep through a
+          same-module helper via the ModuleIndex call graph) — the
+          read-path serialization the snapshot-isolated dispatch plane
+          removed
   JGL009  unbounded blocking wait (`wait()`/`get()`/`acquire()` with no
-          timeout) on the serving path — one wedged producer then hangs
-          a client forever instead of failing fast
+          timeout) on the serving path — directly, or one call deep
+          through a same-module helper invoked under a lock — one
+          wedged producer then hangs a client forever instead of
+          failing fast
   JGL010  dynamically-constructed metric label value (f-string/.format/
           %-format/concat of a runtime value passed to `.labels(...)`) —
           unbounded label cardinality mints a Prometheus series per
@@ -177,13 +181,17 @@ RULE_DOCS = {
     "JGL007": "span leak — a trace span opened in serving/db code must "
               "close structurally: `with tracing.span(...)`, or open "
               "inside a `try:` whose `finally:` calls .end()/.finish()",
-    "JGL008": "blocking device fetch under a held lock — dispatch inside, "
+    "JGL008": "blocking device fetch under a held lock — lexically, or "
+              "one call deep through a same-module helper (the "
+              "interprocedural one-level call graph) — dispatch inside, "
               "fetch OUTSIDE the critical section (snapshot two-phase "
               "pattern, index/tpu.py _dispatch_search)",
     "JGL009": "unbounded blocking wait — wait()/get()/acquire()/join() "
-              "with no timeout on the serving path can hang a request "
-              "forever; pass an explicit timeout (deadline-derived where "
-              "one exists — serving/robustness.py)",
+              "with no timeout on the serving path (directly, or one "
+              "call deep through a same-module helper invoked under a "
+              "lock) can hang a request forever; pass an explicit "
+              "timeout (deadline-derived where one exists — "
+              "serving/robustness.py)",
     "JGL010": "dynamically-constructed metric label value — an f-string/"
               ".format/%-format/concat of a runtime value at a "
               ".labels(...) call site mints one Prometheus series per "
@@ -417,6 +425,19 @@ class ModuleIndex:
         # chains (self.httpd.serve_forever) point outside this module and
         # are skipped (under-approximation on purpose).
         self.thread_targets: set[str] = set()
+        # one-level intra-module call graph (the interprocedural upgrade
+        # for JGL008/JGL009): module-level functions by bare name, class
+        # methods by (class, name) — the targets a `with <lock>:` body can
+        # reach in one hop via `helper(...)` or `self.helper(...)`. The
+        # helper-body summaries (does it sync? does it block unbounded?)
+        # are computed lazily and cached per function node. ONE level
+        # deep on purpose: a sync two calls down is out of scope
+        # (documented in docs/static_analysis.md; the runtime graftsan
+        # device-sync sanitizer catches any depth).
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.methods: dict[tuple, ast.FunctionDef] = {}
+        self._sync_cache: dict[int, list] = {}
+        self._wait_cache: dict[int, list] = {}
         # local names bound to the incidents journal's emit() by a
         # `from ...monitoring.incidents import emit [as X]` — JGL013
         # audits bare-name calls through these too, so aliasing the
@@ -449,6 +470,13 @@ class ModuleIndex:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if _jit_decorated(node):
                     self.jitted_fns.add(node.name)
+                self.functions[node.name] = node
+                continue
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
                 continue
             targets: list[ast.expr] = []
             value: Optional[ast.expr] = None
@@ -484,6 +512,139 @@ class ModuleIndex:
                 "dict", "list", "set", "OrderedDict", "defaultdict", "deque")
         return False
 
+    # -- one-level helper-body summaries (interprocedural JGL008/JGL009) -----
+
+    @staticmethod
+    def _walk_own_body(fn):
+        """Every node of `fn`'s DIRECT body: nested defs/lambdas are
+        skipped wholesale — their bodies run on a later schedule (the
+        finalize-closure idiom), not inside the caller's critical
+        section."""
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _helper_device_names(self, fn) -> set:
+        """Names `fn`'s own body binds from device-producing expressions
+        (flow-insensitive on purpose: a helper is small, and what this
+        over-approximates lands in the baseline with a justification —
+        the JGL001 philosophy). Iterated to a fixpoint: `_walk_own_body`
+        yields in no particular order, and an alias chain
+        (`rows = self._store; out = rows`) must converge regardless."""
+        assigns: list = []
+        for n in self._walk_own_body(fn):
+            targets: list = []
+            value = None
+            if isinstance(n, ast.Assign):
+                targets, value = n.targets, n.value
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                targets, value = [n.target], n.value
+            if value is not None:
+                assigns.append((targets, value))
+        out: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for targets, value in assigns:
+                if not self._is_device_expr(value, out):
+                    continue
+                for t in targets:
+                    names: list = []
+                    if isinstance(t, ast.Name):
+                        names = [t.id]
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names = [e.id for e in t.elts
+                                 if isinstance(e, ast.Name)]
+                    for nm in names:
+                        if nm not in out:
+                            out.add(nm)
+                            changed = True
+        return out
+
+    def _is_device_expr(self, node, device_names: set) -> bool:
+        if isinstance(node, ast.Subscript):
+            return self._is_device_expr(node.value, device_names)
+        if isinstance(node, ast.Name):
+            return node.id in device_names
+        if isinstance(node, ast.Attribute):
+            return node.attr in DEVICE_ATTRS
+        if isinstance(node, ast.Call):
+            f = dotted(node.func) or ""
+            if f.startswith(("jnp.", "jax.lax.", "jax.numpy.")):
+                return True
+            if f == "jax.device_put":
+                return True
+            root = f.split(".")[0]
+            return f in self.jitted_fns or root in self.jitted_fns
+        return False
+
+    def helper_syncs(self, fn) -> list:
+        """(line, description) for each blocking device->host sync in
+        `fn`'s own body — the facts the interprocedural JGL008 reports at
+        a lock-held call site one level up. Same sync set as the lexical
+        check (block_until_ready, asarray-family/device_get on a device
+        value) plus `_fetch_packed`, the repo's named fetch point."""
+        cached = self._sync_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        device = self._helper_device_names(fn)
+        out: list = []
+        for n in self._walk_own_body(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr == "block_until_ready":
+                out.append((n.lineno, "calls `.block_until_ready()`"))
+                continue
+            fd = dotted(f) or ""
+            if fd.split(".")[-1] == "_fetch_packed":
+                out.append((n.lineno, "runs `_fetch_packed(...)` (the "
+                                      "blocking dispatch fetch)"))
+                continue
+            arg = n.args[0] if n.args else None
+            if fd in ("np.asarray", "np.array", "numpy.asarray",
+                      "numpy.array", "jax.device_get") \
+                    and arg is not None \
+                    and self._is_device_expr(arg, device):
+                out.append((n.lineno, f"runs `{fd}(...)` on a device "
+                                      "value"))
+        out.sort()
+        self._sync_cache[id(fn)] = out
+        return out
+
+    def helper_waits(self, fn) -> list:
+        """(line, description) for each unbounded blocking wait in `fn`'s
+        own body — the interprocedural JGL009 facts."""
+        cached = self._wait_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        out: list = []
+        for n in self._walk_own_body(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if not isinstance(f, ast.Attribute) \
+                    or f.attr not in UNBOUNDED_WAIT_NAMES:
+                continue
+            if n.args:
+                continue
+            if any(kw.arg in ("timeout", "block", "blocking")
+                   for kw in n.keywords):
+                continue
+            if f.attr == "get" \
+                    and (dotted(f.value) or "") in self.contextvars:
+                continue
+            out.append((n.lineno, f"calls `.{f.attr}()` with no timeout"))
+        out.sort()
+        self._wait_cache[id(fn)] = out
+        return out
+
 
 # -- the walker --------------------------------------------------------------
 
@@ -505,6 +666,8 @@ class RuleWalker(ast.NodeVisitor):
         self._stamp_fns: list[bool] = []
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
+        self.class_stack: list[str] = []      # enclosing class names
+        self.fn_stack: list = []              # enclosing function nodes
         self.fn_depth = 0
         self.loop_depth = 0
         self.jit_depth = 0                    # inside a jit-decorated fn
@@ -553,7 +716,9 @@ class RuleWalker(ast.NodeVisitor):
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         self.scope.append(node.name)
+        self.class_stack.append(node.name)
         self.generic_visit(node)
+        self.class_stack.pop()
         self.scope.pop()
 
     def _visit_fn(self, node) -> None:
@@ -568,6 +733,7 @@ class RuleWalker(ast.NodeVisitor):
                 d for d in node.args.kw_defaults if d is not None]:
             self.visit(default)
         self.scope.append(node.name)
+        self.fn_stack.append(node)
         self._check_thread_runloop(node)
         self._stamp_fns.append(self._fn_calls_stamp(node))
         self.fn_depth += 1
@@ -597,6 +763,7 @@ class RuleWalker(ast.NodeVisitor):
             self.jit_depth -= 1
         self.fn_depth -= 1
         self._stamp_fns.pop()
+        self.fn_stack.pop()
         self.scope.pop()
 
     visit_FunctionDef = _visit_fn
@@ -698,6 +865,7 @@ class RuleWalker(ast.NodeVisitor):
         self._check_mutation_call(node)
         self._check_span_leak(node)
         self._check_lock_fetch(node)
+        self._check_lock_helper_call(node)
         self._check_unbounded_wait(node)
         self._check_dynamic_label(node)
         self._check_journal_kind(node)
@@ -968,6 +1136,57 @@ class RuleWalker(ast.NodeVisitor):
                       "blocking device->host transfer — every reader and "
                       "writer convoys on it; pin the state in a snapshot "
                       "and fetch outside the critical section")
+
+    # -- interprocedural JGL008/JGL009: a `with <lock>:` body calling a
+    # -- local helper that syncs/blocks (one level deep) ----------------------
+
+    def _resolve_local_helper(self, node: ast.Call):
+        """The same-module function a call reaches, when resolvable with
+        zero type inference: a bare name defined at module level, or
+        `self.helper(...)` defined on the ENCLOSING class. Anything else
+        (imported names, deeper attribute chains, other receivers) is
+        out of this one-level analysis' scope."""
+        f = node.func
+        if isinstance(f, ast.Name):
+            return self.mod.functions.get(f.id)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and self.class_stack:
+            return self.mod.methods.get((self.class_stack[-1], f.attr))
+        return None
+
+    def _check_lock_helper_call(self, node: ast.Call) -> None:
+        if self.with_locks == 0 or self.fn_depth == 0:
+            return
+        if not (self.lock_fetch_scope or self.unbounded_wait_scope):
+            return
+        helper = self._resolve_local_helper(node)
+        if helper is None or (self.fn_stack and helper is self.fn_stack[-1]):
+            return  # unresolvable, or direct recursion (already audited)
+        name = self._call_last_name(node)
+        if self.lock_fetch_scope:
+            syncs = self.mod.helper_syncs(helper)
+            if syncs:
+                line, what = syncs[0]
+                self.emit(
+                    "JGL008", node,
+                    f"calls local helper `{name}()` which {what} (line "
+                    f"{line}) — a device fetch one call deep still holds "
+                    "this lock across the whole round trip; dispatch "
+                    "under the lock, fetch OUTSIDE it (snapshot two-phase "
+                    "pattern), or hoist the helper call out of the "
+                    "critical section")
+        if self.unbounded_wait_scope:
+            waits = self.mod.helper_waits(helper)
+            if waits:
+                line, what = waits[0]
+                self.emit(
+                    "JGL009", node,
+                    f"calls local helper `{name}()` which {what} (line "
+                    f"{line}) while this thread holds a lock — a wedged "
+                    "producer then hangs every thread that wants the "
+                    "mutex, not just this request; bound the helper's "
+                    "wait (deadline-derived where one exists) or move "
+                    "the call outside the critical section")
 
     # -- JGL007: span leak --
 

@@ -1,0 +1,21 @@
+"""graftsan tooling: the lock-hierarchy table + CLI for the runtime
+concurrency sanitizers (weaviate_tpu/testing/sanitizers.py).
+
+``lock_hierarchy.json`` is the machine-readable twin of the
+docs/concurrency.md hierarchy table; ``baseline.json`` is the shrink-only
+runtime baseline (justified pre-existing violations). The CLI
+(`python -m tools.graftsan`) validates the table against the package's
+``register_lock`` call sites — a pure-ast scan, graftlint style, so the
+check runs with no JAX and no device — and renders sanitizer reports.
+See docs/sanitizers.md.
+"""
+
+import os
+
+_REPO_ROOT = os.path.realpath(os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+HIERARCHY_PATH = os.path.join(
+    _REPO_ROOT, "tools", "graftsan", "lock_hierarchy.json")
+BASELINE_PATH = os.path.join(
+    _REPO_ROOT, "tools", "graftsan", "baseline.json")
+PACKAGE_PATH = os.path.join(_REPO_ROOT, "weaviate_tpu")

@@ -1,0 +1,187 @@
+"""CLI: python -m tools.graftsan [--check-hierarchy] [--report FILE].
+
+``--check-hierarchy`` validates tools/graftsan/lock_hierarchy.json against
+the package's sanitizer registry: every ``sanitizers.register_lock(...,
+"<name>")`` call site in weaviate_tpu/ must name a hierarchy entry, and
+every hierarchy entry must be registered somewhere — a lock the table
+doesn't know is witnessed for cycles but never hierarchy-checked, and a
+table entry nothing registers is documentation drift. The scan is pure
+``ast`` (graftlint style): no JAX, no package import, milliseconds, so it
+runs as a tier-1 test (tests/test_sanitizers.py).
+
+``--report`` renders a ``GRAFTSAN_REPORT_FILE`` JSON (written by the
+tier-1 conftest at session end) for humans: violations with both
+acquisition stacks, the baseline disposition, and the witnessed
+acquisition-order edges.
+
+Exit codes: 0 clean, 1 validation/report findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+from tools.graftsan import BASELINE_PATH, HIERARCHY_PATH, PACKAGE_PATH
+
+
+def registered_lock_names(package_path: str) -> dict[str, list[str]]:
+    """name -> [call sites] for every ``register_lock(<expr>, "<name>")``
+    in the package — the registry side of the hierarchy contract. A
+    non-literal name is recorded under ``<dynamic>`` so drift can't hide
+    behind an f-string."""
+    out: dict[str, list[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(package_path):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py") or fn.endswith("_pb2.py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(package_path))
+            rel = rel.replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (SyntaxError, UnicodeDecodeError, ValueError):
+                continue  # graftlint reports unparseable files (JGL999)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f_ = node.func
+                last = f_.attr if isinstance(f_, ast.Attribute) else (
+                    f_.id if isinstance(f_, ast.Name) else "")
+                if last != "register_lock":
+                    continue
+                name = "<dynamic>"
+                if len(node.args) >= 2 and isinstance(
+                        node.args[1], ast.Constant) and isinstance(
+                        node.args[1].value, str):
+                    name = node.args[1].value
+                out.setdefault(name, []).append(f"{rel}:{node.lineno}")
+    return out
+
+
+def check_hierarchy(hierarchy_path: str, package_path: str,
+                    baseline_path: str) -> list[str]:
+    """-> problems (empty = the table, the registry, and the baseline
+    agree)."""
+    problems: list[str] = []
+    # sanitizers.load_hierarchy owns structural validation; it imports
+    # stdlib only, so this stays a no-JAX check
+    from weaviate_tpu.testing.sanitizers import load_hierarchy
+
+    try:
+        table = load_hierarchy(hierarchy_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return [f"lock_hierarchy.json does not load: {e}"]
+    registry = registered_lock_names(package_path)
+    dynamic = registry.pop("<dynamic>", None)
+    if dynamic:
+        problems.append(
+            "register_lock called with a non-literal lock name at "
+            f"{', '.join(dynamic)} — hierarchy validation cannot see it; "
+            "pass a string literal")
+    for name, sites in sorted(registry.items()):
+        if name not in table:
+            problems.append(
+                f"lock {name!r} (registered at {', '.join(sites)}) is not "
+                "in lock_hierarchy.json — it is witnessed for cycles but "
+                "never hierarchy-checked; add it to the table with a level")
+    for name in sorted(table):
+        if name not in registry:
+            problems.append(
+                f"lock_hierarchy.json entry {name!r} is registered nowhere "
+                "in weaviate_tpu/ — documentation drift; remove the entry "
+                "or wire the register_lock shim")
+    # baseline hygiene: entries must reference known kinds and parse
+    try:
+        with open(baseline_path, encoding="utf-8") as f:
+            base = json.load(f)
+        for e in base.get("entries", []):
+            if e.get("kind") not in ("lock-order-cycle", "hierarchy",
+                                     "sync-under-lock", "thread-leak"):
+                problems.append(
+                    f"baseline entry with unknown kind {e.get('kind')!r}")
+            elif not e.get("justification"):
+                problems.append(
+                    f"baseline entry {e.get('key')} has no justification — "
+                    "the runtime baseline carries written rationale only")
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"baseline.json does not load: {e}")
+    return problems
+
+
+def render_report(path: str) -> int:
+    """Pretty-print a GRAFTSAN_REPORT_FILE. -> exit code (1 when the
+    report holds unbaselined violations)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    print(f"graftsan report: sanitizers={','.join(doc.get('enabled', []))} "
+          f"locks={sum(doc.get('locks_registered', {}).values())} "
+          f"({len(doc.get('locks_registered', {}))} names) "
+          f"order-edges={len(doc.get('order_edges', []))} "
+          f"fetch-checks={doc.get('fetch_checks', 0)}")
+    for a, b in doc.get("order_edges", []):
+        print(f"  edge: {a} -> {b}")
+    bad = 0
+    for v in doc.get("violations", []):
+        if not v.get("baselined"):
+            bad += 1
+        head = (f"{'BASELINED ' if v.get('baselined') else ''}"
+                f"[{v['kind']}] {v['message']} (x{v.get('count', 1)})")
+        print(head)
+        if v.get("justification"):
+            print(f"  justification: {v['justification']}")
+        for s in v.get("stacks", []):
+            print("  " + s.replace("\n", "\n  ").rstrip())
+    print(f"graftsan: {bad} unbaselined violation(s), "
+          f"{len(doc.get('violations', []))} total", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftsan",
+        description="runtime concurrency sanitizer tooling "
+                    "(hierarchy validation + report rendering)")
+    ap.add_argument("--check-hierarchy", action="store_true",
+                    help="validate lock_hierarchy.json against the "
+                         "package's register_lock call sites")
+    ap.add_argument("--report", metavar="FILE",
+                    help="render a GRAFTSAN_REPORT_FILE JSON")
+    ap.add_argument("--hierarchy", default=HIERARCHY_PATH,
+                    help="hierarchy table (default tools/graftsan/"
+                         "lock_hierarchy.json)")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="runtime baseline (default tools/graftsan/"
+                         "baseline.json)")
+    ap.add_argument("--package", default=PACKAGE_PATH,
+                    help="package tree to scan for register_lock sites")
+    args = ap.parse_args(argv)
+
+    if args.check_hierarchy:
+        problems = check_hierarchy(args.hierarchy, args.package,
+                                   args.baseline)
+        for p in problems:
+            print(f"graftsan: {p}", file=sys.stderr)
+        if not problems:
+            print("graftsan: lock_hierarchy.json and the register_lock "
+                  "registry agree")
+        return 1 if problems else 0
+    if args.report:
+        if not os.path.exists(args.report):
+            print(f"graftsan: error: no such report {args.report!r}",
+                  file=sys.stderr)
+            return 2
+        return render_report(args.report)
+    ap.print_usage(sys.stderr)
+    print("graftsan: error: pass --check-hierarchy or --report FILE",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,7 +39,7 @@ from weaviate_tpu.monitoring.metrics import record_device_fallback
 # the device circuit breaker that routes reads to the host fallback plane
 from weaviate_tpu.serving import robustness
 # named fault-injection point db.shard.search (testing/faults.py)
-from weaviate_tpu.testing import faults
+from weaviate_tpu.testing import faults, sanitizers
 from weaviate_tpu.inverted.bm25 import BM25Searcher
 from weaviate_tpu.inverted.index import InvertedIndex
 from weaviate_tpu.inverted.searcher import FilterSearcher
@@ -192,7 +192,8 @@ class Shard:
         # eviction time (see build_allow_list)
         self._write_gen = 0
         self._allow_cache: dict[str, tuple[int, Bitmap, str]] = {}
-        self._lock = threading.RLock()
+        self._lock = sanitizers.register_lock(
+            threading.RLock(), "db.shard")
         # memory providers (monitoring/memory.py): the allowList cache's
         # host byte weight and the packed device filter words cached on
         # its bitmaps become /debug/memory components, sized by the same

@@ -51,6 +51,7 @@ from weaviate_tpu.index.tpu import VectorLog, _bucket_b, _bucket_rows
 # stamped analytically at every buffer mutation; unconfigured => one
 # comparison, nothing constructed
 from weaviate_tpu.monitoring import memory
+from weaviate_tpu.testing import sanitizers
 from weaviate_tpu.monitoring.metrics import record_device_fallback
 from weaviate_tpu.parallel.mesh_search import (
     _MESH_SCAN_CHUNK,
@@ -112,7 +113,8 @@ class MeshVectorIndex(VectorIndex):
             if getattr(config, "store_dtype", "float32") == "bfloat16"
             else jnp.float32
         )
-        self._lock = threading.RLock()
+        self._lock = sanitizers.register_lock(
+            threading.RLock(), "index.mesh")
         self._init_loc = _pow2_at_least(
             initial_capacity_per_shard or _MIN_LOC, 32
         )

@@ -85,7 +85,7 @@ from weaviate_tpu.serving import controller
 # named fault-injection points (testing/faults.py): index.tpu.dispatch /
 # index.tpu.finalize / index.tpu.alloc — one-comparison no-ops unless a
 # harness is configured
-from weaviate_tpu.testing import faults
+from weaviate_tpu.testing import faults, sanitizers
 from weaviate_tpu.ops.topk import bitmap_to_mask, merge_top_k
 
 _CHUNK = 8192          # rows staged per device write (fixed => no recompiles)
@@ -993,7 +993,8 @@ class TpuVectorIndex(VectorIndex):
         self.metrics = metrics
         self.device = device
         self.dtype = jnp.bfloat16 if getattr(config, "store_dtype", "float32") == "bfloat16" else jnp.float32
-        self._lock = threading.RLock()
+        self._lock = sanitizers.register_lock(
+            threading.RLock(), "index.tpu")
 
         self.dim: Optional[int] = None
         self.capacity = 0
@@ -1021,7 +1022,8 @@ class TpuVectorIndex(VectorIndex):
         self._staged_t0: Optional[float] = None
         self._read_local = threading.local()  # per-thread last lock wait
         self._inflight = 0                    # dispatches between enqueue
-        self._inflight_lock = threading.Lock()  # ...and finalize
+        self._inflight_lock = sanitizers.register_lock(
+            threading.Lock(), "index.tpu.inflight")  # ...and finalize
         self._inflight_gauge = None  # resolved lazily (None) / broken (False)
         # staging buffer keyed by doc_id: a re-add of a staged doc replaces it
         self._pending: dict[int, np.ndarray] = {}

@@ -77,6 +77,8 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from weaviate_tpu.testing import sanitizers
+
 _LOG = logging.getLogger(__name__)
 
 # -- the bounded event-kind taxonomy ------------------------------------------
@@ -150,7 +152,8 @@ class OpsJournal:
         self.size = max(int(size), 1)
         self.metrics = metrics
         self.burst_window_s = float(burst_window_s)
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            threading.Lock(), "monitoring.incidents.journal")
         self._ring: deque = deque(maxlen=self.size)
         # (kind, scope) -> the live ring dict a burst is coalescing into
         self._burst: dict = {}
@@ -554,7 +557,8 @@ class FlightRecorder:
         self.journal = journal
         self.engine = engine
         self.metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            threading.Lock(), "monitoring.incidents.recorder")
         self._last_dump: dict[str, float] = {}  # folded class -> monotonic
         self._dumped = 0
         self._rate_limited = 0

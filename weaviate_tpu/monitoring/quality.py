@@ -66,6 +66,8 @@ from typing import Optional
 
 import numpy as np
 
+from weaviate_tpu.testing import sanitizers
+
 _LOG = logging.getLogger(__name__)
 
 # RBO persistence: weight of deeper ranks (0.9 = the literature's default
@@ -371,7 +373,8 @@ class QualityAuditor:
         # ADMISSION, not at worker pickup, so drain() can never report
         # idle while a popped-but-unscored task is still running
         self._inflight = 0
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            threading.Lock(), "monitoring.quality")
         # id(index) -> (pinned snapshot, rows, sq_norms): consecutive
         # audits of one generation share the host materialization. ONE
         # entry per index — a new generation REPLACES the old, so the

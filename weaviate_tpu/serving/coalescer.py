@@ -141,7 +141,7 @@ from weaviate_tpu.monitoring.metrics import record_device_fallback
 # while the plane is off. controller never imports this module back
 # (it receives the coalescer object at App wiring), so no cycle.
 from weaviate_tpu.serving import controller, robustness
-from weaviate_tpu.testing import faults
+from weaviate_tpu.testing import faults, sanitizers
 
 
 class CoalescerShutdownError(RuntimeError):
@@ -311,7 +311,8 @@ class QueryCoalescer:
         self.max_queued_rows = max(int(max_queued_rows), 1)
         self.waiter_timeout_s = max(float(waiter_timeout_s), 0.001)
         self.metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            threading.Lock(), "serving.coalescer")
         self._cv = threading.Condition(self._lock)
         self._lanes: dict[tuple, _Lane] = {}
         self._full: list[_Lane] = []  # popped at submit time, flush ASAP

@@ -76,7 +76,7 @@ from typing import Optional
 
 from weaviate_tpu.config import ControllerConfig
 from weaviate_tpu.monitoring import incidents
-from weaviate_tpu.testing import faults
+from weaviate_tpu.testing import faults, sanitizers
 
 _LOG = logging.getLogger(__name__)
 
@@ -205,7 +205,8 @@ class ControlPlane:
         # after this long without a tick refresh: a stalled thread
         # fail-statics in bounded time without any watchdog thread
         self.lease_s = max(self.tick_s * 8.0, 2.0)
-        self._lock = threading.Lock()
+        self._lock = sanitizers.register_lock(
+            threading.Lock(), "serving.controller")
         # knob name -> (value, stamp). Read lock-free on the serving path
         # (tuple replacement is atomic; a torn read is impossible);
         # written only by _set_knob / the lease refresh under _lock.
