@@ -1058,6 +1058,13 @@ def _parse_args(argv=None):
     p.add_argument("--coalesce", choices=("on", "off", "both"),
                    default="both",
                    help="query coalescer state for the serving run")
+    p.add_argument("--fused", choices=("on", "off", "both"),
+                   default="on",
+                   help="fused device dispatch (device-side slot->doc "
+                        "translation, index/tpu.py) for the serving run; "
+                        "'both' additionally commits a fused-vs-staged A/B "
+                        "row (phase shares, duty cycle, online recall) into "
+                        "bench_matrix.json serving_fused_*")
     p.add_argument("--overload", type=int, default=0,
                    help="closed-loop OVERLOAD mode: N client threads, each "
                         "request under a tight deadline "
@@ -1883,9 +1890,12 @@ def run_serving_bench(args, rng):
         (256, dim), dtype=np.float32)
     gt = exact_gt(vecs, pool_q, K)
 
-    def measure(coalesce_on: bool) -> dict:
+    def measure(coalesce_on: bool, fused_on: bool = True) -> dict:
         cfg = Config()
         cfg.coalescer.enabled = coalesce_on
+        # fused device dispatch A/B lever: App applies the knob to the
+        # index layer's process-wide toggle at init
+        cfg.fused_dispatch_enabled = fused_on
         cfg.coalescer.window_ms = float(
             os.environ.get("BENCH_COALESCE_WINDOW_MS", 1.5))
         # re-tune hook for the dispatch pipeline now that finalize no
@@ -2006,6 +2016,7 @@ def run_serving_bench(args, rng):
             row = {
                 "clients": args.clients, "n": n, "dim": dim, "k": K,
                 "coalesce": coalesce_on,
+                "fused": fused_on,
                 "duration_s": round(elapsed, 2),
                 "requests": int(flat.size),
                 "qps": round(flat.size / elapsed, 1),
@@ -2070,7 +2081,16 @@ def run_serving_bench(args, rng):
                 row["phase_share"] = {
                     p: v.get("share_of_wall")
                     for p, v in ps.get("phases", {}).items()}
+                # absolute per-dispatch stage medians too: share-of-wall
+                # is queue_wait-diluted at high client counts, and the
+                # fused-dispatch hop win must be readable either way
+                row["phase_p50_ms"] = {
+                    p: v.get("p50_ms")
+                    for p, v in ps.get("phases", {}).items()}
                 row["perf_tiers"] = ps.get("tiers")
+                # fused-dispatch coverage + ledger-invariant violations
+                # over the counted window (must be 0 violations)
+                row["fused_dispatch"] = ps.get("fused")
             if getattr(app, "memory_ledger", None) is not None:
                 # the byte ledger's compact block (monitoring/memory.py):
                 # device/host footprint, headroom, ingest rate, COW costs
@@ -2084,13 +2104,17 @@ def run_serving_bench(args, rng):
                 srv.stop()
             if app is not None:
                 app.shutdown()
+            from weaviate_tpu.index import tpu as _tpu
+
+            _tpu.set_fused_enabled(None)  # no ambient toggle leaks out
             shutil.rmtree(data_dir, ignore_errors=True)
 
+    fused_default = args.fused != "off"
     modes = {}
     if args.coalesce in ("off", "both"):
-        modes["off"] = measure(False)
+        modes["off"] = measure(False, fused_default)
     if args.coalesce in ("on", "both"):
-        modes["on"] = measure(True)
+        modes["on"] = measure(True, fused_default)
     plat = jax.devices()[0].platform
     backend = "tpu-v5e" if plat in ("tpu", "axon") else "cpu"
     out_row = {
@@ -2102,6 +2126,71 @@ def run_serving_bench(args, rng):
             modes["on"]["qps"] / modes["off"]["qps"], 2)
     suffix = "cpu" if backend == "cpu" else "tpu"
     _merge_matrix({f"serving_coalesce_{suffix}": out_row})
+    if args.fused == "both":
+        # fused-vs-staged A/B at the primary coalesce setting: the fused
+        # half was measured above; measure the staged (legacy host
+        # slot->doc translation) control and commit the decomposition —
+        # phase shares, duty cycle, online recall — so the next live chip
+        # session regenerates the TPU rows with the before/after already
+        # instrumented (ROADMAP standing chore)
+        co = args.coalesce != "off"
+        fused_row = modes["on" if co else "off"]
+        staged_row = measure(co, False)
+
+        def _hop_share(r: dict) -> float:
+            ph = r.get("phase_share") or {}
+            return ((ph.get("gather_hop") or 0.0)
+                    + (ph.get("hydrate") or 0.0))
+
+        def _hop_p50(r: dict) -> float:
+            ph = r.get("phase_p50_ms") or {}
+            return ((ph.get("gather_hop") or 0.0)
+                    + (ph.get("hydrate") or 0.0))
+
+        ab = {
+            "backend": backend, "round": 6,
+            "date": time.strftime("%Y-%m-%d"),
+            "clients": args.clients, "n": n, "dim": dim,
+            "coalesce": co,
+            "fused_on": fused_row, "fused_off": staged_row,
+            # the acceptance decomposition: host share of accounted wall
+            # spent past the fetch (gather_hop) + hydration
+            "gather_hop_hydrate_share": {
+                "fused": round(_hop_share(fused_row), 4),
+                "staged": round(_hop_share(staged_row), 4),
+            },
+            # absolute per-dispatch form (ms): immune to the queue_wait
+            # dilution of share-of-wall at high client counts
+            "gather_hop_hydrate_p50_ms": {
+                "fused": round(_hop_p50(fused_row), 4),
+                "staged": round(_hop_p50(staged_row), 4),
+            },
+            # gather_hop alone — the stage the fusion actually deletes
+            # (hydrate is LSM object materialization, out of scope by
+            # design): the number that must read ~0 on a live chip
+            "gather_hop_p50_ms": {
+                "fused": (fused_row.get("phase_p50_ms") or {}).get(
+                    "gather_hop"),
+                "staged": (staged_row.get("phase_p50_ms") or {}).get(
+                    "gather_hop"),
+            },
+        }
+        if staged_row.get("qps"):
+            ab["speedup_fused_vs_staged"] = round(
+                fused_row["qps"] / staged_row["qps"], 2)
+        if _hop_share(fused_row) > 0:
+            ab["hop_share_drop_x"] = round(
+                _hop_share(staged_row) / _hop_share(fused_row), 2)
+        gh_f = ab["gather_hop_p50_ms"]["fused"]
+        gh_s = ab["gather_hop_p50_ms"]["staged"]
+        if gh_f is not None and gh_s is not None:
+            # an eps floor so a fully-collapsed fused hop (0.0 ms — the
+            # design goal) reports a large finite factor instead of
+            # silently dropping the headline field
+            ab["gather_hop_drop_x"] = round(gh_s / max(gh_f, 1e-3), 2)
+        _merge_matrix({f"serving_fused_{suffix}": ab})
+        log(f"fused A/B: {ab['gather_hop_hydrate_share']} "
+            f"speedup={ab.get('speedup_fused_vs_staged')}")
     headline = modes.get("on") or modes.get("off")
     print(json.dumps({
         "metric": (

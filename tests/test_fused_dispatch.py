@@ -1,0 +1,488 @@
+"""Fully fused device dispatch (index/tpu.py): one program from scan to
+final doc ids, zero host post-processing.
+
+Pins the fused-dispatch PR's contracts:
+
+1. bit-identity — fused vs legacy (host slot_to_doc translation) return
+   EXACTLY the same ids and distances on every tier: exact scan, PQ
+   rescore, PQ codes-only, small-allowList gather (compressed and not),
+   and target-distance widening; sync == async both ways;
+2. snapshot pinning survives fusion — enqueue, then delete the winners
+   and compact(): finalize still returns the OLD snapshot's exact doc
+   ids (the device translation table is pinned by the snapshot like
+   every other device buffer);
+3. the perf-ledger invariant — a fused dispatch records exactly ONE
+   blocking fetch and ZERO host-translation time
+   (costmodel.fused_invariant_ok; the window counts violations);
+4. the satellites — the sorted doc->slot map is gone (gather resolves
+   via a cached vectorized membership pass), the slot_to_doc COW copy is
+   gone from the write path (append-only invariant), R_BUCKETS has one
+   source of truth in config, and the enqueue staging pool reuses
+   per-bucket host buffers.
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.entities.vectorindex import parse_and_validate_config
+from weaviate_tpu.index import tpu
+from weaviate_tpu.index.tpu import TpuVectorIndex
+from weaviate_tpu.monitoring import costmodel, perf, tracing
+from weaviate_tpu.storage.bitmap import Bitmap
+
+DIM = 16
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    yield
+    tpu.set_fused_enabled(None)
+    tracing.configure(None)
+    perf.configure(None)
+
+
+def _mk_index(tmp_path, n=500, pq=None, seed=0, name="fx", **cfg_extra):
+    rng = np.random.default_rng(seed)
+    # small-integer vectors: every L2 distance is exact integer arithmetic
+    # in f32 regardless of accumulation order, so equality checks are exact
+    vecs = rng.integers(-8, 8, (n, DIM)).astype(np.float32)
+    d = {"distance": "l2-squared", **cfg_extra}
+    if pq is not None:
+        d["pq"] = pq
+    cfg = parse_and_validate_config("hnsw_tpu", d)
+    idx = TpuVectorIndex(cfg, str(tmp_path / name), persist=False)
+    idx.add_batch(np.arange(n), vecs)
+    idx.flush()
+    return idx, vecs
+
+
+def _tiers(tmp_path, n=500):
+    """(name, index, allowList) per read tier, sharing one dataset."""
+    out = []
+    idx, vecs = _mk_index(tmp_path, n=n, name="exact")
+    out.append(("exact", idx, vecs, None))
+    cutoff = idx.config.flat_search_cutoff
+    big_allow = Bitmap(np.arange(0, cutoff + 64, dtype=np.uint64))
+    out.append(("filtered_scan", idx, vecs, big_allow))
+    out.append(("gather", idx, vecs,
+                Bitmap(np.array([3, 7, 11, 401], dtype=np.uint64))))
+    pq_r, vecs_r = _mk_index(
+        tmp_path, n=n, name="pqr",
+        pq={"enabled": True, "segments": 4, "centroids": 16})
+    assert pq_r.compressed and pq_r._rescore_dev is not None
+    out.append(("pq_rescore", pq_r, vecs_r, None))
+    pq_c, vecs_c = _mk_index(
+        tmp_path, n=n, name="pqc",
+        pq={"enabled": True, "segments": 4, "centroids": 16,
+            "rescore": False})
+    assert pq_c.compressed and pq_c._rescore_dev is None
+    out.append(("pq_codes", pq_c, vecs_c, None))
+    out.append(("pq_gather", pq_c, vecs_c,
+                Bitmap(np.array([3, 7, 11], dtype=np.uint64))))
+    return out
+
+
+# -- 1. fused == legacy bit identity, sync == async ---------------------------
+
+
+def test_fused_legacy_bit_identity_all_tiers_sync_and_async(tmp_path):
+    for name, idx, vecs, allow in _tiers(tmp_path):
+        q = vecs[:9] + 0.01
+        tpu.set_fused_enabled(True)
+        f_sync = idx.search_by_vectors(q, 10, allow)
+        f_async = idx.search_by_vectors_async(q, 10, allow)()
+        tpu.set_fused_enabled(False)
+        l_sync = idx.search_by_vectors(q, 10, allow)
+        l_async = idx.search_by_vectors_async(q, 10, allow)()
+        for got in (f_sync, f_async, l_async):
+            np.testing.assert_array_equal(got[0], l_sync[0], err_msg=name)
+            np.testing.assert_array_equal(got[1], l_sync[1], err_msg=name)
+        assert f_sync[0].dtype == np.uint64, name
+        assert f_sync[1].dtype == np.float32, name
+
+
+def test_fused_target_distance_widening_matches_legacy(tmp_path):
+    idx, vecs = _mk_index(tmp_path)
+    q = vecs[5] + 0.01
+    tpu.set_fused_enabled(True)
+    ids_f, d_f = idx.search_by_vector_distance(q, 300.0, 64)
+    tpu.set_fused_enabled(False)
+    ids_l, d_l = idx.search_by_vector_distance(q, 300.0, 64)
+    np.testing.assert_array_equal(ids_f, ids_l)
+    np.testing.assert_array_equal(d_f, d_l)
+    assert len(ids_f) > 0
+
+
+def test_fused_missing_slots_carry_legacy_sentinel(tmp_path):
+    """Fewer matches than k: missing slots must read inf/2^64-1 exactly
+    like the legacy host translation emitted (np.int64(-1) as uint64)."""
+    idx, vecs = _mk_index(tmp_path)
+    cutoff = idx.config.flat_search_cutoff
+    # masked full scan with only 3 live matches (the rest are absent ids)
+    allow = Bitmap(np.array(
+        [0, 1, 2] + list(range(10**6, 10**6 + cutoff + 50)),
+        dtype=np.uint64))
+    tpu.set_fused_enabled(True)
+    ids, dists = idx.search_by_vectors(vecs[:2] + 0.01, 8, allow)
+    assert (ids[:, 3:] == np.uint64(0xFFFFFFFFFFFFFFFF)).all()
+    assert np.isinf(dists[:, 3:]).all()
+
+
+def test_fused_keeps_64bit_doc_ids(tmp_path):
+    """Doc ids above 2^32 survive the device translation table's two-word
+    round trip bit-exactly (jax may run with x64 disabled)."""
+    cfg = parse_and_validate_config("hnsw_tpu", {"distance": "l2-squared"})
+    idx = TpuVectorIndex(cfg, str(tmp_path / "big"), persist=False)
+    big = np.array([2**63 + 7, 2**40 + 1, 3], dtype=np.uint64)
+    vecs = np.eye(3, DIM, dtype=np.float32)
+    idx.add_batch(big.astype(np.int64), vecs)
+    idx.flush()
+    tpu.set_fused_enabled(True)
+    ids, _ = idx.search_by_vectors(vecs, 3)
+    assert {int(x) for x in ids[0]} == {int(x) for x in big}
+
+
+# -- 2. snapshot pinning across delete + compact ------------------------------
+
+
+def test_fused_finalize_pins_snapshot_across_delete_compact(tmp_path):
+    """Enqueue -> delete the winners + compact -> finalize returns the
+    OLD snapshot's exact answer, on every tier (the PR-4 contract, now
+    including the device slot->doc table)."""
+    tpu.set_fused_enabled(True)
+    for name, idx, vecs, allow in _tiers(tmp_path):
+        q = vecs[:4] + 0.01
+        want = idx.search_by_vectors(q, 5, allow)
+        fin = idx.search_by_vectors_async(q, 5, allow)
+        winners = [int(x) for x in np.unique(want[0])
+                   if x != 0xFFFFFFFFFFFFFFFF]
+        idx.delete(*winners[:3])
+        idx.compact()
+        got = fin()
+        np.testing.assert_array_equal(got[0], want[0], err_msg=name)
+        np.testing.assert_array_equal(got[1], want[1], err_msg=name)
+        # and a FRESH search sees the post-delete world
+        fresh = idx.search_by_vectors(q, 5, allow)
+        if winners[:3]:
+            assert not set(winners[:3]) & {int(x) for x in fresh[0].ravel()}
+
+
+# -- 3. the perf-ledger fused-dispatch invariant ------------------------------
+
+
+def _with_perf_window():
+    tracing.configure(tracing.Tracer(sample_rate=1.0))
+    return perf.configure(perf.PerfWindow(window_s=60.0))
+
+
+def _pop_shape(idx):
+    s = idx.pop_dispatch_shape()
+    assert s is not None
+    return s
+
+
+def test_fused_invariant_one_fetch_zero_translation(tmp_path):
+    win = _with_perf_window()
+    tpu.set_fused_enabled(True)
+    for name, idx, vecs, allow in _tiers(tmp_path):
+        ids, dists = idx.search_by_vectors(vecs[:4] + 0.01, 5, allow)
+        shape = _pop_shape(idx)
+        assert shape.fused is True, name
+        assert shape.fetches == 1, name
+        assert shape.translate_ms == 0.0, name
+        assert costmodel.fused_invariant_ok(shape), name
+        win.record_dispatch(shape, rows=4)
+    s = win.summary()
+    assert s["fused"]["dispatches"] == 6
+    assert s["fused"]["violations"] == 0
+
+
+def test_legacy_dispatch_measures_translation_and_passes_trivially(tmp_path):
+    win = _with_perf_window()
+    tpu.set_fused_enabled(False)
+    idx, vecs = _mk_index(tmp_path)
+    idx.search_by_vectors(vecs[:4] + 0.01, 5)
+    shape = _pop_shape(idx)
+    assert shape.fused is False
+    assert shape.fetches == 1
+    assert shape.translate_ms >= 0.0  # measured, not -1
+    assert costmodel.fused_invariant_ok(shape)  # no claim, no violation
+    win.record_dispatch(shape, rows=4)
+    s = win.summary()
+    assert s["fused"] == {"dispatches": 0, "violations": 0}
+
+
+def test_fused_invariant_violation_is_counted(tmp_path):
+    win = _with_perf_window()
+    shape = costmodel.DispatchShape(costmodel.TIER_EXACT, n=100, dim=DIM,
+                                    batch=4, bytes_per_row=DIM * 4, k=5)
+    shape.fused = True
+    shape.fetches = 2  # a second blocking fetch broke the contract
+    shape.translate_ms = 0.0
+    assert not costmodel.fused_invariant_ok(shape)
+    win.record_dispatch(shape, rows=4)
+    assert win.summary()["fused"] == {"dispatches": 1, "violations": 1}
+
+
+def test_fused_empty_gather_owes_no_fetch(tmp_path):
+    """The empty-allowList gather early return runs no device work: zero
+    fetches is NOT an invariant violation there (shape.n == 0)."""
+    _with_perf_window()
+    tpu.set_fused_enabled(True)
+    idx, vecs = _mk_index(tmp_path)
+    allow = Bitmap(np.array([10**7, 10**7 + 1], dtype=np.uint64))
+    ids, dists = idx.search_by_vectors(vecs[:2], 5, allow)
+    assert ids.shape == (2, 0)
+    shape = _pop_shape(idx)
+    assert shape.fused and shape.fetches == 0 and shape.n == 0
+    assert costmodel.fused_invariant_ok(shape)
+
+
+# -- 4. satellites ------------------------------------------------------------
+
+
+def test_sorted_map_is_gone_and_gather_slots_cache_on_allowlist(tmp_path):
+    idx, vecs = _mk_index(tmp_path)
+    snap = idx._read_snapshot()
+    assert not hasattr(snap, "_sorted_map")
+    assert not hasattr(snap, "sorted_doc_slots")
+    allow = Bitmap(np.array([3, 7, 11], dtype=np.uint64))
+    idx.search_by_vectors(vecs[:2], 3, allow)
+    cached = allow._slots_cache
+    assert cached[0] == (snap.allow_token, snap.n, snap.capacity)
+    np.testing.assert_array_equal(cached[1], [3, 7, 11])
+    # second search reuses the cached slots object
+    idx.search_by_vectors(vecs[:2], 3, allow)
+    assert allow._slots_cache[1] is cached[1]
+
+
+def test_gather_cached_allowlist_never_returns_deleted_docs(tmp_path):
+    """The review-caught staleness hole: the per-allowList slot cache's
+    (allow_token, n, capacity) key does not change on deletes, so a
+    REUSED AllowList object after a delete hits a stale slot list — the
+    gather kernels must mask tombstones on device with the dispatching
+    snapshot's own tombs (both tiers, fused and legacy)."""
+    for compress in (False, True):
+        pq = ({"enabled": True, "segments": 4, "centroids": 16}
+              if compress else None)
+        idx, vecs = _mk_index(tmp_path, pq=pq,
+                              name=f"stale{int(compress)}")
+        allow = Bitmap(np.array([3, 7, 11], dtype=np.uint64))
+        q = vecs[:2] + 0.01
+        for fused in (True, False):
+            tpu.set_fused_enabled(fused)
+            ids0, _ = idx.search_by_vectors(q, 3, allow)  # warms the cache
+            assert 3 in {int(x) for x in ids0.ravel()}
+        idx.delete(3)
+        idx.flush()
+        for fused in (True, False):
+            tpu.set_fused_enabled(fused)
+            ids1, d1 = idx.search_by_vectors(q, 3, allow)  # same object
+            got = {int(x) for x in ids1.ravel() if x != 2**64 - 1}
+            assert got == {7, 11}, (compress, fused, ids1, d1)
+        tpu.set_fused_enabled(None)
+
+
+def test_gather_fully_deleted_filter_short_circuits_empty(tmp_path):
+    """An allowList whose every match is tombstoned in the dispatching
+    snapshot must return the (b, 0) empty shape with ZERO device work —
+    even through a stale cached slot list (the short-circuit consults
+    the snapshot's own host mirror per dispatch, never the cache)."""
+    _with_perf_window()
+    idx, vecs = _mk_index(tmp_path)
+    allow = Bitmap(np.array([3, 7], dtype=np.uint64))
+    q = vecs[:2] + 0.01
+    idx.search_by_vectors(q, 3, allow)  # warm the slot cache
+    idx.pop_dispatch_shape()
+    idx.delete(3, 7)
+    idx.flush()
+    for fused in (True, False):
+        tpu.set_fused_enabled(fused)
+        ids, dists = idx.search_by_vectors(q, 3, allow)
+        assert ids.shape == (2, 0) and dists.shape == (2, 0), fused
+        shape = _pop_shape(idx)
+        assert shape.n == 0 and shape.fetches == 0, fused
+    tpu.set_fused_enabled(None)
+
+
+def test_gather_resolves_readded_doc_to_newest_slot(tmp_path):
+    idx, vecs = _mk_index(tmp_path)
+    idx.delete(7)
+    idx.add(7, np.full(DIM, 1.0, np.float32))
+    allow = Bitmap(np.array([7], dtype=np.uint64))
+    ids, dists = idx.search_by_vectors(np.ones((1, DIM), np.float32), 3,
+                                       allow)
+    # the old tombstoned slot is gathered but device-masked to the
+    # sentinel; exactly ONE live hit survives — the re-added vector
+    finite = np.isfinite(dists[0])
+    assert finite.sum() == 1
+    assert int(ids[0][finite][0]) == 7
+    assert abs(float(dists[0][finite][0])) < 1e-6  # the NEW vector
+
+
+def test_gather_old_pinned_snapshot_keeps_its_predelete_world(tmp_path):
+    """The reverse staleness direction (review-caught): a dispatch pinned
+    on an OLD snapshot must keep returning docs live in ITS world even
+    when the shared slot cache was (re)computed after a delete — the
+    cached list carries no tombstone knowledge; each dispatch's own
+    device tombs mask decides."""
+    tpu.set_fused_enabled(True)
+    idx, vecs = _mk_index(tmp_path)
+    allow = Bitmap(np.array([3, 7, 11], dtype=np.uint64))
+    q = vecs[:2] + 0.01
+    snap_a = idx._read_snapshot()
+    idx.delete(3)
+    idx.flush()  # publishes B; (allow_token, n, capacity) unchanged
+    # warm the cache from B's world
+    ids_b, _ = idx.search_by_vectors(q, 3, allow)
+    assert 3 not in {int(x) for x in ids_b.ravel()}
+    # a dispatch pinned on A consumes the same cache — doc 3 must be back
+    ids_a, dists_a = idx._dispatch_search(snap_a, q, 3, allow)()
+    assert 3 in {int(x) for x in ids_a.ravel()}
+    tpu.set_fused_enabled(None)
+
+
+def test_slot_to_doc_cow_copy_dropped_host_tombs_kept(tmp_path):
+    idx, vecs = _mk_index(tmp_path)
+    snap = idx._read_snapshot()
+    s2d_obj = snap.slot_to_doc
+    # append within capacity: slot_to_doc mutates in place past snap.n —
+    # NO copy (the append-only invariant), and the snapshot's prefix is
+    # untouched
+    idx.add(10_001, vecs[0])
+    idx.flush()
+    assert idx._slot_to_doc is s2d_obj
+    assert idx._snap.slot_to_doc is s2d_obj
+    # a delete still copy-on-writes the host tombstone mirror the old
+    # snapshot pins
+    tombs_obj = idx._host_tombs
+    assert idx._snap.host_tombs is tombs_obj
+    idx.delete(3)
+    idx.flush()
+    assert idx._host_tombs is not tombs_obj
+    assert not snap.host_tombs[3]  # the pinned view never tore
+
+
+def test_r_buckets_single_source_of_truth():
+    from weaviate_tpu.config.config import RESCORE_R_BUCKETS
+    from weaviate_tpu.serving import controller
+
+    assert controller.R_BUCKETS is RESCORE_R_BUCKETS
+    assert tpu.RESCORE_R_BUCKETS is RESCORE_R_BUCKETS
+    assert RESCORE_R_BUCKETS[-1] == 128
+
+
+def test_stage_pool_reuses_query_buffers(tmp_path):
+    idx, vecs = _mk_index(tmp_path)
+    q = vecs[:3] + 0.01
+    ids1, _ = idx.search_by_vectors(q, 5)
+    key = (tpu._bucket_b(3), DIM)
+    assert len(idx._stage_free.get(key, [])) == 1
+    buf = idx._stage_free[key][0]
+    ids2, _ = idx.search_by_vectors(q, 5)
+    # same buffer went out and came back; results stay correct
+    assert idx._stage_free[key][0] is buf
+    np.testing.assert_array_equal(ids1, ids2)
+    # the pool is bounded
+    assert all(len(v) <= TpuVectorIndex._STAGE_POOL_CAP
+               for v in idx._stage_free.values())
+
+
+def test_stage_pool_ledger_component_and_drop(tmp_path):
+    from weaviate_tpu.monitoring import memory
+
+    idx, vecs = _mk_index(tmp_path)
+    idx.search_by_vectors(vecs[:3] + 0.01, 5)
+    comps = memory.index_host_components(idx)
+    want = sum(b.nbytes for bufs in idx._stage_free.values() for b in bufs)
+    assert want > 0 and comps["stage_buffers"] == want
+    assert "stage_buffers" in memory.HOST_COMPONENTS
+    idx.drop()
+    assert idx._stage_free == {}
+    assert "stage_buffers" not in memory.index_host_components(idx)
+
+
+def test_prefetch_failure_strands_stage_buffer(tmp_path):
+    """A finalize that fails BEFORE the blocking fetch must NOT return
+    its staging buffer to the pool: the enqueued program may not have
+    consumed the (possibly aliased, cpu backend) host memory yet, and a
+    recycled buffer could corrupt a retried dispatch."""
+    from weaviate_tpu.testing import faults
+
+    idx, vecs = _mk_index(tmp_path)
+    q = vecs[:3] + 0.01
+    idx.search_by_vectors(q, 5)  # park one buffer
+    key = (tpu._bucket_b(3), DIM)
+    assert len(idx._stage_free[key]) == 1
+    inj = faults.configure(faults.from_spec("index.tpu.finalize:device_error:times=1"))
+    try:
+        fin = idx.search_by_vectors_async(q, 5)  # checks the buffer out
+        assert len(idx._stage_free[key]) == 0
+        with pytest.raises(Exception):
+            fin()
+        # stranded, not recycled
+        assert len(idx._stage_free[key]) == 0
+    finally:
+        faults.configure(None)
+        del inj
+    # a healthy dispatch parks a fresh buffer again
+    idx.search_by_vectors(q, 5)
+    assert len(idx._stage_free[key]) == 1
+
+
+def test_drop_blocks_stage_buffer_reparking(tmp_path):
+    """An in-flight dispatch finalizing AFTER drop() must not re-park
+    its staging buffer into the cleared pool (stage_buffers must read 0
+    after drop; a re-created index may use a different dim)."""
+    idx, vecs = _mk_index(tmp_path)
+    fin = idx.search_by_vectors_async(vecs[:3] + 0.01, 5)
+    idx.drop()
+    fin()
+    assert idx._stage_free == {}
+
+
+def test_fused_override_token_still_ours_discipline(tmp_path):
+    """set_fused_enabled returns a token; unset reverts only the CURRENT
+    override (a stale token is a no-op) — and App.shutdown() uses it, so
+    a torn-down App leaves no toggle residue while a newer App's setting
+    survives."""
+    t1 = tpu.set_fused_enabled(False)
+    t2 = tpu.set_fused_enabled(True)
+    tpu.unset_fused_enabled(t1)  # stale: the newer override survives
+    assert tpu.fused_dispatch_enabled() is True
+    tpu.unset_fused_enabled(t2)  # current: reverts to the env default
+    assert tpu._fused_override is None
+    # App-level: shutdown reverts its own override
+    from weaviate_tpu.config import Config
+    from weaviate_tpu.server import App
+
+    tpu._fused_env = None
+    cfg = Config()
+    cfg.fused_dispatch_enabled = False
+    app = App(config=cfg, data_path=str(tmp_path / "appdata"))
+    try:
+        assert tpu.fused_dispatch_enabled() is False
+    finally:
+        app.shutdown()
+    assert tpu.fused_dispatch_enabled() is True  # env default restored
+
+
+def test_fused_toggle_env_and_setter(monkeypatch):
+    tpu.set_fused_enabled(None)
+    tpu._fused_env = None
+    monkeypatch.setenv("FUSED_DISPATCH_ENABLED", "false")
+    assert tpu.fused_dispatch_enabled() is False
+    tpu.set_fused_enabled(True)
+    assert tpu.fused_dispatch_enabled() is True
+    tpu.set_fused_enabled(None)
+    assert tpu.fused_dispatch_enabled() is False  # env default again
+    tpu._fused_env = None  # drop the cached env parse for other tests
+
+
+def test_config_knob_parses(monkeypatch):
+    from weaviate_tpu.config import load_config
+
+    monkeypatch.setenv("FUSED_DISPATCH_ENABLED", "false")
+    assert load_config().fused_dispatch_enabled is False
+    monkeypatch.delenv("FUSED_DISPATCH_ENABLED")
+    assert load_config().fused_dispatch_enabled is True

@@ -1392,3 +1392,97 @@ def test_jgl014_controller_module_is_exempt():
     )
     assert "JGL014" not in codes(src, "weaviate_tpu/serving/controller.py")
     assert codes(src, COLD).count("JGL014") == 2
+
+
+# -- JGL015: host post-processing in a fused finalize/unpack path -------------
+
+
+def test_jgl015_row_loop_in_finalize_fires():
+    src = (
+        "def _dispatch(self):\n"
+        "    def finalize():\n"
+        "        packed = _fetch_packed(dev)\n"
+        "        out = []\n"
+        "        for row in packed:\n"           # per-row host loop
+        "            out.append(row)\n"
+        "        return out\n"
+        "    return finalize\n"
+    )
+    assert codes(src, INDEX).count("JGL015") == 1
+
+
+def test_jgl015_foreign_asarray_fires_packed_asarray_passes():
+    src = (
+        "import numpy as np\n"
+        "def finalize():\n"
+        "    packed = np.asarray(packed_dev)\n"  # packed buffer: legal
+        "    extra = np.asarray(slot_to_doc)\n"
+        "    return packed, extra\n"
+        "def unpack_fused(packed):\n"
+        "    return np.asarray(packed)\n"        # THE packed buffer: legal
+    )
+    out = codes(src, INDEX)
+    # packed_dev (a packed name) and packed itself pass; slot_to_doc fires
+    assert out.count("JGL015") == 1
+
+
+def test_jgl015_while_loop_fires_too():
+    src = (
+        "def finalize():\n"
+        "    packed = _fetch_packed(dev)\n"
+        "    i = 0\n"
+        "    while i < packed.shape[0]:\n"  # same per-row work, spelled
+        "        i += 1\n"                  # as a while loop
+        "    return packed\n"
+    )
+    assert codes(src, INDEX).count("JGL015") == 1
+
+
+def test_jgl015_nested_helper_inherits_finalize_scope():
+    src = (
+        "import numpy as np\n"
+        "def finalize():\n"
+        "    def helper():\n"
+        "        for r in rows:\n"
+        "            np.asarray(r)\n"
+        "    return helper()\n"
+    )
+    # the loop AND the asarray inside the nested helper both fire
+    assert codes(src, INDEX).count("JGL015") == 2
+
+
+def test_jgl015_out_of_scope_and_other_functions_pass():
+    src = (
+        "import numpy as np\n"
+        "def finalize():\n"
+        "    for r in rows:\n"
+        "        pass\n"
+    )
+    # ops/ is outside the index scope
+    assert "JGL015" not in codes(src, HOT)
+    # a non-finalize function in scope may loop freely
+    src2 = (
+        "import numpy as np\n"
+        "def _restore(self):\n"
+        "    for rec in replay():\n"
+        "        np.asarray(rec)\n"
+    )
+    assert "JGL015" not in codes(src2, INDEX)
+
+
+def test_jgl015_fetch_packed_itself_is_exempt():
+    src = (
+        "import numpy as np\n"
+        "def _fetch_packed(dev, shape=None):\n"
+        "    return np.asarray(dev)\n"
+    )
+    assert "JGL015" not in codes(src, INDEX)
+
+
+def test_jgl015_repo_index_layer_is_clean():
+    import subprocess as _sp
+
+    r = _sp.run([sys.executable, "-m", "tools.graftlint",
+                 "weaviate_tpu/index"], capture_output=True, text=True,
+                cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr

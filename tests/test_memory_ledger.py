@@ -73,9 +73,11 @@ def test_exact_tier_components_equal_snapshot_nbytes(tmp_path):
         "store": snap.store.nbytes,
         "sq_norms": snap.sq_norms.nbytes,
         "tombs": snap.tombs.nbytes,
+        "slot_to_doc": snap.slot_to_doc_dev.nbytes,
     }
     assert led.device_bytes_total() == (
-        snap.store.nbytes + snap.sq_norms.nbytes + snap.tombs.nbytes)
+        snap.store.nbytes + snap.sq_norms.nbytes + snap.tombs.nbytes
+        + snap.slot_to_doc_dev.nbytes)
 
 
 def test_pq_rescore_tier_components_and_no_stale_store(tmp_path):
@@ -89,6 +91,7 @@ def test_pq_rescore_tier_components_and_no_stale_store(tmp_path):
     # the float store was dropped at compression: no stale component
     assert comps == {
         "tombs": snap.tombs.nbytes,
+        "slot_to_doc": snap.slot_to_doc_dev.nbytes,
         "pq_codes": snap.codes.nbytes,
         "recon_norms": snap.recon_norms.nbytes,
         "rescore_store": snap.rescore_dev.nbytes,
@@ -108,6 +111,7 @@ def test_pq_codes_only_tier_has_no_rescore_components(tmp_path):
     comps = led.device_components()
     assert comps == {
         "tombs": snap.tombs.nbytes,
+        "slot_to_doc": snap.slot_to_doc_dev.nbytes,
         "pq_codes": snap.codes.nbytes,
         "recon_norms": snap.recon_norms.nbytes,
     }
@@ -153,6 +157,7 @@ def test_compact_transition_tracks_new_snapshot(tmp_path):
         "store": snap.store.nbytes,
         "sq_norms": snap.sq_norms.nbytes,
         "tombs": snap.tombs.nbytes,
+        "slot_to_doc": snap.slot_to_doc_dev.nbytes,
     }
     phases = led.summary()["write"]["phases"]
     assert phases["compact"]["samples"] >= 1

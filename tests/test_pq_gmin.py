@@ -120,7 +120,11 @@ def test_pq_gmin_failure_separate_from_dense(tmp_path, monkeypatch):
     def boom(*a, **k):
         raise RuntimeError("mosaic says no")
 
+    # both entries: the fused-dispatch default routes through the _fused
+    # twin, the legacy toggle through the plain one — either failing must
+    # break only the PQ domain
     monkeypatch.setattr(pq_gmin, "search_pq_gmin", boom)
+    monkeypatch.setattr(pq_gmin, "search_pq_gmin_fused", boom)
     q = vecs[:16]
     ids, _ = idx.search_by_vectors(q, 3)  # falls back, still answers
     assert ids.shape[0] == 16

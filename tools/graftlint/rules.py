@@ -223,6 +223,15 @@ RULE_DOCS = {
               "journaled) or the controller's own object actuations; a "
               "direct setter call or knob-field write elsewhere bypasses "
               "the clamp, the journal, and the fail-static revert",
+    "JGL015": "host post-processing in a fused finalize/unpack path — "
+              "inside index-layer functions named `finalize` (or "
+              "containing `unpack`), per-row Python loops over fetched "
+              "results and np.asarray on anything but the one packed "
+              "buffer are findings: the fused dispatch contract is ONE "
+              "blocking fetch that already carries final doc ids, "
+              "consumed with vectorized dtype views "
+              "(ops/topk.unpack_fused) — a loop or a second asarray "
+              "re-grows the host hop the fusion deleted",
     "JGL999": "file does not parse",
 }
 
@@ -292,12 +301,19 @@ JGL012_PREFIXES = ("weaviate_tpu/index/",)
 # IndexSnapshot fields + index/mesh.py slab fields)
 SNAPSHOT_FIELDS = frozenset({
     "_store", "_sq_norms", "_tombs", "_codes", "_recon_norms",
-    "_rescore_dev", "_rescore_sq_norms", "_zero_words",
+    "_rescore_dev", "_rescore_sq_norms", "_zero_words", "_s2d_dev",
 })
 
 # calls that route an allocation through the ledger: the per-class
 # stamping hook, or snapshot publication (which stamps as its last step)
 LEDGER_STAMP_CALLS = frozenset({"_stamp_memory", "_publish_snapshot"})
+
+# JGL015 scope: the index layer's finalize/unpack code paths — where a
+# dispatch's fetched results are turned into caller-visible arrays. The
+# static twin of the fused dispatch's zero-host-post-processing contract
+# (index/tpu.py _finalize_fused): the one legal asarray is the packed
+# fetch itself, and nothing iterates rows in Python.
+JGL015_PREFIXES = ("weaviate_tpu/index/",)
 
 
 def in_metric_label_scope(rel_path: str) -> bool:
@@ -319,6 +335,18 @@ def in_snapshot_ledger_scope(rel_path: str) -> bool:
     rp = rel_path.replace("\\", "/")
     return any(rp == p or rp.startswith(p) or f"/{p}" in rp
                for p in JGL012_PREFIXES)
+
+
+def in_finalize_hostwork_scope(rel_path: str) -> bool:
+    """JGL015 scope check (same interior-boundary matching as is_hot)."""
+    rp = rel_path.replace("\\", "/")
+    return any(rp == p or rp.startswith(p) or f"/{p}" in rp
+               for p in JGL015_PREFIXES)
+
+
+def _is_finalize_name(name: str) -> bool:
+    """JGL015 path predicate: finalize closures and unpack helpers."""
+    return name == "finalize" or "unpack" in name
 
 
 def in_journal_kind_scope(rel_path: str) -> bool:
@@ -660,10 +688,15 @@ class RuleWalker(ast.NodeVisitor):
         self.controller_knob_scope = in_controller_knob_scope(rel_path)
         self.thread_runloop_scope = in_thread_runloop_scope(rel_path)
         self.snapshot_ledger_scope = in_snapshot_ledger_scope(rel_path)
+        self.finalize_hostwork_scope = in_finalize_hostwork_scope(rel_path)
         self.mod = mod
         # JGL012 state: per enclosing function, does it lexically call a
         # ledger stamping hook (_stamp_memory / _publish_snapshot)?
         self._stamp_fns: list[bool] = []
+        # JGL015 state: per enclosing function, are we inside a
+        # finalize/unpack path (nested helpers inherit — they run as part
+        # of the finalize flow)?
+        self._finalize_fns: list[bool] = []
         self.findings: list[Finding] = []
         self.scope: list[str] = []            # qualname stack
         self.class_stack: list[str] = []      # enclosing class names
@@ -736,6 +769,9 @@ class RuleWalker(ast.NodeVisitor):
         self.fn_stack.append(node)
         self._check_thread_runloop(node)
         self._stamp_fns.append(self._fn_calls_stamp(node))
+        self._finalize_fns.append(
+            _is_finalize_name(node.name)
+            or bool(self._finalize_fns and self._finalize_fns[-1]))
         self.fn_depth += 1
         jitted = _jit_decorated(node)
         if jitted:
@@ -763,6 +799,7 @@ class RuleWalker(ast.NodeVisitor):
             self.jit_depth -= 1
         self.fn_depth -= 1
         self._stamp_fns.pop()
+        self._finalize_fns.pop()
         self.fn_stack.pop()
         self.scope.pop()
 
@@ -774,6 +811,9 @@ class RuleWalker(ast.NodeVisitor):
             self.global_names[-1].update(node.names)
 
     def _visit_loop(self, node) -> None:
+        # For AND While: a `while i < rows:` loop is the same per-row
+        # host post-processing JGL015 forbids, just spelled differently
+        self._check_finalize_loop(node)
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
@@ -870,7 +910,41 @@ class RuleWalker(ast.NodeVisitor):
         self._check_dynamic_label(node)
         self._check_journal_kind(node)
         self._check_knob_setter_call(node)
+        self._check_finalize_asarray(node)
         self.generic_visit(node)
+
+    # -- JGL015: host post-processing in a fused finalize/unpack path --
+
+    def _in_finalize_path(self) -> bool:
+        return bool(self.finalize_hostwork_scope and self._finalize_fns
+                    and self._finalize_fns[-1])
+
+    def _check_finalize_loop(self, node) -> None:
+        if not self._in_finalize_path():
+            return
+        self.emit(
+            "JGL015", node,
+            "per-row Python loop in a finalize/unpack path — fetched "
+            "results must be consumed with vectorized dtype views "
+            "(ops/topk.unpack_fused); a row loop re-grows the host hop "
+            "the fused dispatch deleted")
+
+    def _check_finalize_asarray(self, node: ast.Call) -> None:
+        if not self._in_finalize_path():
+            return
+        f = dotted(node.func) or ""
+        if f not in ("np.asarray", "numpy.asarray"):
+            return
+        if node.args and isinstance(node.args[0], ast.Name) \
+                and "packed" in node.args[0].id:
+            return  # the dispatch's ONE packed-buffer materialization
+        self.emit(
+            "JGL015", node,
+            "np.asarray on something other than the one packed buffer in "
+            "a finalize/unpack path — the dispatch's single blocking "
+            "fetch is _fetch_packed's; any other asarray is a second "
+            "device sync or host copy (the zero-host-post-processing "
+            "contract)")
 
     # -- JGL011: unguarded background-thread run-loop --
 

@@ -17,6 +17,18 @@ class ConfigError(ValueError):
     pass
 
 
+# The ONE table of PQ fast-scan candidate-depth buckets. Two consumers
+# import it and may never drift apart (the fused-dispatch satellite):
+#   - serving/controller.py's recall-guarded budget controller steps the
+#     rescore_r cap DOWN this ladder (and snaps operator overrides to it);
+#   - index/tpu.py's `_rescore_r` / codes-tier pool sizing treat the top
+#     bucket as the static maximum and clamp against the controller cap.
+# Because every cap value is a bucket and the index's own static choices
+# are {max(4k, 32)} ∪ buckets, a controller cut can never mint a jit
+# shape the static path wouldn't also compile.
+RESCORE_R_BUCKETS = (32, 48, 64, 96, 128)
+
+
 def _bool(env: Mapping[str, str], key: str, default: bool = False) -> bool:
     v = env.get(key)
     if v is None:
@@ -464,6 +476,12 @@ class Config:
     # TPU extensions
     device_mesh_shards: int = 0  # 0 = one shard per local device
     store_dtype: str = "float32"
+    # fully fused device dispatch (index/tpu.py): final top-k ->
+    # tombstone/allowList masking -> slot->doc translation run in ONE XLA
+    # program, so a search's single packed fetch carries final doc ids
+    # and finalize() does zero host translation. Off = the legacy host
+    # slot_to_doc path (the bench's --fused A/B lever)
+    fused_dispatch_enabled: bool = True
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
@@ -699,6 +717,7 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
 
     cfg.device_mesh_shards = _int(e, "TPU_DEVICE_MESH_SHARDS", 0)
     cfg.store_dtype = e.get("TPU_STORE_DTYPE", "float32")
+    cfg.fused_dispatch_enabled = _bool(e, "FUSED_DISPATCH_ENABLED", True)
 
     cfg.coalescer.enabled = _bool(e, "QUERY_COALESCER_ENABLED")
     cfg.coalescer.window_ms = _float(e, "QUERY_COALESCER_WINDOW_MS", 1.5)

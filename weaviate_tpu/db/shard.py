@@ -919,6 +919,12 @@ class Shard:
         in the `filter` phase, never inside a lock a reader could convoy
         on. Indexes without it (hnsw, mesh) fall back to the sync path.
 
+        With the fused dispatch (index/tpu.py, the default) finalize()'s
+        one packed fetch already carries FINAL doc ids — the slot->doc
+        translation runs on device inside the search program — so the
+        host work between fetch and hydration is dtype views, and the
+        perf ledger's gather_hop stage measures just that.
+
         Robustness gates mirror object_vector_search: deadline fail-fast
         at enqueue, breaker-open reads return a host-fallback closure
         (still ONE batched host pass for a whole coalesced lane), and a
@@ -1148,7 +1154,11 @@ class Shard:
         """All queries' winners in one pass: one valid-mask over [B, k], one
         LSM multi-get per store (docid -> uuid key -> image, single lock
         acquisition each), lazy StorObj wrappers. The per-result Python work
-        is one object alloc + one SearchResult."""
+        is one object alloc + one SearchResult. Under the fused dispatch
+        `ids`/`dists` arrive as VIEWS into the search's one packed device
+        fetch (final doc ids translated on device — index/tpu.py) — the
+        np.asarray normalizations below are no-ops there, and this method
+        is the first host code that looks at per-row content at all."""
         dists = np.asarray(dists, dtype=np.float32)
         ids = np.asarray(ids)
         valid = ~np.isinf(dists)

@@ -86,7 +86,15 @@ from weaviate_tpu.serving import controller
 # index.tpu.finalize / index.tpu.alloc — one-comparison no-ops unless a
 # harness is configured
 from weaviate_tpu.testing import faults, sanitizers
-from weaviate_tpu.ops.topk import bitmap_to_mask, merge_top_k
+# the one rescore-candidate bucket table (shared with the control plane's
+# recall-guarded cap — serving/controller.py R_BUCKETS aliases it), and
+# config's env-bool parser so FUSED_DISPATCH_ENABLED reads the same truth
+# table with or without an App
+from weaviate_tpu.config.config import RESCORE_R_BUCKETS
+from weaviate_tpu.config.config import _bool as _env_bool
+from weaviate_tpu.ops.topk import (bitmap_to_mask, merge_top_k,
+                                   retranslate_packed, translate_pack,
+                                   unpack_fused)
 
 _CHUNK = 8192          # rows staged per device write (fixed => no recompiles)
 _MIN_CAPACITY = 16384
@@ -97,6 +105,56 @@ _LOG_VERSION = 2  # v2 = per-record checksums + skip-ahead corrupt-region replay
 
 # query-batch padding buckets (limit distinct compiled shapes)
 _B_BUCKETS = (1, 4, 16, 64, 256, 1024)
+
+# -- fused-dispatch toggle ----------------------------------------------------
+# When on (the default), every search dispatch is END-TO-END device
+# resident: the final top-k, tombstone/allowList masking, and slot->doc
+# translation run in ONE XLA program against the snapshot's device
+# translation table (IndexSnapshot.slot_to_doc_dev), so the single packed
+# fetch carries final doc ids and finalize() is dtype views — zero host
+# post-processing. Off = the legacy host slot_to_doc translation (kept as
+# the bench's --fused A/B control and as a safety hatch).
+_fused_override: Optional[bool] = None
+_fused_env: Optional[bool] = None
+_fused_token: Optional[object] = None
+
+
+def set_fused_enabled(on: Optional[bool]) -> Optional[object]:
+    """Override the fused-dispatch toggle process-wide (App applies the
+    config knob here; bench/tests flip it for A/B runs). None reverts to
+    the FUSED_DISPATCH_ENABLED environment default — re-read fresh, so
+    the revert actually honors an env change made since the last parse.
+    Returns an opaque token identifying THIS override — pass it to
+    unset_fused_enabled so a torn-down App reverts only its own setting,
+    never a newer App's (the tracer/perf still-ours unconfigure
+    discipline)."""
+    global _fused_override, _fused_token, _fused_env
+    _fused_override = on
+    _fused_token = object() if on is not None else None
+    if on is None:
+        _fused_env = None  # drop the cached parse: revert means re-read
+    return _fused_token
+
+
+def unset_fused_enabled(token: Optional[object]) -> None:
+    """Revert set_fused_enabled's override iff `token` is still the
+    CURRENT one (a newer override wins); None tokens are no-ops."""
+    global _fused_override, _fused_token, _fused_env
+    if token is not None and token is _fused_token:
+        _fused_override = None
+        _fused_token = None
+        _fused_env = None  # revert means re-read the environment
+
+
+def fused_dispatch_enabled() -> bool:
+    global _fused_env
+    if _fused_override is not None:
+        return _fused_override
+    if _fused_env is None:
+        # the SAME parser Config uses: one knob must never read
+        # differently in library use vs under an App
+        _fused_env = _env_bool(os.environ, "FUSED_DISPATCH_ENABLED", True)
+    return _fused_env
 
 
 def _bucket_b(b: int) -> int:
@@ -133,6 +191,28 @@ def _write_norms(norms, chunk, offset):
 def _set_tombstones(tombs, idx):
     # idx padded with an out-of-range sentinel; mode="drop" ignores those
     return tombs.at[idx].set(True, mode="drop")
+
+
+@jax.jit
+def _write_doc_pairs(s2d, idx, pairs):
+    """Scatter doc-id word pairs into the device slot->doc table. idx is
+    padded (to a _bucket_rows width, bounding jit shapes) with an
+    out-of-range sentinel; mode="drop" ignores the padding rows. Like
+    every write kernel: non-donating, so snapshots pinning the previous
+    table generation can never tear."""
+    return s2d.at[idx].set(pairs, mode="drop")
+
+
+# unwritten-slot sentinel: both 32-bit words set, so a (bugged) gather of
+# an unwritten slot reassembles to 2**64-1 — the same "missing" id the
+# kernels' idx -1 sentinel produces, never a plausible doc id
+_S2D_FILL = 0xFFFFFFFF
+
+
+@functools.partial(jax.jit, static_argnames=("new_cap",))
+def _grow_pairs(arr, new_cap):
+    out = jnp.full((new_cap, arr.shape[1]), _S2D_FILL, arr.dtype)
+    return jax.lax.dynamic_update_slice(out, arr, (0, 0))
 
 
 @functools.partial(jax.jit, static_argnames=("new_cap",))
@@ -175,6 +255,7 @@ def _fetch_packed(packed_dev, shape=None) -> np.ndarray:
         return np.asarray(packed_dev)
     t0 = time.perf_counter()
     out = np.asarray(packed_dev)
+    shape.fetches += 1  # the fused-dispatch invariant counts these
     shape.t_fetch = time.perf_counter()
     shape.device_ms = (shape.t_fetch - t0) * 1000.0
     # duty-cycle anchor: the in-flight interval ends HERE, not at the
@@ -286,6 +367,22 @@ def _search_full(
     return _pack(top, idx)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "exact", "active_chunks", "rescore_r"),
+)
+def _search_full_fused(
+    store, sq_norms, tombs, n, q, allow_words, s2d, k, metric, use_allow,
+    exact=False, active_chunks=None, rescore_r=0,
+):
+    """_search_full with the slot->doc translation fused into the SAME
+    XLA program (the inner jitted kernel inlines under this trace): the
+    one packed fetch carries final doc ids (ops/topk FUSED layout)."""
+    packed = _search_full(store, sq_norms, tombs, n, q, allow_words, k,
+                          metric, use_allow, exact, active_chunks, rescore_r)
+    return retranslate_packed(packed, s2d)
+
+
 # rows of the uint8 code matrix scored per PQ scan step ([B, chunk] f32
 # accumulator + one [B, C] VMEM table per segment; codes stream from HBM)
 _PQ_SCAN_CHUNK = 32768
@@ -390,6 +487,25 @@ def _search_pq_recon(codes, recon_norms, tombs, n, codebook, rescore_store, q,
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "r_chunk", "metric", "use_allow", "exact", "active_chunks",
+        "do_rescore",
+    ),
+)
+def _search_pq_recon_fused(codes, recon_norms, tombs, n, codebook,
+                           rescore_store, q, allow_words, s2d, k, r_chunk,
+                           metric, use_allow, exact=False, active_chunks=None,
+                           do_rescore=True, rot=None):
+    """_search_pq_recon with device-side slot->doc translation fused in."""
+    packed = _search_pq_recon(codes, recon_norms, tombs, n, codebook,
+                              rescore_store, q, allow_words, k, r_chunk,
+                              metric, use_allow, exact, active_chunks,
+                              do_rescore, rot)
+    return retranslate_packed(packed, s2d)
+
+
+@functools.partial(
     jax.jit, static_argnames=("r", "use_allow", "exact", "active_chunks")
 )
 def _search_pq(codes, tombs, n, lut, allow_words, r, use_allow, exact=False,
@@ -438,28 +554,85 @@ def _search_pq(codes, tombs, n, lut, allow_words, r, use_allow, exact=False,
     return _pack(top, idx)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("r", "use_allow", "exact", "active_chunks")
+)
+def _search_pq_fused(codes, tombs, n, lut, allow_words, s2d, r, use_allow,
+                     exact=False, active_chunks=None):
+    """_search_pq (LUT tier) with device-side slot->doc translation."""
+    packed = _search_pq(codes, tombs, n, lut, allow_words, r, use_allow,
+                        exact, active_chunks)
+    return retranslate_packed(packed, s2d)
+
+
+def _gather_live(rows, row_valid, tombs):
+    """Row validity for the gather tier, tombstone-masked ON DEVICE with
+    the dispatching snapshot's own `tombs`: the host-side allow-slot
+    resolution is cached per (allow_token, n, capacity) — a key deletes
+    do NOT change — so a cached slot list may include slots tombstoned
+    since it was computed; the snapshot's device mask keeps every
+    dispatch exact for the state it pinned (and an old snapshot's
+    dispatch keeps returning its own pre-delete world)."""
+    safe = jnp.clip(rows, 0, tombs.shape[0] - 1)
+    return jnp.logical_and(row_valid,
+                           jnp.logical_not(jnp.take(tombs, safe)))
+
+
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _score_rows(sub, q, row_valid, k, metric):
+def _score_rows(sub, q, rows, row_valid, tombs, k, metric):
     """Score an uploaded [R, D] row block against [B, D] queries (the gather
-    path when the float store lives host-side under PQ)."""
+    path when the float store lives host-side under PQ). rows [R] carries
+    each block position's store slot for the device tombstone mask."""
     dists = DISTANCE_FNS[metric](q.astype(sub.dtype), sub, None)
-    masked = jnp.where(row_valid[None, :], dists, jnp.inf)
+    masked = jnp.where(_gather_live(rows, row_valid, tombs)[None, :],
+                       dists, jnp.inf)
     neg, idx = jax.lax.top_k(-masked, k)
     top = -neg
     return _pack(top, jnp.where(jnp.isinf(top), -1, idx).astype(jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _search_gathered(store, q, rows, row_valid, k, metric):
+def _search_gathered(store, q, rows, row_valid, tombs, k, metric):
     """Gather path for small allowLists (flat_search.go:19 analog): score only
-    the gathered rows. rows [R] int32 (padded), row_valid [R] bool."""
+    the gathered rows. rows [R] int32 (padded), row_valid [R] bool; the
+    snapshot's tombs mask rides the same program (see _gather_live)."""
     sub = jnp.take(store, rows, axis=0, mode="fill", fill_value=0)
     dists = DISTANCE_FNS[metric](q.astype(store.dtype), sub, None)
-    masked = jnp.where(row_valid[None, :], dists, jnp.inf)
+    masked = jnp.where(_gather_live(rows, row_valid, tombs)[None, :],
+                       dists, jnp.inf)
     kk = min(k, sub.shape[0])
     neg, idx = jax.lax.top_k(-masked, kk)
     top = -neg
     return _pack(top, jnp.where(jnp.isinf(top), -1, idx).astype(jnp.int32))
+
+
+def _rows_to_slots(packed, rows):
+    """Gather-tier epilogue: the kernel's idx are POSITIONS into the
+    uploaded `rows` block — map them back to store slots on device so the
+    shared translate_pack can emit final doc ids."""
+    kc = packed.shape[1] // 2
+    top = jax.lax.bitcast_convert_type(packed[:, :kc], jnp.float32)
+    idx = packed[:, kc:]
+    safe = jnp.clip(idx, 0, rows.shape[0] - 1)
+    slots = jnp.where(idx >= 0, jnp.take(rows, safe), -1)
+    return top, slots
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _score_rows_fused(sub, q, rows, row_valid, tombs, s2d, k, metric):
+    """_score_rows with slot->doc translation fused in (rows carries each
+    uploaded block position's store slot)."""
+    top, slots = _rows_to_slots(
+        _score_rows(sub, q, rows, row_valid, tombs, k, metric), rows)
+    return translate_pack(top, slots, s2d)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _search_gathered_fused(store, q, rows, row_valid, tombs, s2d, k, metric):
+    """_search_gathered with slot->doc translation fused in."""
+    top, slots = _rows_to_slots(
+        _search_gathered(store, q, rows, row_valid, tombs, k, metric), rows)
+    return translate_pack(top, slots, s2d)
 
 
 def _prep_bulk_run(ids: np.ndarray, vecs: np.ndarray, metric: str, known_fn):
@@ -916,19 +1089,27 @@ class IndexSnapshot:
       - the device write kernels do not donate (every update REPLACES the
         array object, the old buffer stays valid until the last snapshot
         holding it drops), and
-      - the host-side arrays (`slot_to_doc`, `host_tombs`) are
-        copy-on-written by any writer that would mutate an array a
-        published snapshot still references.
+      - the host-side `host_tombs` mirror is copy-on-written by any
+        writer that would mutate an array a published snapshot still
+        references; `slot_to_doc` needs NO copy — writers only assign
+        slots at indices >= this snapshot's `n` (slot assignment is
+        append-only between compactions, and compact/grow replace the
+        array object wholesale), so the `[:n]` prefix a reader consults
+        is immutable by construction.
 
-    Everything here is frozen at publish except `_sorted_map`, a lazily
-    computed pure function of the frozen arrays (two racing readers compute
-    identical tuples; the reference assignment is atomic under the GIL).
+    `slot_to_doc_dev` is the DEVICE twin of `slot_to_doc`: a
+    [capacity, 2] uint32 table of each slot's 64-bit doc-id words,
+    maintained by the same staged-generation handshake (rows land via
+    `_stage_doc_ids` before `_publish_snapshot` swaps the reference), so
+    a fused dispatch's in-program slot->doc translation reads exactly the
+    mapping this snapshot's host arrays describe. Everything here is
+    frozen at publish.
     """
 
     __slots__ = ("gen", "dim", "capacity", "n", "live", "store", "sq_norms",
-                 "tombs", "slot_to_doc", "host_tombs", "allow_token",
-                 "compressed", "pq", "codes", "recon_norms", "rescore_dev",
-                 "rescore_sq_norms", "host_vecs", "_sorted_map")
+                 "tombs", "slot_to_doc", "slot_to_doc_dev", "host_tombs",
+                 "allow_token", "compressed", "pq", "codes", "recon_norms",
+                 "rescore_dev", "rescore_sq_norms", "host_vecs")
 
     def __init__(self, gen: int, idx: "TpuVectorIndex"):
         self.gen = gen
@@ -940,6 +1121,7 @@ class IndexSnapshot:
         self.sq_norms = idx._sq_norms
         self.tombs = idx._tombs
         self.slot_to_doc = idx._slot_to_doc
+        self.slot_to_doc_dev = idx._s2d_dev
         self.host_tombs = idx._host_tombs
         self.allow_token = idx._allow_token
         self.compressed = idx.compressed
@@ -949,23 +1131,6 @@ class IndexSnapshot:
         self.rescore_dev = idx._rescore_dev
         self.rescore_sq_norms = idx._rescore_sq_norms
         self.host_vecs = idx._host_vecs
-        self._sorted_map: Optional[tuple[np.ndarray, np.ndarray]] = None
-
-    def sorted_doc_slots(self) -> tuple[np.ndarray, np.ndarray]:
-        """Sorted (docs, slots) of the LIVE rows in this snapshot (the
-        vectorized doc->slot map the small-allowList gather path binary-
-        searches). Derived from the frozen arrays only — tombstoned slots
-        are excluded via `host_tombs`, so a re-added doc maps to exactly
-        its newest slot."""
-        sm = self._sorted_map
-        if sm is None:
-            live = np.flatnonzero(
-                ~self.host_tombs[: self.n]).astype(np.int32)
-            docs = self.slot_to_doc[live].astype(np.uint64)
-            order = np.argsort(docs)
-            sm = (docs[order], live[order])
-            self._sorted_map = sm
-        return sm
 
 
 class TpuVectorIndex(VectorIndex):
@@ -1004,6 +1169,21 @@ class TpuVectorIndex(VectorIndex):
         self._sq_norms = None    # device [capacity] float32 (l2 only)
         self._tombs = None       # device [capacity] bool
         self._slot_to_doc = np.zeros(0, dtype=np.int64)
+        # device slot->doc translation table [capacity, 2] uint32 (lo/hi
+        # words of the int64 doc id per slot): what lets a fused dispatch
+        # emit FINAL doc ids from the one packed fetch (ops/topk
+        # translate_pack) with zero host translation
+        self._s2d_dev = None
+        # reusable pre-pinned host staging buffers for query upload, one
+        # small free-list per (padded batch, dim) jit bucket — the
+        # per-dispatch numpy concat/zeros allocations the fused-dispatch
+        # tentpole collapses. Returned to the pool by finalize, AFTER the
+        # one blocking fetch: by then the program has consumed its inputs,
+        # so reuse is safe even where device_put aliases host memory
+        # (the cpu backend).
+        self._stage_free: dict[tuple[int, int], list[np.ndarray]] = {}
+        self._stage_lock = sanitizers.register_lock(
+            threading.Lock(), "index.tpu.stage_pool")
         # host mirror of the device tombstone mask: snapshots derive the
         # live doc->slot map from it without a device fetch
         self._host_tombs = np.zeros(0, dtype=bool)
@@ -1136,6 +1316,8 @@ class TpuVectorIndex(VectorIndex):
         self._sq_norms = jax.device_put(jnp.zeros((self.capacity,), jnp.float32), dev)
         self._tombs = jax.device_put(jnp.zeros((self.capacity,), jnp.bool_), dev)
         self._slot_to_doc = np.full(self.capacity, -1, dtype=np.int64)
+        self._s2d_dev = jax.device_put(
+            jnp.full((self.capacity, 2), _S2D_FILL, jnp.uint32), dev)
         self._host_tombs = np.zeros(self.capacity, dtype=bool)
         self._stamp_memory()
 
@@ -1162,6 +1344,8 @@ class TpuVectorIndex(VectorIndex):
                 self._store = _grow_store(self._store, cap)
                 self._sq_norms = _grow_1d(self._sq_norms, cap, jnp.float32(0))
             self._tombs = _grow_1d(self._tombs, cap, False)
+            if self._s2d_dev is not None:
+                self._s2d_dev = _grow_pairs(self._s2d_dev, cap)
             s2d = np.full(cap, -1, dtype=np.int64)
             s2d[: self.capacity] = self._slot_to_doc
             self._slot_to_doc = s2d
@@ -1291,6 +1475,7 @@ class TpuVectorIndex(VectorIndex):
         self._cow_host_state()
         self._write_block(np.ascontiguousarray(vecs), self.n)
         self._slot_to_doc[self.n : self.n + count] = ids64
+        self._stage_doc_ids(ids64, self.n)
         d2s.update(zip(ids64.tolist(), range(self.n, self.n + count)))
         self.n += count
         self.live += count
@@ -1315,16 +1500,43 @@ class TpuVectorIndex(VectorIndex):
         if log and self._log is not None:
             self._log.append_delete(doc_id)
 
+    def _stage_doc_ids(self, docs: np.ndarray, start: int) -> None:
+        """Mirror a run of newly-assigned slot->doc entries onto the
+        DEVICE translation table (the fused dispatch's in-program
+        slot->doc source). Row counts pad to _bucket_rows so the scatter's
+        jit shapes stay bounded; padding rows carry an out-of-range slot
+        index that mode="drop" ignores. Runs under the write lock, before
+        _publish_snapshot — the staged-generation handshake that makes the
+        device table and the host mirror describe the same mapping."""
+        if self._s2d_dev is None:
+            return
+        count = len(docs)
+        pad = _bucket_rows(count)
+        idx = np.full(pad, self.capacity + 1, dtype=np.int32)
+        idx[:count] = np.arange(start, start + count, dtype=np.int32)
+        pairs = np.zeros((pad, 2), dtype=np.uint32)
+        pairs[:count] = np.ascontiguousarray(
+            docs.astype("<i8")).view("<u4").reshape(count, 2)
+        self._s2d_dev = _write_doc_pairs(
+            self._s2d_dev, jnp.asarray(idx), jnp.asarray(pairs))
+        led = memory.get_ledger()
+        if led is not None:
+            led.note_write_shape(("write_docs", self.capacity, pad))
+        self._stamp_memory()
+
     def _cow_host_state(self) -> None:
-        """Copy-on-write the host arrays a published snapshot still pins,
-        so in-place writer mutation can never tear a lock-free reader."""
+        """Copy-on-write the host mirrors a published snapshot still pins,
+        so in-place writer mutation can never tear a lock-free reader.
+        Only `host_tombs` needs the copy (deletes flip bits at arbitrary
+        live slots); `slot_to_doc` is append-only between compactions —
+        writers assign only at indices >= every published snapshot's `n`,
+        so the `[:n]` prefix a snapshot reads is immutable in place and
+        the per-flush O(capacity) copy the fused-dispatch PR deleted was
+        pure overhead."""
         snap = self._snap
         if snap is None:
             return
         copied = 0
-        if snap.slot_to_doc is self._slot_to_doc:
-            self._slot_to_doc = self._slot_to_doc.copy()
-            copied += int(self._slot_to_doc.nbytes)
         if snap.host_tombs is self._host_tombs:
             self._host_tombs = self._host_tombs.copy()
             copied += int(self._host_tombs.nbytes)
@@ -1353,6 +1565,7 @@ class TpuVectorIndex(VectorIndex):
             # multiples beyond need so padding only lands in unused slots
             self._write_block(rows, self.n)
             self._slot_to_doc[self.n : self.n + count] = docs
+            self._stage_doc_ids(docs, self.n)
             for i, d in enumerate(docs):
                 self._doc_to_slot[int(d)] = self.n + i
             self.n += count
@@ -1426,6 +1639,7 @@ class TpuVectorIndex(VectorIndex):
         for name, arr in (("store", self._store),
                           ("sq_norms", self._sq_norms),
                           ("tombs", self._tombs),
+                          ("slot_to_doc", self._s2d_dev),
                           ("pq_codes", self._codes),
                           ("recon_norms", self._recon_norms),
                           ("rescore_store", self._rescore_dev),
@@ -1458,9 +1672,11 @@ class TpuVectorIndex(VectorIndex):
             return (memory.array_bytes(self._codes)
                     + memory.array_bytes(self._recon_norms)
                     + memory.array_bytes(self._rescore_dev)
-                    + memory.array_bytes(self._rescore_sq_norms))
+                    + memory.array_bytes(self._rescore_sq_norms)
+                    + memory.array_bytes(self._s2d_dev))
         return (memory.array_bytes(self._store)
-                + memory.array_bytes(self._sq_norms))
+                + memory.array_bytes(self._sq_norms)
+                + memory.array_bytes(self._s2d_dev))
 
     # -- snapshot publication / lock-free reads ------------------------------
 
@@ -1672,6 +1888,7 @@ class TpuVectorIndex(VectorIndex):
             self._cow_host_state()
             self._write_block(vectors, self.n)
             self._slot_to_doc[self.n : self.n + count] = doc_arr
+            self._stage_doc_ids(doc_arr, self.n)
             new_slots = dict(zip(doc_arr.tolist(), range(self.n, self.n + count)))
             self._doc_to_slot.update(new_slots)
             self.n += count
@@ -1773,13 +1990,13 @@ class TpuVectorIndex(VectorIndex):
         return blk
 
     def _search_full_gmin(self, snap: IndexSnapshot, q: np.ndarray, kk: int,
-                          allow_words, store=None, sq_norms=None):
+                          allow_words, store=None, sq_norms=None, s2d=None):
         from weaviate_tpu.ops import gmin_scan
 
         interpret = jax.default_backend() not in ("tpu", "axon")
         ncols = snap.capacity // gmin_scan.G
         s = snap.store if store is None else store
-        return gmin_scan.search_gmin(
+        args = (
             s,
             snap.sq_norms if sq_norms is None else sq_norms,
             snap.tombs,
@@ -1787,6 +2004,8 @@ class TpuVectorIndex(VectorIndex):
             jnp.asarray(q),
             allow_words if allow_words is not None
             else jnp.zeros((snap.capacity // 32,), jnp.uint32),
+        )
+        statics = (
             allow_words is not None,
             kk,
             self.metric,
@@ -1795,9 +2014,13 @@ class TpuVectorIndex(VectorIndex):
             interpret,
             self._gen_blocks(s, gmin_scan.build_rescore_blocks),
         )
+        if s2d is not None:
+            return gmin_scan.search_gmin_fused(*args, s2d, *statics)
+        return gmin_scan.search_gmin(*args, *statics)
 
     def _gmin_packed_or_none(self, snap: IndexSnapshot, q: np.ndarray,
-                             kk: int, allow_words, store=None, sq_norms=None):
+                             kk: int, allow_words, store=None, sq_norms=None,
+                             s2d=None):
         """Run the fused scan, or None to use the legacy kernel. Validation
         is per compiled shape: each distinct (b, k, rg, active_g, use_allow)
         is a separate Mosaic compilation with its own VMEM footprint
@@ -1818,16 +2041,18 @@ class TpuVectorIndex(VectorIndex):
             return None
         # capacity is part of the key: the compilation is parameterized by
         # the [capacity, D] store, so growth invalidates prior validation
+        # (and fused translation is its own program — its own validation)
         key = (q.shape[0], kk, self._gmin_rg(kk, snap.capacity), active_g,
-               snap.capacity, allow_words is not None, store is not None)
+               snap.capacity, allow_words is not None, store is not None,
+               s2d is not None)
         return gmin_scan.guarded_kernel_call(
             self, key,
             lambda: self._search_full_gmin(snap, q, kk, allow_words, store,
-                                           sq_norms),
+                                           sq_norms, s2d),
             "fused gmin kernel", component="index.tpu.gmin")
 
     def _pq_gmin_packed_or_none(self, snap: IndexSnapshot, q: np.ndarray,
-                                b: int, k: int, allow_list):
+                                b: int, k: int, allow_list, s2d=None):
         """Run the fused PQ codes kernel, or None for the legacy recon
         scan. Same per-shape validation contract as the dense kernel, on a
         SEPARATE failure domain (self._pqg_state); gating and codebook
@@ -1849,27 +2074,22 @@ class TpuVectorIndex(VectorIndex):
         words = (self._allow_words(snap, allow_list) if use_allow
                  else jnp.zeros((snap.capacity // 32,), jnp.uint32))
         cb_chunks, flat_cb = pq_gmin.cached_cb_constants(self, snap.pq)
-        key = (q.shape[0], kk, rg, active_g, snap.capacity, m, c, use_allow)
+        key = (q.shape[0], kk, rg, active_g, snap.capacity, m, c, use_allow,
+               s2d is not None)
+
+        def thunk():
+            args = (snap.codes, snap.recon_norms, snap.tombs, snap.n,
+                    jnp.asarray(q), cb_chunks, flat_cb, words)
+            statics = (use_allow, kk, self.metric, rg, active_g, interpret,
+                       snap.pq.rotation_dev(),
+                       self._gen_blocks(snap.codes,
+                                        pq_gmin.build_codes_blocks))
+            if s2d is not None:
+                return pq_gmin.search_pq_gmin_fused(*args, s2d, *statics)
+            return pq_gmin.search_pq_gmin(*args, *statics)
+
         return gmin_scan.guarded_kernel_call(
-            self._pqg_state, key,
-            lambda: pq_gmin.search_pq_gmin(
-                snap.codes,
-                snap.recon_norms,
-                snap.tombs,
-                snap.n,
-                jnp.asarray(q),
-                cb_chunks,
-                flat_cb,
-                words,
-                use_allow,
-                kk,
-                self.metric,
-                rg,
-                active_g,
-                interpret,
-                snap.pq.rotation_dev(),
-                self._gen_blocks(snap.codes, pq_gmin.build_codes_blocks),
-            ),
+            self._pqg_state, key, thunk,
             "fused pq codes kernel", component="index.tpu.pq_gmin")
 
     def _rescore_r(self, k: int, n: int) -> int:
@@ -1886,31 +2106,78 @@ class TpuVectorIndex(VectorIndex):
             return 0
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return 0
-        r_max = controller.rescore_r_cap(128)
+        # R_BUCKETS single source of truth (config.RESCORE_R_BUCKETS,
+        # aliased by serving/controller.py): cap values are buckets and
+        # the static choices are {max(4k, floor)} ∪ buckets, so a
+        # controller cut can never mint a jit shape the static path
+        # wouldn't also compile
+        r_top = RESCORE_R_BUCKETS[-1]
+        r_max = controller.rescore_r_cap(r_top)
         if r_max < 2 * k:
             # a cap below this query's slack threshold would zero r and
             # force the full-precision exact scan — strictly MORE device
             # work than the static path; the budget controller may only
             # cut, so queries too deep for the cap keep the static max
-            r_max = 128
-        r = int(min(max(4 * k, 32), r_max, max(n, 1)))
+            r_max = r_top
+        r = int(min(max(4 * k, RESCORE_R_BUCKETS[0]), r_max, max(n, 1)))
         # no candidate slack over k => the fast pass would pick the FINAL set
         # at reduced precision; fall back to the HIGHEST-precision scan
         return r if r >= 2 * k else 0
 
-    def _prep_queries(self, vectors: np.ndarray) -> tuple[np.ndarray, int]:
+    # bound per-bucket free-list length: buffers parked beyond the live
+    # pipeline depth are dead weight (a burst of concurrent dispatches can
+    # momentarily check out more; the extras just get collected)
+    _STAGE_POOL_CAP = 4
+
+    def _prep_queries_staged(
+            self, vectors: np.ndarray) -> tuple[np.ndarray, int]:
+        """Query prep (f32 cast, cosine normalization, bucket padding)
+        into a REUSABLE pre-staged host buffer from the per-jit-bucket
+        pool: the per-dispatch concatenate/zeros allocations of enqueue
+        collapse to one copy into a warm buffer.
+        -> (padded [bb, D] f32 buffer, actual rows). The buffer must go
+        back via _release_stage AFTER the dispatch's blocking fetch (the
+        finalize wrapper does) — by then the program has consumed its
+        inputs, so the next checkout may overwrite the memory even where
+        device_put aliases it (cpu backend)."""
         q = np.asarray(vectors, dtype=np.float32)
         if q.ndim == 1:
             q = q[None, :]
         b = q.shape[0]
-        if self.metric == vi.DISTANCE_COSINE:
-            norms = np.linalg.norm(q, axis=1, keepdims=True)
-            norms[norms == 0] = 1.0
-            q = q / norms
         bb = _bucket_b(b)
+        key = (bb, q.shape[1])
+        with self._stage_lock:
+            lst = self._stage_free.get(key)
+            buf = lst.pop() if lst else None
+        if buf is None:
+            buf = np.empty(key, np.float32)
+        np.copyto(buf[:b], q)
+        if self.metric == vi.DISTANCE_COSINE:
+            norms = np.linalg.norm(buf[:b], axis=1, keepdims=True)
+            norms[norms == 0] = 1.0
+            buf[:b] /= norms
         if bb != b:
-            q = np.concatenate([q, np.zeros((bb - b, q.shape[1]), np.float32)])
-        return q, b
+            buf[b:] = 0.0
+        return buf, b
+
+    def _release_stage(self, buf: Optional[np.ndarray]) -> None:
+        if buf is None:
+            return
+        key = (buf.shape[0], buf.shape[1])
+        with self._stage_lock:
+            # dim is None once drop() ran (or mid-compact teardown): an
+            # in-flight dispatch finalizing after drop must NOT re-park
+            # its buffer into the cleared pool — "stage_buffers reads 0
+            # after drop" would break, and a re-created index with
+            # another dim could never check the stale-keyed buffer out
+            # again. Checked UNDER the lock: drop() sets dim before its
+            # locked clear, so a racing finalize either sees dim None
+            # here or appends before the clear wipes it — never after
+            if self.dim is None:
+                return
+            lst = self._stage_free.setdefault(key, [])
+            if len(lst) < self._STAGE_POOL_CAP:
+                lst.append(buf)
 
     def _allow_words(self, snap: IndexSnapshot, allow_list: AllowList) -> jax.Array:
         """Packed device filter words for a snapshot's slot layout, cached
@@ -1980,8 +2247,14 @@ class TpuVectorIndex(VectorIndex):
         t_enq0 = 0.0
         if tracing.get_tracer() is not None:
             t_enq0 = time.perf_counter()
-        q, b = self._prep_queries(vectors)
+        q, b = self._prep_queries_staged(vectors)
+        stage_buf = q  # returned to the pool by the finalize wrapper
         k_eff = min(k, snap.live)
+        # fused dispatch: the device translation table rides the snapshot,
+        # so the program's final top-k emits doc ids directly (the legacy
+        # host slot_to_doc translation only runs with the toggle off)
+        s2d = (snap.slot_to_doc_dev
+               if fused_dispatch_enabled() else None)
         if allow_list is not None and len(allow_list) < self.config.flat_search_cutoff:
             if t_enq0:
                 shape = costmodel.DispatchShape(
@@ -1990,7 +2263,7 @@ class TpuVectorIndex(VectorIndex):
                     batch=b, batch_padded=q.shape[0],
                     bytes_per_row=snap.dim * 4, k=int(k_eff))
             fin = self._dispatch_small_allow(snap, q, b, k_eff, allow_list,
-                                             shape)
+                                             shape, s2d)
         elif snap.compressed:
             if t_enq0:
                 rescore = (self.config.pq.rescore
@@ -2006,7 +2279,7 @@ class TpuVectorIndex(VectorIndex):
                                    else snap.pq.segments),
                     k=int(k_eff))
             fin = self._dispatch_full_pq(snap, q, b, k_eff, allow_list,
-                                         shape)
+                                         shape, s2d)
         else:
             if t_enq0:
                 shape = costmodel.DispatchShape(
@@ -2017,11 +2290,17 @@ class TpuVectorIndex(VectorIndex):
             allow_words = (self._allow_words(snap, allow_list)
                            if allow_list is not None else None)
             fin = self._dispatch_scan(snap, q, b, k_eff, allow_words,
-                                      shape=shape)
+                                      shape=shape, s2d=s2d)
         if shape is not None:
             now = time.perf_counter()
             shape.t_start = t_enq0
             shape.enqueue_ms = (now - t_enq0) * 1000.0
+            if s2d is not None:
+                # the fused-dispatch ledger invariant: one blocking fetch,
+                # zero host-translation time (test-pinned; the perf window
+                # counts violations)
+                shape.fused = True
+                shape.translate_ms = 0.0
             self._read_local.dispatch_shape = shape
         # shadow-audit snapshot pin (monitoring/quality.py): record which
         # snapshot THIS dispatch read so a sampled audit re-executes
@@ -2035,12 +2314,24 @@ class TpuVectorIndex(VectorIndex):
         done = [False]
 
         def finalize():
+            fetched = False
             try:
                 faults.fire("index.tpu.finalize")
                 if shape is None:
-                    return fin()
+                    out = fin()
+                    fetched = True
+                    return out
+                if shape.fetches:
+                    # a RETRIED finalize (permitted — see done[] below)
+                    # re-runs the fetch; the ledger invariant is per
+                    # attempt, and the recorded shape must describe the
+                    # attempt whose results the caller actually got — a
+                    # leftover count would read as a spurious double-
+                    # fetch violation in /debug/perf
+                    shape.fetches = 0
                 t0 = time.perf_counter()
                 out = fin()
+                fetched = True
                 t1 = time.perf_counter()
                 shape.finalize_ms = (t1 - t0) * 1000.0
                 shape.t_end = t1
@@ -2049,6 +2340,15 @@ class TpuVectorIndex(VectorIndex):
                 if not done[0]:  # idempotent: finalize may be retried
                     done[0] = True
                     self._track_inflight(-1)
+                    if fetched:
+                        # the staging buffer goes back to the pool ONLY
+                        # after a completed fetch: by then the program has
+                        # consumed its inputs (cpu-backend device_put may
+                        # alias host memory). A pre-fetch failure strands
+                        # the buffer for the GC instead — a recycled
+                        # buffer could be overwritten under a still-
+                        # enqueued program and corrupt a permitted retry
+                        self._release_stage(stage_buf)
 
         return finalize
 
@@ -2092,17 +2392,20 @@ class TpuVectorIndex(VectorIndex):
 
     def _dispatch_scan(self, snap: IndexSnapshot, q: np.ndarray, b: int,
                        k_eff: int, allow_words, store=None, sq_norms=None,
-                       shape=None):
+                       shape=None, s2d=None):
         """Full-store scan (fused gmin when eligible, legacy lax.scan kernel
         otherwise) over `store` — the f32 store uncompressed, or the bf16
         rescore copy under PQ-with-rescore (scanning codes first would read
-        MORE HBM than the copy the rescore pass consults anyway)."""
+        MORE HBM than the copy the rescore pass consults anyway). With
+        `s2d` (the snapshot's device translation table) the slot->doc
+        translation fuses into the same program and finalize is a
+        reshape."""
         kk = min(max(k_eff, 1), snap.n)
         packed_dev = self._gmin_packed_or_none(snap, q, kk, allow_words,
-                                               store, sq_norms)
+                                               store, sq_norms, s2d)
         if packed_dev is None:
             sq = snap.sq_norms if sq_norms is None else sq_norms
-            packed_dev = _search_full(
+            args = (
                 snap.store if store is None else store,
                 sq if self.metric == vi.DISTANCE_L2 else None,
                 snap.tombs,
@@ -2110,6 +2413,8 @@ class TpuVectorIndex(VectorIndex):
                 jnp.asarray(q),
                 allow_words if allow_words is not None
                 else jnp.zeros((snap.capacity // 32,), jnp.uint32),
+            )
+            statics = (
                 kk,
                 self.metric,
                 allow_words is not None,
@@ -2117,6 +2422,12 @@ class TpuVectorIndex(VectorIndex):
                 -(-snap.n // _SCAN_CHUNK),
                 self._rescore_r(kk, snap.n),
             )
+            if s2d is not None:
+                packed_dev = _search_full_fused(*args, s2d, *statics)
+            else:
+                packed_dev = _search_full(*args, *statics)
+        if s2d is not None:
+            return self._finalize_fused(packed_dev, shape, b)
         slot_to_doc = snap.slot_to_doc
 
         def finalize():
@@ -2126,13 +2437,32 @@ class TpuVectorIndex(VectorIndex):
             top, idx = _unpack(packed)
             top = top[:b]
             idx = idx[:b]
+            t0 = time.perf_counter() if shape is not None else 0.0
             ids = np.where(idx >= 0, slot_to_doc[np.clip(idx, 0, None)], -1)
+            if shape is not None:
+                shape.translate_ms = (time.perf_counter() - t0) * 1000.0
             return ids.astype(np.uint64), top.astype(np.float32)
 
         return finalize
 
+    def _finalize_fused(self, packed_dev, shape, b: int,
+                        k: Optional[int] = None):
+        """Finalize for a FUSED dispatch: the one blocking fetch already
+        carries final doc ids, so the host half is dtype views plus two
+        vectorized word copies (ops/topk.unpack_fused) — no slot->doc
+        table read, no per-row work (the JGL015 contract, and the reason
+        the perf ledger's gather_hop share collapses)."""
+        def finalize():
+            packed = _fetch_packed(packed_dev, shape)
+            ids, dists = unpack_fused(packed)
+            if k is not None:
+                ids, dists = ids[:, :k], dists[:, :k]
+            return ids[:b], dists[:b]
+
+        return finalize
+
     def _dispatch_full_pq(self, snap: IndexSnapshot, q: np.ndarray, b: int,
-                          k: int, allow_list, shape=None):
+                          k: int, allow_list, shape=None, s2d=None):
         """Compressed full-store search.
 
         With rescore enabled a full bf16 copy of the rows already lives in
@@ -2156,12 +2486,13 @@ class TpuVectorIndex(VectorIndex):
             return self._dispatch_scan(
                 snap, q, b, k, allow_words,
                 store=snap.rescore_dev, sq_norms=snap.rescore_sq_norms,
-                shape=shape)
+                shape=shape, s2d=s2d)
         slot_to_doc = snap.slot_to_doc
         # codes-only tier from here: raw ADC distances, no rescoring pass.
         # Fast path: the fused PQ-ADC group-min kernel (ops/pq_gmin.py) —
         # reconstruction-as-matmul in VMEM, codes never expand in HBM
-        packed_dev = self._pq_gmin_packed_or_none(snap, q, b, k, allow_list)
+        packed_dev = self._pq_gmin_packed_or_none(snap, q, b, k, allow_list,
+                                                  s2d)
         if packed_dev is None:
             # legacy reconstruction-scan path:
             # per-chunk candidate depth: selection cost on TPU grows sharply
@@ -2171,8 +2502,9 @@ class TpuVectorIndex(VectorIndex):
             # store; deeper per chunk when the store fits fewer chunks).
             nchunks_eff = max(1, -(-snap.n // _SCAN_CHUNK))
             pool_target = pqc.rescore_limit or 1024
-            r_cap = controller.rescore_r_cap(128)
-            if r_cap < 128:
+            r_top = RESCORE_R_BUCKETS[-1]
+            r_cap = controller.rescore_r_cap(r_top)
+            if r_cap < r_top:
                 # the budget controller's cap scales the codes-tier
                 # candidate pool too (the ISSUE's per-chunk budget): cap
                 # values are bucketed, so the derived r_chunk set stays
@@ -2180,7 +2512,7 @@ class TpuVectorIndex(VectorIndex):
                 # the pool's own recall guarantee without ever RAISING
                 # a configured rescore_limit below 512 (the controller
                 # may only cut work)
-                pool_target = max(int(pool_target * r_cap / 128),
+                pool_target = max(int(pool_target * r_cap / r_top),
                                   min(512, pool_target))
             r_chunk = min(
                 max(2 * k, -(-pool_target // nchunks_eff), 64), 256, snap.n
@@ -2193,7 +2525,7 @@ class TpuVectorIndex(VectorIndex):
                      else jnp.zeros((snap.capacity // 32,), jnp.uint32))
             if self.metric in (vi.DISTANCE_L2, vi.DISTANCE_DOT,
                                vi.DISTANCE_COSINE):
-                packed_dev = _search_pq_recon(
+                args = (
                     snap.codes,
                     snap.recon_norms,
                     snap.tombs,
@@ -2202,6 +2534,8 @@ class TpuVectorIndex(VectorIndex):
                     jnp.zeros((1, snap.dim), jnp.bfloat16),
                     jnp.asarray(q),
                     words,
+                )
+                statics = (
                     min(k, snap.live),
                     r_chunk,
                     self.metric,
@@ -2211,20 +2545,26 @@ class TpuVectorIndex(VectorIndex):
                     False,
                     snap.pq.rotation_dev(),
                 )
+                if s2d is not None:
+                    packed_dev = _search_pq_recon_fused(*args, s2d, *statics)
+                else:
+                    packed_dev = _search_pq_recon(*args, *statics)
             else:
                 lut = build_lut(jnp.asarray(q), snap.pq._dev_codebook(),
                                 self.metric)
-                packed_dev = _search_pq(
-                    snap.codes,
-                    snap.tombs,
-                    snap.n,
-                    lut,
-                    words,
+                args = (snap.codes, snap.tombs, snap.n, lut, words)
+                statics = (
                     min(k, snap.n, _PQ_SCAN_CHUNK),
                     allow_words is not None,
                     getattr(self.config, "exact_topk", False),
                     -(-snap.n // _PQ_SCAN_CHUNK),
                 )
+                if s2d is not None:
+                    packed_dev = _search_pq_fused(*args, s2d, *statics)
+                else:
+                    packed_dev = _search_pq(*args, *statics)
+        if s2d is not None:
+            return self._finalize_fused(packed_dev, shape, b, k)
 
         def finalize():
             # the ONE deliberate blocking fetch per PQ search dispatch,
@@ -2232,32 +2572,77 @@ class TpuVectorIndex(VectorIndex):
             packed = _fetch_packed(packed_dev, shape)
             top, slots = _unpack(packed)
             top, slots = top[:b], slots[:b]
+            t0 = time.perf_counter() if shape is not None else 0.0
             # (cosine: the recon path already emits 1 - dot directly)
             ids = np.where(slots >= 0, slot_to_doc[np.clip(slots, 0, None)], -1)
+            if shape is not None:
+                shape.translate_ms = (time.perf_counter() - t0) * 1000.0
             return (ids[:, :k].astype(np.uint64),
                     top[:, :k].astype(np.float32))
 
         return finalize
 
+    def _allow_slots(self, snap: IndexSnapshot,
+                     allow_list: AllowList) -> np.ndarray:
+        """Store slots of `allow_list`'s docs in this snapshot, the
+        gather path's input-side resolution: ONE vectorized membership
+        pass over the snapshot's slot->doc prefix (the same primitive the
+        packed-words filter path uses), cached on the (immutable)
+        allowList per slot layout exactly like `_allow_words` — repeated
+        queries with the same filter skip the pass entirely (the shard's
+        allowList cache reuses AllowList objects per filter signature,
+        and the coalescer only admits filters proven hot, so the serving
+        path hits this cache; a one-off filter pays one vectorized O(n)
+        pass, the cold-filter cost class `_allow_words` already set).
+        This replaced the per-snapshot lazily-sorted doc->slot binary-
+        search map, which died with host-side result translation.
+
+        Staleness contract: the (allow_token, n, capacity) key changes on
+        adds, re-adds, and compaction, but NOT on deletes — so the
+        cached slot list is computed WITHOUT tombstone knowledge (every
+        matching slot, tombstoned or not) and is therefore identical no
+        matter which same-key snapshot computed it. Tombstones are
+        masked ON DEVICE with the dispatching snapshot's own `tombs`
+        (_gather_live): each dispatch is exact for the state it pinned,
+        in BOTH staleness directions — a new snapshot's dispatch hitting
+        an old cache masks fresh deletes, and an old pinned snapshot's
+        dispatch hitting a cache computed after a delete still gathers
+        (and keeps) the doc its own world holds live. Excluding
+        host_tombs here would break that second direction."""
+        from weaviate_tpu.storage.bitmap import Bitmap, allowed_mask
+
+        key = (snap.allow_token, snap.n, snap.capacity)
+        cached = getattr(allow_list, "_slots_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        live_docs = snap.slot_to_doc[: snap.n]
+        if isinstance(allow_list, Bitmap):
+            allowed = allowed_mask(allow_list, live_docs)
+        else:
+            allowed = allow_list.contains_array(live_docs.astype(np.uint64))
+        slots = np.flatnonzero(allowed).astype(np.int32)
+        try:
+            allow_list._slots_cache = (key, slots)
+        except AttributeError:
+            pass  # foreign AllowList impls without the cache slot
+        return slots
+
     def _dispatch_small_allow(self, snap: IndexSnapshot, q: np.ndarray,
                               b: int, k: int, allow_list: AllowList,
-                              shape=None):
+                              shape=None, s2d=None):
         """Gather path (flatSearch over allowList, flat_search.go:19): the
-        host-side doc->slot resolution binary-searches the snapshot's
-        frozen sorted map; the row scoring is one enqueued device call."""
-        allowed_docs = allow_list.to_array()
-        # vectorized doc->slot: keep only docs present in this shard
-        docs_sorted, slots_sorted = snap.sorted_doc_slots()
+        host-side doc->slot resolution is one cached vectorized membership
+        pass (`_allow_slots`); the row scoring is one enqueued device
+        call, and with `s2d` the result-side slot->doc translation rides
+        the same program."""
         empty = (np.zeros((b, 0), np.uint64), np.zeros((b, 0), np.float32))
-        if docs_sorted.size == 0:
-            if shape is not None:
-                shape.n = 0  # no device work ran: zero the analytic cost
-            return lambda: empty
-        pos = np.searchsorted(docs_sorted, allowed_docs)
-        pos_c = np.clip(pos, 0, docs_sorted.size - 1)
-        hit = docs_sorted[pos_c] == allowed_docs
-        slots = slots_sorted[pos_c[hit]].astype(np.int32)
-        if slots.size == 0:
+        slots = self._allow_slots(snap, allow_list)
+        # short-circuit when NOTHING can match in THIS snapshot: the
+        # cached slot list is tombstone-blind, so consult the dispatching
+        # snapshot's own host mirror (O(A), per dispatch — never cached):
+        # a fully-deleted filter must cost zero device work, not a
+        # dispatch that gathers dead rows into all-sentinel columns
+        if slots.size == 0 or not np.any(~snap.host_tombs[slots]):
             if shape is not None:
                 shape.n = 0  # no device work ran: zero the analytic cost
             return lambda: empty
@@ -2272,16 +2657,31 @@ class TpuVectorIndex(VectorIndex):
         row_valid = np.zeros(r, dtype=bool)
         row_valid[: slots.size] = True
         kk = min(k, slots.size)
+        rows_dev = jnp.asarray(rows)
+        valid_dev = jnp.asarray(row_valid)
         if snap.compressed:
             # float rows live host-side under PQ: upload the gathered block
             sub = np.zeros((r, snap.dim), np.float32)
             sub[: slots.size] = snap.host_vecs[slots]
-            packed_dev = _score_rows(jnp.asarray(sub), jnp.asarray(q),
-                                     jnp.asarray(row_valid), kk, self.metric)
+            if s2d is not None:
+                packed_dev = _score_rows_fused(
+                    jnp.asarray(sub), jnp.asarray(q), rows_dev, valid_dev,
+                    snap.tombs, s2d, kk, self.metric)
+            else:
+                packed_dev = _score_rows(
+                    jnp.asarray(sub), jnp.asarray(q), rows_dev, valid_dev,
+                    snap.tombs, kk, self.metric)
         else:
-            packed_dev = _search_gathered(
-                snap.store, jnp.asarray(q), jnp.asarray(rows),
-                jnp.asarray(row_valid), kk, self.metric)
+            if s2d is not None:
+                packed_dev = _search_gathered_fused(
+                    snap.store, jnp.asarray(q), rows_dev, valid_dev,
+                    snap.tombs, s2d, kk, self.metric)
+            else:
+                packed_dev = _search_gathered(
+                    snap.store, jnp.asarray(q), rows_dev, valid_dev,
+                    snap.tombs, kk, self.metric)
+        if s2d is not None:
+            return self._finalize_fused(packed_dev, shape, b)
         slot_to_doc = snap.slot_to_doc
 
         def finalize():
@@ -2291,8 +2691,11 @@ class TpuVectorIndex(VectorIndex):
             top, idx = _unpack(packed)
             top = top[:b]
             idx = idx[:b]
+            t0 = time.perf_counter() if shape is not None else 0.0
             safe = np.clip(idx, 0, r - 1)
             ids = np.where(idx >= 0, slot_to_doc[rows[safe]], -1)
+            if shape is not None:
+                shape.translate_ms = (time.perf_counter() - t0) * 1000.0
             return ids.astype(np.uint64), top.astype(np.float32)
 
         return finalize
@@ -2630,6 +3033,7 @@ class TpuVectorIndex(VectorIndex):
             self.live = 0
             self._doc_to_slot.clear()
             self._store = self._sq_norms = self._tombs = None
+            self._s2d_dev = None
             self._slot_to_doc = np.zeros(0, dtype=np.int64)
             self._host_tombs = np.zeros(0, dtype=bool)
             # suppress the declarative compress trigger for the rebuild:
@@ -2669,12 +3073,18 @@ class TpuVectorIndex(VectorIndex):
                     pass
                 self._log = None
             self._store = self._sq_norms = self._tombs = None
+            self._s2d_dev = None
             self.dim = None
             self.capacity = 0
             self.n = 0
             self.live = 0
             self._slot_to_doc = np.zeros(0, dtype=np.int64)
             self._host_tombs = np.zeros(0, dtype=bool)
+            with self._stage_lock:
+                # parked staging buffers die with the data (a re-created
+                # class may use a different dim; the ledger's
+                # stage_buffers component must read 0 after drop)
+                self._stage_free.clear()
             self._doc_to_slot.clear()
             self._pending.clear()
             self._pending_tombs.clear()

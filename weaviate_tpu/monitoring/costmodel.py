@@ -117,7 +117,8 @@ class DispatchShape:
                  "bytes_per_row", "k", "extra",
                  "enqueue_ms", "device_ms", "finalize_ms",
                  "filter_ms", "hydrate_ms", "t_start", "t_end",
-                 "t_fetch", "t_fetch_mono")
+                 "t_fetch", "t_fetch_mono", "fused", "fetches",
+                 "translate_ms")
 
     def __init__(self, tier: str, n: int, dim: float, batch: int,
                  bytes_per_row: float, k: int = 0,
@@ -144,6 +145,18 @@ class DispatchShape:
         # NOT a usable anchor)
         self.t_fetch = 0.0
         self.t_fetch_mono = 0.0
+        # fused-dispatch ledger (index/tpu.py): `fused` marks a dispatch
+        # whose program emitted final doc ids (slot->doc translation on
+        # device), `fetches` counts blocking device->host fetches
+        # (_fetch_packed), and `translate_ms` is the measured host-side
+        # slot->doc translation — stamped 0.0 at dispatch on the fused
+        # path (nothing to measure, by construction), measured on the
+        # legacy path, -1 = not measured. The invariant a fused dispatch
+        # must keep: exactly ONE fetch and ZERO translation
+        # (fused_invariant_ok; violations counted by the perf window).
+        self.fused = False
+        self.fetches = 0
+        self.translate_ms = -1.0
 
     # -- analytic totals -----------------------------------------------------
 
@@ -156,10 +169,15 @@ class DispatchShape:
         return int(round(self.n * self.bytes_per_row))
 
     def hop_ms(self) -> float:
-        """The host hop between the device fetch and hydration: unpack +
-        slot->doc gather of the finalize (the measurable slice of the
-        gather/rescore hop the r05 profile flagged; rescore itself is
-        fused on device). -1 when the split was not measured."""
+        """The host hop between the device fetch and hydration — finalize
+        wall minus the blocking fetch. REDEFINED by the fused dispatch:
+        on the legacy path this is unpack + the host slot->doc gather
+        (the gather/rescore hop the r05 profile flagged); on a fused
+        dispatch the translation runs ON DEVICE inside the same program,
+        so the hop is dtype views + two word copies and its share of
+        accounted wall collapses toward zero (docs/performance.md
+        "anatomy of a fused dispatch"). -1 when the split was not
+        measured."""
         if self.finalize_ms < 0.0 or self.device_ms < 0.0:
             return -1.0
         return max(self.finalize_ms - self.device_ms, 0.0)
@@ -184,7 +202,8 @@ class DispatchShape:
         """Flat dict of the analytic shape (bench rows, trace facts)."""
         d = {"tier": self.tier, "n": self.n, "dim": round(self.dim, 2),
              "batch": self.batch, "batch_padded": self.batch_padded,
-             "k": self.k, "flops": self.flops(), "bytes": self.bytes()}
+             "k": self.k, "flops": self.flops(), "bytes": self.bytes(),
+             "fused": self.fused}
         if self.extra:
             d.update(self.extra)
         return d
@@ -199,6 +218,23 @@ class DispatchShape:
         """Per-dispatch roofline: this shape's work over `seconds` of
         device time."""
         return roofline(self.flops(), self.bytes(), seconds, backend)
+
+
+def fused_invariant_ok(shape: "DispatchShape") -> bool:
+    """The fused-dispatch ledger invariant: a dispatch that claims device-
+    side translation must have made exactly ONE blocking fetch and spent
+    ZERO measured host-translation time. Non-fused dispatches trivially
+    pass (they make no claim). The perf window counts violations per
+    window (monitoring/perf.py), and tests/test_fused_dispatch.py pins
+    the contract per tier."""
+    if not shape.fused:
+        return True
+    if shape.translate_ms != 0.0:
+        return False
+    if shape.n <= 0:
+        # empty-gather early return: no device work ran, no fetch owed
+        return shape.fetches <= 1
+    return shape.fetches == 1
 
 
 # -- roofline math ------------------------------------------------------------

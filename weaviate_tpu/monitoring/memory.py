@@ -73,12 +73,12 @@ _LOG = logging.getLogger(__name__)
 # bounded component taxonomies — these tuples ARE the gauge label sets
 # (the JGL010 discipline: a foreign component name folds into "other",
 # never mints a new series)
-DEVICE_COMPONENTS = ("store", "sq_norms", "tombs", "pq_codes",
-                     "recon_norms", "rescore_store", "rescore_sq_norms",
-                     "allow_words")
+DEVICE_COMPONENTS = ("store", "sq_norms", "tombs", "slot_to_doc",
+                     "pq_codes", "recon_norms", "rescore_store",
+                     "rescore_sq_norms", "allow_words")
 HOST_COMPONENTS = ("slot_to_doc", "host_tombs", "host_vecs",
                    "pending_rows", "breaker_rows", "auditor_rows",
-                   "allow_cache")
+                   "allow_cache", "stage_buffers")
 DISK_COMPONENTS = ("used", "free", "incident_bundles")
 OTHER = "other"
 SCOPES = ("device", "host", "disk")
@@ -226,6 +226,15 @@ def index_host_components(vidx) -> dict:
     hr = host_rows_cache_bytes(vidx)
     if hr:
         out["breaker_rows"] = hr
+    # parked query-staging buffers (the fused-dispatch enqueue pool):
+    # racy len-free iteration over a dict-of-lists snapshot — sizes only
+    stage = getattr(vidx, "_stage_free", None)
+    if stage:
+        b = 0
+        for bufs in list(stage.values()):
+            b += sum(int(x.nbytes) for x in list(bufs))
+        if b:
+            out["stage_buffers"] = b
     return out
 
 

@@ -195,11 +195,17 @@ class PerfWindow:
                       if shape.t_fetch > 0.0 else 0.0)
         fetch_end = (shape.t_fetch_mono
                      if 0.0 < shape.t_fetch_mono <= now else now)
+        fused = bool(shape.fused)
+        # the fused-dispatch invariant (one blocking fetch, zero host
+        # translation): violations are counted per window — a fused
+        # dispatch quietly re-growing host translation work must be
+        # dashboard-visible, not just test-pinned
+        viol = not costmodel.fused_invariant_ok(shape)
         with self._lock:
             self._evict(now)
             self._entries.append(
                 (now, flops, byts, device_s, shape.tier, regime,
-                 int(rows) or shape.batch))
+                 int(rows) or shape.batch, fused, viol))
             self._flops += flops
             self._bytes += byts
             self._device_s += device_s
@@ -247,7 +253,7 @@ class PerfWindow:
     def _evict(self, now: float) -> None:
         horizon = now - self.window_s
         while self._entries and self._entries[0][0] < horizon:
-            _, f, b, ds, _, _, r = self._entries.popleft()
+            _, f, b, ds, _, _, r, _, _ = self._entries.popleft()
             self._flops -= f
             self._bytes -= b
             self._device_s -= ds
@@ -330,8 +336,13 @@ class PerfWindow:
                         for p, d in self._phase.items() if d}
             tiers: dict[str, int] = {}
             regimes: dict[str, int] = {}
-            for _, _, _, _, tier, regime, _ in self._entries:
+            fused_n = fused_viol = 0
+            for _, _, _, _, tier, regime, _, fused, viol in self._entries:
                 tiers[tier] = tiers.get(tier, 0) + 1
+                if fused:
+                    fused_n += 1
+                if viol:
+                    fused_viol += 1
                 if regime:
                     regimes[regime] = regimes.get(regime, 0) + 1
             total_dispatches = self._total_dispatches
@@ -383,6 +394,11 @@ class PerfWindow:
         out["phases"] = phases
         out["tiers"] = dict(sorted(tiers.items(), key=lambda kv: -kv[1]))
         out["regimes"] = dict(sorted(regimes.items(), key=lambda kv: -kv[1]))
+        # fused-dispatch coverage + invariant violations over the window
+        # (costmodel.fused_invariant_ok): share near 1.0 with violations 0
+        # is the steady state; violations > 0 means host post-processing
+        # crept back into a dispatch that claims device-side translation
+        out["fused"] = {"dispatches": fused_n, "violations": fused_viol}
         return out
 
 

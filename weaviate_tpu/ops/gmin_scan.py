@@ -242,6 +242,25 @@ def search_gmin(store, sq_norms, tombs, n, q, allow_words, use_allow,
     return pack_topk(top, idx)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("use_allow", "k", "metric", "rg", "active_g", "interpret"),
+)
+def search_gmin_fused(store, sq_norms, tombs, n, q, allow_words, s2d,
+                      use_allow, k, metric, rg, active_g=G, interpret=False,
+                      rescore_blk=None):
+    """search_gmin with the slot->doc translation fused into the SAME
+    program: s2d is the device-resident [capacity, 2] uint32 doc-id word
+    table (index/tpu.py IndexSnapshot.slot_to_doc_dev) and the return is
+    the FUSED [B, 3k] layout (ops/topk.translate_pack) — final doc ids
+    leave the device in the one packed fetch, no host translation."""
+    from weaviate_tpu.ops.topk import translate_pack
+
+    top, idx = gmin_topk(store, sq_norms, tombs, n, q, allow_words, use_allow,
+                         k, metric, rg, active_g, interpret, rescore_blk)
+    return translate_pack(top, idx, s2d)
+
+
 def gmin_topk(store, sq_norms, tombs, n, q, allow_words, use_allow,
               k, metric, rg, active_g=G, interpret=False, rescore_blk=None):
     """search_gmin's traceable body -> ([B, k] dists, [B, k] slot idx, -1

@@ -322,3 +322,23 @@ def search_pq_gmin(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
                             flat_cb, allow_words, use_allow, k, metric, rg,
                             active_g, interpret, rot, codes_blk)
     return pack_topk(top, idx)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("use_allow", "k", "metric", "rg", "active_g", "interpret"),
+)
+def search_pq_gmin_fused(codes, recon_norms, tombs, n, q, cb_chunks, flat_cb,
+                         allow_words, s2d, use_allow, k, metric, rg,
+                         active_g=G, interpret=False, rot=None,
+                         codes_blk=None):
+    """search_pq_gmin with the slot->doc translation fused into the same
+    program (ops/topk.translate_pack, the FUSED [B, 3k] layout): the one
+    packed fetch carries final doc ids — gmin_scan.search_gmin_fused's
+    codes-only twin."""
+    from weaviate_tpu.ops.topk import translate_pack
+
+    top, idx = pq_gmin_topk(codes, recon_norms, tombs, n, q, cb_chunks,
+                            flat_cb, allow_words, use_allow, k, metric, rg,
+                            active_g, interpret, rot, codes_blk)
+    return translate_pack(top, idx, s2d)

@@ -46,6 +46,15 @@ class App:
 
         self.stack_sampler = StackSampler()
 
+        # fused device dispatch (index/tpu.py): apply the config knob to
+        # the index layer's process-wide toggle — like the tracer, the
+        # index reaches it without plumbing. Default on; the bench's
+        # --fused A/B and FUSED_DISPATCH_ENABLED flip it.
+        from weaviate_tpu.index import tpu as tpu_index
+
+        self._fused_token = tpu_index.set_fused_enabled(
+            self.config.fused_dispatch_enabled)
+
         # end-to-end request tracing (monitoring/tracing.py): the tracer is
         # a process-wide module global — shards and the coalescer reach it
         # without plumbing — installed here and cleared on shutdown.
@@ -476,6 +485,12 @@ class App:
         # shards they would dispatch to go away
         if self.coalescer is not None:
             self.coalescer.shutdown()
+        # the fused-dispatch toggle reverts to the env default, but only
+        # if OUR override is still the current one (a newer App's setting
+        # survives) — the same still-ours discipline as the tracer below
+        from weaviate_tpu.index import tpu as tpu_index
+
+        tpu_index.unset_fused_enabled(getattr(self, "_fused_token", None))
         if self.tracer is not None:
             from weaviate_tpu.monitoring import tracing
 

@@ -833,70 +833,133 @@ class QueryCoalescer:
                 for ln in due:
                     self._fail_lane(ln, e)
 
+    def _settle_discard(self, done) -> None:
+        """Settle an orphaned, already-enqueued dispatch (results
+        discarded) WITHOUT blocking the flusher: done() is a blocking
+        device fetch, and a wedged device must never pin the flush
+        thread (shutdown joins it with a bounded timeout). Runs on the
+        dispatch pool; if the pool is already torn down the dispatch is
+        abandoned — the process is exiting and the index's in-flight
+        gauge dies with it."""
+        def run() -> None:
+            try:
+                done()
+            except Exception:  # noqa: BLE001 — results already discarded
+                pass
+
+        try:
+            self._dispatch_pool.submit(run)
+        except Exception:  # noqa: BLE001 — pool shut down: abandon
+            pass
+
+    def _acquire_slot(self) -> bool:
+        """Block until one of the `pipeline_depth` in-flight slots frees,
+        or the coalescer closes (-> False). The 0.1 s poll is ONLY the
+        flusher's shutdown check: a pool task that dies frees its slot
+        via _reap_lane_future."""
+        while not self._inflight.acquire(timeout=0.1):
+            if self._closed:
+                return False
+        return True
+
     def _flush(self, due: list[_Lane]) -> None:
-        """Depth-2 pipelined flush: each lane takes an in-flight slot (the
-        flusher BLOCKS when both are busy — that stall is what lets the
-        next window's lanes fill to full width), has its device dispatch
-        enqueued here in order, and finalizes on the dispatch pool so
-        hydration overlaps the next lane's device compute."""
+        """Pipelined flush. Async-capable unfiltered lanes ENQUEUE their
+        device program on this thread FIRST and only then wait for an
+        in-flight slot — so lane i+1's device compute is already queued
+        behind lane i's program while lane i's blocking fetch/hydration
+        is still in flight (the fused-dispatch host pipelining: the
+        existing `pipeline_depth` cap still bounds concurrent finalizes,
+        and the flusher's stall on a busy pipeline is still the
+        backpressure that lets the next window's lanes fill). Sync and
+        filtered lanes take their slot first as before — their whole
+        search runs on the dispatch pool."""
         for i, ln in enumerate(due):
-            while not self._inflight.acquire(timeout=0.1):
-                # this poll is ONLY the flusher's shutdown check now: a
-                # pool task that dies frees its slot via _reap_lane_future
-                if self._closed:
-                    # a wedged in-flight dispatch must not strand the rest
-                    err = CoalescerShutdownError(
-                        "query coalescer shut down with requests queued")
-                    for rest in due[i:]:
-                        self._fail_lane(rest, err)
-                    return
             if not self._prune_expired(ln):
                 # every rider's deadline passed in the queue: the lane
-                # must not occupy a dispatch slot
+                # must not occupy a dispatch slot (none acquired yet)
                 self._mark_settled(ln)
-                self._release_lane(ln)
                 continue
-            done = None
+            done = rec = None
+            slot = False
             try:
                 faults.fire("serving.coalescer.dispatch")
                 vidx = ln.shard.vector_index
-                if not hasattr(vidx, "search_by_vectors_async"):
+                async_plain = (hasattr(vidx, "search_by_vectors_async")
+                               and ln.flt is None)
+                if async_plain:
+                    # enqueue BEFORE taking a slot: the device work of
+                    # this lane overlaps the previous lane's fetch
+                    q = (ln.items[0].vectors if len(ln.items) == 1
+                         else np.concatenate([w.vectors for w in ln.items]))
+                    self._observe_wait(ln)  # queue wait ends at dispatch
+                    rec = self._trace_record(ln)
+                    done = ln.shard.object_vector_search_async(
+                        q, ln.k, include_vector=ln.include_vector)
+                if not self._acquire_slot():
+                    # shutdown while waiting: nothing may hang — fail
+                    # EVERY waiter first (immediate wakeups), and only
+                    # then settle the already-enqueued dispatch (results
+                    # discarded): done() is a blocking fetch, and a
+                    # wedged device must not stand between the remaining
+                    # lanes' waiters and their shutdown error
+                    err = CoalescerShutdownError(
+                        "query coalescer shut down with requests queued")
+                    self._fail_lane(ln, err)
+                    for rest in due[i + 1:]:
+                        self._fail_lane(rest, err)
+                    if done is not None:
+                        if rec is not None:
+                            # a dispatch DID run: close the riders' spans
+                            # (attribution spans never leak — the PR-3
+                            # contract) even though the results are about
+                            # to be discarded
+                            try:
+                                rec.finish()
+                            except Exception:  # noqa: BLE001 — teardown
+                                pass
+                        self._settle_discard(done)
+                    return
+                slot = True
+                if async_plain:
+                    self._submit_lane_task(self._finalize_async, ln, done,
+                                           rec)
+                elif ln.flt is not None and hasattr(
+                        vidx, "search_by_vectors_async"):
+                    # filtered lanes: the allowList resolution (an
+                    # inverted-index scan on a cache miss) must not
+                    # head-of-line block the flusher — resolve, enqueue
+                    # AND finalize on the pool. The search itself still
+                    # rides the lock-free two-phase snapshot path inside
+                    # object_vector_search_async (or the sync fallback
+                    # for index types without filtered async).
+                    self._submit_lane_task(self._dispatch_filtered, ln)
+                else:
                     # indexes without true async dispatch (hnsw, noop,
                     # mesh): the whole blocking search runs on the pool —
                     # object_vector_search_async's sync fallback would
                     # otherwise execute it inline in THIS thread and
                     # head-of-line-block every other lane
                     self._submit_lane_task(self._dispatch_sync, ln)
-                    continue
-                if ln.flt is not None:
-                    # filtered lanes: the allowList resolution (an
-                    # inverted-index scan on a cache miss) must not
-                    # head-of-line block the flusher either — resolve,
-                    # enqueue AND finalize on the pool. The search itself
-                    # still rides the lock-free two-phase snapshot path
-                    # inside object_vector_search_async (or the sync
-                    # fallback for index types without filtered async).
-                    self._submit_lane_task(self._dispatch_filtered, ln)
-                    continue
-                q = (ln.items[0].vectors if len(ln.items) == 1
-                     else np.concatenate([w.vectors for w in ln.items]))
-                self._observe_wait(ln)  # queue wait ends as dispatch starts
-                rec = self._trace_record(ln)
-                done = ln.shard.object_vector_search_async(
-                    q, ln.k, include_vector=ln.include_vector)
-                self._submit_lane_task(self._finalize_async, ln, done, rec)
             except Exception as e:  # noqa: BLE001 — propagate to all waiters
                 # covers pool.submit after shutdown too: no waiter may hang
                 self._fail_lane(ln, e)
-                self._release_lane(ln)
+                if slot:
+                    self._release_lane(ln)
                 if done is not None:
-                    # the dispatch WAS enqueued (submit itself failed):
-                    # settle it so the index's in-flight gauge and any
-                    # device work don't leak; results are discarded
-                    try:
-                        done()
-                    except Exception:  # noqa: BLE001 — already failed lane
-                        pass
+                    if rec is not None:
+                        # a dispatch WAS enqueued and its finalize task
+                        # never ran: close the riders' spans here (an
+                        # enqueue that itself raised leaves rec unused —
+                        # no dispatch happened, so no span is fabricated)
+                        try:
+                            rec.finish()
+                        except Exception:  # noqa: BLE001 — failed lane
+                            pass
+                    # settle the enqueued dispatch so the index's
+                    # in-flight gauge and any device work don't leak;
+                    # results are discarded, and the blocking fetch stays
+                    # off the flusher thread
+                    self._settle_discard(done)
 
     def _submit_lane_task(self, fn, lane: _Lane, *args) -> None:
         """Pool submission with a reaper: if the task is cancelled at
@@ -1182,7 +1245,11 @@ class QueryCoalescer:
     def _resolve_lane(self, lane: _Lane, res) -> None:
         """Scatter [rows] result lists back to the lane's waiters. No k
         trimming is needed: k is part of the lane key (see submit), so every
-        waiter here asked for exactly the k the dispatch ran at."""
+        waiter here asked for exactly the k the dispatch ran at. Under the
+        fused dispatch the per-row ids/distances inside `res` are views
+        into the lane's ONE packed device fetch (index/tpu.py fused
+        finalize) — this scatter's row slices are the only per-waiter
+        work between the fetch and the reply."""
         if not self._mark_settled(lane):
             return  # reaper/failure path won the race; results discarded
         pw = perf.get_window()

@@ -75,6 +75,7 @@ from collections import deque
 from typing import Optional
 
 from weaviate_tpu.config import ControllerConfig
+from weaviate_tpu.config.config import RESCORE_R_BUCKETS
 from weaviate_tpu.monitoring import incidents
 from weaviate_tpu.testing import faults, sanitizers
 
@@ -85,7 +86,10 @@ _LOG = logging.getLogger(__name__)
 # one compiled kernel per distinct value — bucketed, the cache stays as
 # bounded as the index's own query-padding buckets. The top bucket (128)
 # is index/tpu.py's built-in maximum, i.e. "controller inactive".
-R_BUCKETS = (32, 48, 64, 96, 128)
+# The table itself lives in config (ONE source of truth): index/tpu.py's
+# static-arg snapping imports the same tuple, so a controller cut can
+# never mint a jit shape the index wouldn't also compile.
+R_BUCKETS = RESCORE_R_BUCKETS
 
 # brownout ladder stages (stage 0 = normal serving)
 STAGE_NORMAL = 0
