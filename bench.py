@@ -1065,6 +1065,18 @@ def _parse_args(argv=None):
                         "'both' additionally commits a fused-vs-staged A/B "
                         "row (phase shares, duty cycle, online recall) into "
                         "bench_matrix.json serving_fused_*")
+    p.add_argument("--ivf", choices=("on", "off", "both"), default=None,
+                   help="IVF partition-pruned scan A/B (index/tpu.py + "
+                        "ops/ivf.py, ROADMAP item 3): closed-loop batched "
+                        "kNN on the SHARD serving path (direct, no gRPC — "
+                        "the scan-bound regime where pruning is the "
+                        "lever), with the shadow recall auditor sampling "
+                        "live dispatches for online_recall. `both` "
+                        "measures flat vs probed under identical load and "
+                        "commits QPS, recall@10, online_recall, and "
+                        "probed_fraction into the bench_matrix ivf_scan_* "
+                        "row. Knobs: BENCH_IVF_{N,DIM,CLIENTS,BATCH,"
+                        "SECONDS,WARMUP,NLIST,TOP_P,PCA_DIM,AUDIT_RATE}")
     p.add_argument("--overload", type=int, default=0,
                    help="closed-loop OVERLOAD mode: N client threads, each "
                         "request under a tight deadline "
@@ -2205,6 +2217,241 @@ def run_serving_bench(args, rng):
     _gate_exit()
 
 
+def run_ivf_bench(args, rng):
+    """IVF-vs-flat A/B (the partition-pruning tentpole, ROADMAP item 3):
+    closed-loop batched kNN against ONE shard on the direct serving path
+    — shard.object_vector_search, so dispatches ride the real snapshot/
+    trace/audit planes but no gRPC/coalescer overhead dilutes the
+    scan-bound comparison. The shadow recall auditor (monitoring/
+    quality.py) samples the live dispatches against the exact pinned
+    snapshot, so the committed row carries ONLINE recall next to the
+    bench's own sampled-reply recall@10; probed_fraction comes from the
+    index's probe accounting over the counted window, and the costmodel
+    block carries the probed-aware flops (no phantom work in the
+    roofline). Acceptance: probed QPS >= 3x flat at online recall
+    >= 0.99 with probed_fraction < 0.25."""
+    import shutil
+    import tempfile
+    import threading
+    import uuid as uuidlib
+
+    import jax
+
+    if os.environ.get("BENCH_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        _probe_device()
+    from weaviate_tpu.config import Config
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.server import App
+
+    n = int(os.environ.get("BENCH_IVF_N", 120_000))
+    dim = int(os.environ.get("BENCH_IVF_DIM", 64))
+    clients = int(os.environ.get("BENCH_IVF_CLIENTS", 4))
+    batch = int(os.environ.get("BENCH_IVF_BATCH", 16))
+    seconds = float(os.environ.get("BENCH_IVF_SECONDS", 8.0))
+    warmup = float(os.environ.get("BENCH_IVF_WARMUP", 4.0))
+    log(f"ivf bench: n={n} dim={dim} clients={clients} batch={batch} "
+        f"mode={args.ivf}")
+    vecs = make_data(n, dim, rng)
+    pool_q = vecs[rng.integers(0, n, 256)] + 0.05 * rng.standard_normal(
+        (256, dim), dtype=np.float32)
+    gt = exact_gt(vecs, pool_q, K)
+
+    def measure(ivf_on: bool) -> dict:
+        cfg = Config()
+        # online recall: the shadow auditor samples live dispatches and
+        # re-executes them on the exact pinned host plane — the recall
+        # claim is measured on the serving path, not offline
+        cfg.quality.audit_sample_rate = float(
+            os.environ.get("BENCH_IVF_AUDIT_RATE", 0.2))
+        cfg.quality.audit_deadline_ms = 10_000.0  # host scans n rows
+        cfg.quality.audit_max_rows = batch
+        cfg.ivf.enabled = ivf_on
+        # train ONCE at full import (min_n = n): the A/B measures the
+        # steady-state layout, not a half-stale mid-import one — and the
+        # import doesn't pay len(import)/growth reclusters
+        cfg.ivf.min_n = n
+        cfg.ivf.nlist = int(os.environ.get("BENCH_IVF_NLIST", 0))
+        cfg.ivf.top_p = int(os.environ.get("BENCH_IVF_TOP_P", 0))
+        # the low-dim prefilter defaults OFF on the CPU A/B: at D=64 the
+        # candidate pass is gather/selection-bound, not dim-bound, so a
+        # prefilter stage ADDS more selection work than the dims it cuts
+        # (measured: 60 -> 82 ms/batch). It earns its keep on wide
+        # vectors / bandwidth-bound stores — BENCH_IVF_PCA_DIM enables it
+        cfg.ivf.pca_dim = int(os.environ.get("BENCH_IVF_PCA_DIM", 0))
+        data_dir = tempfile.mkdtemp(prefix="benchivf")
+        app = None
+        try:
+            app = App(config=cfg, data_path=data_dir)
+            app.schema.add_class({
+                "class": "Ivf", "vectorIndexType": "hnsw_tpu",
+                "vectorIndexConfig": {"distance": "l2-squared"},
+                "properties": [{"name": "tag", "dataType": ["text"]}],
+            })
+            ci = app.db.get_index("Ivf")
+            t0 = time.perf_counter()
+            for s in range(0, n, 10_000):
+                ci.put_batch([
+                    StorObj(class_name="Ivf",
+                            uuid=str(uuidlib.UUID(int=i + 1)),
+                            properties={"tag": f"t{i % 16}"},
+                            vector=vecs[i])
+                    for i in range(s, min(s + 10_000, n))])
+            import_s = time.perf_counter() - t0
+            shard = ci.single_local_shard()
+            vidx = shard.vector_index
+            if ivf_on:
+                assert getattr(vidx, "_ivf_buckets", None) is not None, \
+                    "ivf bench: layout did not train"
+            log(f"  import {import_s:.1f}s; ivf={'on' if ivf_on else 'off'}"
+                f" health={vidx.health().get('ivf')}")
+            stop = threading.Event()
+            counting = threading.Event()
+            lats: list[list[float]] = [[] for _ in range(clients)]
+            samples: list[list] = [[] for _ in range(clients)]
+            errors = [0] * clients
+
+            def loop(tid: int) -> None:
+                lrng = np.random.default_rng(500 + tid)
+                while not stop.is_set():
+                    qi = int(lrng.integers(0, len(pool_q) - batch))
+                    qb = pool_q[qi: qi + batch]
+                    t1 = time.perf_counter()
+                    try:
+                        res = shard.object_vector_search(qb, K)
+                    except Exception:  # noqa: BLE001 — keep the loop alive
+                        errors[tid] += 1
+                        time.sleep(0.05)
+                        continue
+                    dt = time.perf_counter() - t1
+                    if counting.is_set():
+                        lats[tid].append(dt)
+                        if len(samples[tid]) < 16:
+                            ids = [[int(uuidlib.UUID(r.obj.uuid).int) - 1
+                                    for r in row] for row in res]
+                            samples[tid].append((qi, ids))
+
+            threads = [threading.Thread(target=loop, args=(i,), daemon=True)
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            time.sleep(warmup)  # compile the padding buckets
+            base_stats = vidx.ivf_stats() if ivf_on else None
+            base_audits = None
+            if app.quality_auditor is not None:
+                app.quality_auditor.drain(timeout_s=30.0)
+                app.quality_auditor.clear()
+                base_audits = app.quality_auditor.summary().get("audits", {})
+            counting.set()
+            t1 = time.perf_counter()
+            time.sleep(seconds)
+            counting.clear()
+            elapsed = time.perf_counter() - t1
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            flat = np.array([x for per in lats for x in per], np.float64)
+            hit = tot = 0
+            for per in samples:
+                for qi, rows in per:
+                    for j, ids in enumerate(rows):
+                        want = set(int(x) for x in gt[qi + j])
+                        hit += len(want & set(ids))
+                        tot += K
+            row = {
+                "ivf": ivf_on, "n": n, "dim": dim, "k": K,
+                "clients": clients, "batch": batch,
+                "duration_s": round(elapsed, 2),
+                "requests": int(flat.size),
+                "qps": round(flat.size * batch / elapsed, 1),
+                "p50_ms": round(float(np.percentile(flat, 50)) * 1000, 2)
+                if flat.size else None,
+                "p99_ms": round(float(np.percentile(flat, 99)) * 1000, 2)
+                if flat.size else None,
+                "recall@10": round(hit / tot, 4) if tot else None,
+                "request_errors": int(sum(errors)),
+                "import_s": round(import_s, 1),
+            }
+            if app.quality_auditor is not None:
+                app.quality_auditor.drain(timeout_s=30.0)
+                qs = app.quality_auditor.summary()
+                row["online_recall"] = qs.get("online_recall")
+                row["online_audits"] = {
+                    k: v - (base_audits or {}).get(k, 0)
+                    for k, v in qs.get("audits", {}).items()}
+            if ivf_on:
+                st = vidx.ivf_stats()
+                dp = st["dispatches"] - base_stats["dispatches"]
+                pr = st["probed_rows"] - base_stats["probed_rows"]
+                br = st["base_rows"] - base_stats["base_rows"]
+                row["probed_fraction"] = round(pr / br, 4) if br else None
+                row["ivf_health"] = vidx.health().get("ivf")
+                # the resolved operating point (reproducibility: auto
+                # knobs resolve against n/nlist at run time)
+                plan = vidx._ivf_plan(vidx._read_snapshot(), K)
+                row["ivf_top_p"] = plan[0] if plan else None
+                row["ivf_prefilter_c"] = plan[1] if plan else None
+                h = row["ivf_health"] or {}
+                # rows the device reads per dispatch: the probed bucket
+                # rows plus the nlist centroid rows of the probe itself
+                probed_n = pr // max(dp, 1) + h.get("nlist", 0)
+            else:
+                probed_n = n
+            # probed-aware costmodel block: flops/bytes reflect the rows
+            # the device actually reads, so the roofline carries no
+            # phantom work for the rows the probe skipped
+            plat = jax.devices()[0].platform
+            backend = costmodel.backend_for_platform(plat)
+            shape = costmodel.DispatchShape(
+                costmodel.TIER_EXACT, n=int(probed_n), dim=dim, batch=batch,
+                bytes_per_row=4 * dim, k=K)
+            row["costmodel"] = {
+                "scanned_rows_per_dispatch": int(probed_n),
+                "flops_per_dispatch": shape.flops(),
+                "bytes_per_dispatch": shape.bytes(),
+                "roofline": shape.roofline_at_qps(max(row["qps"], 1e-9),
+                                                  backend),
+            }
+            log(f"  ivf={'on' if ivf_on else 'off'}: {row}")
+            return row
+        finally:
+            if app is not None:
+                app.shutdown()
+            shutil.rmtree(data_dir, ignore_errors=True)
+
+    modes = {}
+    if args.ivf in ("off", "both"):
+        modes["flat"] = measure(False)
+    if args.ivf in ("on", "both"):
+        modes["ivf"] = measure(True)
+    import jax
+
+    plat = jax.devices()[0].platform
+    backend = "tpu-v5e" if plat in ("tpu", "axon") else "cpu"
+    out_row = {
+        "backend": backend, "round": 6, "date": time.strftime("%Y-%m-%d"),
+        "n": n, "dim": dim, "clients": clients, "batch": batch, **modes,
+    }
+    if "ivf" in modes and "flat" in modes and modes["flat"]["qps"]:
+        out_row["speedup_ivf_vs_flat"] = round(
+            modes["ivf"]["qps"] / modes["flat"]["qps"], 2)
+    suffix = "cpu" if backend == "cpu" else "tpu"
+    _merge_matrix({f"ivf_scan_{suffix}": out_row})
+    head = modes.get("ivf") or modes.get("flat")
+    print(json.dumps({
+        "metric": (
+            f"IVF partition-pruned vs flat scan QPS (shard direct path, "
+            f"n={n}, d={dim}, k={K}, batch={batch}, {clients} clients, "
+            f"backend {backend}; online_recall from the shadow auditor)"),
+        "value": head["qps"],
+        "unit": "qps",
+        "vs_baseline": out_row.get("speedup_ivf_vs_flat", 0),
+        "row": out_row,
+    }))
+    _gate_exit()
+
+
 def run_reader_scaling_bench(args, rng):
     """Closed-loop read scaling on the DIRECT index path (no gRPC, no
     coalescer): N reader threads each issue single-query kNN searches
@@ -2392,6 +2639,9 @@ def run_reader_scaling_bench(args, rng):
 def main():
     args = _parse_args()
     rng = np.random.default_rng(7)
+    if args.ivf:
+        run_ivf_bench(args, rng)
+        return
     if args.readers:
         run_reader_scaling_bench(args, rng)
         return

@@ -1251,6 +1251,25 @@ def test_jgl012_repo_index_layer_is_clean():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_jgl012_covers_the_ivf_slab_fields():
+    """The IVF scan plane's device slabs (centroids / padded buckets /
+    PCA projection + rows) ride the same snapshot-field audit as the
+    store: bound from a call in a method that never stamps the ledger is
+    a finding; a stamped method passes."""
+    body = (
+        "import jax, jax.numpy as jnp\n"
+        "class Idx:\n"
+        "    def _train(self, cent, buckets, proj, rows):\n"
+        "        self._ivf_centroids = jax.device_put(jnp.asarray(cent))\n"
+        "        self._ivf_buckets = jax.device_put(jnp.asarray(buckets))\n"
+        "        self._ivf_pca_proj = jax.device_put(jnp.asarray(proj))\n"
+        "        self._ivf_pca_rows = jax.device_put(jnp.asarray(rows))\n"
+    )
+    assert codes(body, INDEX).count("JGL012") == 4
+    stamped = body + "        self._stamp_memory()\n"
+    assert "JGL012" not in codes(stamped, INDEX)
+
+
 def test_jgl012_annotated_assignment_fires_too():
     src = (
         "import jax, jax.numpy as jnp\n"
@@ -1382,6 +1401,20 @@ def test_jgl014_self_writes_and_unrelated_attrs_pass():
         "        other.unrelated = 1\n"
     )
     assert "JGL014" not in codes(src, COLD)
+
+
+def test_jgl014_ivf_top_p_knob_is_controller_owned():
+    """The IVF probe-count cap (the second recall-guarded budget) joins
+    the knob-field set: writes outside serving/controller.py bypass the
+    clamp/journal/lease machinery and are findings."""
+    src = (
+        "def f(plane):\n"
+        "    plane.ivf_top_p = 4\n"
+        "    plane.ivf_top_p_cap = 2\n"
+    )
+    assert codes(src, COLD).count("JGL014") == 2
+    assert "JGL014" not in codes(
+        src, "weaviate_tpu/serving/controller.py")
 
 
 def test_jgl014_controller_module_is_exempt():

@@ -279,6 +279,8 @@ CONTROLLER_KNOB_SETTERS = frozenset({
 CONTROLLER_KNOB_FIELDS = frozenset({
     "admission_margin", "tenant_cap_scale", "retry_after_scale",
     "rescore_r_cap", "rate_scale", "brownout_stage", "_knobs",
+    # the IVF probe-count cap — the second recall-guarded budget
+    "ivf_top_p", "ivf_top_p_cap",
 })
 
 # JGL010 scope: the whole package — metric vecs are registered once in
@@ -302,6 +304,9 @@ JGL012_PREFIXES = ("weaviate_tpu/index/",)
 SNAPSHOT_FIELDS = frozenset({
     "_store", "_sq_norms", "_tombs", "_codes", "_recon_norms",
     "_rescore_dev", "_rescore_sq_norms", "_zero_words", "_s2d_dev",
+    # the IVF scan plane's device slabs (index/tpu.py): centroids,
+    # padded partition buckets, PCA projection + per-slot low-dim rows
+    "_ivf_centroids", "_ivf_buckets", "_ivf_pca_proj", "_ivf_pca_rows",
 })
 
 # calls that route an allocation through the ledger: the per-class

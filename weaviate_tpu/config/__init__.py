@@ -4,8 +4,9 @@ from weaviate_tpu.config.config import (
     Config,
     ConfigError,
     ControllerConfig,
+    IvfConfig,
     load_config,
 )
 
 __all__ = ["Config", "AuthConfig", "AuthzConfig", "ConfigError",
-           "ControllerConfig", "load_config"]
+           "ControllerConfig", "IvfConfig", "load_config"]

@@ -28,6 +28,22 @@ class ConfigError(ValueError):
 # shape the static path wouldn't also compile.
 RESCORE_R_BUCKETS = (32, 48, 64, 96, 128)
 
+# The ONE table of IVF probe-count buckets (ROADMAP item 3). Same
+# discipline as RESCORE_R_BUCKETS, same two consumers:
+#   - serving/controller.py's recall-guarded budget controller steps the
+#     ivf_top_p cap DOWN this ladder (the second recall-guarded knob);
+#   - index/tpu.py snaps every effective probe count to a bucket (or to
+#     nlist exactly when the request covers all partitions), so top_p —
+#     a jit static argument — can only take bounded values and a
+#     controller cut can never mint a jit shape the static path
+#     wouldn't also compile.
+# ~1.5x steps up to the 4096 auto-nlist ceiling: the budget controller's
+# one-bucket-per-hold-period gradualism must hold for large layouts too
+# (a ladder topping out at 128 would make the first cut on a 256-probe
+# layout a 2.7x jump)
+IVF_TOP_P_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128,
+                     192, 256, 384, 512, 768, 1024, 1536, 2048, 3072, 4096)
+
 
 def _bool(env: Mapping[str, str], key: str, default: bool = False) -> bool:
     v = env.get(key)
@@ -312,6 +328,47 @@ class IncidentsConfig:
 
 
 @dataclass
+class IvfConfig:
+    """Partition-pruned search: the clustered IVF scan plane with a
+    low-dim PCA prefilter (index/tpu.py + ops/ivf.py, ROADMAP item 3).
+    TPU extension: a k-means partition layout trained on the write path
+    (assignments ride the staged-generation snapshot handshake, stored
+    as padded partition buckets so jit shapes stay cached across
+    inserts); at query time a cheap centroid scan probes the top-P
+    partitions and only their buckets are scored, making per-dispatch
+    scan cost sublinear in N. Disabled (the default) => a true zero-hop
+    no-op: no centroids/buckets/PCA slabs exist anywhere, the write path
+    never trains, and every dispatch-path gate is one comparison."""
+
+    enabled: bool = False     # IVF_ENABLED
+    # partitions; 0 = auto: ~256 rows per partition, ceil-pow2-snapped,
+    # clamped 16..4096 (the host k-means budget — index/tpu.py
+    # _ivf_nlist; fill-targeted sizing measured 2-4x better than
+    # sqrt(n) in both probe recall and probed_fraction)
+    nlist: int = 0            # IVF_NLIST
+    # partitions probed per query; 0 = auto (nlist/16, min 1). Snapped to
+    # IVF_TOP_P_BUCKETS; the controller's recall-guarded budget may cut
+    # it further down the same ladder, never raise it.
+    top_p: int = 0            # IVF_TOP_P
+    # rows before the first k-means training pass (an IVF layout over a
+    # few thousand rows costs more in probe overhead than it prunes)
+    min_n: int = 20000        # IVF_MIN_N
+    # PCA prefilter subspace dims; 0 = prefilter off
+    pca_dim: int = 0          # IVF_PCA_DIM
+    # candidates surviving the PCA prefilter per query; 0 = auto
+    # (max(8k, probed/8), pow2-snapped). Only meaningful with pca_dim>0.
+    prefilter_c: int = 0      # IVF_PREFILTER_C
+    # k-means training sample / iterations (bounded — training must stay
+    # a write-path pause, not an offline job)
+    train_sample: int = 65536  # IVF_TRAIN_SAMPLE
+    train_iters: int = 6       # IVF_TRAIN_ITERS
+    # recluster (full retrain) once n outgrows the trained layout by
+    # this fraction; between retrains new rows are assigned to the
+    # existing centroids incrementally
+    retrain_growth: float = 0.5  # IVF_RETRAIN_GROWTH
+
+
+@dataclass
 class ControllerConfig:
     """Self-tuning degradation control plane (serving/controller.py).
     TPU extension: four clamped sense->decide->actuate->journal
@@ -482,6 +539,7 @@ class Config:
     # and finalize() does zero host translation. Off = the legacy host
     # slot_to_doc path (the bench's --fused A/B lever)
     fused_dispatch_enabled: bool = True
+    ivf: IvfConfig = field(default_factory=IvfConfig)
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
@@ -503,6 +561,23 @@ class Config:
             raise ConfigError("DISK_USE_READONLY_PERCENTAGE must be 0..100")
         if self.store_dtype not in ("float32", "bfloat16"):
             raise ConfigError("STORE_DTYPE must be float32|bfloat16")
+        ivf = self.ivf
+        if ivf.nlist < 0:
+            raise ConfigError("IVF_NLIST must be >= 0 (0 = auto)")
+        if ivf.top_p < 0:
+            raise ConfigError("IVF_TOP_P must be >= 0 (0 = auto)")
+        if ivf.min_n < 1:
+            raise ConfigError("IVF_MIN_N must be >= 1")
+        if ivf.pca_dim < 0:
+            raise ConfigError("IVF_PCA_DIM must be >= 0 (0 = prefilter off)")
+        if ivf.prefilter_c < 0:
+            raise ConfigError("IVF_PREFILTER_C must be >= 0 (0 = auto)")
+        if ivf.train_sample < 256:
+            raise ConfigError("IVF_TRAIN_SAMPLE must be >= 256")
+        if ivf.train_iters < 1:
+            raise ConfigError("IVF_TRAIN_ITERS must be >= 1")
+        if ivf.retrain_growth <= 0:
+            raise ConfigError("IVF_RETRAIN_GROWTH must be > 0")
         if self.coalescer.window_ms < 0:
             raise ConfigError("QUERY_COALESCER_WINDOW_MS must be >= 0")
         if self.coalescer.max_batch < 2:
@@ -644,6 +719,25 @@ class Config:
             raise ConfigError("TENANT_RATE_BURST_S must be > 0")
 
 
+def ivf_from_env(env: Optional[Mapping[str, str]] = None) -> IvfConfig:
+    """Parse the IVF knob surface. Shared by load_config AND the index
+    layer's bare-library fallback (index/tpu.py ivf_settings) — one knob
+    must never read differently with vs without an App (the
+    FUSED_DISPATCH_ENABLED discipline)."""
+    e = dict(os.environ) if env is None else env
+    return IvfConfig(
+        enabled=_bool(e, "IVF_ENABLED"),
+        nlist=_int(e, "IVF_NLIST", 0),
+        top_p=_int(e, "IVF_TOP_P", 0),
+        min_n=_int(e, "IVF_MIN_N", 20000),
+        pca_dim=_int(e, "IVF_PCA_DIM", 0),
+        prefilter_c=_int(e, "IVF_PREFILTER_C", 0),
+        train_sample=_int(e, "IVF_TRAIN_SAMPLE", 65536),
+        train_iters=_int(e, "IVF_TRAIN_ITERS", 6),
+        retrain_growth=_float(e, "IVF_RETRAIN_GROWTH", 0.5),
+    )
+
+
 def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     """LoadConfig twin (environment.go): parse the env surface, validate."""
     e = dict(os.environ) if env is None else dict(env)
@@ -718,6 +812,8 @@ def load_config(env: Optional[Mapping[str, str]] = None) -> Config:
     cfg.device_mesh_shards = _int(e, "TPU_DEVICE_MESH_SHARDS", 0)
     cfg.store_dtype = e.get("TPU_STORE_DTYPE", "float32")
     cfg.fused_dispatch_enabled = _bool(e, "FUSED_DISPATCH_ENABLED", True)
+
+    cfg.ivf = ivf_from_env(e)
 
     cfg.coalescer.enabled = _bool(e, "QUERY_COALESCER_ENABLED")
     cfg.coalescer.window_ms = _float(e, "QUERY_COALESCER_WINDOW_MS", 1.5)

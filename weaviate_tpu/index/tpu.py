@@ -90,8 +90,14 @@ from weaviate_tpu.testing import faults, sanitizers
 # recall-guarded cap — serving/controller.py R_BUCKETS aliases it), and
 # config's env-bool parser so FUSED_DISPATCH_ENABLED reads the same truth
 # table with or without an App
-from weaviate_tpu.config.config import RESCORE_R_BUCKETS
+from weaviate_tpu.config.config import (IVF_TOP_P_BUCKETS, IvfConfig,
+                                        RESCORE_R_BUCKETS, ivf_from_env)
 from weaviate_tpu.config.config import _bool as _env_bool
+# the partition-pruned scan plane (ROADMAP item 3): k-means/PCA training
+# helpers on the write path, probed-bucket search kernels on the read
+# path (ops/ivf.py); every hook below is a one-comparison no-op while
+# IVF_ENABLED is off
+from weaviate_tpu.ops import ivf as ivf_ops
 from weaviate_tpu.ops.topk import (bitmap_to_mask, merge_top_k,
                                    retranslate_packed, translate_pack,
                                    unpack_fused)
@@ -157,6 +163,73 @@ def fused_dispatch_enabled() -> bool:
     return _fused_env
 
 
+# -- IVF scan-plane toggle ----------------------------------------------------
+# Same process-wide override/env-fallback shape as the fused-dispatch
+# toggle above: App applies Config.ivf here at init (token-scoped so a
+# torn-down App reverts only its own setting); bare-library indexes read
+# the IVF_* environment through config's own parser, so one knob can
+# never read differently with vs without an App. Disabled (the default)
+# => ivf_settings() is None and every IVF hook — write-path training,
+# dispatch planning, health — is a one-comparison no-op.
+_ivf_override: Optional[IvfConfig] = None
+_ivf_env: Optional[IvfConfig] = None
+_ivf_token: Optional[object] = None
+
+
+def set_ivf_config(cfg: Optional[IvfConfig]) -> Optional[object]:
+    """Install a process-wide IvfConfig override (App wiring; bench/tests
+    flip it for A/B runs). None reverts to the IVF_* environment default,
+    re-read fresh. Returns a token for unset_ivf_config — the
+    still-ours unconfigure discipline."""
+    global _ivf_override, _ivf_token, _ivf_env
+    _ivf_override = cfg
+    _ivf_token = object() if cfg is not None else None
+    if cfg is None:
+        _ivf_env = None
+    return _ivf_token
+
+
+def unset_ivf_config(token: Optional[object]) -> None:
+    """Revert set_ivf_config's override iff `token` is still current."""
+    global _ivf_override, _ivf_token, _ivf_env
+    if token is not None and token is _ivf_token:
+        _ivf_override = None
+        _ivf_token = None
+        _ivf_env = None
+
+
+def ivf_settings() -> Optional[IvfConfig]:
+    """The active IVF settings, or None when the plane is disabled (the
+    dispatch/write-path gate: one reference read + one bool)."""
+    global _ivf_env
+    s = _ivf_override
+    if s is not None:
+        return s if s.enabled else None
+    if _ivf_env is None:
+        _ivf_env = ivf_from_env()
+    return _ivf_env if _ivf_env.enabled else None
+
+
+def _snap_top_p(v: int) -> int:
+    """Largest IVF_TOP_P_BUCKETS entry <= v (floor snap, min bucket) —
+    the same bounded-jit-shape discipline as the rescore cap. Beyond the
+    ladder's top (large-nlist layouts legitimately probe hundreds of
+    partitions) the snap continues on pow2 steps: still one static
+    value per octave, so the jit cache stays bounded and a big layout's
+    probe width is never silently collapsed to 128."""
+    top = IVF_TOP_P_BUCKETS[-1]
+    if v > top:
+        p = top
+        while p * 2 <= v:
+            p *= 2
+        return int(p)
+    best = IVF_TOP_P_BUCKETS[0]
+    for b in IVF_TOP_P_BUCKETS:
+        if b <= v:
+            best = b
+    return int(best)
+
+
 def _bucket_b(b: int) -> int:
     for s in _B_BUCKETS:
         if b <= s:
@@ -201,6 +274,24 @@ def _write_doc_pairs(s2d, idx, pairs):
     every write kernel: non-donating, so snapshots pinning the previous
     table generation can never tear."""
     return s2d.at[idx].set(pairs, mode="drop")
+
+
+@jax.jit
+def _scatter_rows(arr, idx, rows):
+    """Scatter padded row runs into a [capacity, d] device table (the
+    IVF plane's low-dim PCA rows); idx padded with an out-of-range
+    sentinel, mode="drop" ignores the padding. Non-donating like every
+    write kernel — snapshots may pin the previous generation."""
+    return arr.at[idx].set(rows, mode="drop")
+
+
+@jax.jit
+def _scatter_bucket(buckets, parts, cols, slots):
+    """Scatter freshly-assigned slots into their partitions' free bucket
+    columns — the O(batch) incremental bucket update (parts padded with
+    an out-of-range id, mode="drop"). Non-donating: snapshots pinning
+    the previous bucket generation can never tear."""
+    return buckets.at[parts, cols].set(slots, mode="drop")
 
 
 # unwritten-slot sentinel: both 32-bit words set, so a (bugged) gather of
@@ -1109,7 +1200,9 @@ class IndexSnapshot:
     __slots__ = ("gen", "dim", "capacity", "n", "live", "store", "sq_norms",
                  "tombs", "slot_to_doc", "slot_to_doc_dev", "host_tombs",
                  "allow_token", "compressed", "pq", "codes", "recon_norms",
-                 "rescore_dev", "rescore_sq_norms", "host_vecs")
+                 "rescore_dev", "rescore_sq_norms", "host_vecs",
+                 "ivf_centroids", "ivf_buckets", "ivf_pca_proj",
+                 "ivf_pca_rows", "ivf_meta")
 
     def __init__(self, gen: int, idx: "TpuVectorIndex"):
         self.gen = gen
@@ -1131,6 +1224,16 @@ class IndexSnapshot:
         self.rescore_dev = idx._rescore_dev
         self.rescore_sq_norms = idx._rescore_sq_norms
         self.host_vecs = idx._host_vecs
+        # the IVF scan plane's device slabs ride the snapshot exactly
+        # like the store: a recluster/compact replaces the arrays
+        # wholesale (non-donating), so an in-flight dispatch pinning
+        # this snapshot keeps answering from ITS partition layout
+        self.ivf_centroids = idx._ivf_centroids
+        self.ivf_buckets = idx._ivf_buckets
+        self.ivf_pca_proj = idx._ivf_pca_proj
+        self.ivf_pca_rows = idx._ivf_pca_rows
+        # (nlist, cap_p, recluster_gen) — host ints, frozen at publish
+        self.ivf_meta = idx._ivf_meta
 
 
 class TpuVectorIndex(VectorIndex):
@@ -1239,6 +1342,39 @@ class TpuVectorIndex(VectorIndex):
         # generation), so object identity IS the write generation. Strong
         # refs keep ids stable.
         self._blk_cache: dict = {}
+        # -- IVF scan plane (ROADMAP item 3; ops/ivf.py) ----------------
+        # device slabs (None until the write path trains a layout):
+        # centroids [nlist, D] f32, padded partition buckets
+        # [nlist, cap_p] i32 (-1 padding), optional PCA projection
+        # [D, dp] + per-slot low-dim rows [capacity, dp] — all
+        # JGL012-stamped, all replaced wholesale (never donated) so
+        # published snapshots can pin them
+        self._ivf_centroids = None
+        self._ivf_buckets = None
+        self._ivf_pca_proj = None
+        self._ivf_pca_rows = None
+        # host twins: centroid matrix + PCA basis for write-path
+        # assignment, per-slot partition assignment (-1 = unassigned),
+        # per-partition fills for health, layout metadata
+        self._ivf_centroids_host: Optional[np.ndarray] = None
+        self._ivf_pca_host: Optional[np.ndarray] = None
+        self._ivf_assign = np.zeros(0, dtype=np.int32)
+        self._ivf_fills: Optional[np.ndarray] = None
+        self._ivf_meta: Optional[tuple[int, int, int]] = None
+        self._ivf_cap_p: Optional[int] = None
+        # freshly-written (slots, partitions) runs awaiting the O(batch)
+        # incremental bucket fold at the next snapshot publish
+        self._ivf_pending_slots: list[tuple[np.ndarray, np.ndarray]] = []
+        self._ivf_trained_n = 0
+        self._ivf_gen = 0            # recluster generation (health)
+        self._ivf_dirty = False      # buckets stale vs assignments
+        # probe-accounting counters (health / bench probed_fraction),
+        # updated per IVF dispatch under a leaf lock (lock_hierarchy
+        # level 45 — nothing ever nests inside it)
+        self._ivf_lock = sanitizers.register_lock(
+            threading.Lock(), "index.tpu.ivf")
+        self._ivf_stats = {"dispatches": 0, "probed_rows": 0,
+                           "base_rows": 0}
         # host f32 copy of the store (+ its row sq-norms) for the breaker's
         # fallback plane (search_by_vectors_host), built once per snapshot
         # generation — (gen, rows, sq_norms)
@@ -1346,6 +1482,12 @@ class TpuVectorIndex(VectorIndex):
             self._tombs = _grow_1d(self._tombs, cap, False)
             if self._s2d_dev is not None:
                 self._s2d_dev = _grow_pairs(self._s2d_dev, cap)
+            if self._ivf_pca_rows is not None:
+                self._ivf_pca_rows = _grow_store(self._ivf_pca_rows, cap)
+            if self._ivf_assign.size:
+                ia = np.full(cap, -1, np.int32)
+                ia[: self.capacity] = self._ivf_assign[: self.capacity]
+                self._ivf_assign = ia
             s2d = np.full(cap, -1, dtype=np.int64)
             s2d[: self.capacity] = self._slot_to_doc
             self._slot_to_doc = s2d
@@ -1399,6 +1541,7 @@ class TpuVectorIndex(VectorIndex):
             off += take
         if self.compressed:
             self._host_vecs[start : start + count] = rows
+        self._ivf_on_rows_written(rows, start)
         led = memory.get_ledger()
         if led is not None:
             led.note_write_shape(
@@ -1597,6 +1740,7 @@ class TpuVectorIndex(VectorIndex):
             # the top of every search and must stay free on the hot path
             self._update_index_gauges()
         self._maybe_declared_compress()
+        self._maybe_ivf_train()
         if flushed or self._published_gen != self._staged_gen:
             # publication is the LAST step: readers grabbing the new
             # reference must see every staged mutation already applied
@@ -1628,6 +1772,261 @@ class TpuVectorIndex(VectorIndex):
                     "declared pq config is invalid (%s); auto-disabling "
                     "compression for this index", e)
 
+    # -- IVF scan plane: write-path training / layout maintenance ------------
+    # (ROADMAP item 3.) The clustered layout is WRITE-PATH state like the
+    # PQ codebook: k-means trains under the index lock once enough rows
+    # exist, every later row run is assigned to its nearest centroid as
+    # it lands (host matmul over the rows the write already holds — no
+    # device fetch), and the padded partition buckets are rebuilt before
+    # the next snapshot publish so readers always see a layout that
+    # matches the slot space they dispatch on. All of it is a
+    # one-comparison no-op while IVF_ENABLED is off.
+
+    def _ivf_on_rows_written(self, rows: np.ndarray, start: int) -> None:
+        """Assign a freshly-written row run to the trained layout (and
+        mirror its PCA projection onto the device low-dim table). Rides
+        _write_block, so every write path — flush, bulk import, restore,
+        compact rebuild — maintains the layout through one hook."""
+        cent = self._ivf_centroids_host
+        if cent is None:
+            return
+        count = rows.shape[0]
+        assign = ivf_ops.assign_partitions(rows, cent)
+        if self._ivf_assign.shape[0] < self.capacity:
+            ia = np.full(self.capacity, -1, np.int32)
+            ia[: self._ivf_assign.shape[0]] = self._ivf_assign
+            self._ivf_assign = ia
+        self._ivf_assign[start: start + count] = assign
+        if self._ivf_pca_host is not None:
+            self._write_ivf_pca(rows @ self._ivf_pca_host, start)
+        # queue the run for the O(batch) incremental bucket fold at the
+        # next publish (_ivf_apply_pending)
+        self._ivf_pending_slots.append(
+            (np.arange(start, start + count, dtype=np.int32), assign))
+        self._ivf_dirty = True
+
+    def _write_ivf_pca(self, block: np.ndarray, start: int) -> None:
+        """Scatter a [count, dp] PCA row run into the device table,
+        padded to the shared pow2 row buckets (bounded jit shapes)."""
+        if self._ivf_pca_rows is None:
+            return
+        count = block.shape[0]
+        pad = _bucket_rows(count)
+        idx = np.full(pad, self.capacity + 1, dtype=np.int32)
+        idx[:count] = np.arange(start, start + count, dtype=np.int32)
+        rows = np.zeros((pad, block.shape[1]), np.float32)
+        rows[:count] = block
+        self._ivf_pca_rows = _scatter_rows(
+            self._ivf_pca_rows, jnp.asarray(idx), jnp.asarray(rows))
+        self._stamp_memory()
+
+    def _ivf_nlist(self, s: IvfConfig, n: int) -> int:
+        """Partition count for an n-row layout: the configured value, or
+        auto targeting ~256 rows per partition snapped to a pow2 —
+        measured on the CPU A/B, fill-targeted sizing beats the sqrt(n)
+        rule by 2-4x in both probe recall and probed_fraction (finer
+        partitions localize better AND shrink the padded bucket the
+        probe pays for); bounded so no layout averages fewer than ~32
+        rows per partition."""
+        if s.nlist > 0:
+            return max(1, min(s.nlist, max(n // 8, 1)))
+        import math
+
+        # ceil, not round: rounding DOWN doubles the mean fill (and with
+        # it the padded bucket every probe reads). The 4096 ceiling is
+        # the HOST k-means budget: training is a write-lock pause, and
+        # past ~4096 partitions the fit/assignment cost stops being one
+        # (device-side training is the 10M-scale follow-up, ROADMAP
+        # item 3) — beyond it the layout goes coarser, not slower
+        target = 2 ** int(math.ceil(math.log2(max(n / 256.0, 16.0))))
+        return int(max(16, min(target, 4096, max(n // 32, 16))))
+
+    def _ivf_rows_for_training(self) -> np.ndarray:
+        """The occupied store rows, host-side, for k-means/PCA fitting.
+        Under PQ the f32 rows already live host-side (host_vecs); the
+        uncompressed store pays ONE bulk fetch under the write lock —
+        the same stop-the-world cold-path trade as compact/compress
+        (the graftsan baseline carries the mirrored runtime waiver)."""
+        if self.compressed and self._host_vecs is not None:
+            return self._host_vecs[: self.n]
+        return np.asarray(self._store[: self.n]).astype(np.float32, copy=False)  # graftlint: disable=JGL001 recluster is a write-path cold pass like compress: the k-means fit runs host-side, so the store must materialize once under the lock that covers the layout swap
+
+    def _maybe_ivf_train(self) -> None:
+        """Declarative training/recluster trigger (the write-path twin of
+        _maybe_declared_compress): train once min_n rows exist, retrain
+        once n outgrows the trained layout by retrain_growth. One
+        comparison while IVF is disabled."""
+        s = ivf_settings()
+        if s is None or self._restoring or self.dim is None:
+            return
+        if self.metric not in ivf_ops.MATMUL_METRICS:
+            return
+        if self.n < max(s.min_n, 256):
+            return
+        if self._ivf_centroids is not None and \
+                self.n < self._ivf_trained_n * (1.0 + s.retrain_growth):
+            return
+        self._ivf_train_locked(s)
+
+    def _ivf_train_locked(self, s: IvfConfig) -> None:
+        """Train (or re-train) the clustered layout: k-means centroids,
+        full partition assignment, optional PCA basis + low-dim rows,
+        padded buckets — then a fresh snapshot publishes it. Runs under
+        the index write lock (callers hold it); a recluster replaces
+        every IVF array wholesale, so snapshots pinned by in-flight
+        dispatches keep their old layout (the COW discipline)."""
+        t0 = time.perf_counter()
+        n = self.n
+        rows = self._ivf_rows_for_training()
+        nlist = self._ivf_nlist(s, n)
+        # sample floors at 16 rows per centroid: capping at train_sample
+        # alone would degenerate a large-nlist fit to ~one row per
+        # cluster (the layout would be the sample, not a clustering)
+        cent = ivf_ops.kmeans_fit(
+            rows, nlist, iters=s.train_iters, seed=self._ivf_gen,
+            sample=min(len(rows), max(s.train_sample, nlist * 16)))
+        if self.metric == vi.DISTANCE_COSINE:
+            nrm = np.linalg.norm(cent, axis=1, keepdims=True)
+            nrm[nrm == 0] = 1.0
+            cent = cent / nrm
+        # capacity-bounded buckets (ops/ivf.balanced_assign): the padded
+        # width is pinned by the MEAN fill with 25% slack — pow2-snapped
+        # — instead of by the worst cluster, so skewed data cannot make
+        # every probe pay a worst-case-sized bucket read; overfull
+        # partitions spill their farthest rows to the nearest centroid
+        # with space
+        cap_t = ivf_ops.bucket_capacity(
+            np.array([int(1.25 * n / nlist) + 1]))
+        assign = np.full(self.capacity, -1, np.int32)
+        assign[:n] = ivf_ops.balanced_assign(rows, cent, cap_t)
+        self._ivf_cap_p = cap_t
+        self._ivf_centroids_host = cent
+        self._ivf_assign = assign
+        self._ivf_centroids = jax.device_put(jnp.asarray(cent), self.device)
+        dp = int(s.pca_dim)
+        if 0 < dp < self.dim:
+            # a RANDOM sample, like the k-means fit — a prefix slice
+            # would bias the basis to insertion-ordered data (early
+            # tenants/domains) and silently misrank later rows
+            psamp = min(len(rows), max(s.train_sample, 4096))
+            if psamp < len(rows):
+                pick = np.random.default_rng(self._ivf_gen).choice(
+                    len(rows), size=psamp, replace=False)
+                proj = ivf_ops.pca_fit(rows[pick], dp)
+            else:
+                proj = ivf_ops.pca_fit(rows, dp)
+            self._ivf_pca_host = proj
+            self._ivf_pca_proj = jax.device_put(
+                jnp.asarray(proj), self.device)
+            pr = np.zeros((self.capacity, dp), np.float32)
+            pr[:n] = rows @ proj
+            self._ivf_pca_rows = jax.device_put(jnp.asarray(pr), self.device)
+        else:
+            self._ivf_pca_host = None
+            self._ivf_pca_proj = None
+            self._ivf_pca_rows = None
+        self._ivf_trained_n = n
+        self._ivf_gen += 1
+        self._ivf_rebuild_buckets()  # keeps the balanced cap_t padding
+        self._staged_gen += 1
+        self._mark_staged()
+        self._stamp_memory()
+        ms = (time.perf_counter() - t0) * 1000.0
+        led = memory.get_ledger()
+        if led is not None:
+            led.note_write("ivf", "recluster", ms, rows=n)
+        incidents.emit("write_phase", scope="ivf_recluster", rows=n,
+                       nlist=nlist, ms=round(ms, 1))
+
+    def _ivf_apply_pending(self) -> None:
+        """Fold freshly-written slots into the padded buckets: an
+        O(batch) device scatter into each bucket's free columns (fills
+        tracked host-side), so a small write's flush cost stays O(batch)
+        like the flat write path — the full O(n log n) rebuild + whole-
+        table upload runs only when a bucket overflows its padding
+        (which widens it) or after a retrain."""
+        pend, self._ivf_pending_slots = self._ivf_pending_slots, []
+        if self._ivf_buckets is None or self._ivf_fills is None or not pend:
+            self._ivf_rebuild_buckets()
+            return
+        slots = np.concatenate([s for s, _ in pend])
+        parts = np.concatenate([p for _, p in pend])
+        nlist = self._ivf_fills.shape[0]
+        counts = np.bincount(parts, minlength=nlist)
+        if bool((self._ivf_fills + counts > self._ivf_cap_p).any()):
+            self._ivf_rebuild_buckets()
+            return
+        order = np.argsort(parts, kind="stable")
+        sp, ss = parts[order], slots[order]
+        starts = np.zeros(nlist + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+        cols = (np.arange(sp.size, dtype=np.int64) - starts[sp]
+                + self._ivf_fills[sp]).astype(np.int32)
+        pad = _bucket_rows(sp.size)
+        pi = np.full(pad, nlist + 1, np.int32)  # out of range: dropped
+        ci = np.zeros(pad, np.int32)
+        si = np.full(pad, -1, np.int32)
+        pi[: sp.size] = sp
+        ci[: sp.size] = cols
+        si[: sp.size] = ss
+        self._ivf_buckets = _scatter_bucket(
+            self._ivf_buckets, jnp.asarray(pi), jnp.asarray(ci),
+            jnp.asarray(si))
+        self._ivf_fills = self._ivf_fills + counts
+        self._ivf_dirty = False
+        self._stamp_memory()
+
+    def _ivf_rebuild_buckets(self) -> None:
+        """Rebuild the padded partition buckets from the host assignment
+        (one vectorized bucket sort + one device upload). The padding
+        width cap_p is KEPT while every bucket still fits — the
+        jit-shape stability contract: a handful of inserts re-uploads
+        the [nlist, cap_p] table but never re-compiles the search — and
+        pow2-widens only on overflow."""
+        cent = self._ivf_centroids_host
+        if cent is None:
+            return
+        nlist = cent.shape[0]
+        buckets, fills = ivf_ops.build_buckets(
+            self._ivf_assign, nlist, self._ivf_cap_p)
+        self._ivf_cap_p = int(buckets.shape[1])
+        self._ivf_fills = fills
+        self._ivf_buckets = jax.device_put(jnp.asarray(buckets), self.device)
+        self._ivf_meta = (nlist, self._ivf_cap_p, self._ivf_gen)
+        self._ivf_pending_slots = []  # the rebuild covered them
+        self._ivf_dirty = False
+        self._stamp_memory()
+
+    def _ivf_reset(self) -> None:
+        """Drop the whole IVF layout (compact's rebuild and drop() call
+        this before wiping the slot space the assignments index)."""
+        self._ivf_centroids = None
+        self._ivf_buckets = None
+        self._ivf_pca_proj = None
+        self._ivf_pca_rows = None
+        self._ivf_centroids_host = None
+        self._ivf_pca_host = None
+        self._ivf_assign = np.zeros(0, dtype=np.int32)
+        self._ivf_fills = None
+        self._ivf_meta = None
+        self._ivf_cap_p = None
+        self._ivf_pending_slots = []
+        self._ivf_trained_n = 0
+        self._ivf_dirty = False
+
+    def ivf_stats(self) -> dict:
+        """Cumulative probe accounting (bench probed_fraction rows and
+        the health() block): dispatches served by the IVF plane, rows
+        the probes actually scanned (top_p x cap_p, padding included —
+        the honest device-work count), and the flat-scan rows each
+        dispatch WOULD have scanned."""
+        with self._ivf_lock:
+            st = dict(self._ivf_stats)
+        st["probed_fraction"] = round(
+            st["probed_rows"] / st["base_rows"], 4) if st["base_rows"] \
+            else None
+        return st
+
     # -- memory ledger stamping (monitoring/memory.py) -----------------------
 
     def _memory_components(self) -> dict:
@@ -1643,7 +2042,11 @@ class TpuVectorIndex(VectorIndex):
                           ("pq_codes", self._codes),
                           ("recon_norms", self._recon_norms),
                           ("rescore_store", self._rescore_dev),
-                          ("rescore_sq_norms", self._rescore_sq_norms)):
+                          ("rescore_sq_norms", self._rescore_sq_norms),
+                          ("ivf_centroids", self._ivf_centroids),
+                          ("ivf_buckets", self._ivf_buckets),
+                          ("ivf_pca_proj", self._ivf_pca_proj),
+                          ("ivf_pca_rows", self._ivf_pca_rows)):
             b = memory.array_bytes(arr)
             if b:
                 comps[name] = b
@@ -1668,15 +2071,22 @@ class TpuVectorIndex(VectorIndex):
         """Device bytes transiently DOUBLED by one non-donating write
         pass: the replaced buffer generations stay alive (pinned by
         snapshots / the functional update) while the new ones build."""
+        # every IVF slab is functionally replaced by its write/fold
+        # kernel (pca scatter, bucket fold) or wholesale on recluster —
+        # the old generation stays snapshot-pinned while the new builds
+        ivf = (memory.array_bytes(self._ivf_pca_rows)
+               + memory.array_bytes(self._ivf_buckets)
+               + memory.array_bytes(self._ivf_centroids)
+               + memory.array_bytes(self._ivf_pca_proj))
         if self.compressed:
             return (memory.array_bytes(self._codes)
                     + memory.array_bytes(self._recon_norms)
                     + memory.array_bytes(self._rescore_dev)
                     + memory.array_bytes(self._rescore_sq_norms)
-                    + memory.array_bytes(self._s2d_dev))
+                    + memory.array_bytes(self._s2d_dev) + ivf)
         return (memory.array_bytes(self._store)
                 + memory.array_bytes(self._sq_norms)
-                + memory.array_bytes(self._s2d_dev))
+                + memory.array_bytes(self._s2d_dev) + ivf)
 
     # -- snapshot publication / lock-free reads ------------------------------
 
@@ -1685,6 +2095,12 @@ class TpuVectorIndex(VectorIndex):
         (one reference swap — callers hold self._lock). Always the LAST
         step of a mutation: a reader that grabs the new reference sees a
         fully applied write."""
+        if self._ivf_dirty:
+            # partition assignments changed since the last bucket build:
+            # the buckets a snapshot carries must describe exactly the
+            # slot space its other arrays hold (the staged-generation
+            # handshake, extended to the partition table)
+            self._ivf_apply_pending()
         self._snap_gen += 1
         self._snap = IndexSnapshot(self._snap_gen, self)
         self._published_gen = self._staged_gen
@@ -1902,6 +2318,7 @@ class TpuVectorIndex(VectorIndex):
                     rows=count, bytes_moved=count * self.dim * 4)
             self._update_index_gauges()
             self._maybe_declared_compress()
+            self._maybe_ivf_train()
             self._publish_snapshot()
 
     def delete(self, *doc_ids: int) -> None:
@@ -2264,6 +2681,15 @@ class TpuVectorIndex(VectorIndex):
                     bytes_per_row=snap.dim * 4, k=int(k_eff))
             fin = self._dispatch_small_allow(snap, q, b, k_eff, allow_list,
                                              shape, s2d)
+        elif (ivf_plan := self._ivf_plan(snap, k_eff)) is not None:
+            # partition-pruned path (ROADMAP item 3): scan only the
+            # probed buckets; large allowLists compose via the same
+            # packed words, small ones took the gather tier above
+            if t_enq0:
+                shape = self._ivf_shape(snap, ivf_plan, b, q.shape[0],
+                                        k_eff)
+            fin = self._dispatch_ivf(snap, q, b, k_eff, allow_list,
+                                     ivf_plan, shape, s2d)
         elif snap.compressed:
             if t_enq0:
                 rescore = (self.config.pq.rescore
@@ -2389,6 +2815,158 @@ class TpuVectorIndex(VectorIndex):
                 return costmodel.TIER_PQ_RESCORE
             return costmodel.TIER_PQ_CODES
         return costmodel.TIER_EXACT
+
+    # -- IVF scan plane: dispatch half ---------------------------------------
+
+    def _ivf_plan(self, snap: IndexSnapshot,
+                  k: int) -> Optional[tuple[int, int]]:
+        """(top_p, prefilter_c) for an IVF dispatch on `snap`, or None to
+        take the flat path. None whenever the plane is disabled, the
+        snapshot carries no trained layout, or the metric has no
+        matmul/rescore form — the first two checks are one comparison
+        each (the zero-hop contract). The effective probe count is the
+        configured value capped by the controller's recall-guarded
+        budget (serving/controller.py ivf_top_p_cap) and snapped to the
+        bounded IVF_TOP_P_BUCKETS ladder (or to nlist exactly when the
+        request covers every partition), so top_p — a jit static — can
+        only take bounded values."""
+        if snap.ivf_buckets is None:
+            return None
+        s = ivf_settings()
+        if s is None:
+            return None
+        if self.metric not in ivf_ops.MATMUL_METRICS:
+            return None
+        nlist, cap_p, _gen = snap.ivf_meta
+        req = s.top_p if s.top_p > 0 else max(1, nlist // 16)
+        req = min(req, nlist)
+        eff = max(1, min(req, controller.ivf_top_p_cap(req)))
+        if eff < nlist:
+            eff = min(_snap_top_p(eff), nlist)
+        # deep-k coverage: a probe set under ~4k candidates starves the
+        # final selection (the flat fast-scan's slack rationale) — widen
+        # up the ladder before dispatching; neither the config nor the
+        # controller cap may shrink a query below its own k
+        while eff < nlist and eff * cap_p < 4 * k:
+            nxt = _snap_top_p(min(eff * 2, nlist))
+            eff = nlist if nxt <= eff else nxt
+        pre_c = 0
+        if snap.ivf_pca_proj is not None:
+            r = eff * cap_p
+            # auto: 8k floor for selection quality, r/8 cut, capped at
+            # 2048 — past that the full-dim pass stops being the
+            # bottleneck the prefilter exists to shrink
+            pc = s.prefilter_c if s.prefilter_c > 0 \
+                else max(8 * k, min(2048, r // 8))
+            pc = _bucket_rows(min(pc, r))  # pow2: bounded jit shapes
+            if pc < r:
+                pre_c = pc
+        return (eff, pre_c)
+
+    def _ivf_shape(self, snap: IndexSnapshot, plan: tuple[int, int],
+                   b: int, padded: int, k_eff: int):
+        """The probed-aware costmodel shape of an IVF dispatch: `n` is
+        the rows the device actually reads (top_p x cap_p candidates,
+        padding included, plus the nlist centroid rows), so flops/bytes
+        — and every roofline derived from them — never credit the rows
+        the probe skipped (no phantom work)."""
+        top_p, _pre_c = plan
+        nlist, cap_p, _gen = snap.ivf_meta
+        probed = top_p * cap_p + nlist
+        rescore = (snap.compressed and self.config.pq.rescore
+                   and snap.rescore_dev is not None)
+        if not snap.compressed:
+            tier = costmodel.TIER_EXACT
+            bpr = snap.dim * snap.store.dtype.itemsize
+        elif rescore:
+            tier = costmodel.TIER_PQ_RESCORE
+            bpr = 2 * snap.dim
+        else:
+            tier = costmodel.TIER_PQ_CODES
+            bpr = snap.pq.segments
+        return costmodel.DispatchShape(
+            tier, n=probed, dim=snap.dim, batch=b, batch_padded=padded,
+            bytes_per_row=bpr, k=int(k_eff),
+            extra={"ivf": True, "ivf_top_p": top_p, "ivf_nlist": nlist,
+                   "probed_fraction": round(
+                       min(probed / max(snap.n, 1), 1.0), 4)})
+
+    def _dispatch_ivf(self, snap: IndexSnapshot, q: np.ndarray, b: int,
+                      k: int, allow_list, plan: tuple[int, int],
+                      shape=None, s2d=None):
+        """Partition-pruned search: probe the centroids, score only the
+        probed buckets (ops/ivf.py), finish through the SAME packed /
+        fused-translate epilogue as every flat tier. Covers the exact,
+        PQ-rescore, and PQ-codes tiers; tombstones and allowLists mask
+        with identical semantics to the flat kernels (the snapshot's own
+        device tombs, the same packed filter words)."""
+        top_p, pre_c = plan
+        nlist, cap_p, _gen = snap.ivf_meta
+        allow_words = (self._allow_words(snap, allow_list)
+                       if allow_list is not None else None)
+        use_allow = allow_words is not None
+        words = (allow_words if use_allow
+                 else jnp.zeros((snap.capacity // 32,), jnp.uint32))
+        exact = getattr(self.config, "exact_topk", False)
+        kk = min(max(k, 1), top_p * cap_p)
+        gp = ivf_ops.group_steps(q.shape[0], cap_p, snap.dim, top_p)
+        # second-stage chunking (prefilter survivors): pow2 steps so the
+        # full-dim gather stays within the same element budget
+        steps2 = 1
+        if pre_c:
+            while steps2 < pre_c and \
+                    (q.shape[0] * (pre_c // steps2) * snap.dim) > (1 << 21):
+                steps2 *= 2
+        rescore = (snap.compressed and self.config.pq.rescore
+                   and snap.rescore_dev is not None)
+        statics = (kk, self.metric, use_allow, top_p, pre_c, exact, gp,
+                   steps2)
+        if not snap.compressed or rescore:
+            store = snap.store if not snap.compressed else snap.rescore_dev
+            args = (store, snap.tombs, snap.n, jnp.asarray(q), words,
+                    snap.ivf_centroids, snap.ivf_buckets,
+                    snap.ivf_pca_proj, snap.ivf_pca_rows)
+            if s2d is not None:
+                packed_dev = ivf_ops.search_ivf_dense_fused(
+                    *args, s2d, *statics)
+            else:
+                packed_dev = ivf_ops.search_ivf_dense(*args, *statics)
+        else:
+            args = (snap.codes, snap.recon_norms, snap.tombs, snap.n,
+                    jnp.asarray(q), words, snap.pq._dev_codebook(),
+                    snap.ivf_centroids, snap.ivf_buckets,
+                    snap.ivf_pca_proj, snap.ivf_pca_rows,
+                    snap.pq.rotation_dev())
+            if s2d is not None:
+                packed_dev = ivf_ops.search_ivf_codes_fused(
+                    *args, s2d, *statics)
+            else:
+                packed_dev = ivf_ops.search_ivf_codes(*args, *statics)
+        # probe accounting (health / bench probed_fraction): a leaf lock,
+        # three integer adds — nothing nests inside it
+        with self._ivf_lock:
+            st = self._ivf_stats
+            st["dispatches"] += 1
+            st["probed_rows"] += top_p * cap_p
+            st["base_rows"] += int(snap.n)
+        if s2d is not None:
+            return self._finalize_fused(packed_dev, shape, b)
+        slot_to_doc = snap.slot_to_doc
+
+        def finalize():
+            # the ONE blocking fetch of the legacy (non-fused) IVF
+            # dispatch, outside any lock
+            packed = _fetch_packed(packed_dev, shape)
+            top, idx = _unpack(packed)
+            top = top[:b]
+            idx = idx[:b]
+            t0 = time.perf_counter() if shape is not None else 0.0
+            ids = np.where(idx >= 0, slot_to_doc[np.clip(idx, 0, None)], -1)
+            if shape is not None:
+                shape.translate_ms = (time.perf_counter() - t0) * 1000.0
+            return ids.astype(np.uint64), top.astype(np.float32)
+
+        return finalize
 
     def _dispatch_scan(self, snap: IndexSnapshot, q: np.ndarray, b: int,
                        k_eff: int, allow_words, store=None, sq_norms=None,
@@ -2857,6 +3435,51 @@ class TpuVectorIndex(VectorIndex):
         ids = np.where(np.isinf(top), -1, snap.slot_to_doc[idx])
         return ids.astype(np.uint64), top.astype(np.float32)
 
+    def _ivf_health(self) -> dict:
+        """The health() block for the IVF scan plane: partition count,
+        bucket fill / padding-waste histogram, imbalance factor, last
+        recluster generation, probe accounting. Lock-free racy reads
+        like the rest of health()."""
+        s = ivf_settings()
+        cent = self._ivf_centroids_host
+        out = {"enabled": s is not None, "trained": cent is not None}
+        if cent is None:
+            return out
+        meta = self._ivf_meta or (cent.shape[0], self._ivf_cap_p or 0,
+                                  self._ivf_gen)
+        nlist, cap_p, gen = meta
+        out.update({
+            "nlist": int(nlist),
+            "bucket_capacity": int(cap_p),
+            "trained_n": int(self._ivf_trained_n),
+            "last_recluster_gen": int(gen),
+            "pca_dim": (int(self._ivf_pca_host.shape[1])
+                        if self._ivf_pca_host is not None else 0),
+        })
+        fills = self._ivf_fills
+        if fills is not None and fills.size and cap_p:
+            total = int(fills.sum())
+            mean = total / max(int(nlist), 1)
+            out["buckets"] = {
+                "fill_min": int(fills.min()),
+                "fill_mean": round(mean, 1),
+                "fill_max": int(fills.max()),
+                "empty": int((fills == 0).sum()),
+                # fraction of the padded [nlist, cap_p] table holding
+                # sentinel rows the probes still read — the price of
+                # jit-stable shapes, and the first thing to check when
+                # probed_fraction looks too high for the recall it buys
+                "padding_waste": round(1.0 - total / (nlist * cap_p), 4),
+                "imbalance": (round(float(fills.max()) / mean, 2)
+                              if mean > 0 else None),
+                # 8 equal-width fill bins over [0, cap_p] — the skew
+                # shape at a glance
+                "fill_histogram": np.histogram(
+                    fills, bins=8, range=(0, cap_p))[0].tolist(),
+            }
+        out["probes"] = self.ivf_stats()
+        return out
+
     def health(self) -> dict:
         """Per-index introspection for ``GET /debug/index`` (server/
         rest.py): live/tombstone accounting, snapshot + staged generation
@@ -2887,6 +3510,10 @@ class TpuVectorIndex(VectorIndex):
             "staged_lag": max(self._staged_gen - self._published_gen, 0),
             "compressed": self.compressed,
             "pq": None,
+            # the IVF partition layout's health: a skewed or
+            # padding-wasteful layout is visible HERE before it costs
+            # recall or HBM (the /debug/index satellite)
+            "ivf": self._ivf_health(),
             # a resident copy is a full f32 store materialization held for
             # the breaker's fallback plane (or a recent degraded window);
             # bytes come from the ledger's shared sizing helper so this
@@ -3034,6 +3661,10 @@ class TpuVectorIndex(VectorIndex):
             self._doc_to_slot.clear()
             self._store = self._sq_norms = self._tombs = None
             self._s2d_dev = None
+            # the partition layout indexes the OLD slot space — drop it
+            # wholesale; the post-rebuild retrain below is the
+            # "recluster on compact" half of the IVF lifecycle
+            self._ivf_reset()
             self._slot_to_doc = np.zeros(0, dtype=np.int64)
             self._host_tombs = np.zeros(0, dtype=bool)
             # suppress the declarative compress trigger for the rebuild:
@@ -3053,6 +3684,12 @@ class TpuVectorIndex(VectorIndex):
             if was_compressed and self.n > 0:
                 fresh = np.asarray(self._store[: self.n], dtype=np.float32)  # graftlint: disable=JGL008 compact is a stop-the-world rebuild: the lock must cover it and the materialized store IS the rebuild's input
                 self._enable_pq(pq, fresh, save=False)
+            # recluster on the compacted slot space (fresh k-means — the
+            # densified layout is a different distribution than the
+            # tombstone-riddled one); publish so readers see it
+            self._maybe_ivf_train()
+            if self._published_gen != self._staged_gen:
+                self._publish_snapshot()
             led = memory.get_ledger()
             if led is not None:
                 led.note_write(
@@ -3074,6 +3711,7 @@ class TpuVectorIndex(VectorIndex):
                 self._log = None
             self._store = self._sq_norms = self._tombs = None
             self._s2d_dev = None
+            self._ivf_reset()
             self.dim = None
             self.capacity = 0
             self.n = 0

@@ -93,7 +93,13 @@ class DispatchShape:
     Analytic fields (set at construction):
       tier           one of the TIER_* constants
       n              rows the dispatch scans (live rows; the allowList size
-                     on the gather tier; n_pad on the BM25 matmul)
+                     on the gather tier; n_pad on the BM25 matmul; on an
+                     IVF partition-pruned dispatch the PROBED rows —
+                     top_p x bucket capacity, plus the nlist centroid
+                     rows — so flops()/bytes() are probed-aware and the
+                     roofline never reports the phantom work of the rows
+                     the probe skipped; ``extra`` then carries
+                     {"ivf": True, "probed_fraction": probed/N})
       dim            vector dims (effective units for BM25)
       batch          ACTUAL query rows (useful work — padding is reported
                      separately, never smeared; the PR-3 convention)

@@ -75,10 +75,19 @@ _LOG = logging.getLogger(__name__)
 # never mints a new series)
 DEVICE_COMPONENTS = ("store", "sq_norms", "tombs", "slot_to_doc",
                      "pq_codes", "recon_norms", "rescore_store",
-                     "rescore_sq_norms", "allow_words")
+                     "rescore_sq_norms", "allow_words",
+                     # the IVF scan plane's slabs (index/tpu.py +
+                     # ops/ivf.py): k-means centroids, padded partition
+                     # buckets, PCA projection + per-slot low-dim rows
+                     "ivf_centroids", "ivf_buckets", "ivf_pca_proj",
+                     "ivf_pca_rows")
 HOST_COMPONENTS = ("slot_to_doc", "host_tombs", "host_vecs",
                    "pending_rows", "breaker_rows", "auditor_rows",
-                   "allow_cache", "stage_buffers")
+                   "allow_cache", "stage_buffers",
+                   # the IVF plane's host twins: centroid matrix + PCA
+                   # basis (write-path assignment) + per-slot partition
+                   # assignment mirror
+                   "ivf_host")
 DISK_COMPONENTS = ("used", "free", "incident_bundles")
 OTHER = "other"
 SCOPES = ("device", "host", "disk")
@@ -226,6 +235,17 @@ def index_host_components(vidx) -> dict:
     hr = host_rows_cache_bytes(vidx)
     if hr:
         out["breaker_rows"] = hr
+    # IVF host twins (index/tpu.py): the centroid matrix + PCA basis the
+    # write path assigns against, and the per-slot assignment mirror —
+    # tens of MB at scale, and the ledger must see them like every
+    # other host mirror
+    ivf = 0
+    for attr in ("_ivf_centroids_host", "_ivf_pca_host", "_ivf_assign"):
+        arr = getattr(vidx, attr, None)
+        if arr is not None:
+            ivf += int(arr.nbytes)
+    if ivf:
+        out["ivf_host"] = ivf
     # parked query-staging buffers (the fused-dispatch enqueue pool):
     # racy len-free iteration over a dict-of-lists snapshot — sizes only
     stage = getattr(vidx, "_stage_free", None)

@@ -1,0 +1,532 @@
+"""Partition-pruned (IVF) scan plane: clustered layout + probed search.
+
+ROADMAP item 3 (the KScaNN/KBest recipe, PAPERS.md): a flat scan is O(N)
+per dispatch no matter how fused the program is — at production corpus
+sizes the headroom the fused dispatch (PR 14) won back burns on rows the
+query never needed. This module holds the IVF plane's two halves:
+
+HOST (write path, under the index write lock):
+  - ``kmeans_fit``: Lloyd's k-means over a bounded training sample ->
+    [nlist, D] f32 centroids (cosine metrics get row-normalized
+    centroids so the probe ranks by angle);
+  - ``assign_partitions``: nearest-centroid assignment of every row,
+    chunked so the [chunk, nlist] distance block stays bounded;
+  - ``pca_fit``: top-``dp`` eigenvectors of the sample covariance — the
+    pHNSW-style low-dimensional prefilter projection;
+  - ``build_buckets``: partition assignments -> PADDED partition buckets
+    [nlist, cap_p] int32 (cap_p snapped to the shared pow2 row buckets,
+    padding = -1), so jit shapes stay CACHED across inserts until a
+    bucket overflows its padding.
+
+DEVICE (read path, one program per dispatch — traced together with the
+shared epilogue so IVF composes with the fused dispatch instead of
+forking it):
+  - ``probe``: one [B, nlist] centroid distance block + exact top_p
+    selection -> the probed partitions per query;
+  - ``search_ivf_dense`` / ``search_ivf_codes``: gather the probed
+    buckets' slots, mask validity exactly like the flat kernels
+    (capacity padding, tombstones via the snapshot's own device mask,
+    allowList via the SAME packed words the flat kernels consume), an
+    optional PCA low-dim prefilter pass, then full-fidelity scoring of
+    the survivors through the shared rescore core
+    (ops/topk.rescore_distances) and the shared top-k/slot->doc
+    epilogue (merge_top_k / pack_topk / translate_pack). ``*_fused``
+    twins emit the fused packed layout with final doc ids, exactly like
+    every other tier's kernel.
+
+Candidate memory is bounded: probed buckets are scored in groups of
+``gp`` probes per lax.scan step (the caller sizes gp so one step's
+[B, gp*cap_p, D] gather stays VMEM/host-cache friendly), with the
+running top-k merged exactly across steps — the same
+collect-then-merge discipline as the flat chunked scans.
+
+Every kernel here is shape-static in (top_p, cap_p, pre_c, gp, k): the
+probe count comes from the bounded IVF_TOP_P_BUCKETS ladder (config —
+the controller's second recall-guarded budget steps down the same
+ladder), cap_p from the pow2 bucket padding, so the jit cache stays as
+bounded as the flat path's.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.entities import vectorindex as vi
+from weaviate_tpu.ops.topk import (merge_top_k, pack_topk,
+                                   rescore_distances, retranslate_packed)
+
+Array = jax.Array
+
+INF = float("inf")
+
+# metrics the IVF plane serves: the probe and the candidate rescore are
+# both built on the matmul/elementwise distance forms — manhattan and
+# hamming keep the flat streamed scan (they are also the metrics the PQ
+# plane already excludes)
+MATMUL_METRICS = (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE)
+
+# rows per assignment chunk: bounds the [chunk, nlist] host distance block
+_ASSIGN_CHUNK = 65536
+
+
+# -- host half: training / assignment / layout --------------------------------
+
+
+def _kpp_init(rows: np.ndarray, nlist: int, rng) -> np.ndarray:
+    """k-means++ seeding (D^2 sampling): spreads the initial centroids
+    over the data's density, which keeps partition fills far more even
+    than uniform seeding — and even fills are what bound the padded
+    bucket width the probe pays for."""
+    n = rows.shape[0]
+    cent = np.empty((nlist, rows.shape[1]), np.float32)
+    cent[0] = rows[int(rng.integers(n))]
+    d2 = ((rows - cent[0]) ** 2).sum(1)
+    for i in range(1, nlist):
+        total = float(d2.sum())
+        if total <= 0:
+            cent[i:] = rows[rng.choice(n, size=nlist - i)]
+            break
+        cent[i] = rows[int(rng.choice(n, p=d2 / total))]
+        d2 = np.minimum(d2, ((rows - cent[i]) ** 2).sum(1))
+    return cent
+
+
+def kmeans_fit(rows: np.ndarray, nlist: int, iters: int = 6,
+               seed: int = 0, sample: int = 0) -> np.ndarray:
+    """Lloyd's k-means on (a sample of) ``rows`` -> [nlist, D] f32
+    centroids, k-means++ seeded. Deterministic for a given seed; empty
+    clusters are re-seeded from the rows farthest from their centroid so
+    a skewed init cannot strand partitions at zero fill. Cosine callers
+    should pass normalized rows (the index stores them normalized) — the
+    centroids are re-normalized by the caller for the angular probe."""
+    rows = np.asarray(rows, np.float32)
+    n = rows.shape[0]
+    nlist = max(1, min(int(nlist), n))
+    rng = np.random.default_rng(seed)
+    if sample and n > sample:
+        rows = rows[rng.choice(n, size=sample, replace=False)]
+        n = rows.shape[0]
+    if nlist <= 1024:
+        cent = _kpp_init(rows, nlist, rng)
+    else:
+        # k-means++ is one vectorized pass PER centroid — past ~1024
+        # centroids that is minutes of write-lock stall for a seeding
+        # refinement Lloyd largely recovers anyway; big layouts seed
+        # from distinct random rows (one vectorized draw)
+        cent = rows[rng.choice(n, size=nlist, replace=False)].copy()
+    for _ in range(max(1, int(iters))):
+        assign = assign_partitions(rows, cent)
+        counts = np.bincount(assign, minlength=nlist)
+        sums = np.zeros_like(cent, dtype=np.float64)  # graftlint: disable=JGL006 host-side numpy accumulation at fit time: f64 partial sums avoid centroid drift over big clusters and never touch the device (the pq.py fit discipline)
+        np.add.at(sums, assign, rows)
+        nonzero = counts > 0
+        cent[nonzero] = (sums[nonzero]
+                         / counts[nonzero, None]).astype(np.float32)
+        empty = np.flatnonzero(~nonzero)
+        if empty.size:
+            # re-seed each empty cluster from the globally worst-fit rows
+            d = rows - cent[assign]
+            far = np.argsort(-np.einsum("ij,ij->i", d, d))[: empty.size]
+            cent[empty] = rows[far]
+    return cent
+
+
+def assign_partitions(rows: np.ndarray, centroids: np.ndarray,
+                      chunk: int = 0) -> np.ndarray:
+    """Nearest-centroid (L2) partition of every row -> int32 [n]. L2
+    assignment is the standard IVF layout for every matmul metric
+    (cosine rows are insert-normalized, so L2 argmin == angular argmax;
+    dot follows the FAISS convention of an L2-built coarse layout).
+    chunk=0 sizes the [chunk, nlist] distance block to ~64 MB — scaled
+    DOWN with nlist, so a 4096-partition recluster never holds a
+    multi-GB transient under the index write lock."""
+    rows = np.asarray(rows, np.float32)
+    if chunk <= 0:
+        chunk = min(_ASSIGN_CHUNK,
+                    max(1024, (1 << 24) // max(centroids.shape[0], 1)))
+    cn = np.einsum("ij,ij->i", centroids, centroids, dtype=np.float64  # graftlint: disable=JGL006 host-side numpy norms at assignment time: f64 accumulation without a full f64 temp, cast before any device use (the index/tpu.py einsum idiom)
+                   ).astype(np.float32)
+    out = np.empty(rows.shape[0], np.int32)
+    for s in range(0, rows.shape[0], chunk):
+        blk = rows[s: s + chunk]
+        d = cn[None, :] - 2.0 * (blk @ centroids.T)
+        out[s: s + blk.shape[0]] = np.argmin(d, axis=1)
+    return out
+
+
+def balanced_assign(rows: np.ndarray, centroids: np.ndarray,
+                    cap: int) -> np.ndarray:
+    """Capacity-bounded partition assignment (the KScaNN balanced-bucket
+    recipe): nearest-centroid first, then every partition over ``cap``
+    keeps its ``cap`` CLOSEST rows and spills the rest to the nearest
+    centroid with space (walked in that row's own distance order). The
+    padded bucket width is then pinned by ``cap`` instead of by the
+    worst cluster's fill — on skewed data that is the difference between
+    probing 2x the corpus and probing a tenth of it. Requires
+    nlist * cap > n (callers size cap from the mean fill with slack)."""
+    rows = np.asarray(rows, np.float32)
+    assign = assign_partitions(rows, centroids)
+    nlist = centroids.shape[0]
+    if nlist * cap <= rows.shape[0]:
+        return assign  # cannot balance into this cap: serve unbalanced
+    fills = np.bincount(assign, minlength=nlist)
+    over = np.flatnonzero(fills > cap)
+    if not over.size:
+        return assign
+    spilled = []
+    for p in over:
+        members = np.flatnonzero(assign == p)
+        d = ((rows[members] - centroids[p]) ** 2).sum(1)
+        spill = members[np.argsort(d, kind="stable")[cap:]]
+        spilled.append(spill)
+        assign[spill] = -1
+        fills[p] = cap
+    spilled = np.concatenate(spilled)
+    cn = np.einsum("ij,ij->i", centroids, centroids).astype(np.float32)
+    # chunked [S, nlist] distance blocks; each spilled row walks its own
+    # centroid preference order into the first partition with space. The
+    # walk is bounded at 32 preferences (near-full layouts could
+    # otherwise cost O(spilled x nlist) interpreter time under the index
+    # write lock); the rare row whose 32 nearest partitions are all full
+    # falls back to the globally emptiest one — placement quality for
+    # that row is already marginal, liveness is not
+    walk = min(32, nlist)
+    for s in range(0, spilled.size, _ASSIGN_CHUNK // 8):
+        blk = spilled[s: s + _ASSIGN_CHUNK // 8]
+        d = cn[None, :] - 2.0 * (rows[blk] @ centroids.T)
+        order = np.argpartition(d, walk - 1, axis=1)[:, :walk]
+        order = np.take_along_axis(
+            order, np.argsort(np.take_along_axis(d, order, axis=1),
+                              axis=1, kind="stable"), axis=1)
+        for i, r in enumerate(blk):
+            for p in order[i]:
+                if fills[p] < cap:
+                    assign[r] = p
+                    fills[p] += 1
+                    break
+            else:
+                p = int(np.argmin(fills))
+                assign[r] = p
+                fills[p] += 1
+    return assign
+
+
+def pca_fit(rows: np.ndarray, dp: int) -> np.ndarray:
+    """Top-``dp`` principal directions of (a sample of) ``rows`` ->
+    [D, dp] f32 projection — the low-dim prefilter basis. Eigh on the
+    [D, D] covariance: D is vector dims, never corpus-sized."""
+    rows = np.asarray(rows, np.float32)
+    mean = rows.mean(axis=0)
+    x = rows - mean
+    cov = (x.T @ x) / max(x.shape[0] - 1, 1)
+    _, vecs = np.linalg.eigh(cov.astype(np.float64))  # graftlint: disable=JGL006 host-side eigendecomposition at fit time: f64 keeps the small [D, D] eigh numerically clean; the projection is cast to f32 before upload
+    dp = max(1, min(int(dp), rows.shape[1]))
+    return np.ascontiguousarray(vecs[:, ::-1][:, :dp]).astype(np.float32)
+
+
+def bucket_capacity(fills: np.ndarray) -> int:
+    """Padded bucket width for the given per-partition fills: snapped UP
+    to a 128-row multiple (the lane-alignment granule), min 128 — coarse
+    enough that the [nlist, cap_p] jit shape survives inserts and the
+    distinct compiled widths stay bounded, fine enough that padding
+    waste stays ~tens of percent instead of the up-to-2x a pow2 snap
+    costs (every probe reads cap_p rows, padding included)."""
+    top = int(fills.max()) if fills.size else 0
+    return max(128, -(-top // 128) * 128)
+
+
+def build_buckets(assign: np.ndarray, nlist: int,
+                  cap_p: Optional[int] = None
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """Partition assignment [n] int32 (-1 = unassigned/dead) -> (padded
+    buckets [nlist, cap_p] int32 with -1 padding, fills [nlist] int64).
+    One vectorized bucket sort — no per-row Python. ``cap_p`` pins the
+    padding width (callers keep the previous width while it still fits,
+    the jit-stability contract); None re-derives it from the fills."""
+    assign = np.asarray(assign, np.int32)
+    valid = assign >= 0
+    slots = np.flatnonzero(valid).astype(np.int32)
+    parts = assign[slots]
+    fills = np.bincount(parts, minlength=nlist).astype(np.int64)
+    if cap_p is None or (fills.size and int(fills.max()) > cap_p):
+        cap_p = bucket_capacity(fills)
+    order = np.argsort(parts, kind="stable")
+    slots = slots[order]
+    parts = parts[order]
+    buckets = np.full((nlist, cap_p), -1, np.int32)
+    starts = np.zeros(nlist + 1, np.int64)
+    np.cumsum(fills, out=starts[1:])
+    col = np.arange(slots.size, dtype=np.int64) - starts[parts]
+    buckets[parts, col] = slots
+    return buckets, fills
+
+
+# -- device half: probe + candidate scoring ------------------------------------
+
+
+def _probe(q: Array, centroids: Array, top_p: int,
+           metric: str) -> Array:
+    """[B, D] queries x [L, D] centroids -> the top_p probed partition
+    ids per query [B, top_p] (exact selection — L is nlist-sized, the
+    whole point is that this scan is cheap). Centroid norms are computed
+    in-program: L·D flops per dispatch beats carrying another slab."""
+    qf = q.astype(jnp.float32)
+    qx = jnp.matmul(qf, centroids.T, preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+    if metric == vi.DISTANCE_L2:
+        q_sq = jnp.sum(qf ** 2, axis=-1, keepdims=True)
+        cnorms = jnp.sum(centroids.astype(jnp.float32) ** 2, axis=-1)
+        d = jnp.maximum(q_sq - 2.0 * qx + cnorms[None, :], 0.0)
+    elif metric == vi.DISTANCE_DOT:
+        d = -qx
+    else:  # cosine: centroids are train-time normalized
+        d = 1.0 - qx
+    _, parts = jax.lax.top_k(-d, top_p)
+    return parts.astype(jnp.int32)
+
+
+def _candidate_slots(parts: Array, buckets: Array, gp: int) -> Array:
+    """Probed partitions [B, top_p] -> grouped candidate slots
+    [steps, B, gp*cap_p] (int32, -1 = padding), where each lax.scan step
+    covers ``gp`` probes. top_p pads up to a gp multiple with an
+    out-of-range partition id that gathers -1 rows (mode=fill)."""
+    b, top_p = parts.shape
+    steps = -(-top_p // gp)
+    pad = steps * gp - top_p
+    if pad:
+        parts = jnp.concatenate(
+            [parts, jnp.full((b, pad), buckets.shape[0], jnp.int32)], axis=1)
+    sl = jnp.take(buckets, parts, axis=0, mode="fill",
+                  fill_value=-1)                       # [B, steps*gp, cap_p]
+    cap_p = buckets.shape[1]
+    return jnp.moveaxis(sl.reshape(b, steps, gp * cap_p), 1, 0)
+
+
+def _slot_valid(slots: Array, n, tombs: Array, allow_words: Optional[Array]
+                ) -> Array:
+    """The flat kernels' masking semantics, per candidate slot: capacity
+    padding (slots >= n), the dispatching snapshot's OWN device
+    tombstones (the _gather_live discipline), and the packed allowList
+    words the filtered scan kernels already consume."""
+    safe = jnp.clip(slots, 0, tombs.shape[0] - 1)
+    ok = jnp.logical_and(slots >= 0, slots < n)
+    ok = jnp.logical_and(ok, jnp.logical_not(jnp.take(tombs, safe)))
+    if allow_words is not None:
+        w = jnp.take(allow_words, (safe >> 5).astype(jnp.int32))
+        bit = (w >> (safe & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        ok = jnp.logical_and(ok, bit.astype(jnp.bool_))
+    return ok
+
+
+def _select(d: Array, slots: Array, kk: int, exact: bool):
+    """Per-group smallest-kk selection (the flat scans' exact/approx
+    split), returning (dists, slot ids) with -1 for masked winners."""
+    if exact or kk >= d.shape[1]:
+        neg, pos = jax.lax.top_k(-d, kk)
+        td = -neg
+    else:
+        td, pos = jax.lax.approx_min_k(d, kk, recall_target=0.95)
+    ts = jnp.take_along_axis(slots, pos, axis=1)
+    return td, jnp.where(jnp.isinf(td), -1, ts)
+
+
+def _grouped_topk(slots_g: Array, valid_g: Array, score_fn, keep: int,
+                  exact: bool, slack: bool = True):
+    """Scan the [steps, B, g] candidate groups, scoring each through
+    ``score_fn(slots [B, g]) -> [B, g] f32`` and exactly merging the
+    running best across steps — the flat scans' collect-then-merge,
+    over probed buckets instead of HBM chunks.
+
+    Selection discipline mirrors the flat fast scan: each group's
+    approx_min_k keeps 4x``keep`` SLACK candidates (selection errors of
+    the approximate pass sit well within 4k — index/tpu.py _rescore_r's
+    rationale), the cross-step merge is an exact top-k over the widened
+    set, and the final [:, :keep] slice of the sorted merge is the exact
+    best of everything any group surfaced. The PCA prefilter stage
+    passes slack=False: its `keep` is already a wide cut over the final
+    k, and quadrupling it again only inflates the per-step merge sort."""
+    steps, b, g = slots_g.shape
+    w = min(max(4 * keep, 32), max(steps * g, keep)) if slack else keep
+    w = max(w, keep)
+    kk = min(w, g)
+    init = (jnp.full((b, w), INF, jnp.float32),
+            jnp.full((b, w), -1, jnp.int32))
+
+    def step(carry, xs):
+        sl, va = xs
+        d = jnp.where(va, score_fn(sl), INF)
+        td, ts = _select(d, sl, kk, exact)
+        return merge_top_k(carry[0], carry[1], td, ts, w), None
+
+    (top, out), _ = jax.lax.scan(step, init, (slots_g, valid_g))
+    # merge_top_k sorts by distance: the first `keep` columns are the
+    # exact top-keep of the union
+    return top[:, :keep], out[:, :keep]
+
+
+def _regroup(slots: Array, valid: Array, steps: int):
+    """[B, C] survivors -> [steps, B, C/steps] groups for the second
+    scoring stage (C is a pow2 by construction, steps divides it)."""
+    b, c = slots.shape
+    g = c // steps
+    return (jnp.moveaxis(slots.reshape(b, steps, g), 1, 0),
+            jnp.moveaxis(valid.reshape(b, steps, g), 1, 0))
+
+
+def group_steps(b: int, cap_p: int, dim: int, top_p: int,
+                budget_elems: int = 1 << 21) -> int:
+    """Probes per scan step so one step's [B, gp*cap_p, D] gather stays
+    under ``budget_elems`` elements (~8 MB f32 at the default)."""
+    per_probe = max(b * cap_p * dim, 1)
+    return max(1, min(top_p, budget_elems // per_probe))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "top_p", "pre_c", "exact",
+                     "gp", "steps2"),
+)
+def search_ivf_dense(store, tombs, n, q, allow_words, centroids,
+                     buckets, pca_proj, pca_rows, k, metric, use_allow,
+                     top_p, pre_c, exact, gp, steps2):
+    """IVF search over a dense row store (the exact tier's f32/bf16
+    store, or the PQ-rescore tier's bf16 copy): probe -> gather the
+    probed buckets -> optional PCA prefilter -> full-dim scoring of the
+    survivors through the shared rescore core -> packed top-k.
+
+    pre_c > 0 enables the low-dim prefilter: candidates are first ranked
+    in the pca_proj subspace (dp dims instead of D) and only the best
+    pre_c per query reach the full-dim pass — the pHNSW recipe. pre_c=0
+    scores every probed candidate at full dim (and is the setting the
+    ``top_p=all`` bit-identity contract pins)."""
+    qf = q.astype(jnp.float32)
+    parts = _probe(qf, centroids, top_p, metric)
+    slots_g = _candidate_slots(parts, buckets, gp)
+    valid_g = _slot_valid(slots_g, n, tombs,
+                          allow_words if use_allow else None)
+    cap = store.shape[0]
+
+    def score_full(sl):
+        rows = jnp.take(store, jnp.clip(sl, 0, cap - 1), axis=0)
+        return rescore_distances(rows, qf, metric)
+
+    if pre_c:
+        qp = jnp.matmul(qf, pca_proj, preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)
+
+        def score_pca(sl):
+            rows = jnp.take(pca_rows, jnp.clip(sl, 0, cap - 1), axis=0)
+            # the prefilter ranks, it never reports: L2 in the subspace
+            # orders candidates for every matmul metric (cosine/dot rows
+            # are normalized/compared in the same basis)
+            return jnp.sum((rows - qp[:, None, :]) ** 2, axis=-1)
+
+        ptop, pslots = _grouped_topk(slots_g, valid_g, score_pca, pre_c,
+                                     False, slack=False)
+        slots2, valid2 = _regroup(pslots, pslots >= 0, steps2)
+        top, idx = _grouped_topk(slots2, valid2, score_full, k, exact)
+    else:
+        top, idx = _grouped_topk(slots_g, valid_g, score_full, k, exact)
+    return pack_topk(top, jnp.where(jnp.isinf(top), -1, idx))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "top_p", "pre_c", "exact",
+                     "gp", "steps2"),
+)
+def search_ivf_dense_fused(store, tombs, n, q, allow_words, centroids,
+                           buckets, pca_proj, pca_rows, s2d, k,
+                           metric, use_allow, top_p, pre_c, exact, gp,
+                           steps2):
+    """search_ivf_dense with the device-side slot->doc translation fused
+    into the SAME program (ops/topk FUSED layout) — the IVF plane rides
+    the fused dispatch's one-fetch/zero-translation contract."""
+    packed = search_ivf_dense(store, tombs, n, q, allow_words, centroids,
+                              buckets, pca_proj, pca_rows, k,
+                              metric, use_allow, top_p, pre_c, exact, gp,
+                              steps2)
+    return retranslate_packed(packed, s2d)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "top_p", "pre_c", "exact",
+                     "gp", "steps2"),
+)
+def search_ivf_codes(codes, recon_norms, tombs, n, q, allow_words,
+                     codebook, centroids, buckets, pca_proj,
+                     pca_rows, rot, k, metric, use_allow, top_p, pre_c,
+                     exact, gp, steps2):
+    """IVF search over the codes-only PQ tier: probed candidates are
+    scored by the SAME asymmetric-ADC math as the flat reconstruction
+    scan (gather codes -> reconstruct from the bf16 codebook -> one
+    f32-accumulated product against the (rotated) query, plus the
+    precomputed ||recon||^2 for L2) — per candidate instead of per HBM
+    chunk. No rescore pass, exactly like the flat codes tier."""
+    qf = q.astype(jnp.float32)
+    parts = _probe(qf, centroids, top_p, metric)
+    slots_g = _candidate_slots(parts, buckets, gp)
+    valid_g = _slot_valid(slots_g, n, tombs,
+                          allow_words if use_allow else None)
+    cap, m = codes.shape
+    _, c, ds = codebook.shape
+    flat_cb = codebook.reshape(m * c, ds).astype(jnp.bfloat16)
+    seg_off = (jnp.arange(m, dtype=jnp.int32) * c)[None, None, :]
+    qr = qf if rot is None else jnp.matmul(
+        qf, rot, preferred_element_type=jnp.float32)
+    qd = qr.astype(jnp.bfloat16)
+    q_sq = jnp.sum(qr.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+
+    def score_adc(sl):
+        safe = jnp.clip(sl, 0, cap - 1)
+        cd = jnp.take(codes, safe, axis=0).astype(jnp.int32)   # [B, g, M]
+        recon = jnp.take(flat_cb, cd + seg_off, axis=0)        # [B,g,M,ds]
+        recon = recon.reshape(cd.shape[0], cd.shape[1], m * ds)
+        qx = jnp.einsum("bd,bgd->bg", qd, recon,
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.DEFAULT)
+        if metric == vi.DISTANCE_L2:
+            nrm = jnp.take(recon_norms, safe)
+            return jnp.maximum(q_sq - 2.0 * qx + nrm, 0.0)
+        if metric == vi.DISTANCE_DOT:
+            return -qx
+        return 1.0 - qx
+
+    if pre_c:
+        qp = jnp.matmul(qf, pca_proj, preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)
+
+        def score_pca(sl):
+            rows = jnp.take(pca_rows, jnp.clip(sl, 0, cap - 1), axis=0)
+            return jnp.sum((rows - qp[:, None, :]) ** 2, axis=-1)
+
+        ptop, pslots = _grouped_topk(slots_g, valid_g, score_pca, pre_c,
+                                     False, slack=False)
+        slots2, valid2 = _regroup(pslots, pslots >= 0, steps2)
+        top, idx = _grouped_topk(slots2, valid2, score_adc, k, exact)
+    else:
+        top, idx = _grouped_topk(slots_g, valid_g, score_adc, k, exact)
+    return pack_topk(top, jnp.where(jnp.isinf(top), -1, idx))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "top_p", "pre_c", "exact",
+                     "gp", "steps2"),
+)
+def search_ivf_codes_fused(codes, recon_norms, tombs, n, q, allow_words,
+                           codebook, centroids, buckets, pca_proj,
+                           pca_rows, rot, s2d, k, metric, use_allow, top_p,
+                           pre_c, exact, gp, steps2):
+    """search_ivf_codes with device-side slot->doc translation fused in."""
+    packed = search_ivf_codes(codes, recon_norms, tombs, n, q, allow_words,
+                              codebook, centroids, buckets,
+                              pca_proj, pca_rows, rot, k, metric,
+                              use_allow, top_p, pre_c, exact, gp, steps2)
+    return retranslate_packed(packed, s2d)

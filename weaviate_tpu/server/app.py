@@ -54,6 +54,10 @@ class App:
 
         self._fused_token = tpu_index.set_fused_enabled(
             self.config.fused_dispatch_enabled)
+        # IVF scan plane (index/tpu.py, ROADMAP item 3): same
+        # process-wide toggle shape — the index layer reads Config.ivf
+        # without plumbing, and the token scopes the revert to THIS App
+        self._ivf_token = tpu_index.set_ivf_config(self.config.ivf)
 
         # end-to-end request tracing (monitoring/tracing.py): the tracer is
         # a process-wide module global — shards and the coalescer reach it
@@ -491,6 +495,7 @@ class App:
         from weaviate_tpu.index import tpu as tpu_index
 
         tpu_index.unset_fused_enabled(getattr(self, "_fused_token", None))
+        tpu_index.unset_ivf_config(getattr(self, "_ivf_token", None))
         if self.tracer is not None:
             from weaviate_tpu.monitoring import tracing
 

@@ -75,7 +75,7 @@ from collections import deque
 from typing import Optional
 
 from weaviate_tpu.config import ControllerConfig
-from weaviate_tpu.config.config import RESCORE_R_BUCKETS
+from weaviate_tpu.config.config import IVF_TOP_P_BUCKETS, RESCORE_R_BUCKETS
 from weaviate_tpu.monitoring import incidents
 from weaviate_tpu.testing import faults, sanitizers
 
@@ -90,6 +90,14 @@ _LOG = logging.getLogger(__name__)
 # static-arg snapping imports the same tuple, so a controller cut can
 # never mint a jit shape the index wouldn't also compile.
 R_BUCKETS = RESCORE_R_BUCKETS
+
+# the IVF probe-count cap's bucket ladder (config.IVF_TOP_P_BUCKETS —
+# the same one-source-of-truth discipline as R_BUCKETS: index/tpu.py
+# snaps every effective top_p to this table, so a controller cut can
+# never mint a jit shape the static path wouldn't also compile). The
+# top bucket means "controller inactive": the index's own configured
+# probe count applies unchanged.
+P_BUCKETS = IVF_TOP_P_BUCKETS
 
 # brownout ladder stages (stage 0 = normal serving)
 STAGE_NORMAL = 0
@@ -107,8 +115,10 @@ KNOB_CAP_SCALE = "tenant_cap_scale"
 KNOB_RETRY_SCALE = "retry_after_scale"
 KNOB_RESCORE_CAP = "rescore_r_cap"
 KNOB_RATE_SCALE = "rate_scale"
+KNOB_IVF_TOP_P = "ivf_top_p"
 KNOB_NAMES = (KNOB_WINDOW_S, KNOB_MARGIN, KNOB_CAP_SCALE,
-              KNOB_RETRY_SCALE, KNOB_RESCORE_CAP, KNOB_RATE_SCALE)
+              KNOB_RETRY_SCALE, KNOB_RESCORE_CAP, KNOB_RATE_SCALE,
+              KNOB_IVF_TOP_P)
 
 
 def _snap_bucket(value: float, buckets=R_BUCKETS) -> int:
@@ -224,6 +234,7 @@ class ControlPlane:
             KNOB_RETRY_SCALE: 1.0,
             KNOB_RESCORE_CAP: float(R_BUCKETS[-1]),
             KNOB_RATE_SCALE: 1.0,
+            KNOB_IVF_TOP_P: float(P_BUCKETS[-1]),
         }
         self._depth_default = (coalescer._depth if coalescer is not None
                                else 1)
@@ -237,6 +248,7 @@ class ControlPlane:
             KNOB_RETRY_SCALE: (1.0, 8.0),
             KNOB_RESCORE_CAP: (float(R_BUCKETS[0]), float(R_BUCKETS[-1])),
             KNOB_RATE_SCALE: (0.25, 1.0),
+            KNOB_IVF_TOP_P: (float(P_BUCKETS[0]), float(P_BUCKETS[-1])),
         }
         # token buckets (controller 4); rate 0 = quota off
         self.rate_buckets = _TokenBuckets(
@@ -250,6 +262,11 @@ class ControlPlane:
         # recall-budget state: index into R_BUCKETS (top = inactive)
         self._r_idx = len(R_BUCKETS) - 1
         self._r_hold = 0
+        # the second recall-guarded budget (ROADMAP item-4 follow-up,
+        # landed with the IVF plane): index into P_BUCKETS for the IVF
+        # probe-count cap (top = inactive)
+        self._p_idx = len(P_BUCKETS) - 1
+        self._p_hold = 0
         # lane-controller state: hysteresis counts CONSECUTIVE qualifying
         # ticks in ONE direction — the paired _dir resets the counter when
         # the qualifying branch flips, so mixed evidence never actuates
@@ -294,6 +311,8 @@ class ControlPlane:
         v = min(max(float(value), lo), hi)
         if name == KNOB_RESCORE_CAP:
             v = float(_snap_bucket(v))
+        elif name == KNOB_IVF_TOP_P:
+            v = float(_snap_bucket(v, P_BUCKETS))
         prev = self._read(name, self._defaults[name])
         now = time.monotonic()
         with self._lock:
@@ -528,9 +547,44 @@ class ControlPlane:
                 if n >= self.cfg.recall_min_samples]
         return min(vals) if vals else None
 
-    def _tick_budget(self) -> None:
+    def _ladder_step(self, knob: str, buckets, idx: int, hold: int,
+                     ewma) -> tuple[int, int]:
+        """The ONE recall-guarded cut/backoff/dead-band state machine,
+        shared by both budgets (the rescore cap and the IVF probe cap —
+        their only legitimate divergence is what a paused sample gate
+        means, which the CALLERS decide by what they pass as `ewma`).
+        -> (new bucket index, new hold count)."""
         cfg = self.cfg
-        top = len(R_BUCKETS) - 1
+        top = len(buckets) - 1
+        if ewma is None:
+            # signal gone: fail static — a budget may only stay cut
+            # while the recall meter actively vouches for it
+            if idx != top:
+                self._set_knob(knob, buckets[top], "budget",
+                               reason="no recall signal")
+            return top, 0
+        if ewma < cfg.recall_floor + cfg.recall_backoff_margin:
+            # near (or under) the floor: back off IMMEDIATELY — restores
+            # are never held behind hysteresis, only cuts are
+            if idx < top:
+                idx = min(idx + 1, top)
+                self._set_knob(knob, buckets[idx], "budget",
+                               reason=f"ewma {ewma:.4f} near floor "
+                                      f"{cfg.recall_floor}")
+            return idx, 0
+        if ewma >= cfg.recall_floor + cfg.recall_slack:
+            hold += 1
+            if hold >= cfg.hold_ticks and idx > 0:
+                idx -= 1
+                self._set_knob(knob, buckets[idx], "budget",
+                               reason=f"ewma {ewma:.4f} holds slack over "
+                                      f"floor {cfg.recall_floor}")
+                return idx, 0
+            return idx, hold
+        return idx, 0  # dead band: hold position
+
+    def _tick_budget(self) -> None:
+        self._tick_ivf_budget()
         if self._sampling_paused:
             # brownout stage 3 silenced the meter ITSELF: hold the cap at
             # its last vouched-for value — restoring to the 128 maximum
@@ -540,37 +594,23 @@ class ControlPlane:
             # a stalled/dead plane still fail-statics at the readers.
             self._r_hold = 0
             return
-        ewma = self._sense_recall()
-        if ewma is None:
-            # auditor gone/cold: fail static — the budget may only be cut
-            # while the recall meter actively vouches for it
-            if self._r_idx != top:
-                self._r_idx = top
-                self._r_hold = 0
-                self._set_knob(KNOB_RESCORE_CAP, R_BUCKETS[top], "budget",
-                               reason="no recall signal")
-            return
-        if ewma < cfg.recall_floor + cfg.recall_backoff_margin:
-            # near (or under) the floor: back off IMMEDIATELY — restores
-            # are never held behind hysteresis, only cuts are
-            if self._r_idx < top:
-                self._r_idx = min(self._r_idx + 1, top)
-                self._r_hold = 0
-                self._set_knob(KNOB_RESCORE_CAP, R_BUCKETS[self._r_idx],
-                               "budget",
-                               reason=f"ewma {ewma:.4f} near floor "
-                                      f"{cfg.recall_floor}")
-        elif ewma >= cfg.recall_floor + cfg.recall_slack:
-            self._r_hold += 1
-            if self._r_hold >= cfg.hold_ticks and self._r_idx > 0:
-                self._r_hold = 0
-                self._r_idx -= 1
-                self._set_knob(KNOB_RESCORE_CAP, R_BUCKETS[self._r_idx],
-                               "budget",
-                               reason=f"ewma {ewma:.4f} holds slack over "
-                                      f"floor {cfg.recall_floor}")
-        else:
-            self._r_hold = 0  # in the dead band: hold position
+        self._r_idx, self._r_hold = self._ladder_step(
+            KNOB_RESCORE_CAP, R_BUCKETS, self._r_idx, self._r_hold,
+            self._sense_recall())
+
+    def _tick_ivf_budget(self) -> None:
+        """The SECOND recall-guarded budget (ROADMAP item 3/4): the IVF
+        probe-count cap on the same shared ladder. The one divergence
+        from the rescore cap is what a brownout-paused sample gate
+        means: here it reads as NO SIGNAL -> revert — unlike the
+        rescore cap (where restoring to maximum 4x's per-query work
+        mid-burn and the last vouched-for value is held), restoring
+        top_p to the configured probe count is the recall-safe
+        direction and the index's own configured value bounds its cost,
+        so a silenced meter may not keep vouching for probe cuts."""
+        ewma = None if self._sampling_paused else self._sense_recall()
+        self._p_idx, self._p_hold = self._ladder_step(
+            KNOB_IVF_TOP_P, P_BUCKETS, self._p_idx, self._p_hold, ewma)
 
     # -- controller 3: coalescer window / pipeline depth ----------------------
 
@@ -692,7 +732,8 @@ class ControlPlane:
         self.brownout_stage = STAGE_NORMAL
         self._stage_clean_ticks = 0
         self._r_idx = len(R_BUCKETS) - 1
-        self._r_hold = self._win_hold = self._depth_hold = 0
+        self._p_idx = len(P_BUCKETS) - 1
+        self._r_hold = self._p_hold = self._win_hold = self._depth_hold = 0
         self._win_dir = self._depth_dir = 0
         incidents.emit("controller_revert", scope="serving",
                        reason=reason, knobs=sorted(had))
@@ -748,6 +789,7 @@ class ControlPlane:
                              "sampling_paused": self._sampling_paused},
                 "budget": {"enabled": self.cfg.budget_enabled,
                            "rescore_r_cap": R_BUCKETS[self._r_idx],
+                           "ivf_top_p_cap": P_BUCKETS[self._p_idx],
                            "recall_floor": self.cfg.recall_floor,
                            "recall_ewma_min": self._sense_recall()},
                 "lanes": {"enabled": self.cfg.lanes_enabled,
@@ -907,6 +949,19 @@ def rescore_r_cap(default: int) -> int:
     if p is None:
         return default
     return min(int(p._read(KNOB_RESCORE_CAP, default)), int(default))
+
+
+def ivf_top_p_cap(default: int) -> int:
+    """Cap on the IVF probe count (index/tpu.py ``_ivf_plan``) — the
+    second recall-guarded budget: while the shadow auditor's recall
+    EWMA holds measured slack over the floor, probes step down the
+    P_BUCKETS ladder; signal loss (including a brownout-paused sample
+    gate) reverts to `default` (the index's own configured probe
+    count). Never exceeds `default` — the budget may only cut."""
+    p = _plane
+    if p is None:
+        return default
+    return min(int(p._read(KNOB_IVF_TOP_P, default)), int(default))
 
 
 def take_rate_token(tenant: Optional[str]) -> Optional[float]:
