@@ -154,11 +154,11 @@ def main() -> int:
         # stage breakdown at the headline shape with the block rescore —
         # records WHERE serving time goes on real hardware (in-jit amortized,
         # so relay latency cannot fake it)
-        log("running profile_gmin3 (stage breakdown)...")
+        log("running profile_gmin --mode loop (stage breakdown)...")
         try:
             prc = subprocess.call(
-                f"{sys.executable} tools/profile_gmin3.py 1048576 16384 4 "
-                ">> chip_profile.log 2>&1",
+                f"{sys.executable} tools/profile_gmin.py --mode loop "
+                "1048576 16384 4 >> chip_profile.log 2>&1",
                 shell=True, cwd=REPO, timeout=1800)
             log(f"profile rc={prc}")
         except subprocess.TimeoutExpired:

@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
-# Pre-PR gate: graftlint + ruff + tier-1 tests. Run from the repo root:
+# Pre-PR gate: graftlint + graftflow + ruff + tier-1 tests. Run from the
+# repo root:
 #   bash tools/ci_check.sh
 # Exits nonzero on the first failing stage. Documented in README.md.
 #
-# CI_ARTIFACT_DIR (optional): when set, the graftlint report and the tier-1
-# log are written there under stable names (graftlint-report.txt, _t1.log)
+# CI_ARTIFACT_DIR (optional): when set, the graftlint/graftflow reports and
+# the tier-1 log are written there under stable names (graftlint-report.txt,
+# graftflow-report.txt, _t1.log)
 # and kept — the workflow uploads them as artifacts on failure so a red run
 # is debuggable without a rerun. Unset (local use) => per-run mktemp logs,
 # cleaned up as before.
@@ -70,6 +72,25 @@ if ! python -m tools.graftlint weaviate_tpu $strict_flag 2>&1 \
     fail=1
 fi
 [ -z "$art" ] && rm -f "$gl_log"
+
+echo "== graftflow (whole-program dataflow: JGL016-JGL019, strict baseline) =="
+# interprocedural twin of the graftlint stage: lock-order conformance,
+# device-sync-under-lock at any call depth, snapshot escape, jit-shape
+# churn. Honors the same GRAFTLINT_STRICT switch and shrink-only ratchet,
+# with its own baseline (tools/graftflow/baseline.json). The pickled
+# call-graph cache (keyed on file mtimes) keeps warm reruns fast.
+gf_cache="${art:+$art/graftflow-cache.pkl}"
+gf_cache="${gf_cache:-${TMPDIR:-/tmp}/graftflow-cache-$(id -u).pkl}"
+gf_log="${art:+$art/graftflow-report.txt}"
+gf_log="${gf_log:-$(mktemp)}"
+if ! python -m tools.graftflow weaviate_tpu $strict_flag \
+        --cache "$gf_cache" 2>&1 | tee "$gf_log"; then
+    echo "ci_check: graftflow FAILED — fix the findings or suppress inline" \
+         "(# graftflow: disable=JGLxxx reason); the baseline may only" \
+         "shrink" >&2
+    fail=1
+fi
+[ -z "$art" ] && rm -f "$gf_log"
 
 echo "== graftsan (lock-hierarchy table vs register_lock registry) =="
 # the machine-readable docs/concurrency.md hierarchy table must agree with

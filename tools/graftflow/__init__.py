@@ -1,0 +1,33 @@
+"""graftflow: whole-program interprocedural dataflow analysis for
+weaviate_tpu.
+
+Where graftlint (tools/graftlint) audits one file at a time with a
+one-level same-module call graph, graftflow builds a package-wide call
+graph (module functions, methods via class indexing, the ``self._x``
+callback idiom, attribute receivers typed from constructor assignments
+and factory return unions) and runs a fixed-point interprocedural
+dataflow pass propagating three facts through calls at ANY depth:
+
+  locks-held          which hierarchy locks a region transitively acquires
+  device provenance   which values are device arrays / which calls sync
+  snapshot reach      which values derive from an IndexSnapshot's arrays
+
+Four rules ride on it — JGL016 (device sync under a no-fetch lock at
+arbitrary call depth), JGL017 (static lock-order conformance against
+tools/graftsan/lock_hierarchy.json, with cycle detection), JGL018
+(snapshot-escape into state that outlives the snapshot), JGL019
+(jit-shape churn: non-bucket-snapped dims reaching static jit params).
+
+Run ``python -m tools.graftflow weaviate_tpu`` from the repo root. See
+docs/static_analysis.md for the architecture, the soundness caveats, and
+the baseline policy (shrink-only, same ratchet as graftlint).
+"""
+
+import os
+
+from tools.graftlint.engine import _REPO_ROOT  # one path anchor for all tools
+
+DEFAULT_BASELINE = os.path.join(
+    _REPO_ROOT, "tools", "graftflow", "baseline.json")
+HIERARCHY_PATH = os.path.join(
+    _REPO_ROOT, "tools", "graftsan", "lock_hierarchy.json")

@@ -1,0 +1,142 @@
+"""CLI: python -m tools.graftflow <target> [options].
+
+Mirrors graftlint's CLI contract exactly (same flags, same exit codes,
+same shrink-only baseline ratchet) plus ``--cache`` for the pickled
+call-graph keyed on file mtimes — the tier-1/CI gate path.
+
+Exit codes: 0 clean (or every finding baselined), 1 findings outside the
+baseline (or stale baseline entries under --strict-baseline), 2 usage
+error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftflow import DEFAULT_BASELINE
+from tools.graftflow.engine import analyze_program
+from tools.graftflow.rules import RULE_DOCS
+from tools.graftlint.__main__ import _entry_key, _split_by_scope
+from tools.graftlint.engine import (
+    apply_baseline,
+    build_baseline,
+    default_root,
+    iter_python_files,
+    load_baseline,
+    target_scope,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftflow",
+        description="whole-program interprocedural dataflow analysis "
+                    "(JGL016-JGL019)")
+    ap.add_argument("target", nargs="?",
+                    help="package directory to analyze (the whole package "
+                         "— graftflow is interprocedural)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default tools/graftflow/"
+                         "baseline.json at the repo root)")
+    ap.add_argument("--root", default=None,
+                    help="directory finding paths are relative to")
+    ap.add_argument("--cache", default=None,
+                    help="pickled call-graph cache path, keyed on file "
+                         "mtimes (CI uses this to keep the gate fast)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(use only when shrinking it)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop stale entries whose findings are fixed")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="stale baseline entries are an error (the ratchet)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULE_DOCS):
+            print(f"{code}  {RULE_DOCS[code]}")
+        return 0
+    if not args.target:
+        ap.print_usage(sys.stderr)
+        print("graftflow: error: a target is required", file=sys.stderr)
+        return 2
+    if not os.path.exists(args.target):
+        print(f"graftflow: error: no such target {args.target!r}",
+              file=sys.stderr)
+        return 2
+    rp = os.path.realpath(args.target)
+    if not any(iter_python_files(rp, args.root or default_root(rp))):
+        print(f"graftflow: error: no Python files to analyze under "
+              f"{args.target!r}", file=sys.stderr)
+        return 2
+
+    findings = analyze_program(args.target, root=args.root,
+                               cache_path=args.cache)
+    scope = target_scope(args.target, root=args.root)
+
+    if args.update_baseline:
+        old = load_baseline(args.baseline) if os.path.exists(args.baseline) \
+            else None
+        base = build_baseline(findings, old)
+        if old:
+            _, outside = _split_by_scope(old.get("entries", []), scope)
+            base["entries"] = sorted(base["entries"] + outside,
+                                     key=_entry_key)
+        write_baseline(args.baseline, base)
+        print(f"graftflow: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}; fill in the justifications")
+        return 0
+
+    waived = 0
+    stale: list[dict] = []
+    if args.no_baseline:
+        new = findings
+    else:
+        baseline = load_baseline(args.baseline)
+        inside, outside = _split_by_scope(baseline.get("entries", []), scope)
+        new, waived, stale = apply_baseline(
+            findings, dict(baseline, entries=inside))
+        if args.prune_baseline and stale:
+            live = build_baseline([f for f in findings if f not in new],
+                                  baseline)
+            live["entries"] = sorted(live["entries"] + outside,
+                                     key=_entry_key)
+            write_baseline(args.baseline, live)
+            print(f"graftflow: pruned {len(stale)} stale entr(y|ies) from "
+                  f"{args.baseline}")
+            stale = []
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "baselined": waived,
+            "stale_baseline_entries": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"graftflow: STALE baseline entry {e['code']} "
+                  f"{e['path']} [{e['symbol']}] — shrink the baseline "
+                  "(--prune-baseline)")
+        summary = (f"graftflow: {len(new)} finding(s), {waived} baselined, "
+                   f"{len(stale)} stale baseline entr(y|ies)")
+        print(summary, file=sys.stderr)
+
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

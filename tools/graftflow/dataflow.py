@@ -1,0 +1,467 @@
+"""graftflow dataflow: fixed-point interprocedural fact propagation.
+
+One scan pass per function extracts the raw material (call sites with the
+lock stack held at each, ``with`` acquisitions, assignments, returns);
+then a whole-program fixpoint grows five monotone summaries until nothing
+changes:
+
+  syncs                 blocking device->host syncs reachable from a
+                        function, each with the static call chain
+  acquires              hierarchy locks a function transitively acquires
+  returns_device        functions returning device arrays
+  returns_snap[_derived]functions returning a snapshot / a value derived
+                        from a snapshot's arrays (views share lifetime)
+  static_sinks          parameters that flow into a STATIC argument of a
+                        jit entry point, at any depth
+
+Termination: every summary only grows, keyed on finite (function, site)
+sets — first witness wins, later iterations cannot replace an entry, so
+recursive call cycles converge (pinned by test_graftflow.py).
+
+Soundness stance (documented in docs/static_analysis.md): calls the
+callgraph cannot resolve contribute nothing — the analysis under-reports
+rather than drowning the baseline; graftsan's runtime witnessing covers
+the unresolved remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.graftflow import callgraph, resolve
+from tools.graftlint.rules import DEVICE_ATTRS
+
+# IndexSnapshot attributes that are host scalars / long-lived objects in
+# their own right — reading these does NOT pin snapshot array lifetime
+SNAP_SCALAR_ATTRS = frozenset({
+    "gen", "dim", "capacity", "n", "live", "compressed", "allow_token",
+    "ivf_meta", "pq",
+})
+
+# parameter names that bind a snapshot by convention across the tree
+SNAP_PARAM_NAMES = frozenset({"snap", "snapshot", "prev_snap", "new_snap"})
+
+# container-mutation method names that smuggle a value into the receiver
+MUTATOR_NAMES = frozenset({
+    "append", "add", "put", "setdefault", "extend", "insert", "update",
+    "appendleft", "push",
+})
+
+
+class CallSite:
+    __slots__ = ("line", "node", "held", "callees", "jit")
+
+    def __init__(self, line: int, node: ast.Call, held: tuple) -> None:
+        self.line = line
+        self.node = node
+        self.held = held          # lock names held when the call runs
+        self.callees: list = []   # FuncInfo candidates (resolved later)
+        self.jit = None           # JitSpec when the callee is a jit entry
+
+
+class FnScan:
+    """Everything one pass over a function's own body extracts."""
+
+    __slots__ = ("info", "assigns", "calls", "call_by_id", "acquires",
+                 "returns", "local_types", "jitted", "raw_params",
+                 "local_dev", "snap_locals", "derived_locals",
+                 "global_names")
+
+    def __init__(self, info) -> None:
+        self.info = info
+        self.assigns: list = []       # (targets, value)
+        self.calls: list = []         # CallSite
+        self.call_by_id: dict = {}    # id(Call node) -> CallSite
+        self.acquires: list = []      # (lock_name, line, held_before)
+        self.returns: list = []       # return value exprs (non-None)
+        self.local_types: dict = {}   # local var -> {(module, class)}
+        self.jitted: set = set()      # jit callable names in scope
+        self.raw_params: set = set()
+        # final inner-fixpoint results, refreshed each outer iteration
+        # (rules reuse them instead of recomputing)
+        self.local_dev: set = set()
+        self.snap_locals: set = set()
+        self.derived_locals: set = set()
+        self.global_names: set = set()
+
+
+def _scan_expr(scan: FnScan, expr: Optional[ast.AST],
+               held: tuple) -> None:
+    """Record every call in an expression subtree, skipping lambda bodies
+    (deferred work does not run under the caller's locks)."""
+    if expr is None:
+        return
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Lambda):
+            continue
+        if isinstance(n, ast.Call):
+            cs = CallSite(n.lineno, n, held)
+            scan.calls.append(cs)
+            scan.call_by_id[id(n)] = cs
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _walk_stmts(prog, scan: FnScan, stmts: list, held: tuple) -> None:
+    """Statement walk tracking the lock stack: ``with`` bodies run with
+    their (resolvable) locks pushed; nested defs are skipped wholesale."""
+    for node in stmts:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Global):
+            scan.global_names.update(node.names)
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                _scan_expr(scan, item.context_expr, inner)
+                kind, name = prog.lock_name(item.context_expr, scan.info)
+                if kind == "named":
+                    scan.acquires.append((name, node.lineno, inner))
+                    inner = inner + (name,)
+            _walk_stmts(prog, scan, node.body, inner)
+            continue
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                scan.returns.append(node.value)
+        if isinstance(node, ast.Assign):
+            scan.assigns.append((node.targets, node.value))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            scan.assigns.append(([node.target], node.value))
+        elif isinstance(node, ast.AugAssign):
+            scan.assigns.append(([node.target], node.value))
+        for _field, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                nested = [v for v in value
+                          if isinstance(v, (ast.stmt, ast.excepthandler))]
+                if nested:
+                    _walk_stmts(prog, scan, nested, held)
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        _scan_expr(scan, v, held)
+            elif isinstance(value, ast.expr):
+                _scan_expr(scan, value, held)
+
+
+def _scan_function(prog, info) -> FnScan:
+    scan = FnScan(info)
+    mi = prog.modules[info.module]
+    scan.jitted = set(mi.defs.jitted_fns) | set(mi.jit_entries)
+    a = info.node.args
+    scan.raw_params = {p.arg for p in
+                       list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+    _walk_stmts(prog, scan, resolve.fn_body(info.node), ())
+    for targets, value in scan.assigns:
+        if isinstance(value, ast.Call):
+            types = callgraph._call_result_types(prog, mi, value)
+            if types:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        scan.local_types.setdefault(t.id, set()).update(types)
+    for cs in scan.calls:
+        cs.callees = prog.resolve_call(cs.node, info, scan.local_types)
+        cs.jit = prog.jit_spec_for_call(cs.node, info)
+    return scan
+
+
+class Summaries:
+    def __init__(self, scans: dict) -> None:
+        self.scans = scans
+        self.syncs: dict = {q: {} for q in scans}       # key -> fact
+        self.acquires: dict = {q: {} for q in scans}    # lock -> (line, chain)
+        self.returns_device: set = set()
+        self.returns_snap: set = set()
+        self.returns_snap_derived: set = set()
+        self.static_sinks: dict = {q: {} for q in scans}  # param -> chain
+
+
+def _frame(callee, line: int) -> str:
+    return f"{callee.symbol()} ({callee.rel}:{line})"
+
+
+# -- device provenance -------------------------------------------------------
+
+def _is_device(s: Summaries, scan: FnScan, expr, local_dev: set) -> bool:
+    if isinstance(expr, ast.Call):
+        cs = scan.call_by_id.get(id(expr))
+        if cs is not None and any(c.qual in s.returns_device
+                                  for c in cs.callees):
+            return True
+    if isinstance(expr, ast.Subscript):
+        return _is_device(s, scan, expr.value, local_dev)
+    return resolve.is_device_expr(expr, local_dev, DEVICE_ATTRS,
+                                  scan.jitted)
+
+
+def _device_locals(s: Summaries, scan: FnScan) -> set:
+    out: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for targets, value in scan.assigns:
+            if not _is_device(s, scan, value, out):
+                continue
+            for t in targets:
+                names = [t.id] if isinstance(t, ast.Name) else [
+                    e.id for e in getattr(t, "elts", [])
+                    if isinstance(e, ast.Name)]
+                for nm in names:
+                    if nm not in out:
+                        out.add(nm)
+                        changed = True
+    return out
+
+
+# -- snapshot provenance -----------------------------------------------------
+
+def _snap_kind(s: Summaries, scan: FnScan, expr,
+               snap: set, derived: set) -> Optional[str]:
+    """'snap' (the snapshot object), 'derived' (a value sharing its array
+    lifetime: field reads, views/subscripts, derived-returning calls), or
+    None."""
+    if isinstance(expr, ast.Name):
+        if expr.id in snap:
+            return "snap"
+        if expr.id in derived:
+            return "derived"
+        return None
+    if isinstance(expr, ast.Attribute):
+        if resolve.dotted(expr) == "self._snap":
+            return "snap"
+        base = _snap_kind(s, scan, expr.value, snap, derived)
+        if base == "snap":
+            return None if expr.attr in SNAP_SCALAR_ATTRS else "derived"
+        return base
+    if isinstance(expr, ast.Subscript):
+        return "derived" if _snap_kind(s, scan, expr.value, snap,
+                                       derived) else None
+    if isinstance(expr, ast.Call):
+        cs = scan.call_by_id.get(id(expr))
+        if cs is not None:
+            if any(c.qual in s.returns_snap for c in cs.callees):
+                return "snap"
+            if any(c.qual in s.returns_snap_derived for c in cs.callees):
+                return "derived"
+            # a Snapshot constructor resolves to its class __init__
+            if any(c.cls and c.cls.endswith("Snapshot")
+                   and c.name == "__init__" for c in cs.callees):
+                return "snap"
+    return None
+
+
+def _snap_locals(s: Summaries, scan: FnScan) -> tuple:
+    snap = {p for p in scan.raw_params if p in SNAP_PARAM_NAMES}
+    derived: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for targets, value in scan.assigns:
+            kind = _snap_kind(s, scan, value, snap, derived)
+            if kind is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    bucket = snap if kind == "snap" else derived
+                    if t.id not in bucket:
+                        bucket.add(t.id)
+                        changed = True
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    # unpacking a snap-derived call result taints every
+                    # bound name (host_rows -> (rows, sq))
+                    for e in t.elts:
+                        if isinstance(e, ast.Name) and e.id not in derived:
+                            derived.add(e.id)
+                            changed = True
+    return snap, derived
+
+
+# -- the fixpoint ------------------------------------------------------------
+
+def _map_call_args(call: ast.Call, params: list) -> dict:
+    """param name -> argument expr for a call against a positional
+    signature (keywords by name; *args/**kwargs unmapped)."""
+    out: dict = {}
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(params):
+            out[params[i]] = arg
+    for kw in call.keywords:
+        if kw.arg is not None:
+            out[kw.arg] = kw.value
+    return out
+
+
+def _update_function(prog, s: Summaries, scan: FnScan) -> bool:
+    qual = scan.info.qual
+    changed = False
+    # inner fixpoints against the CURRENT interprocedural summaries
+    scan.local_dev = _device_locals(s, scan)
+    scan.snap_locals, scan.derived_locals = _snap_locals(s, scan)
+    # return summaries
+    for r in scan.returns:
+        if qual not in s.returns_device \
+                and _is_device(s, scan, r, scan.local_dev):
+            s.returns_device.add(qual)
+            changed = True
+        kind = _snap_kind(s, scan, r, scan.snap_locals,
+                          scan.derived_locals)
+        if kind is None and isinstance(r, (ast.Tuple, ast.List)):
+            if any(_snap_kind(s, scan, e, scan.snap_locals,
+                              scan.derived_locals) for e in r.elts):
+                kind = "derived"
+        if kind == "snap" and qual not in s.returns_snap:
+            s.returns_snap.add(qual)
+            changed = True
+        elif kind == "derived" and qual not in s.returns_snap_derived:
+            s.returns_snap_derived.add(qual)
+            changed = True
+    syncs = s.syncs[qual]
+    acquires = s.acquires[qual]
+    sinks = s.static_sinks[qual]
+    # own-body leaf syncs (the same facts as resolve.sync_facts, but with
+    # the interprocedural device predicate)
+    for cs in scan.calls:
+        n = cs.node
+        f = n.func
+        fact = None
+        if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+            fact = "calls `.block_until_ready()`"
+        else:
+            fd = resolve.dotted(f) or ""
+            if fd.split(".")[-1] == "_fetch_packed":
+                fact = "runs `_fetch_packed(...)` (the blocking dispatch fetch)"
+            elif fd in resolve.FETCH_CALL_NAMES and n.args \
+                    and _is_device(s, scan, n.args[0], scan.local_dev):
+                fact = f"runs `{fd}(...)` on a device value"
+        if fact is not None:
+            key = ("own", cs.line, fact)
+            if key not in syncs:
+                syncs[key] = (cs.line, fact, ())
+                changed = True
+    # direct acquisitions
+    for name, line, _held in scan.acquires:
+        if name not in acquires:
+            acquires[name] = (line, ())
+            changed = True
+    # propagate through every resolvable call
+    for cs in scan.calls:
+        for callee in cs.callees:
+            if callee.qual == qual:
+                continue  # self-recursion adds no new facts
+            for (cline, desc, chain) in s.syncs.get(
+                    callee.qual, {}).values():
+                key = ("call", cs.line, callee.qual, desc)
+                if key not in syncs:
+                    syncs[key] = (cs.line, desc,
+                                  (_frame(callee, cline),) + chain)
+                    changed = True
+            for name, (l2, chain2) in s.acquires.get(
+                    callee.qual, {}).items():
+                if name not in acquires:
+                    acquires[name] = (cs.line,
+                                      (_frame(callee, l2),) + chain2)
+                    changed = True
+            # static-sink propagation: our param -> callee's sink param
+            callee_sinks = s.static_sinks.get(callee.qual, {})
+            if callee_sinks:
+                argmap = _map_call_args(cs.node, callee.params())
+                for p, chain in callee_sinks.items():
+                    arg = argmap.get(p)
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in scan.raw_params \
+                            and arg.id not in sinks:
+                        sinks[arg.id] = (_frame(callee, cs.line),) + chain
+                        changed = True
+        if cs.jit is not None and cs.jit.static_names:
+            argmap = _map_call_args(cs.node, list(cs.jit.params))
+            for p in cs.jit.static_names:
+                arg = argmap.get(p)
+                if isinstance(arg, ast.Name) \
+                        and arg.id in scan.raw_params \
+                        and arg.id not in sinks:
+                    sinks[arg.id] = (
+                        f"jit entry `{cs.jit.name}` static `{p}` "
+                        f"({scan.info.rel}:{cs.line})",)
+                    changed = True
+    return changed
+
+
+def analyze(prog) -> Summaries:
+    scans = {q: _scan_function(prog, fi)
+             for q, fi in prog.functions.items()}
+    s = Summaries(scans)
+    changed = True
+    while changed:
+        changed = False
+        for scan in scans.values():
+            if _update_function(prog, s, scan):
+                changed = True
+    return s
+
+
+# -- the static lock-acquisition graph (JGL017 + the drift/pin tests) --------
+
+class Edge:
+    __slots__ = ("src", "dst", "rel", "line", "symbol", "chain")
+
+    def __init__(self, src, dst, info, line, chain) -> None:
+        self.src = src
+        self.dst = dst
+        self.rel = info.rel
+        self.line = line
+        self.symbol = info.symbol()
+        self.chain = chain      # call frames from the witness site down
+
+    def describe(self) -> str:
+        base = f"{self.symbol} ({self.rel}:{self.line})"
+        return " -> ".join((base,) + self.chain)
+
+
+def lock_edges(prog, s: Summaries) -> dict:
+    """(held_lock, acquired_lock) -> first static witness, over every
+    path: direct nested ``with`` blocks AND acquisitions reached through
+    calls at any depth while a lock is held."""
+    edges: dict = {}
+    for qual, scan in s.scans.items():
+        info = scan.info
+        for name, line, held in scan.acquires:
+            for L in dict.fromkeys(held):
+                if L != name and (L, name) not in edges:
+                    edges[(L, name)] = Edge(L, name, info, line, ())
+        for cs in scan.calls:
+            if not cs.held:
+                continue
+            for callee in cs.callees:
+                for name, (l2, chain2) in s.acquires.get(
+                        callee.qual, {}).items():
+                    frame = (_frame(callee, l2),) + chain2
+                    for L in dict.fromkeys(cs.held):
+                        if L != name and (L, name) not in edges:
+                            edges[(L, name)] = Edge(L, name, info,
+                                                    cs.line, frame)
+    return edges
+
+
+def find_path(edges: dict, src: str, dst: str) -> Optional[list]:
+    """A lock path src -> ... -> dst through the edge graph (DFS), as the
+    Edge list walked — JGL017's cycle reporter uses it to print BOTH
+    chains of an AB/BA pair."""
+    adj: dict = {}
+    for (a, _b), e in edges.items():
+        adj.setdefault(a, []).append(e)
+    stack = [(src, [])]
+    seen = set()
+    while stack:
+        node, path = stack.pop()
+        if node == dst and path:
+            return path
+        if node in seen:
+            continue
+        seen.add(node)
+        for e in adj.get(node, ()):
+            stack.append((e.dst, path + [e]))
+    return None

@@ -1,0 +1,84 @@
+"""graftflow engine: build the program, run the fixpoint, apply
+suppressions.
+
+The Finding shape and the baseline machinery (load/apply/build/write,
+shrink-only ratchet) are graftlint's — one implementation, two baseline
+files. Suppressions use graftflow's OWN tag::
+
+    self._cache = snap.store  # graftflow: disable=JGL018 gen-keyed, released on publish
+
+A reason is required: a bare ``# graftflow: disable=JGL018`` is NOT
+honored (the finding still reports). The tag differs from graftlint's so
+graftlint's JGL000 suppression-hygiene rule never sees (and never
+mis-flags) a graftflow waiver, and vice versa.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import tokenize
+from typing import Optional
+
+from tools.graftflow import callgraph, dataflow
+from tools.graftflow import rules as flow_rules
+from tools.graftlint.engine import default_root
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftflow:\s*disable=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+    r"(?:\s+(?P<reason>\S.*))?"
+)
+
+
+def parse_suppressions(source: str) -> dict[int, set]:
+    """Line -> codes suppressed on that line (reasoned comments only)."""
+    out: dict[int, set] = {}
+    if "graftflow:" not in source:
+        return out
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if m and m.group("reason"):
+                codes = {c.strip() for c in m.group(1).split(",")}
+                out.setdefault(tok.start[0], set()).update(codes)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _apply_suppressions(findings: list, root: str) -> list:
+    by_path: dict[str, list] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    kept: list = []
+    for path, fs in by_path.items():
+        try:
+            with open(os.path.join(root, path), encoding="utf-8") as fh:
+                sup = parse_suppressions(fh.read())
+        except OSError:
+            sup = {}
+        for f in fs:
+            if f.code in sup.get(f.line, ()):
+                continue
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
+    return kept
+
+
+def analyze_program(target: str, root: Optional[str] = None,
+                    cache_path: Optional[str] = None,
+                    hierarchy_path: str = callgraph.HIERARCHY_PATH) -> list:
+    """All JGL016-JGL019 findings for a package tree, suppressions
+    applied. Note graftflow is a WHOLE-program analysis: pointing it at a
+    subdirectory analyzes only the calls visible inside that subtree, so
+    the tier-1 gate always runs it on the full package."""
+    target = os.path.realpath(target)
+    root_real = os.path.realpath(root) if root else default_root(target)
+    prog = callgraph.load_or_build(target, root_real, cache_path,
+                                   hierarchy_path)
+    summaries = dataflow.analyze(prog)
+    findings = flow_rules.run_rules(prog, summaries)
+    return _apply_suppressions(findings, root_real)

@@ -1,0 +1,290 @@
+"""The ONE module-local name-resolution engine.
+
+Both static tools build on this module: graftlint's one-level
+interprocedural helpers (the JGL008/JGL009 reach logic) resolve calls and
+summarize helper bodies through it, and graftflow's whole-program call
+graph uses the same per-module definition index as its bottom layer — so
+a resolution fix lands in both tools at once instead of drifting apart
+(the PR-12 ModuleIndex traversal this replaces was a second copy).
+
+Everything here is pure ``ast``: no JAX, no package imports, so the
+tier-1 static-analysis tests run with no device and in milliseconds.
+
+The resolution tiers (documented in docs/static_analysis.md):
+
+  bare name        ``helper(...)``       -> a module-level def
+  self method      ``self.helper(...)``  -> a def on the enclosing class
+  self callback    ``self._cb(...)``     -> the defs/lambdas any method of
+                                           the class binds to ``self._cb``
+                                           (the finalize-callback idiom)
+
+Anything else (imported names, attribute receivers, locals) needs the
+cross-module tables graftflow's callgraph layer owns.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+# zero-positional-arg attribute calls that block forever without a bound
+# (shared by graftlint JGL009 and graftflow's wait summaries)
+UNBOUNDED_WAIT_NAMES = frozenset({"wait", "get", "acquire", "join"})
+
+# np/jax spellings whose first argument a fetch materializes host-side
+FETCH_CALL_NAMES = (
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get",
+)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit, or functools.partial(jax.jit, ...) around it."""
+    d = dotted(node)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        f = dotted(node.func)
+        if f in ("functools.partial", "partial") and node.args:
+            return is_jit_expr(node.args[0])
+        return is_jit_expr(node.func)
+    return False
+
+
+def jit_decorated(fn: ast.AST) -> bool:
+    return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+        is_jit_expr(d) for d in fn.decorator_list)
+
+
+def fn_body(fn) -> list:
+    """The statement list a function-like node runs: ``body`` for defs, a
+    synthesized single expression statement for lambdas (so the same
+    walkers cover the ``self._cb = lambda ...`` callback shape)."""
+    if isinstance(fn, ast.Lambda):
+        return [ast.Expr(value=fn.body)]
+    return fn.body
+
+
+def walk_own_body(fn) -> Iterator[ast.AST]:
+    """Every node of `fn`'s DIRECT body: nested defs/lambdas are skipped
+    wholesale — their bodies run on a later schedule (the
+    finalize-closure idiom), not inside the caller's critical section."""
+    stack = list(fn_body(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class ModuleDefs:
+    """Per-module definition index: module-level functions by bare name,
+    methods by (class, name), classes, jit-decorated/jit-assigned
+    callables, and the ``self._x = <callable>`` callback bindings."""
+
+    def __init__(self, tree: ast.Module):
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.methods: dict[tuple, ast.FunctionDef] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.jitted_fns: set[str] = set()
+        # (class, attr) -> method/function NAMES bound to self.attr
+        # anywhere in the class body (the self._x callback idiom)
+        self.self_callbacks: dict[tuple, set[str]] = {}
+        # (class, attr) -> lambda nodes bound to self.attr
+        self.self_lambda_callbacks: dict[tuple, list[ast.Lambda]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if jit_decorated(node):
+                    self.jitted_fns.add(node.name)
+                self.functions[node.name] = node
+                continue
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+                self._index_callbacks(node)
+                continue
+            targets: list[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is not None and is_jit_expr(value):
+                self.jitted_fns.update(
+                    t.id for t in targets if isinstance(t, ast.Name))
+
+    def _index_callbacks(self, cls: ast.ClassDef) -> None:
+        """``self.attr = self.meth`` / ``= module_fn`` / ``= lambda``
+        assignments anywhere inside the class body."""
+        for sub in ast.walk(cls):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for t in sub.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                key = (cls.name, t.attr)
+                v = sub.value
+                if isinstance(v, ast.Lambda):
+                    self.self_lambda_callbacks.setdefault(
+                        key, []).append(v)
+                    continue
+                d = dotted(v)
+                if d is None:
+                    continue
+                parts = d.split(".")
+                if len(parts) == 2 and parts[0] == "self" \
+                        and (cls.name, parts[1]) in self.methods:
+                    self.self_callbacks.setdefault(key, set()).add(parts[1])
+                elif len(parts) == 1 and parts[0] in self.functions:
+                    self.self_callbacks.setdefault(key, set()).add(parts[0])
+
+
+def resolve_local(defs: ModuleDefs, func_expr: ast.AST,
+                  enclosing_class: Optional[str]):
+    """The same-module function a call reaches, when resolvable with zero
+    type inference: a bare name defined at module level, or
+    ``self.helper(...)`` defined on the ENCLOSING class. Anything else
+    (imported names, deeper attribute chains, other receivers) is the
+    whole-program layer's job (tools/graftflow/callgraph.py)."""
+    if isinstance(func_expr, ast.Name):
+        return defs.functions.get(func_expr.id)
+    if isinstance(func_expr, ast.Attribute) \
+            and isinstance(func_expr.value, ast.Name) \
+            and func_expr.value.id == "self" and enclosing_class:
+        return defs.methods.get((enclosing_class, func_expr.attr))
+    return None
+
+
+# -- flow-insensitive per-function device tracking ---------------------------
+
+def is_device_expr(node, local_device_names: set, device_attrs: frozenset,
+                   jitted_fns: set) -> bool:
+    """Heuristic: does this expression hold a device array? (The JGL001
+    dataflow's predicate, shared by graftlint's helper summaries and
+    graftflow's provenance pass.)"""
+    if isinstance(node, ast.Subscript):
+        return is_device_expr(node.value, local_device_names, device_attrs,
+                              jitted_fns)
+    if isinstance(node, ast.Name):
+        return node.id in local_device_names
+    if isinstance(node, ast.Attribute):
+        return node.attr in device_attrs
+    if isinstance(node, ast.Call):
+        f = dotted(node.func) or ""
+        if f.startswith(("jnp.", "jax.lax.", "jax.numpy.")):
+            return True
+        if f == "jax.device_put":
+            return True
+        root = f.split(".")[0]
+        return f in jitted_fns or root in jitted_fns
+    return False
+
+
+def bound_device_names(fn, device_attrs: frozenset,
+                       jitted_fns: set) -> set:
+    """Names `fn`'s own body binds from device-producing expressions
+    (flow-insensitive on purpose: a helper is small, and what this
+    over-approximates lands in the baseline with a justification — the
+    JGL001 philosophy). Iterated to a fixpoint: `walk_own_body` yields in
+    no particular order, and an alias chain (`rows = self._store;
+    out = rows`) must converge regardless."""
+    assigns: list = []
+    for n in walk_own_body(fn):
+        targets: list = []
+        value = None
+        if isinstance(n, ast.Assign):
+            targets, value = n.targets, n.value
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            targets, value = [n.target], n.value
+        if value is not None:
+            assigns.append((targets, value))
+    out: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for targets, value in assigns:
+            if not is_device_expr(value, out, device_attrs, jitted_fns):
+                continue
+            for t in targets:
+                names: list = []
+                if isinstance(t, ast.Name):
+                    names = [t.id]
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    names = [e.id for e in t.elts
+                             if isinstance(e, ast.Name)]
+                for nm in names:
+                    if nm not in out:
+                        out.add(nm)
+                        changed = True
+    return out
+
+
+def sync_facts(fn, device_attrs: frozenset, jitted_fns: set) -> list:
+    """(line, description) for each blocking device->host sync in `fn`'s
+    own body — the facts graftlint's interprocedural JGL008 reports at a
+    lock-held call site one level up, and the leaf facts graftflow's
+    fixed-point sync summaries start from. Same sync set as the lexical
+    check (block_until_ready, asarray-family/device_get on a device
+    value) plus `_fetch_packed`, the repo's named fetch point."""
+    device = bound_device_names(fn, device_attrs, jitted_fns)
+    out: list = []
+    for n in walk_own_body(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+            out.append((n.lineno, "calls `.block_until_ready()`"))
+            continue
+        fd = dotted(f) or ""
+        if fd.split(".")[-1] == "_fetch_packed":
+            out.append((n.lineno, "runs `_fetch_packed(...)` (the "
+                                  "blocking dispatch fetch)"))
+            continue
+        arg = n.args[0] if n.args else None
+        if fd in FETCH_CALL_NAMES and arg is not None \
+                and is_device_expr(arg, device, device_attrs, jitted_fns):
+            out.append((n.lineno, f"runs `{fd}(...)` on a device value"))
+    out.sort()
+    return out
+
+
+def wait_facts(fn, contextvars: set) -> list:
+    """(line, description) for each unbounded blocking wait in `fn`'s own
+    body — graftlint's interprocedural JGL009 facts."""
+    out: list = []
+    for n in walk_own_body(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if not isinstance(f, ast.Attribute) \
+                or f.attr not in UNBOUNDED_WAIT_NAMES:
+            continue
+        if n.args:
+            continue
+        if any(kw.arg in ("timeout", "block", "blocking")
+               for kw in n.keywords):
+            continue
+        if f.attr == "get" and (dotted(f.value) or "") in contextvars:
+            continue
+        out.append((n.lineno, f"calls `.{f.attr}()` with no timeout"))
+    out.sort()
+    return out

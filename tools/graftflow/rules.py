@@ -1,0 +1,341 @@
+"""graftflow rules: JGL016-JGL019 over the interprocedural summaries.
+
+Where graftlint's rules are lexical-plus-one-level, these four consume
+the whole-program facts (tools/graftflow/dataflow.py) and report with the
+static call chain in the message, so a finding at depth four reads like a
+stack trace instead of a riddle.
+
+Code allocation continues graftlint's JGL space (next free after JGL015);
+both tools share the Finding shape and baseline machinery, but each owns
+its own baseline file and suppression tag (``# graftflow: disable=...``)
+so the ratchets stay independent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.graftflow import dataflow, resolve
+from tools.graftlint.engine import Finding
+
+RULE_DOCS = {
+    "JGL016": "device sync reachable under a no-fetch lock at ANY call "
+              "depth — the static twin of graftsan's runtime check "
+              "(graftlint JGL008 stops at one level)",
+    "JGL017": "static lock-order conformance: every derivable "
+              "held->acquired edge must climb tools/graftsan/"
+              "lock_hierarchy.json levels; cycles report both chains",
+    "JGL018": "snapshot escape: a snapshot (or a view of its arrays) "
+              "bound into state that outlives the publish window — "
+              "stale/torn-read hazard unless generation-keyed",
+    "JGL019": "jit-shape churn: a non-bucket-snapped dimension reaching "
+              "a STATIC jit parameter — every distinct value is a "
+              "recompile (snap with _bucket_rows/_pow2_at_least first)",
+}
+
+# call-name tokens that certify a dimension was snapped to the bucketed
+# grid before use (the tpu.py idiom: _bucket_b/_bucket_rows/_snap_top_p/
+# _pow2_at_least, plus generic pad/round/align spellings)
+SANITIZER_TOKENS = ("bucket", "snap", "pow2", "pad", "round", "align",
+                    "grid")
+
+
+def _chain_suffix(chain: tuple) -> str:
+    return f" via {' -> '.join(chain)}" if chain else ""
+
+
+# -- JGL016: device sync under a no-fetch lock, any depth --------------------
+
+def _no_fetch_locks(prog) -> frozenset:
+    return frozenset(n for n, row in prog.hierarchy.items()
+                     if row.get("no_fetch_under"))
+
+
+def check_sync_under_lock(prog, s: dataflow.Summaries) -> list:
+    nfu = _no_fetch_locks(prog)
+    out: dict = {}
+    for qual, scan in s.scans.items():
+        info = scan.info
+        for cs in scan.calls:
+            held = [L for L in dict.fromkeys(cs.held) if L in nfu]
+            if not held:
+                continue
+            for callee in cs.callees:
+                for (_l, desc, chain) in s.syncs.get(
+                        callee.qual, {}).values():
+                    full = (dataflow._frame(callee, _l),) + chain
+                    key = (info.rel, cs.line, held[0], desc,
+                           callee.qual)
+                    if key in out:
+                        continue
+                    out[key] = Finding(
+                        "JGL016", info.rel, cs.line, cs.node.col_offset,
+                        info.symbol(),
+                        f"call while holding `{held[0]}` (no_fetch_under) "
+                        f"reaches a device sync at depth {len(chain) + 1}: "
+                        f"{desc}{_chain_suffix(full)}")
+    return list(out.values())
+
+
+# -- JGL017: static lock-order conformance -----------------------------------
+
+def check_lock_order(prog, s: dataflow.Summaries) -> list:
+    levels = {n: row.get("level") for n, row in prog.hierarchy.items()}
+    edges = dataflow.lock_edges(prog, s)
+    out: list = []
+    for (src, dst), e in sorted(edges.items()):
+        if src not in levels or dst not in levels:
+            continue  # unregistered locks are the drift test's job
+        if levels[src] < levels[dst]:
+            continue  # climbs the hierarchy: legal
+        msg = (f"acquires `{dst}` (level {levels[dst]}) while holding "
+               f"`{src}` (level {levels[src]}) — the hierarchy requires "
+               f"strictly increasing levels; witness: {e.describe()}")
+        back = dataflow.find_path(edges, dst, src)
+        if back:
+            msg += ("; closes a cycle via "
+                    + " , then ".join(b.describe() for b in back))
+        out.append(Finding("JGL017", e.rel, e.line, 0, e.symbol, msg))
+    return out
+
+
+# -- JGL018: snapshot escape -------------------------------------------------
+
+def _module_globals(mi) -> set:
+    out: set = set()
+    for node in mi.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        out.update(t.id for t in targets if isinstance(t, ast.Name))
+    return out
+
+
+def _escape_target(t, scan, mod_globals: set) -> Optional[str]:
+    """A description of the outliving store a target writes, or None when
+    the target is snapshot-safe (locals, the `self._snap` publish
+    itself)."""
+    if isinstance(t, ast.Attribute):
+        d = resolve.dotted(t)
+        if d and d.startswith("self.") and d != "self._snap":
+            return d
+        return None
+    if isinstance(t, ast.Subscript):
+        base = t.value
+        d = resolve.dotted(base)
+        if d is None:
+            return None
+        if d.startswith("self."):
+            return f"{d}[...]"
+        if "." not in d and d in mod_globals:
+            return f"{d}[...]"
+        return None
+    if isinstance(t, ast.Name) and t.id in scan.global_names:
+        return t.id
+    return None
+
+
+def _value_kind(s, scan, value) -> Optional[str]:
+    kind = dataflow._snap_kind(s, scan, value, scan.snap_locals,
+                               scan.derived_locals)
+    if kind is not None:
+        return kind
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        for e in value.elts:
+            k = dataflow._snap_kind(s, scan, e, scan.snap_locals,
+                                    scan.derived_locals)
+            if k is not None:
+                return k
+    if isinstance(value, ast.Dict):
+        for e in list(value.keys) + list(value.values):
+            if e is not None:
+                k = dataflow._snap_kind(s, scan, e, scan.snap_locals,
+                                        scan.derived_locals)
+                if k is not None:
+                    return k
+    return None
+
+
+def check_snapshot_escape(prog, s: dataflow.Summaries) -> list:
+    out: list = []
+    seen: set = set()
+    for qual, scan in s.scans.items():
+        info = scan.info
+        mi = prog.modules[info.module]
+        mod_globals = _module_globals(mi)
+        for targets, value in scan.assigns:
+            kind = _value_kind(s, scan, value)
+            if kind is None:
+                continue
+            for t in targets:
+                tgt = _escape_target(t, scan, mod_globals)
+                if tgt is None:
+                    continue
+                key = (info.rel, t.lineno, tgt)
+                if key in seen:
+                    continue
+                seen.add(key)
+                what = "a snapshot" if kind == "snap" \
+                    else "a view of a snapshot's arrays"
+                out.append(Finding(
+                    "JGL018", info.rel, t.lineno, t.col_offset,
+                    info.symbol(),
+                    f"binds {what} into `{tgt}`, which outlives the "
+                    f"snapshot's publish window — stale/torn-read hazard "
+                    f"unless generation-keyed and explicitly released "
+                    f"(docs/concurrency.md, snapshot plane)"))
+        for cs in scan.calls:
+            f = cs.node.func
+            if not isinstance(f, ast.Attribute) \
+                    or f.attr not in dataflow.MUTATOR_NAMES:
+                continue
+            tgt = _escape_target(f.value, scan, mod_globals) \
+                if not isinstance(f.value, ast.Name) else (
+                    f.value.id if f.value.id in mod_globals else None)
+            if tgt is None:
+                continue
+            args = list(cs.node.args) + [kw.value
+                                         for kw in cs.node.keywords]
+            kind = None
+            for a in args:
+                kind = dataflow._snap_kind(s, scan, a, scan.snap_locals,
+                                           scan.derived_locals)
+                if kind is not None:
+                    break
+            if kind is None:
+                continue
+            key = (info.rel, cs.line, f"{tgt}.{f.attr}")
+            if key in seen:
+                continue
+            seen.add(key)
+            what = "a snapshot" if kind == "snap" \
+                else "a view of a snapshot's arrays"
+            out.append(Finding(
+                "JGL018", info.rel, cs.line, cs.node.col_offset,
+                info.symbol(),
+                f"`.{f.attr}(...)` smuggles {what} into `{tgt}`, which "
+                f"outlives the snapshot's publish window — stale/"
+                f"torn-read hazard unless generation-keyed and "
+                f"explicitly released (docs/concurrency.md)"))
+    return out
+
+
+# -- JGL019: jit-shape churn -------------------------------------------------
+
+def _is_sanitizer_call(fd: str) -> bool:
+    last = fd.split(".")[-1].lower()
+    return any(tok in last for tok in SANITIZER_TOKENS)
+
+
+def _tainted(expr, tainted: set) -> bool:
+    """Does this expression carry a data-dependent (non-snapped)
+    dimension? Sources: len(...), ``.shape``; propagated through
+    arithmetic, min/max, conditionals, and tainted locals; cleared by any
+    bucket/snap/pow2/pad/round/align-named call."""
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        if expr.attr == "shape":
+            return True
+        return False
+    if isinstance(expr, ast.Subscript):
+        return _tainted(expr.value, tainted)
+    if isinstance(expr, ast.Call):
+        fd = resolve.dotted(expr.func) or ""
+        if _is_sanitizer_call(fd):
+            return False
+        if fd == "len" and expr.args:
+            return True
+        if fd.split(".")[-1] in ("min", "max"):
+            return any(_tainted(a, tainted) for a in expr.args)
+        return False
+    if isinstance(expr, ast.BinOp):
+        return _tainted(expr.left, tainted) or _tainted(expr.right,
+                                                        tainted)
+    if isinstance(expr, ast.UnaryOp):
+        return _tainted(expr.operand, tainted)
+    if isinstance(expr, ast.IfExp):
+        return _tainted(expr.body, tainted) or _tainted(expr.orelse,
+                                                        tainted)
+    return False
+
+
+def _tainted_locals(scan) -> set:
+    out: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for targets, value in scan.assigns:
+            if not _tainted(value, out):
+                continue
+            for t in targets:
+                names = [t.id] if isinstance(t, ast.Name) else [
+                    e.id for e in getattr(t, "elts", [])
+                    if isinstance(e, ast.Name)]
+                for nm in names:
+                    if nm not in out:
+                        out.add(nm)
+                        changed = True
+    return out
+
+
+def check_jit_shape_churn(prog, s: dataflow.Summaries) -> list:
+    out: list = []
+    seen: set = set()
+    for qual, scan in s.scans.items():
+        info = scan.info
+        tainted = _tainted_locals(scan)
+        for cs in scan.calls:
+            if cs.jit is not None and cs.jit.static_names:
+                argmap = dataflow._map_call_args(cs.node,
+                                                 list(cs.jit.params))
+                for p in sorted(cs.jit.static_names):
+                    arg = argmap.get(p)
+                    if arg is None or not _tainted(arg, tainted):
+                        continue
+                    key = (info.rel, cs.line, cs.jit.name, p)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(Finding(
+                        "JGL019", info.rel, cs.line,
+                        cs.node.col_offset, info.symbol(),
+                        f"non-bucket-snapped dimension reaches STATIC "
+                        f"jit param `{p}` of `{cs.jit.name}` — every "
+                        f"distinct value recompiles; snap it "
+                        f"(_bucket_rows/_pow2_at_least) first"))
+            for callee in cs.callees:
+                sinks = s.static_sinks.get(callee.qual, {})
+                if not sinks:
+                    continue
+                argmap = dataflow._map_call_args(cs.node, callee.params())
+                for p, chain in sorted(sinks.items()):
+                    arg = argmap.get(p)
+                    if arg is None or not _tainted(arg, tainted):
+                        continue
+                    key = (info.rel, cs.line, callee.qual, p)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(Finding(
+                        "JGL019", info.rel, cs.line,
+                        cs.node.col_offset, info.symbol(),
+                        f"non-bucket-snapped dimension flows into "
+                        f"STATIC jit argument via param `{p}` of "
+                        f"{dataflow._frame(callee, cs.line)}"
+                        f"{_chain_suffix(chain)} — every distinct value "
+                        f"recompiles; snap it first"))
+    return out
+
+
+def run_rules(prog, s: dataflow.Summaries) -> list:
+    findings: list = []
+    findings += check_sync_under_lock(prog, s)
+    findings += check_lock_order(prog, s)
+    findings += check_snapshot_escape(prog, s)
+    findings += check_jit_shape_churn(prog, s)
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
+    return findings

@@ -85,6 +85,7 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
+from tools.graftflow import resolve
 from tools.graftlint.engine import Finding
 
 # -- scope configuration -----------------------------------------------------
@@ -158,8 +159,9 @@ JGL009_PREFIXES = (
 # `.get(key)` / `.wait(5)` / `.acquire(timeout=...)` all pass: any
 # positional argument or a timeout/block(ing) kwarg counts as bounded
 # (approximate on purpose — what it over-reports lands in the baseline
-# with a written justification, the JGL001 philosophy)
-UNBOUNDED_WAIT_NAMES = frozenset({"wait", "get", "acquire", "join"})
+# with a written justification, the JGL001 philosophy). Shared with
+# graftflow's interprocedural wait summaries — one definition.
+UNBOUNDED_WAIT_NAMES = resolve.UNBOUNDED_WAIT_NAMES
 
 RULE_DOCS = {
     "JGL000": "suppression hygiene: every inline disable needs a reason and "
@@ -403,34 +405,12 @@ def is_hot(rel_path: str) -> bool:
 
 # -- small AST helpers -------------------------------------------------------
 
-def dotted(node: ast.AST) -> Optional[str]:
-    """'a.b.c' for a Name/Attribute chain, else None."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _is_jit_expr(node: ast.AST) -> bool:
-    """jax.jit / jit, or functools.partial(jax.jit, ...) around it."""
-    d = dotted(node)
-    if d in ("jax.jit", "jit"):
-        return True
-    if isinstance(node, ast.Call):
-        f = dotted(node.func)
-        if f in ("functools.partial", "partial") and node.args:
-            return _is_jit_expr(node.args[0])
-        return _is_jit_expr(node.func)
-    return False
-
-
-def _jit_decorated(fn: ast.AST) -> bool:
-    return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
-        _is_jit_expr(d) for d in fn.decorator_list)
+# one resolution engine: the dotted/jit helpers live in graftflow's
+# resolve module now (the module-local layer both tools build on); the
+# old names stay as aliases so rule code and tests read unchanged
+dotted = resolve.dotted
+_is_jit_expr = resolve.is_jit_expr
+_jit_decorated = resolve.jit_decorated
 
 
 def _const_str(node: ast.AST) -> Optional[str]:
@@ -462,13 +442,17 @@ class ModuleIndex:
         # for JGL008/JGL009): module-level functions by bare name, class
         # methods by (class, name) — the targets a `with <lock>:` body can
         # reach in one hop via `helper(...)` or `self.helper(...)`. The
-        # helper-body summaries (does it sync? does it block unbounded?)
-        # are computed lazily and cached per function node. ONE level
-        # deep on purpose: a sync two calls down is out of scope
-        # (documented in docs/static_analysis.md; the runtime graftsan
-        # device-sync sanitizer catches any depth).
-        self.functions: dict[str, ast.FunctionDef] = {}
-        self.methods: dict[tuple, ast.FunctionDef] = {}
+        # indexing and the helper-body summaries (does it sync? does it
+        # block unbounded?) live in tools/graftflow/resolve.py — the ONE
+        # resolution engine graftflow's whole-program call graph also
+        # builds on — and are cached here per function node. ONE level
+        # deep on purpose in graftlint: a sync two calls down is
+        # graftflow JGL016's job (any depth), and the runtime graftsan
+        # device-sync sanitizer witnesses it too.
+        self.defs = resolve.ModuleDefs(tree)
+        self.functions = self.defs.functions
+        self.methods = self.defs.methods
+        self.jitted_fns = set(self.defs.jitted_fns)
         self._sync_cache: dict[int, list] = {}
         self._wait_cache: dict[int, list] = {}
         # local names bound to the incidents journal's emit() by a
@@ -499,18 +483,10 @@ class ModuleIndex:
                     self.thread_targets.add(parts[0])
                 elif len(parts) == 2 and parts[0] == "self":
                     self.thread_targets.add(parts[1])
+        # defs/methods/jit callables come from the shared ModuleDefs index
+        # above; this pass owns only the graftlint-specific module facts
+        # (mutable registries, module locks, ContextVars)
         for node in tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if _jit_decorated(node):
-                    self.jitted_fns.add(node.name)
-                self.functions[node.name] = node
-                continue
-            if isinstance(node, ast.ClassDef):
-                for sub in node.body:
-                    if isinstance(sub, (ast.FunctionDef,
-                                        ast.AsyncFunctionDef)):
-                        self.methods[(node.name, sub.name)] = sub
-                continue
             targets: list[ast.expr] = []
             value: Optional[ast.expr] = None
             if isinstance(node, ast.Assign):
@@ -526,8 +502,6 @@ class ModuleIndex:
                 for n in names:
                     if n != "__all__":
                         self.registries[n] = node.lineno
-            elif _is_jit_expr(value):
-                self.jitted_fns.update(names)
             if isinstance(value, ast.Call) and (dotted(value.func) or "") in (
                     "threading.Lock", "threading.RLock", "Lock", "RLock"):
                 self.locks.update(names)
@@ -546,137 +520,37 @@ class ModuleIndex:
         return False
 
     # -- one-level helper-body summaries (interprocedural JGL008/JGL009) -----
+    # The traversal and fact extraction live in tools/graftflow/resolve.py
+    # (the one resolution engine); this class keeps only the per-node
+    # memoization and the graftlint-specific constants it feeds in.
 
-    @staticmethod
-    def _walk_own_body(fn):
-        """Every node of `fn`'s DIRECT body: nested defs/lambdas are
-        skipped wholesale — their bodies run on a later schedule (the
-        finalize-closure idiom), not inside the caller's critical
-        section."""
-        stack = list(fn.body)
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-                continue
-            yield n
-            stack.extend(ast.iter_child_nodes(n))
+    _walk_own_body = staticmethod(resolve.walk_own_body)
 
     def _helper_device_names(self, fn) -> set:
-        """Names `fn`'s own body binds from device-producing expressions
-        (flow-insensitive on purpose: a helper is small, and what this
-        over-approximates lands in the baseline with a justification —
-        the JGL001 philosophy). Iterated to a fixpoint: `_walk_own_body`
-        yields in no particular order, and an alias chain
-        (`rows = self._store; out = rows`) must converge regardless."""
-        assigns: list = []
-        for n in self._walk_own_body(fn):
-            targets: list = []
-            value = None
-            if isinstance(n, ast.Assign):
-                targets, value = n.targets, n.value
-            elif isinstance(n, ast.AnnAssign) and n.value is not None:
-                targets, value = [n.target], n.value
-            if value is not None:
-                assigns.append((targets, value))
-        out: set = set()
-        changed = True
-        while changed:
-            changed = False
-            for targets, value in assigns:
-                if not self._is_device_expr(value, out):
-                    continue
-                for t in targets:
-                    names: list = []
-                    if isinstance(t, ast.Name):
-                        names = [t.id]
-                    elif isinstance(t, (ast.Tuple, ast.List)):
-                        names = [e.id for e in t.elts
-                                 if isinstance(e, ast.Name)]
-                    for nm in names:
-                        if nm not in out:
-                            out.add(nm)
-                            changed = True
-        return out
+        return resolve.bound_device_names(fn, DEVICE_ATTRS, self.jitted_fns)
 
     def _is_device_expr(self, node, device_names: set) -> bool:
-        if isinstance(node, ast.Subscript):
-            return self._is_device_expr(node.value, device_names)
-        if isinstance(node, ast.Name):
-            return node.id in device_names
-        if isinstance(node, ast.Attribute):
-            return node.attr in DEVICE_ATTRS
-        if isinstance(node, ast.Call):
-            f = dotted(node.func) or ""
-            if f.startswith(("jnp.", "jax.lax.", "jax.numpy.")):
-                return True
-            if f == "jax.device_put":
-                return True
-            root = f.split(".")[0]
-            return f in self.jitted_fns or root in self.jitted_fns
-        return False
+        return resolve.is_device_expr(node, device_names, DEVICE_ATTRS,
+                                      self.jitted_fns)
 
     def helper_syncs(self, fn) -> list:
         """(line, description) for each blocking device->host sync in
         `fn`'s own body — the facts the interprocedural JGL008 reports at
-        a lock-held call site one level up. Same sync set as the lexical
-        check (block_until_ready, asarray-family/device_get on a device
-        value) plus `_fetch_packed`, the repo's named fetch point."""
+        a lock-held call site one level up."""
         cached = self._sync_cache.get(id(fn))
-        if cached is not None:
-            return cached
-        device = self._helper_device_names(fn)
-        out: list = []
-        for n in self._walk_own_body(fn):
-            if not isinstance(n, ast.Call):
-                continue
-            f = n.func
-            if isinstance(f, ast.Attribute) \
-                    and f.attr == "block_until_ready":
-                out.append((n.lineno, "calls `.block_until_ready()`"))
-                continue
-            fd = dotted(f) or ""
-            if fd.split(".")[-1] == "_fetch_packed":
-                out.append((n.lineno, "runs `_fetch_packed(...)` (the "
-                                      "blocking dispatch fetch)"))
-                continue
-            arg = n.args[0] if n.args else None
-            if fd in ("np.asarray", "np.array", "numpy.asarray",
-                      "numpy.array", "jax.device_get") \
-                    and arg is not None \
-                    and self._is_device_expr(arg, device):
-                out.append((n.lineno, f"runs `{fd}(...)` on a device "
-                                      "value"))
-        out.sort()
-        self._sync_cache[id(fn)] = out
-        return out
+        if cached is None:
+            cached = resolve.sync_facts(fn, DEVICE_ATTRS, self.jitted_fns)
+            self._sync_cache[id(fn)] = cached
+        return cached
 
     def helper_waits(self, fn) -> list:
         """(line, description) for each unbounded blocking wait in `fn`'s
         own body — the interprocedural JGL009 facts."""
         cached = self._wait_cache.get(id(fn))
-        if cached is not None:
-            return cached
-        out: list = []
-        for n in self._walk_own_body(fn):
-            if not isinstance(n, ast.Call):
-                continue
-            f = n.func
-            if not isinstance(f, ast.Attribute) \
-                    or f.attr not in UNBOUNDED_WAIT_NAMES:
-                continue
-            if n.args:
-                continue
-            if any(kw.arg in ("timeout", "block", "blocking")
-                   for kw in n.keywords):
-                continue
-            if f.attr == "get" \
-                    and (dotted(f.value) or "") in self.contextvars:
-                continue
-            out.append((n.lineno, f"calls `.{f.attr}()` with no timeout"))
-        out.sort()
-        self._wait_cache[id(fn)] = out
-        return out
+        if cached is None:
+            cached = resolve.wait_facts(fn, self.contextvars)
+            self._wait_cache[id(fn)] = cached
+        return cached
 
 
 # -- the walker --------------------------------------------------------------
@@ -1221,17 +1095,13 @@ class RuleWalker(ast.NodeVisitor):
 
     def _resolve_local_helper(self, node: ast.Call):
         """The same-module function a call reaches, when resolvable with
-        zero type inference: a bare name defined at module level, or
-        `self.helper(...)` defined on the ENCLOSING class. Anything else
-        (imported names, deeper attribute chains, other receivers) is
-        out of this one-level analysis' scope."""
-        f = node.func
-        if isinstance(f, ast.Name):
-            return self.mod.functions.get(f.id)
-        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
-                and f.value.id == "self" and self.class_stack:
-            return self.mod.methods.get((self.class_stack[-1], f.attr))
-        return None
+        zero type inference (tools/graftflow/resolve.py — the shared
+        resolution engine). Imported names, deeper attribute chains, and
+        other receivers are graftflow's whole-program scope, not this
+        one-level analysis'."""
+        return resolve.resolve_local(
+            self.mod.defs, node.func,
+            self.class_stack[-1] if self.class_stack else None)
 
     def _check_lock_helper_call(self, node: ast.Call) -> None:
         if self.with_locks == 0 or self.fn_depth == 0:

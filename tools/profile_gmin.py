@@ -1,23 +1,49 @@
-"""Component-level timing of the headline search at the SIFT shape on the
-live chip: where does the 1.2 s/batch actually go?
+"""Stage-level profiler for the headline gmin search — three timing modes
+over one shared setup (this file replaces the former profile_gmin2.py /
+profile_gmin3.py scripts).
 
-Times (median of reps, after warmup):
-  kernel      group_min_scores pallas call alone
-  select      approx_min_k over the [B, ncols] group-min matrix
-  topk        full gmin_topk (kernel + select + gather-rescore + top-k)
-  legacy      _search_full (round-1 lax.scan kernel, rescore_r=128)
-  kernel_nt   variant kernel: store pre-transposed [G, d, ncols], dot
-              without the in-loop .T
-  kernel_c4   variant: transposed layout + groups processed 4-at-a-time as
-              one [qb,d]@[d,4*scg] matmul per slice (bigger MXU ops, fewer
-              fori iterations)
+Modes (``--mode``):
 
-Usage: python tools/profile_gmin.py [N] [B]
+  loop (default)  Relay-proof: each stage runs ITERS times INSIDE one jit
+                  via lax.scan, the carry perturbing the query so XLA
+                  cannot hoist or CSE the body. The axon relay costs
+                  ~70-140 ms per device round trip, so single-call
+                  timings measure enqueue, not execution; wall / ITERS is
+                  true device time to within one round trip. Stages:
+                    kernel        group_min_scores (pallas fast scan)
+                    kernsel       kernel + approx_min_k group selection
+                    topk_strided  full gmin_topk, strided-row gather
+                    topk_block    full gmin_topk, contiguous block gather
+                    legacy        _search_full lax.scan, rescore_r=128
+                  Runs interpreted off-TPU so it smokes on CPU.
+
+  component       Single-call medians (enqueue-bound on the relay — use
+                  loop mode for truth) of the search components plus two
+                  pallas layout variants:
+                    kernel / select / topk / legacy   as above
+                    kernel_nt     store pre-transposed [G, d, ncols],
+                                  dot without the in-loop .T
+                    kernel_c2/c4  transposed layout + groups processed
+                                  2/4-at-a-time as one [qb,d]@[d,gc*scg]
+                                  matmul per slice (bigger MXU ops,
+                                  fewer fori iterations)
+
+  gather          Isolates the candidate-rescore gather stage:
+                    search_gmin       full jitted serving entry
+                    kernel / select   as above
+                    gather_strided    strided-member gather (old path)
+                    gather_blocked    contiguous [ncols, G*D] block rows
+                    rescore_nogather  dense-slab upper bound (no gather)
+
+Usage: python tools/profile_gmin.py [--mode loop|component|gather]
+           [N] [B] [ITERS]
 """
 
+import argparse
 import functools
 import sys
 import time
+from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
@@ -29,16 +55,30 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 from weaviate_tpu.ops import gmin_scan
 from weaviate_tpu.ops.gmin_scan import G
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
-B = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
 D = 128
 K = 10
-RG = 64
 REPS = 5
 
 
-def timed(name, fn, *args):
-    fn(*args)  # warmup/compile
+def make_data(n, b):
+    """The shared SIFT-shape inputs every mode profiles against."""
+    rng = np.random.default_rng(0)
+    store = jnp.asarray(rng.standard_normal((n, D)), jnp.float32)
+    norms = jnp.sum(store**2, axis=1)
+    return SimpleNamespace(
+        n=n, b=b, rng=rng, store=store, norms=norms,
+        tombs=jnp.zeros((n,), jnp.bool_),
+        q=jnp.asarray(rng.standard_normal((b, D)), jnp.float32),
+        words=jnp.zeros((n // 32,), jnp.uint32),
+        ncols=n // G, alpha=-2.0,
+        bias2=norms.reshape(G, n // G),
+        store3=store.reshape(G, n // G, D),
+    )
+
+
+def timed(name, b, fn, *args):
+    """Single-call timing: median of REPS after a blocked warmup."""
+    jax.block_until_ready(fn(*args))
     ts = []
     for _ in range(REPS):
         t0 = time.perf_counter()
@@ -46,10 +86,34 @@ def timed(name, fn, *args):
         jax.block_until_ready(out)
         ts.append(time.perf_counter() - t0)
     med = sorted(ts)[len(ts) // 2]
-    qps = B / med
-    print(f"{name:12s} {med * 1e3:9.1f} ms/batch  {qps:10.0f} qps")
+    print(f"{name:16s} {med * 1e3:9.1f} ms/batch  {b / med:10.0f} qps",
+          flush=True)
     return med
 
+
+def loop_timed(name, b, iters, fn, q, *rest):
+    """fn(q, *rest) -> array; runs ITERS chained iterations in ONE jit."""
+
+    @jax.jit
+    def run(q0, *r):
+        def body(carry, _):
+            out = fn(q0 + carry, *r)
+            # fold one element back into the carry: serializes iterations
+            return 1e-9 * out.ravel()[0].astype(jnp.float32), None
+
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+
+    jax.block_until_ready(run(q, *rest))  # compile + warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(q, *rest))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:16s} {dt * 1e3:9.1f} ms/batch  {b / dt:10.0f} qps",
+          flush=True)
+    return dt
+
+
+# -- component-mode pallas layout variants ------------------------------------
 
 def _nt_kernel(q_ref, s_ref, b_ref, o_ref, *, alpha, g):
     qd = q_ref[...].astype(jnp.bfloat16)
@@ -117,61 +181,178 @@ def c4_scores(q, store4, bias4, alpha, qb, scg, gc):
     )(q, store4, bias4)
 
 
-def main():
-    print(f"backend={jax.default_backend()} N={N} B={B} D={D}")
-    rng = np.random.default_rng(0)
-    store = jnp.asarray(rng.standard_normal((N, D)), jnp.float32)
-    norms = jnp.sum(store**2, axis=1)
-    tombs = jnp.zeros((N,), jnp.bool_)
-    q = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
-    words = jnp.zeros((N // 32,), jnp.uint32)
-    ncols = N // G
-    qb, scg, fp = gmin_scan.plan_tiles(B, D, ncols, G, 4)
+# -- modes --------------------------------------------------------------------
+
+def run_component(d):
+    rg = 64
+    qb, scg, fp = gmin_scan.plan_tiles(d.b, D, d.ncols, G, 4)
     print(f"tiles qb={qb} scg={scg} vmem={fp >> 20}MB")
 
-    alpha = -2.0
-    bias2 = norms.reshape(G, ncols)
-    store3 = store.reshape(G, ncols, D)
+    fn_k = jax.jit(functools.partial(gmin_scan.group_min_scores,
+                                     alpha=d.alpha))
+    timed("kernel", d.b, fn_k, d.q, d.store3, d.bias2)
 
-    fn_k = jax.jit(functools.partial(gmin_scan.group_min_scores, alpha=alpha))
-    timed("kernel", fn_k, q, store3, bias2)
-
-    gmin = fn_k(q, store3, bias2)
+    gmin = fn_k(d.q, d.store3, d.bias2)
     jax.block_until_ready(gmin)
-    fn_s = jax.jit(lambda x: jax.lax.approx_min_k(x, RG, recall_target=0.99))
-    timed("select", fn_s, gmin)
+    fn_s = jax.jit(lambda x: jax.lax.approx_min_k(x, rg, recall_target=0.99))
+    timed("select", d.b, fn_s, gmin)
 
     fn_t = functools.partial(
-        gmin_scan.gmin_topk, k=K, metric="l2-squared", rg=RG,
+        gmin_scan.gmin_topk, k=K, metric="l2-squared", rg=rg,
         active_g=G, interpret=False)
-    timed("topk", lambda: fn_t(store, norms, tombs, N, q, words, False))
+    timed("topk", d.b, lambda: fn_t(d.store, d.norms, d.tombs, d.n, d.q,
+                                    d.words, False))
 
     from weaviate_tpu.index.tpu import _search_full
     fn_l = jax.jit(_search_full, static_argnames=(
         "k", "metric", "use_allow", "exact", "active_chunks", "rescore_r"))
-    timed("legacy", lambda: fn_l(
-        store, norms, tombs, N, q, words, k=K, metric="l2-squared",
-        use_allow=False, rescore_r=128))
+    timed("legacy", d.b, lambda: fn_l(
+        d.store, d.norms, d.tombs, d.n, d.q, d.words, k=K,
+        metric="l2-squared", use_allow=False, rescore_r=128))
 
-    store3t = jnp.ascontiguousarray(jnp.transpose(store3, (0, 2, 1)))
+    store3t = jnp.ascontiguousarray(jnp.transpose(d.store3, (0, 2, 1)))
     jax.block_until_ready(store3t)
-    timed("kernel_nt", jax.jit(functools.partial(
-        nt_scores, alpha=alpha, qb=qb, scg=scg)), q, store3t, bias2)
+    timed("kernel_nt", d.b, jax.jit(functools.partial(
+        nt_scores, alpha=d.alpha, qb=qb, scg=scg)), d.q, store3t, d.bias2)
 
     for gc in (2, 4):
         scg_c = max(128, scg // gc)
         # tile-wise interleave: tile i of the slice is gc consecutive
         # width-scg_c blocks, block t = group si*gc+t, columns i*scg_c..
-        view = store3t.reshape(G // gc, gc, D, ncols // scg_c, scg_c)
+        view = store3t.reshape(G // gc, gc, D, d.ncols // scg_c, scg_c)
         s4 = jnp.ascontiguousarray(
-            view.transpose(0, 2, 3, 1, 4).reshape(G // gc, D, ncols * gc))
+            view.transpose(0, 2, 3, 1, 4).reshape(G // gc, D, d.ncols * gc))
         b4 = jnp.ascontiguousarray(
-            bias2.reshape(G // gc, gc, ncols // scg_c, scg_c)
-            .transpose(0, 2, 1, 3).reshape(G // gc, ncols * gc))
+            d.bias2.reshape(G // gc, gc, d.ncols // scg_c, scg_c)
+            .transpose(0, 2, 1, 3).reshape(G // gc, d.ncols * gc))
         jax.block_until_ready(s4)
         print(f"  gc={gc}: scg={scg_c} slice_width={gc * scg_c}")
-        timed(f"kernel_c{gc}", jax.jit(functools.partial(
-            c4_scores, alpha=alpha, qb=qb, scg=scg_c, gc=gc)), q, s4, b4)
+        timed(f"kernel_c{gc}", d.b, jax.jit(functools.partial(
+            c4_scores, alpha=d.alpha, qb=qb, scg=scg_c, gc=gc)),
+            d.q, s4, b4)
+
+
+def run_gather(d):
+    rg = 32
+    fn_full = functools.partial(
+        gmin_scan.search_gmin, use_allow=False, k=K, metric="l2-squared",
+        rg=rg, active_g=G, interpret=False)
+    timed("search_gmin", d.b, fn_full, d.store, d.norms, d.tombs, d.n,
+          d.q, d.words)
+
+    fn_k = jax.jit(functools.partial(gmin_scan.group_min_scores,
+                                     alpha=d.alpha))
+    timed("kernel", d.b, fn_k, d.q, d.store3, d.bias2)
+    gmin = fn_k(d.q, d.store3, d.bias2)
+    jax.block_until_ready(gmin)
+    fn_s = jax.jit(
+        lambda x: jax.lax.approx_min_k(x, rg, recall_target=0.99)[1])
+    timed("select", d.b, fn_s, gmin)
+    gidx = fn_s(gmin)
+    jax.block_until_ready(gidx)
+
+    # the strided-member gather as gmin_topk does it (jitted, incl. rescore)
+    offs = (jnp.arange(G) * d.ncols)[None, None, :]
+
+    @jax.jit
+    def gather_strided(gidx_, q_):
+        slots = (gidx_[:, :, None] + offs).reshape(gidx_.shape[0], rg * G)
+        cand = jnp.take(d.store, slots, axis=0)
+        return jnp.einsum("bd,brd->br", q_.astype(jnp.float32), cand)
+
+    timed("gather_strided", d.b, gather_strided, gidx, d.q)
+
+    # contiguous-block alternative: pretend groups were 16 adjacent slots —
+    # one take of [rg] 8KB rows per query from a [ncols, G*D] view
+    store_blk = d.store.reshape(d.ncols, G * D)
+
+    @jax.jit
+    def gather_blocked(gidx_, q_):
+        cand = jnp.take(store_blk, gidx_, axis=0).reshape(
+            gidx_.shape[0], rg * G, D)
+        return jnp.einsum("bd,brd->br", q_.astype(jnp.float32), cand)
+
+    timed("gather_blocked", d.b, gather_blocked, gidx, d.q)
+
+    # upper bound: no gather at all — rescore on a dense slab
+    slab = jnp.asarray(d.rng.standard_normal((d.b, rg * G, D)), jnp.float32)
+
+    @jax.jit
+    def rescore_only(slab_, q_):
+        return jnp.einsum("bd,brd->br", q_.astype(jnp.float32), slab_)
+
+    timed("rescore_nogather", d.b, rescore_only, slab, d.q)
+
+
+def run_loop(d, iters):
+    rg = 32
+    interp = jax.default_backend() not in ("tpu", "axon")
+
+    loop_timed(
+        "kernel", d.b, iters,
+        lambda qq, s3, b2: gmin_scan.group_min_scores(
+            qq, s3, b2, d.alpha, interpret=interp),
+        d.q, d.store3, d.bias2)
+
+    loop_timed(
+        "kernsel", d.b, iters,
+        lambda qq, s3, b2: jax.lax.approx_min_k(
+            gmin_scan.group_min_scores(qq, s3, b2, d.alpha,
+                                       interpret=interp),
+            rg, recall_target=0.99)[1].astype(jnp.float32),
+        d.q, d.store3, d.bias2)
+
+    def topk(qq, s, nrm, tb, w, blk):
+        d_, i_ = gmin_scan.gmin_topk(s, nrm, tb, d.n, qq, w, False,
+                                     K, "l2-squared", rg, G, interp, blk)
+        return d_
+
+    loop_timed(
+        "topk_strided", d.b, iters,
+        lambda qq, s, nrm, tb, w: topk(qq, s, nrm, tb, w, None),
+        d.q, d.store, d.norms, d.tombs, d.words)
+
+    blk = gmin_scan.build_rescore_blocks(d.store)
+    jax.block_until_ready(blk)
+    loop_timed("topk_block", d.b, iters, topk,
+               d.q, d.store, d.norms, d.tombs, d.words, blk)
+
+    from weaviate_tpu.index.tpu import _search_full
+
+    loop_timed(
+        "legacy", d.b, iters,
+        lambda qq, s, nrm, tb, w: _search_full(
+            s, nrm, tb, d.n, qq, w, K, "l2-squared", False,
+            rescore_r=128).astype(jnp.float32),
+        d.q, d.store, d.norms, d.tombs, d.words)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        prog="profile_gmin",
+        description="stage-level gmin search profiler (see module "
+                    "docstring for the mode catalogue)")
+    ap.add_argument("--mode", choices=("loop", "component", "gather"),
+                    default="loop",
+                    help="timing harness (default: loop — the relay-proof "
+                         "in-jit measurement)")
+    ap.add_argument("n", nargs="?", type=int, default=1_048_576,
+                    help="store rows (default 1048576)")
+    ap.add_argument("b", nargs="?", type=int, default=16384,
+                    help="query batch (default 16384)")
+    ap.add_argument("iters", nargs="?", type=int, default=8,
+                    help="in-jit iterations, loop mode only (default 8)")
+    args = ap.parse_args()
+
+    print(f"backend={jax.default_backend()} mode={args.mode} "
+          f"N={args.n} B={args.b} D={D} ITERS={args.iters}", flush=True)
+    d = make_data(args.n, args.b)
+    if args.mode == "component":
+        run_component(d)
+    elif args.mode == "gather":
+        run_gather(d)
+    else:
+        run_loop(d, args.iters)
 
 
 if __name__ == "__main__":
