@@ -356,9 +356,14 @@ def test_compressed_large_k(tmp_path, rng):
 def test_rescore_false_warns_at_config_time(caplog):
     """pq.rescore=false is a measured 4x recall drop (codes-only recall@10
     0.24 vs 0.99 rescored) — the config parse must say so loudly while
-    still accepting the opt-in (VERDICT r4 item 6)."""
+    still accepting the opt-in (VERDICT r4 item 6). Rate-limited: a fleet
+    restart parses one config per shard, and one warning per minute says
+    everything N copies would."""
     import logging
 
+    from weaviate_tpu.entities import vectorindex as vi_mod
+
+    vi_mod._rescore_warn_last[0] = 0.0  # reset the process-wide rate limit
     with caplog.at_level(logging.WARNING, logger="weaviate_tpu.entities.vectorindex"):
         cfg = _cfg(enabled=True, segments=8, rescore=False)
     assert cfg.pq.rescore is False  # still legal — a warning, not an error
@@ -367,6 +372,8 @@ def test_rescore_false_warns_at_config_time(caplog):
 
     caplog.clear()
     with caplog.at_level(logging.WARNING, logger="weaviate_tpu.entities.vectorindex"):
+        # within the rate-limit window: a second rescore=False parse is quiet
+        _cfg(enabled=True, segments=8, rescore=False)
         _cfg(enabled=True, segments=8, rescore=True)
         _cfg(enabled=False, rescore=False)  # pq off: nothing to warn about
     assert not [r for r in caplog.records if "rescore" in r.message]

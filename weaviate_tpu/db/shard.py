@@ -610,7 +610,7 @@ class Shard:
         else:
             # only a real DEVICE dispatch may feed the breaker's success
             # side: an empty-allowList early return (zero device work) or
-            # a device-less index (hnsw/mesh, no host plane) must not
+            # a device-less index (hnsw, no host plane) must not
             # reset the consecutive-failure count — or close an OPEN
             # breaker without a probe — while the device is down
             if br is not None and dispatched[0] and self._has_host_plane():
@@ -801,14 +801,14 @@ class Shard:
     def _pop_lock_wait(self) -> Optional[float]:
         """ms this thread's last snapshot read waited on the index write
         lock (0.0 = the lock-free fast path), or None when the index has no
-        snapshot plane (hnsw, mesh)."""
+        snapshot plane (hnsw)."""
         pop = getattr(self.vector_index, "pop_read_lock_wait", None)
         return pop() if pop is not None else None
 
     def _pop_dispatch_shape(self):
         """This thread's last dispatch's costmodel.DispatchShape (None
         while the tracer is down, or for indexes without the perf plane —
-        hnsw, mesh). Must be popped on the DISPATCHING thread, like the
+        hnsw). Must be popped on the DISPATCHING thread, like the
         lock wait."""
         pop = getattr(self.vector_index, "pop_dispatch_shape", None)
         return pop() if pop is not None else None
@@ -917,7 +917,9 @@ class Shard:
         supports snapshot dispatch (`async_supports_filters`): the
         allowList builds HERE, on the submitting thread — its cost lands
         in the `filter` phase, never inside a lock a reader could convoy
-        on. Indexes without it (hnsw, mesh) fall back to the sync path.
+        on. Indexes without it (hnsw) fall back to the sync path; the
+        mesh index serves filtered lanes here too (async_supports_filters
+        on MeshVectorIndex).
 
         With the fused dispatch (index/tpu.py, the default) finalize()'s
         one packed fetch already carries FINAL doc ids — the slot->doc
@@ -1013,7 +1015,7 @@ class Shard:
                     raise
                 if br is not None:
                     # this closure exists only when the index dispatched
-                    # async device work (hnsw/mesh take the sync path), so
+                    # async device work (hnsw takes the sync path), so
                     # a finalize() success IS a device success
                     self._record_device_success(br)
                 self._maybe_audit(audit_snap, q, k, allow, ids, dists)
@@ -1047,7 +1049,7 @@ class Shard:
     def debug_health(self) -> dict:
         """Per-shard introspection for ``GET /debug/index``: object count,
         allowList-cache occupancy, and the vector index's health snapshot
-        (index/tpu.py health(); indexes without the API — hnsw, mesh —
+        (index/tpu.py and index/mesh.py health(); indexes without the API — hnsw —
         report just their type). Lock-free racy reads — introspection,
         not an invariant."""
         out = {

@@ -226,16 +226,36 @@ class HnswUserConfig:
                 # candidates, so the quantizer's intrinsic error lands directly
                 # on the result set (recall@10 ≈ 0.24 on the synthetic bench vs
                 # ≈ 0.95+ rescored). Loud at config time; opting in stays legal.
-                import logging
+                # Rate-limited: validate() runs on every config load/update
+                # across every class, and a fleet restart would otherwise
+                # emit one warning per shard. The degraded mode also stays
+                # visible structurally — health() reports "rescore": false
+                # in GET /debug/index.
+                _warn_rescore_off()
 
-                logging.getLogger(__name__).warning(
-                    "pq.rescore=false serves raw ADC distances with NO exact "
-                    "rescoring pass: expect a severe recall drop on flat scans "
-                    "(recall@10 ~0.24 vs ~0.95+ with rescoring on the synthetic "
-                    "bench). Set pq.rescore=true (default) unless you need the "
-                    "absolute memory floor; pq.rotation='opq' recovers part of "
-                    "the loss for codes-only serving."
-                )
+
+_RESCORE_WARN_INTERVAL_S = 60.0
+_rescore_warn_last = [0.0]  # module-level: one rate limit per process
+_rescore_warn_lock = threading.Lock()
+
+
+def _warn_rescore_off() -> None:
+    import logging
+    import time as _time
+
+    with _rescore_warn_lock:
+        now = _time.monotonic()
+        if now - _rescore_warn_last[0] < _RESCORE_WARN_INTERVAL_S:
+            return
+        _rescore_warn_last[0] = now
+    logging.getLogger(__name__).warning(
+        "pq.rescore=false serves raw ADC distances with NO exact "
+        "rescoring pass: expect a severe recall drop on flat scans "
+        "(recall@10 ~0.24 vs ~0.95+ with rescoring on the synthetic "
+        "bench). Set pq.rescore=true (default) unless you need the "
+        "absolute memory floor; pq.rotation='opq' recovers part of "
+        "the loss for codes-only serving."
+    )
 
 
 IMMUTABLE_FIELDS = (

@@ -9,13 +9,25 @@ weaviate_tpu/parallel/mesh_search.py):
   each chip lands its own chunk at its own offset (no per-shard dispatch
   loop);
 - search: chunked masked scan per slab + local top-k, cross-chip merge over
-  ICI (all_gather + reselect) inside the same jit;
+  ICI (all_gather + reselect) inside the same jit, then on-device slot→doc
+  translation against the sharded pair table — the fused dispatch returns
+  the packed [B, 3k] buffer, so finalize is ONE fetch and dtype views
+  (the single-chip one-fetch/zero-translation invariant, now across chips);
 - delete: tombstone scatter where each chip claims the global rows in its
   slab;
 - filters: the allowList becomes a packed uint32 bitmap sharded over the
   mesh, ANDed into the validity mask on device (helpers/allow_list.go
   semantics; no host-side row gathering);
 - growth: geometric slab doubling fully on device (maintainance.go:31).
+
+Reads are SNAPSHOT-ISOLATED with the same lock-free discipline as the
+single-chip index (docs/concurrency.md, docs/mesh_serving.md): writers
+publish an immutable MeshSnapshot with one atomic reference swap; readers
+grab it without the index lock and run the whole two-phase dispatch
+(enqueue on the snapshot, fetch outside any lock). Because the mesh write
+kernels are NON-donating, a published snapshot pins the exact device slabs
+it was built from — deletes, growth, compression, and compaction can never
+tear an in-flight dispatch.
 
 Durability reuses the single-chip index's VectorLog (add/delete records,
 torn-tail-tolerant replay) — the log format is placement-independent, so a
@@ -31,10 +43,19 @@ slab, rescores its local candidates against its local row slab at exact
 f32, and the k best per chip merge over ICI. Compression downcasts an f32
 store to bf16 (the memory move the single-chip index makes by dropping its
 float cache); post-compress appends encode on write.
+
+IVF (the partition-pruned tier, mesh-shaped): one k-means codebook is
+trained over ALL chips' rows, then each chip gets its own KScaNN-style
+balanced bucket table over its local slab (ops/ivf.py balanced_assign per
+device, one shared capacity so the [n_dev, nlist, cap_p] table shards
+cleanly). The probe runs per chip against replicated centroids; training
+happens off-lock from a pinned snapshot with a write backlog, exactly like
+the single-chip staged-clustering plane.
 """
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -46,22 +67,54 @@ import numpy as np
 
 from weaviate_tpu.entities import vectorindex as vi
 from weaviate_tpu.index.interface import AllowList, VectorIndex
-from weaviate_tpu.index.tpu import VectorLog, _bucket_b, _bucket_rows
+from weaviate_tpu.index.tpu import (
+    VectorLog,
+    _S2D_FILL,
+    _bucket_b,
+    _bucket_rows,
+    _fetch_packed,
+    _snap_top_p,
+    fused_dispatch_enabled,
+    ivf_settings,
+)
+# dispatch-shape recording for the perf-attribution plane: a
+# costmodel.DispatchShape is built per dispatch ONLY while the tracer is
+# up (tracing.get_tracer() gate — the zero-cost-when-disabled contract);
+# shapes carry ndev so the roofline normalizes to per-chip work
+from weaviate_tpu.monitoring import costmodel, tracing
 # memory ledger (monitoring/memory.py): per-device slab components are
 # stamped analytically at every buffer mutation; unconfigured => one
 # comparison, nothing constructed
 from weaviate_tpu.monitoring import memory
-from weaviate_tpu.testing import sanitizers
+# shadow recall auditing (monitoring/quality.py): the dispatch snapshot is
+# pinned in TLS ONLY while an auditor is configured, so the audit compares
+# against the exact mesh state the live answer saw
+from weaviate_tpu.monitoring import quality
+from weaviate_tpu.monitoring.costmodel import (
+    TIER_EXACT,
+    TIER_PQ_CODES,
+    TIER_PQ_RESCORE,
+    DispatchShape,
+)
 from weaviate_tpu.monitoring.metrics import record_device_fallback
+from weaviate_tpu.ops import ivf as ivf_ops
+from weaviate_tpu.ops.topk import unpack_fused, unpack_topk
+# the recall-guarded probe-depth cap shares the single-chip controller;
+# controller imports nothing from the index layer, so no cycle
+from weaviate_tpu.serving import controller
+from weaviate_tpu.testing import faults, sanitizers
 from weaviate_tpu.parallel.mesh_search import (
     _MESH_SCAN_CHUNK,
     make_mesh,
     mesh_delete_step,
     mesh_grow_1d,
     mesh_grow_2d,
+    mesh_grow_pairs,
     mesh_insert_step,
+    mesh_search_ivf_step,
     mesh_search_pq_step,
     mesh_search_step,
+    mesh_write_pairs_step,
     mesh_write_rows_step,
     shard_spec,
 )
@@ -85,7 +138,66 @@ def _downcast_bf16(store):
     return store.astype(jnp.bfloat16)
 
 
+class MeshSnapshot:
+    """An immutable view of the mesh index state, published atomically.
+
+    Same contract as the single-chip IndexSnapshot (index/tpu.py): the
+    constructor copies REFERENCES under the write lock; correctness rests
+    on every referenced buffer being effectively immutable once published —
+    the mesh write kernels are non-donating (every flush/delete/grow binds
+    NEW device arrays to the index fields, the snapshot keeps the old
+    ones), ``host_tombs`` is copy-on-write (_mark_dead), ``slot_to_doc``
+    is append-only within a device generation (rows past a snapshot's
+    per-device counts are never read by it), and ``counts`` is copied
+    outright because the index mutates it in place."""
+
+    __slots__ = (
+        "gen", "dim", "n_dev", "n_loc", "counts", "counts_dev", "n_total",
+        "live", "store", "sq_norms", "tombs", "zero_words", "slot_to_doc",
+        "slot_to_doc_dev", "host_tombs", "allow_token", "compressed", "pq",
+        "codes", "recon_norms", "host_vecs", "ivf_centroids", "ivf_buckets",
+        "ivf_meta",
+    )
+
+    def __init__(self, gen: int, idx: "MeshVectorIndex"):
+        self.gen = gen
+        self.dim = idx.dim
+        self.n_dev = idx.n_dev
+        self.n_loc = idx.n_loc
+        self.counts = idx._counts.copy()
+        # replicated i32 per-shard high-water marks for the kernels (the
+        # P() in_spec broadcasts a plain committed array)
+        self.counts_dev = (
+            jnp.asarray(self.counts.astype(np.int32))
+            if idx.dim is not None else None
+        )
+        self.n_total = int(self.counts.sum())
+        self.live = idx.live
+        self.store = idx._store
+        self.sq_norms = idx._sq_norms
+        self.tombs = idx._tombs
+        self.zero_words = idx._zero_words
+        self.slot_to_doc = idx._slot_to_doc
+        self.slot_to_doc_dev = idx._s2d_dev
+        self.host_tombs = idx._host_tombs
+        self.allow_token = idx._allow_token
+        self.compressed = idx.compressed
+        self.pq = idx._pq
+        self.codes = idx._codes
+        self.recon_norms = idx._recon_norms
+        self.host_vecs = idx._host_vecs
+        self.ivf_centroids = idx._ivf_centroids
+        self.ivf_buckets = idx._ivf_buckets
+        self.ivf_meta = idx._ivf_meta
+
+
 class MeshVectorIndex(VectorIndex):
+    # serving layers key off this: filtered lanes ride the coalesced
+    # two-phase dispatch instead of falling back to the sync pool
+    async_supports_filters = True
+
+    _HOST_SCAN_CHUNK = 65536  # rows per host-fallback scan block
+
     def __init__(
         self,
         config: vi.HnswUserConfig,
@@ -127,9 +239,44 @@ class MeshVectorIndex(VectorIndex):
         self._zero_words = None      # sharded [n_dev * n_loc / 32] u32 (no-filter)
         self._counts = np.zeros(self.n_dev, dtype=np.int64)
         self._slot_to_doc = np.zeros(0, dtype=np.int64)  # global row -> doc
+        self._s2d_dev = None         # sharded [cap, 2] u32 (id_lo, id_hi)
+        self._host_tombs = np.zeros(0, dtype=bool)  # COW: snapshots pin copies
         self._doc_to_row: dict[int, int] = {}
         self._pending: dict[int, np.ndarray] = {}
         self._pending_tombs: list[int] = []
+        # snapshot plane (docs/mesh_serving.md): readers are lock-free on
+        # the published MeshSnapshot; staged/published generations drive
+        # the republish-on-read slow path
+        self._snap: Optional[MeshSnapshot] = None
+        self._snap_gen = 0
+        self._staged_gen = 0
+        self._published_gen = -1  # != staged: the first read publishes
+        self._staged_t0: Optional[float] = None
+        self._read_local = threading.local()
+        self._inflight = 0
+        self._inflight_lock = sanitizers.register_lock(
+            threading.Lock(), "index.mesh.inflight")
+        self._inflight_gauge = None
+        self._host_rows_cache = None  # (gen, rows, sq) breaker-path cache
+        # device generation: compact/drop re-create the slabs; an off-lock
+        # IVF trainer must abandon results targeted at a dead epoch
+        self._device_epoch = 0
+        # IVF plane (mesh twin of the single-chip staged clustering):
+        # stats lock is leaf-level, ordered after index.mesh
+        self._ivf_lock = sanitizers.register_lock(
+            threading.Lock(), "index.mesh.ivf")
+        self._ivf_stats = {"dispatches": 0, "probed_rows": 0, "base_rows": 0}
+        self._ivf_centroids_host = None   # np [nlist, D] f32
+        self._ivf_centroids = None        # replicated device copy
+        self._ivf_buckets = None          # sharded [n_dev, nlist, cap_p] i32
+        self._ivf_assign = np.zeros(0, dtype=np.int32)  # per-row partition
+        self._ivf_fills = None            # np [n_dev, nlist] bucket fills
+        self._ivf_cap_p = 0
+        self._ivf_meta = None             # (nlist, cap_p, gen)
+        self._ivf_dirty = False
+        self._ivf_trained_n = 0
+        self._ivf_gen = 0
+        self._ivf_backlog = None          # rows written during off-lock training
         # PQ state (mesh twin of index/tpu.py compression): codes and
         # ||recon||^2 are sharded like the store; the (possibly bf16)
         # store itself stays resident as the per-chip rescore source
@@ -190,7 +337,7 @@ class MeshVectorIndex(VectorIndex):
             self._restoring = False
 
     def post_startup(self) -> None:
-        self._flush_pending()
+        self.flush()
 
     # -- memory ledger stamping (monitoring/memory.py) -----------------------
 
@@ -202,8 +349,11 @@ class MeshVectorIndex(VectorIndex):
         for name, arr in (("store", self._store),
                           ("sq_norms", self._sq_norms),
                           ("tombs", self._tombs),
+                          ("slot_to_doc", self._s2d_dev),
                           ("pq_codes", self._codes),
                           ("recon_norms", self._recon_norms),
+                          ("ivf_centroids", self._ivf_centroids),
+                          ("ivf_buckets", self._ivf_buckets),
                           ("allow_words", self._zero_words)):
             b = memory.array_bytes(arr)
             if b:
@@ -230,7 +380,14 @@ class MeshVectorIndex(VectorIndex):
         self._sq_norms = jax.device_put(jnp.zeros((cap,), jnp.float32), sh1)
         self._tombs = jax.device_put(jnp.zeros((cap,), jnp.bool_), sh1)
         self._zero_words = jax.device_put(jnp.zeros((cap // 32,), jnp.uint32), sh1)
+        self._s2d_dev = jax.device_put(
+            jnp.full((cap, 2), _S2D_FILL, jnp.uint32), sh2)
         self._slot_to_doc = np.full(cap, -1, dtype=np.int64)
+        self._host_tombs = np.zeros(cap, dtype=bool)
+        self._ivf_assign = np.full(cap, -1, dtype=np.int32)
+        self._device_epoch += 1
+        if self._ivf_centroids_host is not None:
+            self._ivf_dirty = True
         if self.compressed and self._pq is not None:
             # a device reset in compressed mode (compact) re-creates the
             # code slabs too; _write_balanced re-encodes rows as they land
@@ -250,6 +407,8 @@ class MeshVectorIndex(VectorIndex):
         self._store = mesh_grow_2d(self._store, new_loc, self.mesh)
         self._sq_norms = mesh_grow_1d(self._sq_norms, new_loc, self.mesh)
         self._tombs = mesh_grow_1d(self._tombs, new_loc, self.mesh)
+        self._s2d_dev = mesh_grow_pairs(
+            self._s2d_dev, new_loc, _S2D_FILL, self.mesh)
         if self.compressed:
             self._codes = mesh_grow_2d(self._codes, new_loc, self.mesh)
             self._recon_norms = mesh_grow_1d(self._recon_norms, new_loc, self.mesh)
@@ -263,20 +422,36 @@ class MeshVectorIndex(VectorIndex):
         self._zero_words = jax.device_put(
             jnp.zeros((cap // 32,), jnp.uint32), shard_spec(self.mesh)
         )
-        # remap global rows: slab-local offsets are preserved
+        # remap global rows: slab-local offsets are preserved. Fresh host
+        # arrays every grow — published snapshots keep the old ones.
         s2d = np.full(cap, -1, dtype=np.int64)
+        ht = np.zeros(cap, dtype=bool)
+        ia = np.full(cap, -1, dtype=np.int32)
         for s in range(self.n_dev):
             c = int(self._counts[s])
             s2d[s * new_loc : s * new_loc + c] = self._slot_to_doc[
                 s * old_loc : s * old_loc + c
             ]
+            ht[s * new_loc : s * new_loc + old_loc] = self._host_tombs[
+                s * old_loc : (s + 1) * old_loc
+            ]
+            ia[s * new_loc : s * new_loc + old_loc] = self._ivf_assign[
+                s * old_loc : (s + 1) * old_loc
+            ]
         self._slot_to_doc = s2d
-        rows = np.nonzero(s2d >= 0)[0]
-        self._doc_to_row = dict(zip(s2d[rows].tolist(), rows.tolist()))
+        self._host_tombs = ht
+        self._ivf_assign = ia
+        occ = np.nonzero((s2d >= 0) & ~ht)[0]
+        self._doc_to_row = dict(zip(s2d[occ].tolist(), occ.tolist()))
         # staged-but-unflushed tombstone rows move with their slab
         self._pending_tombs = [
             (r // old_loc) * new_loc + (r % old_loc) for r in self._pending_tombs
         ]
+        if self._ivf_backlog is not None:
+            self._ivf_backlog = [
+                ((g // old_loc) * new_loc + (g % old_loc), r)
+                for g, r in self._ivf_backlog
+            ]
         self.n_loc = new_loc
         led = memory.get_ledger()
         if led is not None:
@@ -286,6 +461,15 @@ class MeshVectorIndex(VectorIndex):
         self._stamp_memory()
 
     # -- staging -------------------------------------------------------------
+
+    def _mark_dead(self, row: int) -> None:
+        """Tombstone `row` in the host mask, copy-on-write: a published
+        snapshot referencing the current mask keeps its version — torn
+        reads of a half-updated liveness mask are impossible."""
+        snap = self._snap
+        if snap is not None and snap.host_tombs is self._host_tombs:
+            self._host_tombs = self._host_tombs.copy()
+        self._host_tombs[row] = True
 
     def _stage_add(self, doc_id: int, vector: np.ndarray, log: bool = True) -> None:
         vector = np.asarray(vector, dtype=np.float32)
@@ -300,12 +484,14 @@ class MeshVectorIndex(VectorIndex):
         old = self._doc_to_row.pop(doc_id, None)
         if old is not None:
             self._pending_tombs.append(old)
-            self._slot_to_doc[old] = -1  # dead row must not resurrect via _grow
+            self._mark_dead(old)  # dead row must not resurrect via _grow
             self.live -= 1
         if doc_id in self._pending:
             self.live -= 1
         self._pending[doc_id] = vector
         self.live += 1
+        self._staged_gen += 1
+        self._mark_staged()
         if log and self._log is not None:
             self._log.append_add(doc_id, vector)
         if len(self._pending) >= _FLUSH_CHUNK:
@@ -341,6 +527,8 @@ class MeshVectorIndex(VectorIndex):
                 return
         self._pending.update(zip(ids64.tolist(), vecs))
         self.live += len(ids64)
+        self._staged_gen += 1
+        self._mark_staged()
         if len(self._pending) >= _FLUSH_CHUNK:
             self._flush_pending()
 
@@ -350,12 +538,16 @@ class MeshVectorIndex(VectorIndex):
             if doc_id in self._pending:
                 del self._pending[doc_id]
                 self.live -= 1
+                self._staged_gen += 1
+                self._mark_staged()
                 if log and self._log is not None:
                     self._log.append_delete(doc_id)
             return
         self._pending_tombs.append(row)
-        self._slot_to_doc[row] = -1  # dead row must not resurrect via _grow
+        self._mark_dead(row)  # dead row must not resurrect via _grow
         self.live -= 1
+        self._staged_gen += 1
+        self._mark_staged()
         if log and self._log is not None:
             self._log.append_delete(doc_id)
 
@@ -385,6 +577,10 @@ class MeshVectorIndex(VectorIndex):
         return out
 
     def _flush_pending(self) -> None:
+        """Land staged adds/tombstones on device. PURE staging drain — no
+        compression, no IVF training — so the read path's republish
+        (_read_snapshot slow path) can call it without ever reaching a
+        stop-the-world maintenance fetch."""
         led = memory.get_ledger()
         if self._pending:
             t0 = time.perf_counter()
@@ -411,27 +607,32 @@ class MeshVectorIndex(VectorIndex):
                     rows=len(self._pending_tombs))
             self._pending_tombs.clear()
             self._stamp_memory()
-        # declarative pq.enabled compresses once enough data exists to fit
-        # codebooks (same trigger as the single-chip index)
-        if (
+
+    def _maybe_autocompress(self) -> None:
+        """Declarative pq.enabled compresses once enough data exists to fit
+        codebooks (same trigger as the single-chip index). Reached only
+        from flush()/compress()/update_user_config — never from the
+        staging threshold sites."""
+        if not (
             self.config.pq.enabled
             and not self.compressed
             and not self._restoring
             and self.live >= max(256, self.config.pq.centroids)
         ):
-            try:
-                self._compress_locked()
-            except vi.ConfigValidationError as e:
-                # a pq config that only turns out invalid once dims are
-                # known (declared before the first import) must not turn
-                # every later add/search into an error: auto-disable with a
-                # warning and keep serving uncompressed
-                import logging
+            return
+        try:
+            self._compress_locked()
+        except vi.ConfigValidationError as e:
+            # a pq config that only turns out invalid once dims are
+            # known (declared before the first import) must not turn
+            # every later add/search into an error: auto-disable with a
+            # warning and keep serving uncompressed
+            import logging
 
-                self.config.pq.enabled = False
-                logging.getLogger(__name__).warning(
-                    "declared pq config is invalid (%s); auto-disabling "
-                    "compression for this index", e)
+            self.config.pq.enabled = False
+            logging.getLogger(__name__).warning(
+                "declared pq config is invalid (%s); auto-disabling "
+                "compression for this index", e)
 
     def _write_balanced(self, docs: np.ndarray, rows: np.ndarray) -> None:
         """Land [count, D] rows across slabs in whole-mesh insert steps."""
@@ -449,6 +650,7 @@ class MeshVectorIndex(VectorIndex):
             c = min(_bucket_rows(max_rem), _MAX_WRITE_C, self.n_loc - max_off)
             c = max(c, 1)
             chunks = np.zeros((self.n_dev, c, self.dim), np.float32)
+            pairs = np.zeros((self.n_dev, c, 2), np.uint32)
             offsets = self._counts.astype(np.int32)
             takes = np.zeros(self.n_dev, dtype=np.int32)
             taken: list[np.ndarray] = []
@@ -458,6 +660,10 @@ class MeshVectorIndex(VectorIndex):
                 queues[s] = queues[s][take:]
                 if take:
                     chunks[s, :take] = rows[sel]
+                    du = docs[sel].view(np.uint64)
+                    pairs[s, :take, 0] = (du & np.uint64(0xFFFFFFFF)).astype(
+                        np.uint32)
+                    pairs[s, :take, 1] = (du >> np.uint64(32)).astype(np.uint32)
                 takes[s] = take
                 taken.append(sel)
             chunks_dev = jax.device_put(
@@ -470,6 +676,16 @@ class MeshVectorIndex(VectorIndex):
                 jnp.asarray(offsets),
                 jnp.asarray(takes),
                 self.metric == vi.DISTANCE_L2,
+                self.mesh,
+            )
+            # the device translation table lands the same rows, so the fused
+            # dispatch's on-device slot->doc stays in lockstep with the host map
+            self._s2d_dev = mesh_write_pairs_step(
+                self._s2d_dev,
+                jax.device_put(jnp.asarray(pairs),
+                               shard_spec(self.mesh, None, None)),
+                jnp.asarray(offsets),
+                jnp.asarray(takes),
                 self.mesh,
             )
             if self.compressed:
@@ -503,6 +719,14 @@ class MeshVectorIndex(VectorIndex):
                 self._doc_to_row.update(zip(d.tolist(), grows.tolist()))
                 if self.compressed:
                     self._host_vecs[grows] = rows[taken[s]]
+                if self._ivf_backlog is not None:
+                    # an off-lock k-means fit is in flight: queue the rows,
+                    # the trainer (or its finally block) assigns them
+                    self._ivf_backlog.append((grows, rows[taken[s]]))
+                elif self._ivf_centroids_host is not None:
+                    self._ivf_assign[grows] = ivf_ops.assign_partitions(
+                        rows[taken[s]], self._ivf_centroids_host)
+                    self._ivf_dirty = True
                 self._counts[s] += take
         self._stamp_memory()
 
@@ -528,7 +752,7 @@ class MeshVectorIndex(VectorIndex):
         if self.live == 0:
             raise RuntimeError("compress requires imported vectors to fit on")
         host = np.asarray(self._store, dtype=np.float32)  # [cap, D] gather
-        occupied = self._slot_to_doc >= 0
+        occupied = (self._slot_to_doc >= 0) & ~self._host_tombs
         pq = ProductQuantizer(
             dim=self.dim,
             segments=self.config.pq.segments,
@@ -563,6 +787,11 @@ class MeshVectorIndex(VectorIndex):
             self._store = jax.device_put(
                 _downcast_bf16(self._store), shard_spec(self.mesh, None))
         self.compressed = True
+        # compressed mode has no IVF tier (parity with the PQ tiers owning
+        # the scan); drop any clustering so snapshots don't carry it
+        self._ivf_reset()
+        self._staged_gen += 1
+        self._mark_staged()
         if save and self._pq_path:
             pq.save(self._pq_path)
         led = memory.get_ledger()
@@ -610,6 +839,8 @@ class MeshVectorIndex(VectorIndex):
                 self._log.append_add_batch(doc_arr, vectors)
             self._write_balanced(doc_arr, vectors)
             self.live += doc_arr.size
+            self._staged_gen += 1
+            self._mark_staged()
 
     def delete(self, *doc_ids: int) -> None:
         with self._lock:
@@ -640,22 +871,29 @@ class MeshVectorIndex(VectorIndex):
             q = np.concatenate([q, np.zeros((bb - b, q.shape[1]), np.float32)])
         return q, b
 
-    def _allow_words(self, allow_list: AllowList) -> jax.Array:
-        """Sharded packed filter words, cached ON the (immutable) allowList
-        per index state — same contract as the single-chip twin
-        (index/tpu.py _allow_words)."""
+    def padded_width(self, b: int) -> int:
+        """The query-batch bucket `b` pads to — the coalescer packs lanes
+        up to this width for free (same contract as the single-chip twin)."""
+        return _bucket_b(max(int(b), 1))
+
+    def _allow_words(self, snap: MeshSnapshot, allow_list: AllowList) -> jax.Array:
+        """Sharded packed filter words for `snap`, cached ON the (immutable)
+        allowList per index state — same contract as the single-chip twin
+        (index/tpu.py _allow_words). Keyed on (allow_token, n_total, cap):
+        deletions alone don't rotate the key, but a stale mask only
+        re-admits tombstoned rows the device tomb mask kills anyway."""
         from weaviate_tpu.storage.bitmap import (
             Bitmap, allowed_mask, pack_allow_words)
 
-        cap = self.n_dev * self.n_loc
-        key = (self._allow_token, int(self._counts.sum()), cap)
+        cap = snap.n_dev * snap.n_loc
+        key = (snap.allow_token, snap.n_total, cap)
         cached = getattr(allow_list, "_words_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
         mask = np.zeros(cap, dtype=bool)
-        occupied = self._slot_to_doc >= 0
+        occupied = (snap.slot_to_doc >= 0) & ~snap.host_tombs
         if occupied.any():
-            docs = self._slot_to_doc[occupied]
+            docs = snap.slot_to_doc[occupied]
             if isinstance(allow_list, Bitmap):
                 mask[occupied] = allowed_mask(allow_list, docs)
             else:
@@ -668,96 +906,495 @@ class MeshVectorIndex(VectorIndex):
             pass
         return out
 
-    def search_by_vectors(
-        self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
-    ) -> tuple[np.ndarray, np.ndarray]:
-        with self._lock:
-            self._flush_pending()
-            if self.live == 0 or self.dim is None:
-                b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
-                return (
-                    np.zeros((b, 0), dtype=np.uint64),
-                    np.zeros((b, 0), dtype=np.float32),
-                )
-            q, b = self._prep_queries(vectors)
-            chunk = min(self.n_loc, _MESH_SCAN_CHUNK)
-            kk = max(1, min(k, self.live, chunk))
-            use_allow = allow_list is not None
-            words = self._allow_words(allow_list) if use_allow else self._zero_words
-            from weaviate_tpu.ops.topk import unpack_topk
+    # -- snapshot plane (docs/mesh_serving.md) -------------------------------
 
-            if self.compressed:
-                if not self.config.pq.rescore:
-                    # codes-only tier: try the fused per-shard ADC kernel
-                    # (mesh twin of the single-chip pq_gmin dispatch)
-                    packed = self._pq_gmin_step_or_none(q, kk, words, use_allow)
-                    if packed is not None:
-                        top, rows = unpack_topk(np.asarray(packed))
-                        top, rows = top[:b], rows[:b]
-                        ids = np.where(
-                            rows >= 0,
-                            self._slot_to_doc[np.clip(rows, 0, None)], -1)
-                        return ids.astype(np.uint64), top.astype(np.float32)
-                nchunks_eff = max(1, self.n_loc // chunk)
+    def _mark_staged(self) -> None:
+        """Stamp the first staging moment of the current unpublished batch
+        (ledger publish-lag attribution; no-op when the ledger is down)."""
+        if self._staged_t0 is None and memory.get_ledger() is not None:
+            self._staged_t0 = time.perf_counter()
+
+    def _publish_snapshot(self) -> None:
+        """Build and atomically publish a MeshSnapshot. Caller holds _lock."""
+        if self._ivf_dirty:
+            self._ivf_rebuild_buckets()
+        self._snap_gen += 1
+        self._snap = MeshSnapshot(self._snap_gen, self)
+        self._published_gen = self._staged_gen
+        m = self.metrics
+        if m is not None:
+            m.index_snapshot_gen.labels(*self._metric_labels()).set(
+                self._snap_gen)
+        self._stamp_memory()
+        led = memory.get_ledger()
+        if led is not None and self._staged_t0 is not None:
+            led.note_publish(
+                (time.perf_counter() - self._staged_t0) * 1000.0)
+        self._staged_t0 = None
+
+    def _read_snapshot(self) -> MeshSnapshot:
+        """Current MeshSnapshot, lock-free when nothing is staged: one
+        reference load + one generation compare. Staged writes take the
+        slow path — drain staging under the lock, republish, serve."""
+        snap = self._snap
+        if snap is not None and self._published_gen == self._staged_gen:
+            self._read_local.lock_wait_ms = 0.0
+            return snap
+        t0 = time.perf_counter()
+        with self._lock:
+            wait_ms = (time.perf_counter() - t0) * 1000.0
+            self._flush_pending()
+            if self._snap is None or self._published_gen != self._staged_gen:
+                self._publish_snapshot()
+            snap = self._snap
+        self._read_local.lock_wait_ms = wait_ms
+        m = self.metrics
+        if m is not None:
+            m.index_lock_wait.labels(*self._metric_labels()).observe(wait_ms)
+        return snap
+
+    def pop_read_lock_wait(self) -> float:
+        """Lock wait of the calling thread's last snapshot read, then 0."""
+        w = getattr(self._read_local, "lock_wait_ms", 0.0)
+        self._read_local.lock_wait_ms = 0.0
+        return w
+
+    @property
+    def snapshot_gen(self) -> int:
+        snap = self._snap
+        return snap.gen if snap is not None else 0
+
+    def _track_inflight(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._inflight += delta
+            n = self._inflight
+        m = self.metrics
+        if m is None:
+            return
+        g = self._inflight_gauge
+        if g is None:
+            g = m.index_inflight_dispatches.labels(*self._metric_labels())
+            self._inflight_gauge = g
+        g.set(n)
+
+    def pop_dispatch_shape(self):
+        """The DispatchShape of the calling thread's last dispatch (serving
+        layer hands it to the perf tracer), then None."""
+        shape = getattr(self._read_local, "dispatch_shape", None)
+        self._read_local.dispatch_shape = None
+        return shape
+
+    def pop_audit_snapshot(self):
+        """The snapshot the calling thread's last dispatch answered from
+        (set only while the quality auditor is up), then None."""
+        snap = getattr(self._read_local, "audit_snap", None)
+        self._read_local.audit_snap = None
+        return snap
+
+    # -- IVF plane (per-device KScaNN buckets, shared codebook) --------------
+
+    def _ivf_nlist(self, s, n: int) -> int:
+        if s.nlist > 0:
+            return max(1, min(s.nlist, max(n // 8, 1)))
+        target = 2 ** int(math.ceil(math.log2(max(n / 256.0, 16.0))))
+        return int(max(16, min(target, 4096, max(n // 32, 16))))
+
+    def _ivf_maybe_train(self) -> None:
+        """Train/retrain the shared k-means codebook when warranted. Called
+        from flush() AFTER the lock is released — the training fetch and
+        fit run against a pinned snapshot, never under the index lock."""
+        s = ivf_settings()
+        if (
+            s is None
+            or self._restoring
+            or self.compressed
+            or self.dim is None
+            or self.metric not in ivf_ops.MATMUL_METRICS
+            or self.live < max(s.min_n, 256)
+        ):
+            return
+        if (self._ivf_centroids_host is not None
+                and self.live < self._ivf_trained_n * (1.0 + s.retrain_growth)):
+            return
+        self._ivf_train(s)
+
+    def _ivf_train(self, s) -> None:
+        """Off-lock (re)clustering: pin a snapshot, fetch + fit outside the
+        lock while concurrent writes queue into _ivf_backlog, then install
+        under the lock iff the device epoch is unchanged."""
+        snap = self._read_snapshot()
+        if snap.dim is None or snap.n_total == 0:
+            return
+        epoch = self._device_epoch
+        with self._lock:
+            if self._ivf_backlog is not None:
+                return  # another trainer is in flight
+            self._ivf_backlog = []
+        t0 = time.perf_counter()
+        try:
+            # maintenance fetch, off-lock, against the pinned snapshot
+            src = np.asarray(snap.store, dtype=np.float32)
+            slots = []
+            for dev in range(snap.n_dev):
+                base = dev * snap.n_loc
+                sl = np.arange(base, base + int(snap.counts[dev]))
+                slots.append(sl[~snap.host_tombs[sl]])
+            rows = src[np.concatenate(slots)] if slots else src[:0]
+            n = rows.shape[0]
+            if n < 2:
+                return
+            nlist = self._ivf_nlist(s, n)
+            cent = ivf_ops.kmeans_fit(
+                rows, nlist, iters=s.train_iters, seed=self._ivf_gen,
+                sample=min(len(rows), max(s.train_sample, nlist * 16)))
+            if self.metric == vi.DISTANCE_COSINE:
+                nrm = np.linalg.norm(cent, axis=1, keepdims=True)
+                nrm[nrm == 0] = 1.0
+                cent = cent / nrm
+            # one shared spill capacity across devices so the per-device
+            # balanced assignments stack into one sharded bucket table
+            max_per = max((int(sl.size) for sl in slots), default=0)
+            cap_t = int(ivf_ops.bucket_capacity(
+                np.array([int(1.25 * max_per / nlist) + 1])))
+            a_snap = np.full(snap.n_dev * snap.n_loc, -1, dtype=np.int32)
+            off = 0
+            for sl in slots:
+                if sl.size:
+                    a_snap[sl] = ivf_ops.balanced_assign(
+                        rows[off:off + sl.size], cent, cap_t)
+                off += sl.size
+            with self._lock:
+                if (self._device_epoch != epoch or self.dim != snap.dim
+                        or self.n_loc < snap.n_loc):
+                    return  # slabs were re-created under us: abandon
+                assign = np.full(self.n_dev * self.n_loc, -1, dtype=np.int32)
+                for dev in range(snap.n_dev):
+                    assign[dev * self.n_loc:
+                           dev * self.n_loc + snap.n_loc] = a_snap[
+                        dev * snap.n_loc:(dev + 1) * snap.n_loc]
+                for g, r in self._ivf_backlog:
+                    assign[g] = ivf_ops.assign_partitions(
+                        np.asarray(r, np.float32), cent)
+                self._ivf_backlog = None
+                self._ivf_assign = assign
+                self._ivf_centroids_host = cent
+                self._ivf_centroids = jax.device_put(
+                    jnp.asarray(cent), shard_spec(self.mesh))
+                self._ivf_cap_p = cap_t
+                self._ivf_trained_n = n
+                self._ivf_gen += 1
+                self._ivf_dirty = True
+                self._staged_gen += 1
+                self._mark_staged()
+                self._stamp_memory()
+            led = memory.get_ledger()
+            if led is not None:
+                led.note_write(
+                    "ivf", "recluster",
+                    (time.perf_counter() - t0) * 1000.0, rows=n)
+        finally:
+            with self._lock:
+                bl, self._ivf_backlog = self._ivf_backlog, None
+                if bl and self._ivf_centroids_host is not None:
+                    # install aborted after writes queued: classify the
+                    # leftovers against whatever codebook is current
+                    for g, r in bl:
+                        self._ivf_assign[g] = ivf_ops.assign_partitions(
+                            np.asarray(r, np.float32),
+                            self._ivf_centroids_host)
+                    self._ivf_dirty = True
+
+    def _ivf_rebuild_buckets(self) -> None:
+        """Rebuild the sharded [n_dev, nlist, cap_p] bucket table from the
+        per-row assignments. Caller holds _lock (publish path)."""
+        cent = self._ivf_centroids_host
+        if cent is None or self.dim is None:
+            self._ivf_dirty = False
+            return
+        nlist = cent.shape[0]
+        per_dev = []
+        for dev in range(self.n_dev):
+            a = self._ivf_assign[dev * self.n_loc:(dev + 1) * self.n_loc].copy()
+            a[self._host_tombs[dev * self.n_loc:(dev + 1) * self.n_loc]] = -1
+            per_dev.append(a)
+        fills = np.stack([
+            np.bincount(a[a >= 0], minlength=nlist) for a in per_dev])
+        # shared capacity: never below what any device needs, never below
+        # the training-time spill cap (keeps the table shape monotonic)
+        cap_shared = max(int(ivf_ops.bucket_capacity(fills.reshape(-1))),
+                         int(self._ivf_cap_p or 0))
+        bkt = np.stack([
+            ivf_ops.build_buckets(a, nlist, cap_shared)[0] for a in per_dev])
+        self._ivf_buckets = jax.device_put(
+            jnp.asarray(bkt), shard_spec(self.mesh, None, None))
+        self._ivf_fills = fills
+        self._ivf_cap_p = cap_shared
+        self._ivf_meta = (nlist, cap_shared, self._ivf_gen)
+        self._ivf_dirty = False
+        self._stamp_memory()
+
+    def _ivf_reset(self) -> None:
+        """Drop the clustering (compact/compress/drop paths)."""
+        self._ivf_centroids_host = None
+        self._ivf_centroids = None
+        self._ivf_buckets = None
+        self._ivf_assign = np.zeros(0, dtype=np.int32)
+        self._ivf_fills = None
+        self._ivf_cap_p = 0
+        self._ivf_meta = None
+        self._ivf_dirty = False
+        self._ivf_trained_n = 0
+
+    def ivf_stats(self) -> dict:
+        with self._ivf_lock:
+            st = dict(self._ivf_stats)
+        st["probed_fraction"] = (
+            round(st["probed_rows"] / st["base_rows"], 4)
+            if st["base_rows"] else None
+        )
+        return st
+
+    def _ivf_plan(self, snap: MeshSnapshot, k: int) -> Optional[int]:
+        """-> effective top_p when the partition-pruned tier applies to
+        this snapshot, else None (full scan)."""
+        if (snap.ivf_buckets is None or snap.ivf_meta is None
+                or snap.compressed):
+            return None
+        s = ivf_settings()
+        if s is None or self.metric not in ivf_ops.MATMUL_METRICS:
+            return None
+        nlist, cap_p, _gen = snap.ivf_meta
+        req = s.top_p if s.top_p > 0 else max(1, nlist // 16)
+        req = min(req, nlist)
+        eff = max(1, min(req, controller.ivf_top_p_cap(req)))
+        if eff < nlist:
+            eff = min(_snap_top_p(eff), nlist)
+        while eff < nlist and eff * cap_p < 4 * k:
+            nxt = _snap_top_p(min(eff * 2, nlist))
+            eff = nlist if nxt <= eff else nxt
+        return eff
+
+    # -- search dispatch (two-phase: enqueue on the snapshot, fetch later) ---
+
+    def dispatch_tier(self, snap: MeshSnapshot,
+                      allow_list: Optional[AllowList] = None) -> str:
+        """The tier a dispatch against `snap` takes (quality auditor
+        attribution). The mesh has no gather tier — small filtered reads
+        still run the full sharded scan."""
+        if snap.compressed:
+            return TIER_PQ_RESCORE if self.config.pq.rescore else TIER_PQ_CODES
+        return TIER_EXACT
+
+    def _dispatch_search(self, snap: MeshSnapshot, vectors: np.ndarray,
+                         k: int, allow_list: Optional[AllowList] = None):
+        """Enqueue ONE whole-mesh program against `snap` and return the
+        finalize closure. The program runs per-shard scan -> local top-k ->
+        all-gather -> final select -> on-device slot->doc translation, so
+        finalize is one packed fetch + dtype views (the JGL015 one-fetch /
+        zero-translation invariant, across chips). No locks anywhere."""
+        if snap.dim is None or snap.live == 0 or snap.n_total == 0:
+            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
+            empty = (np.zeros((b, 0), dtype=np.uint64),
+                     np.zeros((b, 0), dtype=np.float32))
+            return lambda: empty
+        faults.fire("index.mesh.dispatch")
+        shape = None
+        t_enq0 = 0.0
+        if tracing.get_tracer() is not None:
+            t_enq0 = time.perf_counter()
+        q, b = self._prep_queries(vectors)
+        chunk = min(snap.n_loc, _MESH_SCAN_CHUNK)
+        kk = max(1, min(k, snap.live, chunk))
+        use_allow = allow_list is not None
+        words = self._allow_words(snap, allow_list) if use_allow else snap.zero_words
+        fused = fused_dispatch_enabled()
+        exact = getattr(self.config, "exact_topk", False)
+
+        if snap.compressed:
+            rescore = self.config.pq.rescore
+            packed_dev = None
+            if not rescore:
+                # codes-only tier: try the fused per-shard ADC kernel
+                # (mesh twin of the single-chip pq_gmin dispatch)
+                packed_dev = self._pq_gmin_step_or_none(
+                    snap, q, kk, words, use_allow, fused)
+            if packed_dev is None:
+                nchunks_eff = max(1, snap.n_loc // chunk)
                 pool_target = self.config.pq.rescore_limit or 1024
                 r_chunk = min(
                     max(2 * kk, -(-pool_target // nchunks_eff), 64), 256, chunk)
                 # the concatenated per-chip pool must cover k (tpu.py:1080)
                 r_chunk = max(r_chunk, min(-(-kk // nchunks_eff), chunk))
-                packed = np.asarray(
-                    mesh_search_pq_step(
-                        self._codes,
-                        self._recon_norms,
-                        self._tombs,
-                        jnp.asarray(self._counts.astype(np.int32)),
-                        words,
-                        self._pq._dev_codebook(),
-                        self._store,
-                        jnp.asarray(q),
-                        self._pq.rotation_dev(),
-                        kk,
-                        r_chunk,
-                        self.metric,
-                        use_allow,
-                        getattr(self.config, "exact_topk", False),
-                        self.config.pq.rescore,
-                        self.mesh,
-                    )
+                packed_dev = mesh_search_pq_step(
+                    snap.codes,
+                    snap.recon_norms,
+                    snap.tombs,
+                    snap.counts_dev,
+                    words,
+                    snap.pq._dev_codebook(),
+                    snap.store,
+                    jnp.asarray(q),
+                    snap.pq.rotation_dev(),
+                    snap.slot_to_doc_dev,
+                    kk,
+                    r_chunk,
+                    self.metric,
+                    use_allow,
+                    exact,
+                    rescore,
+                    fused,
+                    self.mesh,
                 )
-                top, rows = unpack_topk(packed)
-                top, rows = top[:b], rows[:b]
-                ids = np.where(rows >= 0, self._slot_to_doc[np.clip(rows, 0, None)], -1)
-                return ids.astype(np.uint64), top.astype(np.float32)
-
-            packed = self._gmin_step_or_none(q, kk, words, use_allow)
-            if packed is None:
-                packed = np.asarray(
-                    mesh_search_step(
-                        self._store,
-                        self._sq_norms,
-                        self._tombs,
-                        jnp.asarray(self._counts.astype(np.int32)),
+            if t_enq0:
+                shape = DispatchShape(
+                    TIER_PQ_RESCORE if rescore else TIER_PQ_CODES,
+                    n=snap.n_total, dim=snap.dim, batch=b,
+                    batch_padded=q.shape[0],
+                    bytes_per_row=(snap.dim * snap.store.dtype.itemsize
+                                   if rescore else snap.pq.segments),
+                    k=int(kk), ndev=snap.n_dev)
+        else:
+            top_p = self._ivf_plan(snap, kk)
+            if top_p is not None:
+                nlist, cap_p, _gen = snap.ivf_meta
+                gp = ivf_ops.group_steps(q.shape[0], cap_p, snap.dim, top_p)
+                packed_dev = mesh_search_ivf_step(
+                    snap.store,
+                    snap.tombs,
+                    snap.counts_dev,
+                    words,
+                    snap.ivf_centroids,
+                    snap.ivf_buckets,
+                    jnp.asarray(q),
+                    snap.slot_to_doc_dev,
+                    kk,
+                    self.metric,
+                    use_allow,
+                    top_p,
+                    exact,
+                    gp,
+                    fused,
+                    self.mesh,
+                )
+                with self._ivf_lock:
+                    st = self._ivf_stats
+                    st["dispatches"] += 1
+                    st["probed_rows"] += snap.n_dev * top_p * cap_p
+                    st["base_rows"] += int(snap.n_total)
+                if t_enq0:
+                    probed = snap.n_dev * top_p * cap_p + nlist
+                    shape = DispatchShape(
+                        TIER_EXACT, n=probed, dim=snap.dim, batch=b,
+                        batch_padded=q.shape[0],
+                        bytes_per_row=snap.dim * snap.store.dtype.itemsize,
+                        k=int(kk), ndev=snap.n_dev,
+                        extra={"ivf": True, "ivf_top_p": top_p,
+                               "ivf_nlist": nlist,
+                               "probed_fraction": round(
+                                   min(probed / max(snap.n_total, 1), 1.0), 4)})
+            else:
+                packed_dev = self._gmin_step_or_none(
+                    snap, q, kk, words, use_allow, fused)
+                if packed_dev is None:
+                    packed_dev = mesh_search_step(
+                        snap.store,
+                        snap.sq_norms,
+                        snap.tombs,
+                        snap.counts_dev,
                         words,
                         jnp.asarray(q),
+                        snap.slot_to_doc_dev,
                         kk,
                         self.metric,
                         use_allow,
                         self.metric == vi.DISTANCE_L2,
-                        getattr(self.config, "exact_topk", False),
+                        exact,
+                        fused,
                         self.mesh,
                     )
-                )
-            top, rows = unpack_topk(packed)
-            top, rows = top[:b], rows[:b]
-            ids = np.where(rows >= 0, self._slot_to_doc[np.clip(rows, 0, None)], -1)
+                if t_enq0:
+                    shape = DispatchShape(
+                        TIER_EXACT, n=snap.n_total, dim=snap.dim, batch=b,
+                        batch_padded=q.shape[0],
+                        bytes_per_row=snap.dim * snap.store.dtype.itemsize,
+                        k=int(kk), ndev=snap.n_dev)
+
+        if shape is not None:
+            shape.t_start = t_enq0
+            shape.enqueue_ms = (time.perf_counter() - t_enq0) * 1000.0
+            if fused:
+                shape.fused = True
+                shape.translate_ms = 0.0
+            self._read_local.dispatch_shape = shape
+        if quality.get_auditor() is not None:
+            self._read_local.audit_snap = snap  # graftflow: disable=JGL018 TLS pin by design: at most one snapshot per serving thread, overwritten on the next sampled dispatch — the shadow audit must re-read the SAME snapshot the live dispatch answered from
+        self._track_inflight(1)
+        done = [False]
+        slot_to_doc = snap.slot_to_doc
+
+        def finish():
+            packed = _fetch_packed(packed_dev, shape)
+            if fused:
+                ids, dists = unpack_fused(packed)
+                return ids[:b], dists[:b]
+            top, idx = unpack_topk(packed)
+            top = top[:b]
+            idx = idx[:b]
+            t0 = time.perf_counter() if shape is not None else 0.0
+            ids = np.where(idx >= 0, slot_to_doc[np.clip(idx, 0, None)], -1)
+            if shape is not None:
+                shape.translate_ms = (time.perf_counter() - t0) * 1000.0
             return ids.astype(np.uint64), top.astype(np.float32)
 
-    def _gmin_plan(self, b: int, kk: int):
+        def finalize():
+            try:
+                faults.fire("index.mesh.finalize")
+                if shape is None:
+                    return finish()
+                if shape.fetches:
+                    shape.fetches = 0  # a retried finalize re-counts
+                t0 = time.perf_counter()
+                out = finish()
+                t1 = time.perf_counter()
+                shape.finalize_ms = (t1 - t0) * 1000.0
+                shape.t_end = t1
+                return out
+            finally:
+                if not done[0]:
+                    done[0] = True
+                    self._track_inflight(-1)
+
+        return finalize
+
+    def search_by_vectors(
+        self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        snap = self._read_snapshot()
+        return self._dispatch_search(snap, vectors, k, allow_list)()
+
+    def search_by_vectors_async(
+        self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
+    ):
+        """Two-phase dispatch for the serving coalescer: enqueue the whole
+        sharded program now (lock-free, on the current snapshot), return
+        the finalize closure. The coalescer overlaps the next lane's
+        enqueue with this lane's device time (pipeline depth 2); filtered
+        lanes ride the same path (async_supports_filters)."""
+        snap = self._read_snapshot()
+        return self._dispatch_search(snap, vectors, k, allow_list)
+
+    # -- fused group-min kernels (guarded; separate failure domains) ---------
+
+    def _gmin_plan(self, b: int, kk: int, snap: Optional[MeshSnapshot] = None):
         """-> (rg, active_g) when the fused mesh kernel is eligible for this
         shape (metric, slab size, VMEM budget), else None. Pure gate — no
         kernel execution — so tests can assert eligibility directly."""
         from weaviate_tpu.ops import gmin_scan
 
+        n_loc = snap.n_loc if snap is not None else self.n_loc
+        dim = snap.dim if snap is not None else self.dim
+        counts = snap.counts if snap is not None else self._counts
+        store = snap.store if snap is not None else self._store
         if getattr(self.config, "exact_topk", False):
             return None  # config opt-out, not degradation
         if self._gmin_broken:
@@ -765,88 +1402,96 @@ class MeshVectorIndex(VectorIndex):
             return None
         if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
             return None
-        if self.n_loc < 16384 or b < 8:
+        if n_loc < 16384 or b < 8:
             return None
-        ncols_l = self.n_loc // gmin_scan.G
+        ncols_l = n_loc // gmin_scan.G
         rg = min(max(32, 2 * kk), 128, ncols_l)
         if rg < kk:
             return None
-        active_g = max(1, -(-int(self._counts.max()) // ncols_l))
-        if not gmin_scan.fits_vmem(b, self.dim, ncols_l, active_g,
-                                   self._store.dtype.itemsize):
+        active_g = max(1, -(-int(counts.max()) // ncols_l))
+        if not gmin_scan.fits_vmem(b, dim, ncols_l, active_g,
+                                   store.dtype.itemsize):
             return None
         return rg, active_g
 
-    def _pq_gmin_step_or_none(self, q: np.ndarray, kk: int, words, use_allow):
-        """Run the fused per-shard PQ codes kernel, or None for the legacy
-        reconstruction scan — separate failure domain (self._pqg_state);
-        gating and codebook constants are the shared helpers in
-        ops/pq_gmin.py (one copy with the single-chip dispatch)."""
+    def _pq_gmin_step_or_none(self, snap: MeshSnapshot, q: np.ndarray,
+                              kk: int, words, use_allow: bool, fused: bool):
+        """Enqueue the fused per-shard PQ codes kernel, or None for the
+        legacy reconstruction scan — separate failure domain
+        (self._pqg_state); gating and codebook constants are the shared
+        helpers in ops/pq_gmin.py. Returns the guarded result RAW (host
+        array on the first validation run, device array after), so the
+        async finalize defers the fetch."""
         from weaviate_tpu.parallel.mesh_search import mesh_search_pq_gmin_step
 
         from weaviate_tpu.ops import gmin_scan, pq_gmin
 
-        ncols_l = self.n_loc // gmin_scan.G
-        active_g = max(1, -(-int(self._counts.max()) // ncols_l)) if ncols_l else 1
+        ncols_l = snap.n_loc // gmin_scan.G
+        active_g = max(1, -(-int(snap.counts.max()) // ncols_l)) if ncols_l else 1
         rg = pq_gmin.eligible_rg(
             self._pqg_state, getattr(self.config, "exact_topk", False),
-            self.metric, self._pq, q.shape[0], ncols_l, kk, self.dim, active_g,
+            self.metric, snap.pq, q.shape[0], ncols_l, kk, snap.dim, active_g,
             component="index.mesh.pq_gmin")
         if rg is None:
             return None
-        m, c = self._pq.segments, self._pq.centroids
+        m, c = snap.pq.segments, snap.pq.centroids
         interpret = jax.default_backend() not in ("tpu", "axon")
         cb_chunks, flat_cb = pq_gmin.cached_cb_constants(self)
-        key = ("pq", q.shape[0], kk, rg, active_g, self.n_loc, m, c, use_allow)
-        packed = gmin_scan.guarded_kernel_call(
+        key = ("pq", q.shape[0], kk, rg, active_g, snap.n_loc, m, c,
+               use_allow, fused)
+        return gmin_scan.guarded_kernel_call(
             self._pqg_state, key,
             lambda: mesh_search_pq_gmin_step(
-                self._codes,
-                self._recon_norms,
-                self._tombs,
-                jnp.asarray(self._counts.astype(np.int32)),
+                snap.codes,
+                snap.recon_norms,
+                snap.tombs,
+                snap.counts_dev,
                 words,
                 cb_chunks,
                 flat_cb,
                 jnp.asarray(q),
-                self._pq.rotation_dev(),
+                snap.pq.rotation_dev(),
+                snap.slot_to_doc_dev,
                 kk,
                 self.metric,
                 use_allow,
                 rg,
                 active_g,
                 interpret,
+                fused,
                 self.mesh,
             ),
             "mesh pq codes kernel", component="index.mesh.pq_gmin")
-        return None if packed is None else np.asarray(packed)
 
-    def _gmin_step_or_none(self, q: np.ndarray, kk: int, words, use_allow):
-        """Run the fused group-min mesh kernel, or None for the legacy scan.
-        Validation mirrors tpu.py's _gmin_packed_or_none: per compiled
-        shape — a Mosaic rejection on a NEW shape falls back for that shape
-        only, a failure on a shape that already served propagates, and only
-        repeated distinct-shape failures with zero successes disable the
-        path."""
+    def _gmin_step_or_none(self, snap: MeshSnapshot, q: np.ndarray, kk: int,
+                           words, use_allow: bool, fused: bool):
+        """Enqueue the fused group-min mesh kernel, or None for the legacy
+        scan. Validation mirrors tpu.py's _gmin_packed_or_none: per
+        compiled shape — a Mosaic rejection on a NEW shape falls back for
+        that shape only, a failure on a shape that already served
+        propagates, and only repeated distinct-shape failures with zero
+        successes disable the path. Returns the guarded result RAW so the
+        async finalize defers the fetch."""
         from weaviate_tpu.parallel.mesh_search import mesh_search_gmin_step
 
         from weaviate_tpu.ops import gmin_scan
 
-        plan = self._gmin_plan(q.shape[0], kk)
+        plan = self._gmin_plan(q.shape[0], kk, snap)
         if plan is None:
             return None
         rg, active_g = plan
-        key = (q.shape[0], kk, rg, active_g, self.n_loc, use_allow)
+        key = (q.shape[0], kk, rg, active_g, snap.n_loc, use_allow, fused)
         interpret = jax.default_backend() not in ("tpu", "axon")
-        packed = gmin_scan.guarded_kernel_call(
+        return gmin_scan.guarded_kernel_call(
             self, key,
             lambda: mesh_search_gmin_step(
-                self._store,
-                self._sq_norms,
-                self._tombs,
-                jnp.asarray(self._counts.astype(np.int32)),
+                snap.store,
+                snap.sq_norms,
+                snap.tombs,
+                snap.counts_dev,
                 words,
                 jnp.asarray(q),
+                snap.slot_to_doc_dev,
                 kk,
                 self.metric,
                 use_allow,
@@ -854,10 +1499,236 @@ class MeshVectorIndex(VectorIndex):
                 rg,
                 active_g,
                 interpret,
+                fused,
                 self.mesh,
             ),
             "mesh gmin kernel", component="index.mesh.gmin")
-        return None if packed is None else np.asarray(packed)
+
+    # -- host fallback plane (breaker-degraded serving + shadow audits) ------
+
+    def _snap_prefix_slots(self, snap: MeshSnapshot) -> np.ndarray:
+        """Global row ids of every written slot in `snap`, slab order —
+        the per-device counts prefixes concatenated. Includes tombstoned
+        rows (masked by the caller), matching the single-chip convention
+        that host_rows covers the full high-water prefix."""
+        if snap.dim is None or snap.n_total == 0:
+            return np.zeros(0, dtype=np.int64)
+        return np.concatenate([
+            np.arange(dev * snap.n_loc,
+                      dev * snap.n_loc + int(snap.counts[dev]))
+            for dev in range(snap.n_dev)
+        ])
+
+    def host_rows(self, snap: MeshSnapshot) -> tuple[np.ndarray, np.ndarray]:
+        """(rows f32 [n, D], sq_norms f32 [n]) for `snap`'s written slots —
+        the quality auditor's ground-truth source. Compressed mode serves
+        the full-precision host copy (the device store is bf16 by then)."""
+        slots = self._snap_prefix_slots(snap)
+        if snap.compressed and snap.host_vecs is not None:
+            rows = snap.host_vecs[slots]
+        else:
+            rows = np.asarray(snap.store, dtype=np.float32)[slots]
+        sq = np.einsum("ij,ij->i", rows, rows, dtype=np.float32)
+        return rows, sq
+
+    def _host_fallback_rows(self, snap: MeshSnapshot):
+        """Generation-keyed single-entry cache of host_rows for the breaker
+        path — one fetch per snapshot generation while degraded."""
+        cached = self._host_rows_cache
+        if cached is not None and cached[0] == snap.gen:
+            return cached[1], cached[2]
+        rows, sq = self.host_rows(snap)
+        self._host_rows_cache = (snap.gen, rows, sq)  # graftflow: disable=JGL018 generation-keyed single-entry cache with an explicit release (release_host_fallback_cache on breaker recovery); outliving the snapshot is the point
+        return rows, sq
+
+    def release_host_fallback_cache(self) -> None:
+        """Drop the breaker-path row cache (called on breaker recovery)."""
+        self._host_rows_cache = None
+
+    def search_by_vectors_host(
+        self, vectors: np.ndarray, k: int, allow_list: Optional[AllowList] = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Pure-host scan over the current snapshot (the breaker's degraded
+        serving path; bit-compatible contract with the device scan)."""
+        snap = self._read_snapshot()
+        if snap.dim is None or snap.n_total == 0 or snap.live == 0:
+            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
+            return (np.zeros((b, 0), dtype=np.uint64),
+                    np.zeros((b, 0), dtype=np.float32))
+        rows, sq = self._host_fallback_rows(snap)
+        return self._host_search_snap(snap, vectors, k, allow_list, rows, sq)
+
+    def search_by_vectors_host_pinned(
+        self, snap: MeshSnapshot, vectors: np.ndarray, k: int,
+        allow_list: Optional[AllowList] = None, rows=None, sq_norms=None,
+        deadline: Optional[float] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Host scan against a PINNED snapshot (quality auditor: the shadow
+        re-execution must read the exact state the live dispatch saw)."""
+        if snap.dim is None or snap.n_total == 0 or snap.live == 0:
+            b = 1 if np.asarray(vectors).ndim == 1 else len(vectors)
+            return (np.zeros((b, 0), dtype=np.uint64),
+                    np.zeros((b, 0), dtype=np.float32))
+        if rows is None or sq_norms is None:
+            rows, sq_norms = self.host_rows(snap)
+        return self._host_search_snap(
+            snap, vectors, k, allow_list, rows, sq_norms, deadline)
+
+    def _host_search_snap(self, snap: MeshSnapshot, vectors, k, allow_list,
+                          rows, row_sq, deadline: Optional[float] = None):
+        from weaviate_tpu.storage.bitmap import Bitmap, allowed_mask
+
+        q = np.asarray(vectors, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if self.metric == vi.DISTANCE_COSINE:
+            norms = np.linalg.norm(q, axis=1, keepdims=True)
+            norms[norms == 0] = 1.0
+            q = q / norms
+        slots = self._snap_prefix_slots(snap)
+        live = ~snap.host_tombs[slots]
+        docs = snap.slot_to_doc[slots]
+        if allow_list is not None:
+            if isinstance(allow_list, Bitmap):
+                live = live & allowed_mask(allow_list, docs)
+            else:
+                live = live & allow_list.contains_array(docs.astype(np.uint64))
+        n = slots.size
+        n_live = int(live.sum())
+        if n_live == 0:
+            return (np.zeros((q.shape[0], 0), dtype=np.uint64),
+                    np.zeros((q.shape[0], 0), dtype=np.float32))
+        q_sq = (q ** 2).sum(1)[:, None] if self.metric == vi.DISTANCE_L2 else None
+        chunk = (4096 if self.metric in (vi.DISTANCE_MANHATTAN,
+                                         vi.DISTANCE_HAMMING)
+                 else self._HOST_SCAN_CHUNK)
+        d = np.empty((q.shape[0], n), dtype=np.float32)
+        for s in range(0, n, chunk):
+            if deadline is not None and time.perf_counter() > deadline:
+                raise quality.AuditDeadlineExceeded(
+                    f"host scan over audit budget at row {s}/{n}")
+            e = min(s + chunk, n)
+            blk = rows[s:e]
+            if self.metric == vi.DISTANCE_L2:
+                qx = q @ blk.T
+                d[:, s:e] = np.maximum(
+                    q_sq - 2.0 * qx + row_sq[s:e][None, :], 0.0)
+            elif self.metric == vi.DISTANCE_DOT:
+                d[:, s:e] = -(q @ blk.T)
+            elif self.metric == vi.DISTANCE_COSINE:
+                d[:, s:e] = 1.0 - q @ blk.T
+            elif self.metric == vi.DISTANCE_MANHATTAN:
+                d[:, s:e] = np.abs(q[:, None, :] - blk[None, :, :]).sum(-1)
+            else:
+                d[:, s:e] = (q[:, None, :] != blk[None, :, :]).sum(-1)
+        d[:, ~live] = np.inf
+        kk = min(max(int(k), 1), n_live)
+        idx = np.argpartition(d, kk - 1, axis=1)[:, :kk]
+        top = np.take_along_axis(d, idx, axis=1)
+        order = np.argsort(top, axis=1, kind="stable")
+        top = np.take_along_axis(top, order, axis=1)
+        idx = np.take_along_axis(idx, order, axis=1)
+        ids = np.where(np.isinf(top), -1, docs[idx])
+        return ids.astype(np.uint64), top.astype(np.float32)
+
+    # -- health (GET /debug/index parity with TpuVectorIndex) ----------------
+
+    def _ivf_health(self) -> dict:
+        s = ivf_settings()
+        out: dict = {
+            "enabled": s is not None,
+            "trained": self._ivf_centroids_host is not None,
+        }
+        if self._ivf_centroids_host is not None:
+            nlist, cap_p, gen = self._ivf_meta or (
+                self._ivf_centroids_host.shape[0], self._ivf_cap_p or 0,
+                self._ivf_gen)
+            out.update({"nlist": int(nlist), "cap_p": int(cap_p),
+                        "gen": int(gen), "trained_n": self._ivf_trained_n,
+                        "pca_dim": 0})
+            fills = self._ivf_fills
+            if fills is not None:
+                flat = fills.reshape(-1)
+                mean = float(flat.mean()) if flat.size else 0.0
+                total = int(flat.sum())
+                out["buckets"] = {
+                    "fill_min": int(flat.min()) if flat.size else 0,
+                    "fill_mean": round(mean, 1),
+                    "fill_max": int(flat.max()) if flat.size else 0,
+                    "empty": int((flat == 0).sum()),
+                    "padding_waste": round(
+                        1.0 - total / max(flat.size * cap_p, 1), 4),
+                    "imbalance": (round(float(flat.max()) / mean, 2)
+                                  if mean > 0 else None),
+                    "fill_histogram": np.histogram(
+                        flat, bins=8, range=(0, max(cap_p, 1)))[0].tolist(),
+                    "per_device_rows": fills.sum(axis=1).tolist(),
+                }
+        out["probes"] = self.ivf_stats()
+        return out
+
+    def health(self) -> dict:
+        """Mesh diagnostics for GET /debug/index — same keys as the
+        single-chip index plus the per-device breakdown."""
+        with self._lock:
+            counts = self._counts.copy()
+            slots = int(counts.sum())
+            tombs = int(self._host_tombs.sum())
+            comps = self._memory_components()
+            slab_bytes_total = sum(comps.values())
+            per_device = []
+            for dev in range(self.n_dev):
+                sl = slice(dev * self.n_loc, dev * self.n_loc + self.n_loc)
+                per_device.append({
+                    "device": dev,
+                    "rows": int(counts[dev]),
+                    "tombstones": int(self._host_tombs[sl].sum())
+                    if self._host_tombs.size else 0,
+                    "slab_bytes": slab_bytes_total // self.n_dev,
+                })
+            out = {
+                "type": "hnsw_tpu_mesh",
+                "metric": self.metric,
+                "dim": self.dim,
+                "devices": self.n_dev,
+                "rows_per_device": self.n_loc,
+                "capacity": self.n_dev * self.n_loc,
+                "slots": slots,
+                "live": self.live,
+                "tombstones": tombs,
+                "tombstone_fraction": round(tombs / max(slots, 1), 4),
+                "pending_adds": len(self._pending),
+                "pending_tombstones": len(self._pending_tombs),
+                "snapshot_gen": self.snapshot_gen,
+                "staged_gen": self._staged_gen,
+                "published_gen": self._published_gen,
+                "staged_lag": self._staged_gen - max(self._published_gen, 0),
+                "per_device": per_device,
+                "compressed": self.compressed,
+                # rescore=false is the MULTICHIP_r05 footgun: raw ADC
+                # distances at recall ~0.24 — surfaced, not just documented
+                "pq": None if self._pq is None else {
+                    "segments": self._pq.segments,
+                    "centroids": self._pq.centroids,
+                    "rotation": bool(self.config.pq.rotation),
+                    "rescore": bool(self.config.pq.rescore),
+                    "code_dtype": str(np.dtype(self._pq.code_dtype)),
+                },
+                "ivf": self._ivf_health(),
+                "host_fallback_cache": {
+                    "resident": self._host_rows_cache is not None,
+                    "gen": (self._host_rows_cache[0]
+                            if self._host_rows_cache is not None else None),
+                    "bytes": memory.host_rows_cache_bytes(self),
+                },
+                "memory": {
+                    "device_components": comps,
+                    "host_components": memory.index_host_components(self),
+                },
+            }
+        return out
+
+    # -- single-vector entry points ------------------------------------------
 
     def search_by_vector(
         self, vector: np.ndarray, k: int, allow_list: Optional[AllowList] = None
@@ -887,6 +1758,8 @@ class MeshVectorIndex(VectorIndex):
                 return ids[:max_limit], dists[:max_limit]
             limit *= 2
 
+    # -- config / maintenance ------------------------------------------------
+
     def update_user_config(self, updated: vi.HnswUserConfig) -> None:
         with self._lock:
             vi.validate_config_update(self.config, updated)
@@ -915,20 +1788,25 @@ class MeshVectorIndex(VectorIndex):
                 except Exception:
                     # a failed pq-enable must not stick — config or runtime
                     # (an OOM'd kmeans fit): a committed-but-uncompressed
-                    # config would re-run the full fit from _flush_pending's
-                    # declarative trigger on every later add/search
+                    # config would re-run the full fit from the flush-path
+                    # declarative trigger on every later flush
                     self.config = prev
                     raise
 
     def flush(self) -> None:
         with self._lock:
             self._flush_pending()
+            self._maybe_autocompress()
             if self._log is not None:
                 self._log.flush()
+        # IVF (re)training fetches + fits OFF the lock, from a pinned
+        # snapshot; concurrent writes queue into the backlog
+        self._ivf_maybe_train()
 
     def compact(self) -> None:
         """Condense: drop tombstoned slots, rewrite the log, rebuild balanced
-        (condensor.go analog)."""
+        (condensor.go analog). In-flight dispatches keep their pinned
+        snapshots — the rebuild swaps whole slabs, never mutates them."""
         with self._lock:
             self._flush_pending()
             if self.dim is None or not self._doc_to_row:
@@ -948,6 +1826,7 @@ class MeshVectorIndex(VectorIndex):
                 self._log.rewrite(zip(docs.tolist(), store_host))
             # mapping rebuild invalidates any packed-words cache keyed on it
             self._allow_token = object()
+            self._ivf_reset()
             dim = self.dim
             self.dim = None
             self.n_loc = 0
@@ -956,12 +1835,16 @@ class MeshVectorIndex(VectorIndex):
             self._doc_to_row.clear()
             self._slot_to_doc = np.zeros(0, dtype=np.int64)
             self._store = self._sq_norms = self._tombs = None
+            self._s2d_dev = None
+            self._host_tombs = np.zeros(0, dtype=bool)
             self._init_device(dim)
             self._restoring = True
             try:
                 self.add_batch(docs, store_host)
             finally:
                 self._restoring = False
+            self._staged_gen += 1
+            self._mark_staged()
             led = memory.get_ledger()
             if led is not None:
                 led.note_write(
@@ -980,6 +1863,7 @@ class MeshVectorIndex(VectorIndex):
                 self._log = None
             self._store = self._sq_norms = self._tombs = None
             self._zero_words = None  # sharded device words must free too
+            self._s2d_dev = None
             self._codes = self._recon_norms = None
             self._host_vecs = None
             self._pq = None
@@ -994,9 +1878,15 @@ class MeshVectorIndex(VectorIndex):
             self.live = 0
             self._counts = np.zeros(self.n_dev, dtype=np.int64)
             self._slot_to_doc = np.zeros(0, dtype=np.int64)
+            self._host_tombs = np.zeros(0, dtype=bool)
             self._doc_to_row.clear()
             self._pending.clear()
             self._pending_tombs.clear()
+            self._snap = None
+            self._host_rows_cache = None
+            self._ivf_reset()
+            self._device_epoch += 1
+            self._staged_gen += 1
             self._stamp_memory()  # zero this index's device components
 
     def shutdown(self) -> None:

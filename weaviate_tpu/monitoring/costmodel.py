@@ -120,7 +120,7 @@ class DispatchShape:
     """
 
     __slots__ = ("tier", "n", "dim", "batch", "batch_padded",
-                 "bytes_per_row", "k", "extra",
+                 "bytes_per_row", "k", "extra", "ndev",
                  "enqueue_ms", "device_ms", "finalize_ms",
                  "filter_ms", "hydrate_ms", "t_start", "t_end",
                  "t_fetch", "t_fetch_mono", "fused", "fetches",
@@ -128,7 +128,8 @@ class DispatchShape:
 
     def __init__(self, tier: str, n: int, dim: float, batch: int,
                  bytes_per_row: float, k: int = 0,
-                 batch_padded: int = 0, extra: Optional[dict] = None):
+                 batch_padded: int = 0, extra: Optional[dict] = None,
+                 ndev: int = 1):
         self.tier = tier
         self.n = int(n)
         self.dim = dim
@@ -137,6 +138,10 @@ class DispatchShape:
         self.bytes_per_row = bytes_per_row
         self.k = int(k)
         self.extra = extra
+        # devices the SPMD program spans (mesh dispatches): `n` stays the
+        # GLOBAL row count so flops()/bytes() keep reporting whole-dispatch
+        # work; per-chip attribution divides by ndev (monitoring/perf.py)
+        self.ndev = max(int(ndev), 1)
         self.enqueue_ms = -1.0
         self.device_ms = -1.0
         self.finalize_ms = -1.0
@@ -210,6 +215,8 @@ class DispatchShape:
              "batch": self.batch, "batch_padded": self.batch_padded,
              "k": self.k, "flops": self.flops(), "bytes": self.bytes(),
              "fused": self.fused}
+        if self.ndev != 1:
+            d["ndev"] = self.ndev
         if self.extra:
             d.update(self.extra)
         return d

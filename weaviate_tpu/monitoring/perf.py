@@ -176,6 +176,15 @@ class PerfWindow:
         device_s = max(shape.device_ms, 0.0) / 1000.0
         flops = shape.flops()
         byts = shape.bytes()
+        # mesh dispatches (shape.ndev > 1) count GLOBAL work in n — the
+        # whole sharded program's rows. The roofline compares achieved
+        # rates against ONE chip's peak, so normalize to per-chip work;
+        # arithmetic intensity (flops/bytes) is unchanged by the division,
+        # so the regime classification stays identical
+        nd = max(int(getattr(shape, "ndev", 1)), 1)
+        if nd > 1:
+            flops //= nd
+            byts //= nd
         regime = (costmodel.regime(flops, byts, self.backend)
                   if device_s > 0.0 else None)
         # the shape's wall endpoints are perf_counter stamps; the window

@@ -10,7 +10,11 @@ Every kernel here is a whole-mesh step:
 
 - mesh_search_step:  chunked masked kNN per slab (tombstones + allowList
   bitmap, same semantics as the single-chip scan in index/tpu.py) with the
-  cross-chip merge riding ICI.
+  cross-chip merge riding ICI. With ``fused=True`` every search kernel
+  translates its LOCAL winners through its slab of the sharded slot->doc
+  word table BEFORE the collective, so the gathered candidates already
+  carry final doc ids and the merged output is the PR-14 packed [B, 3k]
+  fused layout — one fetch, zero host translation, across chips.
 - mesh_insert_step:  ALL shards land their staged rows in ONE program — the
   host ships a [n_dev, C, D] block sharded over the mesh, each chip writes its
   own chunk at its own offset (and derives l2 norms on device). No per-shard
@@ -34,7 +38,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from weaviate_tpu.ops.distances import DISTANCE_FNS
-from weaviate_tpu.ops.topk import bitmap_to_mask, merge_top_k, pack_topk
+from weaviate_tpu.ops.topk import (
+    bitmap_to_mask, merge_top_k, pack_topk, rescore_distances,
+    translate_pack,
+)
 
 SHARD_AXIS = "shard"
 
@@ -67,6 +74,47 @@ def _merge_across_shards(d_top, i_glob, k):
     return pack_topk(d_fin, i_fin)
 
 
+def _merge_across_shards_fused(d_top, i_loc, s2d_l, k):
+    """Cross-chip merge with the slot->doc translation fused BEFORE the
+    collective: each chip gathers its k winners' doc-id words from its
+    LOCAL slab of the sharded [cap, 2] uint32 table (a k-row gather — the
+    table itself never crosses ICI), packs (dist | id_lo | id_hi) into the
+    PR-14 fused [B, 3k] layout, all_gathers the per-chip packed blocks,
+    and reselects the final k by distance. The winning id words ride the
+    selection, so the replicated output is ALREADY the fused layout:
+    finalize stays one fetch / zero host translation across chips
+    (the JGL015 invariant, mesh-shaped). Missing slots (i_loc < 0) carry
+    the 0xFFFFFFFF sentinel words from translate_pack and +inf distance,
+    so they lose every selection and unpack to the same 2**64-1 id the
+    single-chip fused path emits."""
+    packed_l = translate_pack(d_top, i_loc, s2d_l)          # [B, 3k] i32
+    all_p = jax.lax.all_gather(packed_l, SHARD_AXIS, axis=1, tiled=True)
+    b = d_top.shape[0]
+    w = all_p.reshape(b, -1, 3, k)                          # [B, n_dev, 3, k]
+    d_all = jax.lax.bitcast_convert_type(
+        w[:, :, 0, :], jnp.float32).reshape(b, -1)
+    lo_all = w[:, :, 1, :].reshape(b, -1)
+    hi_all = w[:, :, 2, :].reshape(b, -1)
+    neg, pos = jax.lax.top_k(-d_all, k)
+    d_fin = -neg
+    lo = jnp.take_along_axis(lo_all, pos, axis=1)
+    hi = jnp.take_along_axis(hi_all, pos, axis=1)
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(d_fin, jnp.int32), lo, hi], axis=1)
+
+
+def _merge_local(d_top, i_loc, s2d_l, my, n_loc, k, fused):
+    """The shared per-shard epilogue of every mesh search kernel
+    (i_loc [B, k] = LOCAL slab rows, -1 for missing): fused mode
+    translates LOCAL winners through the local s2d slab and merges packed
+    doc-id candidates; legacy mode rebases to global rows and merges
+    (dist, row) pairs for the host-side slot->doc translation."""
+    if fused:
+        return _merge_across_shards_fused(d_top, i_loc, s2d_l, k)
+    i_glob = jnp.where(i_loc >= 0, i_loc + my * n_loc, -1)
+    return _merge_across_shards(d_top, i_glob, k)
+
+
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()[: n_devices or len(jax.devices())]
@@ -84,11 +132,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "metric", "use_allow", "use_norms", "exact", "mesh"),
+    static_argnames=("k", "metric", "use_allow", "use_norms", "exact",
+                     "fused", "mesh"),
 )
 def mesh_search_step(
-    store, sq_norms, tombs, n_per_shard, allow_words, queries,
-    k, metric, use_allow, use_norms, exact, mesh,
+    store, sq_norms, tombs, n_per_shard, allow_words, queries, s2d,
+    k, metric, use_allow, use_norms, exact, fused, mesh,
 ):
     """Fully-sharded masked kNN.
 
@@ -98,8 +147,13 @@ def mesh_search_step(
     n_per_shard: [n_dev] int32 replicated — live high-water mark per slab
     allow_words: [n_dev * n_loc / 32] uint32 sharded — packed filter bitmap
     queries:     [B, D] replicated
-    -> packed [B, 2k] i32 (pack_topk), replicated; global row = slab row +
-       shard_index * n_loc (the host maps rows -> docIDs).
+    s2d:         [n_dev * n_loc, 2] uint32 sharded — per-slab slot->doc id
+                 words (consumed only under fused=True; XLA dead-code
+                 eliminates the operand otherwise)
+    -> fused=True: FUSED packed [B, 3k] i32 (translate_pack layout, doc ids
+       already resolved on device), replicated.
+       fused=False: packed [B, 2k] i32 (pack_topk), replicated; global
+       row = slab row + shard_index * n_loc (the host maps rows -> docIDs).
 
     Per-chunk selection is lax.approx_min_k (the TPU PartialReduce primitive)
     unless exact; the cross-chunk and cross-chip merges are exact, mirroring
@@ -111,7 +165,7 @@ def mesh_search_step(
     chunk = min(n_loc, _MESH_SCAN_CHUNK)
     nchunks = n_loc // chunk  # n_loc is a power of two, so this divides
 
-    def shard_fn(store_l, norms_l, tombs_l, n_all, allow_l, q):
+    def shard_fn(store_l, norms_l, tombs_l, n_all, allow_l, q, s2d_l):
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
         b = q.shape[0]
@@ -151,28 +205,27 @@ def mesh_search_step(
         if use_allow:
             xs.append(allow_c)
         (d_top, i_top), _ = jax.lax.scan(step, init, tuple(xs))
-        i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
-        return _merge_across_shards(d_top, i_glob, k)
+        return _merge_local(d_top, i_top, s2d_l, my, n_loc, k, fused)
 
     return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
-            P(SHARD_AXIS), P(),
+            P(SHARD_AXIS), P(), P(SHARD_AXIS, None),
         ),
         out_specs=P(),
-    )(store, sq_norms, tombs, n_per_shard, allow_words, queries)
+    )(store, sq_norms, tombs, n_per_shard, allow_words, queries, s2d)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "metric", "use_allow", "use_norms", "rg",
-                     "active_g", "interpret", "mesh"),
+                     "active_g", "interpret", "fused", "mesh"),
 )
 def mesh_search_gmin_step(
-    store, sq_norms, tombs, n_per_shard, allow_words, queries,
-    k, metric, use_allow, use_norms, rg, active_g, interpret, mesh,
+    store, sq_norms, tombs, n_per_shard, allow_words, queries, s2d,
+    k, metric, use_allow, use_norms, rg, active_g, interpret, fused, mesh,
 ):
     """Fused group-min kNN, mesh-sharded: each chip runs the SAME Pallas
     fast-scan + exact-rescore the single-chip index uses
@@ -186,7 +239,7 @@ def mesh_search_gmin_step(
     n_dev = mesh.devices.size
     n_loc = store.shape[0] // n_dev
 
-    def shard_fn(store_l, norms_l, tombs_l, n_all, allow_l, q):
+    def shard_fn(store_l, norms_l, tombs_l, n_all, allow_l, q, s2d_l):
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
         norms = norms_l if use_norms else jnp.zeros_like(norms_l)
@@ -196,28 +249,28 @@ def mesh_search_gmin_step(
         d_top, i_top = gmin_scan.gmin_topk(
             store_l, norms, tombs_l, n_mine, q, allow_l, use_allow,
             k, metric, rg, active_g, interpret, blk_l)
-        i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
-        return _merge_across_shards(d_top, i_glob, k)
+        return _merge_local(d_top, i_top, s2d_l, my, n_loc, k, fused)
 
     return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
-            P(SHARD_AXIS), P(),
+            P(SHARD_AXIS), P(), P(SHARD_AXIS, None),
         ),
         out_specs=P(),
-    )(store, sq_norms, tombs, n_per_shard, allow_words, queries)
+    )(store, sq_norms, tombs, n_per_shard, allow_words, queries, s2d)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "metric", "use_allow", "rg", "active_g",
-                     "interpret", "mesh"),
+                     "interpret", "fused", "mesh"),
 )
 def mesh_search_pq_gmin_step(
     codes, recon_norms, tombs, n_per_shard, allow_words, cb_chunks, flat_cb,
-    queries, rot, k, metric, use_allow, rg, active_g, interpret, mesh,
+    queries, rot, s2d, k, metric, use_allow, rg, active_g, interpret, fused,
+    mesh,
 ):
     """Codes-only fused ADC kNN, mesh-sharded: each chip runs the SAME
     reconstruction-as-matmul Pallas scan the single-chip index uses
@@ -231,37 +284,37 @@ def mesh_search_pq_gmin_step(
     n_dev = mesh.devices.size
     n_loc = codes.shape[0] // n_dev
 
-    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb_c, fcb, q, r):
+    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb_c, fcb, q, r,
+                 s2d_l):
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
         d_top, i_top = pq_gmin.pq_gmin_topk(
             codes_l, norms_l, tombs_l, n_mine, q, cb_c, fcb, allow_l,
             use_allow, k, metric, rg, active_g, interpret, r,
             pq_gmin.build_codes_blocks(codes_l))
-        i_glob = jnp.where(i_top >= 0, i_top + my * n_loc, -1)
-        return _merge_across_shards(d_top, i_glob, k)
+        return _merge_local(d_top, i_top, s2d_l, my, n_loc, k, fused)
 
     return shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(
             P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
-            P(SHARD_AXIS), P(), P(), P(), P(),
+            P(SHARD_AXIS), P(), P(), P(), P(), P(SHARD_AXIS, None),
         ),
         out_specs=P(),
     )(codes, recon_norms, tombs, n_per_shard, allow_words, cb_chunks,
-      flat_cb, queries, rot)
+      flat_cb, queries, rot, s2d)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "r_chunk", "metric", "use_allow", "exact",
-                     "do_rescore", "mesh"),
+                     "do_rescore", "fused", "mesh"),
 )
 def mesh_search_pq_step(
     codes, recon_norms, tombs, n_per_shard, allow_words, codebook,
-    rescore_store, queries, rot, k, r_chunk, metric, use_allow, exact,
-    do_rescore, mesh,
+    rescore_store, queries, rot, s2d, k, r_chunk, metric, use_allow, exact,
+    do_rescore, fused, mesh,
 ):
     """Mesh twin of the single-chip PQ reconstruction scan
     (index/tpu.py _search_pq_recon): each chip scans its OWN code slab —
@@ -288,7 +341,8 @@ def mesh_search_pq_step(
     chunk = min(n_loc, _MESH_SCAN_CHUNK)
     nchunks = n_loc // chunk
 
-    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb, rs_l, q, r):
+    def shard_fn(codes_l, norms_l, tombs_l, n_all, allow_l, cb, rs_l, q, r,
+                 s2d_l):
         my = jax.lax.axis_index(SHARD_AXIS)
         n_mine = n_all[my]
         b = q.shape[0]
@@ -339,8 +393,6 @@ def mesh_search_pq_step(
         cand_d = jnp.moveaxis(tds, 0, 1).reshape(b, pool)
         cand_i = jnp.moveaxis(lis, 0, 1).reshape(b, pool)
         if do_rescore:
-            from weaviate_tpu.ops.topk import rescore_distances
-
             safe = jnp.clip(cand_i, 0, n_loc - 1)
             cand = jnp.take(rs_l, safe, axis=0)
             ed = rescore_distances(cand, q, metric)
@@ -348,8 +400,8 @@ def mesh_search_pq_step(
         neg, pos = jax.lax.top_k(-cand_d, k)
         d_top = -neg
         i_top = jnp.take_along_axis(cand_i, pos, axis=1)
-        i_glob = jnp.where(jnp.isinf(d_top), -1, i_top + my * n_loc)
-        return _merge_across_shards(d_top, i_glob, k)
+        i_loc = jnp.where(jnp.isinf(d_top), -1, i_top)
+        return _merge_local(d_top, i_loc, s2d_l, my, n_loc, k, fused)
 
     return shard_map_compat(
         shard_fn,
@@ -357,15 +409,78 @@ def mesh_search_pq_step(
         in_specs=(
             P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(),
             P(SHARD_AXIS), P(), P(SHARD_AXIS, None), P(), P(),
+            P(SHARD_AXIS, None),
         ),
         out_specs=P(),
     )(codes, recon_norms, tombs, n_per_shard, allow_words, codebook,
-      rescore_store, queries, rot)
+      rescore_store, queries, rot, s2d)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh",), donate_argnums=(0, 1)
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "top_p", "exact", "gp",
+                     "fused", "mesh"),
 )
+def mesh_search_ivf_step(
+    store, tombs, n_per_shard, allow_words, centroids, buckets, queries,
+    s2d, k, metric, use_allow, top_p, exact, gp, fused, mesh,
+):
+    """Partition-pruned kNN over the sharded dense store: the mesh twin of
+    ops/ivf.search_ivf_dense. Centroids are replicated (every chip probes
+    the SAME nlist partitions — the KScaNN-style balanced assignment is
+    done at build time per device), but buckets are per-device: buckets
+    [n_dev, nlist, cap_p] int32 sharded over dim 0 holds LOCAL slab slot
+    ids (-1 padding), so each chip gathers only the probed candidates that
+    physically live in its own HBM slab. Per-shard candidate scoring and
+    local top-k mirror the single-chip grouped scan exactly (shared
+    _probe/_candidate_slots/_slot_valid/_grouped_topk helpers); the
+    cross-chip merge is the same fused/legacy epilogue as every other mesh
+    search kernel. No PCA prefilter tier here: the probed per-device pool
+    is already 1/n_dev of the single-chip pool, below where the prefilter
+    pays for its extra gather."""
+    from weaviate_tpu.ops import ivf as ivf_ops
+
+    n_dev = mesh.devices.size
+    n_loc = store.shape[0] // n_dev
+
+    def shard_fn(store_l, tombs_l, n_all, allow_l, cent, bkt_l, q, s2d_l):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        n_mine = n_all[my]
+        qf = q.astype(jnp.float32)
+        parts = ivf_ops._probe(qf, cent, top_p, metric)
+        slots_g = ivf_ops._candidate_slots(parts, bkt_l[0], gp)
+        valid_g = ivf_ops._slot_valid(slots_g, n_mine, tombs_l,
+                                      allow_l if use_allow else None)
+
+        def score_full(sl):
+            rows = jnp.take(store_l, jnp.clip(sl, 0, n_loc - 1), axis=0)
+            return rescore_distances(rows, qf, metric)
+
+        d_top, i_top = ivf_ops._grouped_topk(slots_g, valid_g, score_full,
+                                             k, exact)
+        i_loc = jnp.where(jnp.isinf(d_top), -1, i_top)
+        return _merge_local(d_top, i_loc, s2d_l, my, n_loc, k, fused)
+
+    return shard_map_compat(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS), P(), P(SHARD_AXIS), P(),
+            P(SHARD_AXIS, None, None), P(), P(SHARD_AXIS, None),
+        ),
+        out_specs=P(),
+    )(store, tombs, n_per_shard, allow_words, centroids, buckets, queries,
+      s2d)
+
+
+# NOTE on donation: the write kernels below deliberately do NOT donate
+# their input slabs. Published MeshSnapshot objects pin the previous
+# arrays for in-flight lock-free readers (docs/concurrency.md, snapshot
+# plane); donating would hand XLA permission to overwrite buffers a
+# concurrent dispatch is still scanning. The copy cost is the price of
+# the snapshot contract — identical to the single-chip index's
+# non-donating _write_rows/_set_tombstones kernels.
+@functools.partial(jax.jit, static_argnames=("mesh",))
 def mesh_write_rows_step(arr2d, arr1d, chunks2d, vals1d, offsets, takes, mesh):
     """Generic whole-mesh append for an arbitrary-dtype sharded matrix plus
     a per-row f32 vector (codes + recon_norms on the PQ path): each chip
@@ -392,9 +507,7 @@ def mesh_write_rows_step(arr2d, arr1d, chunks2d, vals1d, offsets, takes, mesh):
     )(arr2d, arr1d, chunks2d, vals1d, offsets, takes)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("use_norms", "mesh"), donate_argnums=(0, 1)
-)
+@functools.partial(jax.jit, static_argnames=("use_norms", "mesh"))
 def mesh_insert_step(store, sq_norms, chunks, offsets, takes, use_norms, mesh):
     """One whole-mesh append: chunks [n_dev, C, D] sharded over dim 0 (each
     chip receives only its own [C, D] block), offsets/takes [n_dev]
@@ -434,7 +547,7 @@ def mesh_insert_step(store, sq_norms, chunks, offsets, takes, use_norms, mesh):
     )(store, sq_norms, chunks, offsets, takes)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+@functools.partial(jax.jit, static_argnames=("mesh",))
 def mesh_delete_step(tombs, rows, mesh):
     """Tombstone scatter: rows [P] int32 global rows, padded with -1. Each
     chip claims the rows inside its slab; out-of-slab rows map to the
@@ -452,6 +565,48 @@ def mesh_delete_step(tombs, rows, mesh):
         shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
         out_specs=P(SHARD_AXIS),
     )(tombs, rows)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def mesh_write_pairs_step(s2d, pairs, offsets, takes, mesh):
+    """Whole-mesh append for the sharded slot->doc word table: pairs
+    [n_dev, C, 2] uint32 sharded over dim 0 (each chip lands only its own
+    [C, 2] block of (id_lo, id_hi) words), offsets/takes [n_dev]
+    replicated. Same masked-select discipline as mesh_insert_step, and
+    same non-donation contract — published snapshots pin the old table."""
+
+    def shard_fn(s2d_l, pairs_l, offs, tks):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        off = offs[my]
+        active = tks[my] > 0
+        written = jax.lax.dynamic_update_slice(s2d_l, pairs_l[0], (off, 0))
+        return jnp.where(active, written, s2d_l)
+
+    return shard_map_compat(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS, None, None), P(), P(),
+        ),
+        out_specs=P(SHARD_AXIS, None),
+    )(s2d, pairs, offsets, takes)
+
+
+@functools.partial(jax.jit, static_argnames=("new_loc", "fill", "mesh"))
+def mesh_grow_pairs(arr, new_loc, fill, mesh):
+    """mesh_grow_2d for the slot->doc word table: the growth padding is
+    the unwritten-slot sentinel (index/tpu.py _S2D_FILL), not zero — a
+    zero pad would read as doc id 0. Slab-local offsets are preserved, so
+    each chip's prefix stays valid after the grow."""
+
+    def shard_fn(arr_l):
+        out = jnp.full((new_loc, arr_l.shape[1]), fill, arr_l.dtype)
+        return jax.lax.dynamic_update_slice(out, arr_l, (0, 0))
+
+    return shard_map_compat(
+        shard_fn, mesh=mesh, in_specs=(P(SHARD_AXIS, None),),
+        out_specs=P(SHARD_AXIS, None),
+    )(arr)
 
 
 @functools.partial(jax.jit, static_argnames=("new_loc", "mesh"))

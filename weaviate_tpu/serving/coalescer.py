@@ -27,8 +27,9 @@ hydration runs on a small dispatch pool so lanes overlap device compute
 with hydration and with each other. FILTERED lanes ride the same two-phase
 pipeline (snapshot-isolated indexes dispatch filtered searches, both PQ
 tiers, and the small-allowList gather without a lock — index/tpu.py
-IndexSnapshot); only index types without snapshot dispatch (hnsw, noop,
-mesh) still run their whole blocking search on the pool.
+IndexSnapshot and the multi-chip twin index/mesh.py MeshSnapshot); only
+index types without snapshot dispatch (hnsw, noop) still run their whole
+blocking search on the pool.
 Results scatter back to per-request waiters. k is deliberately part of the
 lane key — requests only share a dispatch at IDENTICAL k — because the
 bit-identical contract (coalesced == direct, pinned by the tests) would
@@ -934,8 +935,8 @@ class QueryCoalescer:
                     # for index types without filtered async).
                     self._submit_lane_task(self._dispatch_filtered, ln)
                 else:
-                    # indexes without true async dispatch (hnsw, noop,
-                    # mesh): the whole blocking search runs on the pool —
+                    # indexes without true async dispatch (hnsw,
+                    # noop): the whole blocking search runs on the pool —
                     # object_vector_search_async's sync fallback would
                     # otherwise execute it inline in THIS thread and
                     # head-of-line-block every other lane

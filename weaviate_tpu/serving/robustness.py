@@ -436,7 +436,11 @@ _STATE_NAMES = {STATE_CLOSED: "closed", STATE_OPEN: "open",
 class CircuitBreaker:
     """Device-dispatch circuit breaker (three-state, consecutive-failure
     trip). One instance guards the process's device: dispatch failures are
-    a property of the accelerator, not of one shard.
+    a property of the accelerator, not of one shard. This scoping holds
+    for the multi-chip mesh too — a mesh dispatch is ONE SPMD program
+    spanning every chip, so any chip failing fails the whole program and
+    the mesh is one failure domain, not eight (docs/mesh_serving.md);
+    per-chip breakers would just trip in lockstep.
 
     CLOSED     normal serving; ``allow()`` is lock-free. N consecutive
                device errors (``record_failure``) trip to OPEN.
