@@ -1085,6 +1085,21 @@ def _parse_args(argv=None):
                         "probed_fraction into the bench_matrix ivf_scan_* "
                         "row. Knobs: BENCH_IVF_{N,DIM,CLIENTS,BATCH,"
                         "SECONDS,WARMUP,NLIST,TOP_P,PCA_DIM,AUDIT_RATE}")
+    p.add_argument("--quant", choices=("exact", "pq8", "pq4-funnel", "all"),
+                   default=None,
+                   help="quantization-ladder A/B (ops/pq4.py + index/"
+                        "tpu.py): closed-loop batched kNN on the SHARD "
+                        "serving path comparing the exact scan, the 8-bit "
+                        "codes tier, and the 4-bit Quick-ADC three-stage "
+                        "funnel (nibble scan -> 8-bit re-rank -> exact "
+                        "rescore) under identical load, with the shadow "
+                        "recall auditor sampling live dispatches for "
+                        "online_recall and code bytes/vector read from "
+                        "the memory ledger. `all` commits QPS, recall@10, "
+                        "online_recall, and funnel survivor counts into "
+                        "the bench_matrix quant_ladder_* row. Knobs: "
+                        "BENCH_QUANT_{N,DIM,SEGMENTS,CLIENTS,BATCH,"
+                        "SECONDS,WARMUP,AUDIT_RATE}")
     p.add_argument("--overload", type=int, default=0,
                    help="closed-loop OVERLOAD mode: N client threads, each "
                         "request under a tight deadline "
@@ -2225,6 +2240,244 @@ def run_serving_bench(args, rng):
     _gate_exit()
 
 
+def run_quant_bench(args, rng):
+    """Quantization-ladder A/B (the 4-bit Quick-ADC funnel tentpole):
+    closed-loop batched kNN against ONE shard on the direct serving path,
+    comparing three rungs under identical load — the exact scan, the
+    8-bit codes tier (rescore off: the tier the funnel must beat on
+    QPS), and the 4-bit funnel (nibble scan -> exact 8-bit ADC re-rank
+    of the top C -> exact rescore of the top c, OPQ-rotated). The shadow
+    recall auditor samples live dispatches against the exact pinned host
+    plane, so the committed row carries ONLINE recall next to the
+    bench's own sampled-reply recall@10; code bytes/vector come from the
+    memory ledger components (pq4_codes / pq_codes over slab capacity),
+    and the funnel's per-stage survivor counts come from the index's
+    funnel accounting. Acceptance: funnel recall@10 >= 0.99 and funnel
+    QPS > the 8-bit codes tier's QPS on the CPU A/B; 4-bit code
+    bytes/vector <= M/2 plus the shared rotation matrix."""
+    import shutil
+    import tempfile
+    import threading
+    import uuid as uuidlib
+
+    import jax
+
+    if os.environ.get("BENCH_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        _probe_device()
+    from weaviate_tpu.config import Config
+    from weaviate_tpu.entities.storobj import StorObj
+    from weaviate_tpu.server import App
+
+    n = int(os.environ.get("BENCH_QUANT_N", 60_000))
+    dim = int(os.environ.get("BENCH_QUANT_DIM", 64))
+    segments = int(os.environ.get("BENCH_QUANT_SEGMENTS", dim // 4))
+    clients = int(os.environ.get("BENCH_QUANT_CLIENTS", 2))
+    # small batches: the regime where the per-query LUT build amortizes
+    # and the scan (not the select) dominates — the funnel's home turf
+    batch = int(os.environ.get("BENCH_QUANT_BATCH", 4))
+    seconds = float(os.environ.get("BENCH_QUANT_SECONDS", 6.0))
+    warmup = float(os.environ.get("BENCH_QUANT_WARMUP", 4.0))
+    log(f"quant bench: n={n} dim={dim} m={segments} clients={clients} "
+        f"batch={batch} mode={args.quant}")
+    vecs = make_data(n, dim, rng)
+    pool_q = vecs[rng.integers(0, n, 256)] + 0.05 * rng.standard_normal(
+        (256, dim), dtype=np.float32)
+    gt = exact_gt(vecs, pool_q, K)
+
+    PQ_MODES = {
+        "exact": None,
+        "pq8": {"enabled": True, "segments": segments, "centroids": 256,
+                "rescore": False, "rotation": "none"},
+        "pq4-funnel": {"enabled": True, "segments": segments,
+                       "centroids": 256, "bits": 4, "rescore": True,
+                       "rotation": "opq"},
+    }
+
+    def measure(mode: str) -> dict:
+        pq_cfg = PQ_MODES[mode]
+        cfg = Config()
+        cfg.quality.audit_sample_rate = float(
+            os.environ.get("BENCH_QUANT_AUDIT_RATE", 0.2))
+        cfg.quality.audit_deadline_ms = 10_000.0  # host scans n rows
+        cfg.quality.audit_max_rows = batch
+        data_dir = tempfile.mkdtemp(prefix="benchquant")
+        app = None
+        try:
+            app = App(config=cfg, data_path=data_dir)
+            vic = {"distance": "l2-squared"}
+            if pq_cfg is not None:
+                vic["pq"] = pq_cfg
+            app.schema.add_class({
+                "class": "Quant", "vectorIndexType": "hnsw_tpu",
+                "vectorIndexConfig": vic,
+                "properties": [{"name": "tag", "dataType": ["text"]}],
+            })
+            ci = app.db.get_index("Quant")
+            t0 = time.perf_counter()
+            for s in range(0, n, 10_000):
+                ci.put_batch([
+                    StorObj(class_name="Quant",
+                            uuid=str(uuidlib.UUID(int=i + 1)),
+                            properties={"tag": f"t{i % 16}"},
+                            vector=vecs[i])
+                    for i in range(s, min(s + 10_000, n))])
+            import_s = time.perf_counter() - t0
+            shard = ci.single_local_shard()
+            vidx = shard.vector_index
+            if pq_cfg is not None:
+                assert vidx.compressed, f"quant bench: {mode} did not compress"
+            if mode == "pq4-funnel":
+                assert getattr(vidx, "_codes4", None) is not None, \
+                    "quant bench: the 4-bit rung did not build"
+            log(f"  import {import_s:.1f}s; mode={mode} "
+                f"health={vidx.health().get('pq')}")
+            stop = threading.Event()
+            counting = threading.Event()
+            lats: list[list[float]] = [[] for _ in range(clients)]
+            samples: list[list] = [[] for _ in range(clients)]
+            errors = [0] * clients
+
+            def loop(tid: int) -> None:
+                lrng = np.random.default_rng(700 + tid)
+                while not stop.is_set():
+                    qi = int(lrng.integers(0, len(pool_q) - batch))
+                    qb = pool_q[qi: qi + batch]
+                    t1 = time.perf_counter()
+                    try:
+                        res = shard.object_vector_search(qb, K)
+                    except Exception:  # noqa: BLE001 — keep the loop alive
+                        errors[tid] += 1
+                        time.sleep(0.05)
+                        continue
+                    dt = time.perf_counter() - t1
+                    if counting.is_set():
+                        lats[tid].append(dt)
+                        if len(samples[tid]) < 32:
+                            ids = [[int(uuidlib.UUID(r.obj.uuid).int) - 1
+                                    for r in row] for row in res]
+                            samples[tid].append((qi, ids))
+
+            threads = [threading.Thread(target=loop, args=(i,), daemon=True)
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            time.sleep(warmup)  # compile the padding buckets
+            base_audits = None
+            if app.quality_auditor is not None:
+                app.quality_auditor.drain(timeout_s=30.0)
+                app.quality_auditor.clear()
+                base_audits = app.quality_auditor.summary().get("audits", {})
+            counting.set()
+            t1 = time.perf_counter()
+            time.sleep(seconds)
+            counting.clear()
+            elapsed = time.perf_counter() - t1
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            flat = np.array([x for per in lats for x in per], np.float64)
+            hit = tot = 0
+            for per in samples:
+                for qi, rows in per:
+                    for j, ids in enumerate(rows):
+                        want = set(int(x) for x in gt[qi + j])
+                        hit += len(want & set(ids))
+                        tot += K
+            row = {
+                "mode": mode, "n": n, "dim": dim, "k": K,
+                "segments": segments, "clients": clients, "batch": batch,
+                "duration_s": round(elapsed, 2),
+                "requests": int(flat.size),
+                "qps": round(flat.size * batch / elapsed, 1),
+                "p50_ms": round(float(np.percentile(flat, 50)) * 1000, 2)
+                if flat.size else None,
+                "p99_ms": round(float(np.percentile(flat, 99)) * 1000, 2)
+                if flat.size else None,
+                "recall@10": round(hit / tot, 4) if tot else None,
+                "request_errors": int(sum(errors)),
+                "import_s": round(import_s, 1),
+            }
+            if app.quality_auditor is not None:
+                app.quality_auditor.drain(timeout_s=30.0)
+                qs = app.quality_auditor.summary()
+                row["online_recall"] = qs.get("online_recall")
+                row["online_audits"] = {
+                    k: v - (base_audits or {}).get(k, 0)
+                    for k, v in qs.get("audits", {}).items()}
+            # code bytes/vector from the ledger's analytic components —
+            # the acceptance claim (<= M/2 + rotation) reads the same
+            # numbers /debug/memory serves
+            comps = vidx._memory_components()
+            if "pq_codes" in comps and getattr(vidx, "_codes", None) is not None:
+                row["code_bytes_per_vector"] = round(
+                    comps["pq_codes"] / int(vidx._codes.shape[0]), 2)
+            if "pq4_codes" in comps and getattr(vidx, "_codes4", None) is not None:
+                row["pq4_code_bytes_per_vector"] = round(
+                    comps["pq4_codes"] / int(vidx._codes4.shape[0]), 2)
+                row["opq_rot_bytes"] = comps.get("opq_rot", 0)
+            if mode == "pq4-funnel":
+                row["pq_health"] = vidx.health().get("pq")
+                assert (row["pq_health"] or {}).get("funnel"), \
+                    "quant bench: funnel never dispatched"
+            scan_bpr = {"exact": 4 * dim, "pq8": segments,
+                        "pq4-funnel": segments // 2}[mode]
+            plat = jax.devices()[0].platform
+            backend = costmodel.backend_for_platform(plat)
+            shape = costmodel.DispatchShape(
+                costmodel.TIER_PQ_ADC4 if mode == "pq4-funnel"
+                else (costmodel.TIER_PQ_CODES if mode == "pq8"
+                      else costmodel.TIER_EXACT),
+                n=n, dim=dim, batch=batch, bytes_per_row=scan_bpr, k=K)
+            row["costmodel"] = {
+                "scan_bytes_per_row": scan_bpr,
+                "flops_per_dispatch": shape.flops(),
+                "bytes_per_dispatch": shape.bytes(),
+                "roofline": shape.roofline_at_qps(max(row["qps"], 1e-9),
+                                                  backend),
+            }
+            log(f"  mode={mode}: {row}")
+            return row
+        finally:
+            if app is not None:
+                app.shutdown()
+            shutil.rmtree(data_dir, ignore_errors=True)
+
+    wanted = (("exact", "pq8", "pq4-funnel") if args.quant == "all"
+              else (args.quant,))
+    modes = {m: measure(m) for m in wanted}
+    plat = jax.devices()[0].platform
+    backend = "tpu-v5e" if plat in ("tpu", "axon") else "cpu"
+    out_row = {
+        "backend": backend, "round": 6, "date": time.strftime("%Y-%m-%d"),
+        "n": n, "dim": dim, "segments": segments, "clients": clients,
+        "batch": batch, **modes,
+    }
+    if "pq4-funnel" in modes and "pq8" in modes and modes["pq8"]["qps"]:
+        out_row["speedup_pq4_vs_pq8"] = round(
+            modes["pq4-funnel"]["qps"] / modes["pq8"]["qps"], 2)
+    if "pq4-funnel" in modes and "exact" in modes and modes["exact"]["qps"]:
+        out_row["speedup_pq4_vs_exact"] = round(
+            modes["pq4-funnel"]["qps"] / modes["exact"]["qps"], 2)
+    suffix = "cpu" if backend == "cpu" else "tpu"
+    _merge_matrix({f"quant_ladder_{suffix}": out_row})
+    head = (modes.get("pq4-funnel") or modes.get("pq8")
+            or modes.get("exact"))
+    print(json.dumps({
+        "metric": (
+            f"quantization ladder QPS — exact vs 8-bit codes vs 4-bit "
+            f"funnel (shard direct path, n={n}, d={dim}, M={segments}, "
+            f"k={K}, batch={batch}, {clients} clients, backend {backend}; "
+            f"online_recall from the shadow auditor)"),
+        "value": head["qps"],
+        "unit": "qps",
+        "vs_baseline": out_row.get("speedup_pq4_vs_pq8", 0),
+        "row": out_row,
+    }))
+    _gate_exit()
+
+
 def run_ivf_bench(args, rng):
     """IVF-vs-flat A/B (the partition-pruning tentpole, ROADMAP item 3):
     closed-loop batched kNN against ONE shard on the direct serving path
@@ -2791,6 +3044,9 @@ def main():
     rng = np.random.default_rng(7)
     if args.ivf:
         run_ivf_bench(args, rng)
+        return
+    if args.quant:
+        run_quant_bench(args, rng)
         return
     if args.readers:
         run_reader_scaling_bench(args, rng)

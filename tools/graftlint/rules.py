@@ -283,6 +283,9 @@ CONTROLLER_KNOB_FIELDS = frozenset({
     "rescore_r_cap", "rate_scale", "brownout_stage", "_knobs",
     # the IVF probe-count cap — the second recall-guarded budget
     "ivf_top_p", "ivf_top_p_cap",
+    # the 4-bit funnel's stage budgets — the third and fourth
+    # recall-guarded budgets (serving/controller.py FC_/FR_BUCKETS)
+    "funnel_c_cap", "funnel_rescore_cap",
 })
 
 # JGL010 scope: the whole package — metric vecs are registered once in
@@ -309,6 +312,9 @@ SNAPSHOT_FIELDS = frozenset({
     # the IVF scan plane's device slabs (index/tpu.py): centroids,
     # padded partition buckets, PCA projection + per-slot low-dim rows
     "_ivf_centroids", "_ivf_buckets", "_ivf_pca_proj", "_ivf_pca_rows",
+    # the 4-bit Quick-ADC ladder's slabs (index/tpu.py): packed codes,
+    # reconstruction norms, and the shared OPQ rotation matrix
+    "_codes4", "_recon_norms4", "_opq_rot_dev",
 })
 
 # calls that route an allocation through the ledger: the per-class

@@ -145,6 +145,33 @@ def lut_scan_block(codes_block: Array, lut: Array) -> Array:
     return jax.lax.fori_loop(0, m, seg, jnp.zeros((b, chunk), jnp.float32))
 
 
+# -- 4-bit code packing ------------------------------------------------------
+
+def pack_codes4(codes: np.ndarray) -> np.ndarray:
+    """[N, M] 4-bit codes (values 0..15) -> [N, M//2] packed uint8.
+
+    Byte j carries segment j in the LOW nibble and segment M//2 + j in the
+    HIGH nibble, so unpacking is a lane-wise concat (codes = [lo | hi]) —
+    no per-element interleave in either the Pallas kernel or the traceable
+    LUT scan (ops/pq4.py), which keeps the unpack VPU-shaped."""
+    codes = np.asarray(codes)
+    n, m = codes.shape
+    if m % 2:
+        raise ValueError("pack_codes4 requires an even segment count")
+    if codes.size and int(codes.max()) > 15:
+        raise ValueError("pack_codes4 requires 4-bit codes (centroids <= 16)")
+    mb = m // 2
+    lo = codes[:, :mb].astype(np.uint8)
+    hi = codes[:, mb:].astype(np.uint8)
+    return (lo | (hi << 4)).astype(np.uint8)
+
+
+def unpack_codes4(packed: np.ndarray) -> np.ndarray:
+    """[N, M//2] packed uint8 -> [N, M] 4-bit codes (pack_codes4 inverse)."""
+    packed = np.asarray(packed, dtype=np.uint8)
+    return np.concatenate([packed & 0xF, packed >> 4], axis=1)
+
+
 # -- the quantizer -----------------------------------------------------------
 
 class ProductQuantizer:
@@ -207,13 +234,27 @@ class ProductQuantizer:
 
     # fit ---------------------------------------------------------------
 
-    def fit(self, vectors: np.ndarray, seed: int = 0) -> None:
+    def fit(self, vectors: np.ndarray, seed: int = 0,
+            rotation_matrix: Optional[np.ndarray] = None) -> None:
+        """Fit codebooks (and the OPQ rotation when configured). Passing
+        ``rotation_matrix`` pins a PRE-FITTED orthogonal rotation instead of
+        learning one — the 4-bit funnel quantizer reuses the 8-bit
+        quantizer's OPQ rotation this way, so both ladders of the funnel
+        rank in the SAME rotated space and the Procrustes alternation runs
+        once per compress, not once per bit depth."""
         vectors = np.asarray(vectors, dtype=np.float32)
         if vectors.shape[0] > _FIT_SAMPLE_MAX:
             rng = np.random.default_rng(seed)
             sel = rng.choice(vectors.shape[0], _FIT_SAMPLE_MAX, replace=False)
             vectors = vectors[sel]
-        if self.encoder == vi.PQ_ENCODER_TILE:
+        if rotation_matrix is not None:
+            if self.encoder == vi.PQ_ENCODER_TILE:
+                raise vi.ConfigValidationError(
+                    "a preset rotation requires the kmeans encoder")
+            self.rotation_matrix = np.asarray(rotation_matrix, np.float32)
+            self.codebook = self._fit_kmeans(
+                vectors @ self.rotation_matrix, seed)
+        elif self.encoder == vi.PQ_ENCODER_TILE:
             self.codebook = self._fit_tile(vectors)
         elif self.rotation == vi.PQ_ROTATION_OPQ:
             self._fit_opq(vectors, seed)

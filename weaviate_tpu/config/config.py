@@ -44,6 +44,24 @@ RESCORE_R_BUCKETS = (32, 48, 64, 96, 128)
 IVF_TOP_P_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128,
                      192, 256, 384, 512, 768, 1024, 1536, 2048, 3072, 4096)
 
+# The ONE table of 4-bit funnel stage-C buckets (the pq.bits=4 three-stage
+# re-ranking funnel's FIRST budget: how many 4-bit ADC scan survivors reach
+# the 8-bit reconstruction rescore). Same discipline and the same two
+# consumers as RESCORE_R_BUCKETS:
+#   - serving/controller.py's recall-guarded budget controller steps the
+#     funnel_c cap DOWN this ladder (the third recall-guarded knob);
+#   - index/tpu.py's funnel planner snaps C to a bucket (clamped to the
+#     candidate-set size), and the fused kernel keeps C/G whole groups, so
+#     a controller cut can never mint a jit shape the static path wouldn't
+#     also compile. Values are multiples of the group width G=16.
+PQ4_FUNNEL_C_BUCKETS = (256, 384, 512, 768, 1024, 1536, 2048, 3072, 4096)
+
+# The ONE table of 4-bit funnel stage-c buckets (the funnel's SECOND
+# budget: how many 8-bit rescore survivors reach the final bf16/exact
+# rescore). Mirrors RESCORE_R_BUCKETS — the two knobs are the same kind of
+# recall-budget, one per funnel hand-off.
+PQ4_FUNNEL_RESCORE_BUCKETS = (32, 48, 64, 96, 128, 192, 256)
+
 
 def _bool(env: Mapping[str, str], key: str, default: bool = False) -> bool:
     v = env.get(key)

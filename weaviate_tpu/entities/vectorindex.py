@@ -84,6 +84,13 @@ class PQConfig:
     # decorrelates segments — big raw-ADC recall gains on clustered
     # data for the codes-only tier; query-side cost is one tiny matmul
     rotation: str = PQ_ROTATION_NONE
+    # TPU extension: quantization ladder depth. 8 = the classic uint8
+    # codes. 4 adds a nibble-packed 16-centroid sub-quantizer beside the
+    # 8-bit codes and serves through the three-stage re-ranking funnel
+    # (4-bit ADC scan -> 8-bit ADC rescore of top-C -> bf16/exact rescore
+    # of top-c; ops/pq4.py) — half the scanned bytes per row at matched
+    # recall through the funnel
+    bits: int = 8
 
     @classmethod
     def from_dict(cls, d: dict) -> "PQConfig":
@@ -100,6 +107,7 @@ class PQConfig:
             rescore=bool(d.get("rescore", True)),
             rescore_limit=int(d.get("rescoreLimit", 0)),
             rotation=str(d.get("rotation", PQ_ROTATION_NONE)),
+            bits=int(d.get("bits", 8)),
         )
 
     def to_dict(self) -> dict:
@@ -112,6 +120,7 @@ class PQConfig:
             "rescore": self.rescore,
             "rescoreLimit": self.rescore_limit,
             "rotation": self.rotation,
+            "bits": self.bits,
         }
 
 
@@ -221,6 +230,20 @@ class HnswUserConfig:
             if self.pq.rotation not in (PQ_ROTATION_NONE, PQ_ROTATION_OPQ):
                 raise ConfigValidationError(
                     f"invalid pq rotation {self.pq.rotation!r} (none|opq)")
+            if self.pq.bits not in (4, 8):
+                raise ConfigValidationError("pq.bits must be 4 or 8")
+            if self.pq.bits == 4:
+                if self.distance not in (DISTANCE_L2, DISTANCE_DOT,
+                                         DISTANCE_COSINE):
+                    # the funnel's 4-bit scan and 8-bit rescore are both
+                    # matmul-ADC formulations; manhattan's LUT tier has no
+                    # 4-bit twin, and a config that silently served 8-bit
+                    # would misreport its memory floor
+                    raise ConfigValidationError(
+                        "pq.bits=4 requires an l2-squared/dot/cosine distance")
+                if self.pq.encoder.type != PQ_ENCODER_KMEANS:
+                    raise ConfigValidationError(
+                        "pq.bits=4 requires the kmeans encoder")
             if not self.pq.rescore:
                 # Codes-only ADC over a flat scan has no graph to localize
                 # candidates, so the quantizer's intrinsic error lands directly

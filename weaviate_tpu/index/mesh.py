@@ -92,6 +92,7 @@ from weaviate_tpu.monitoring import memory
 from weaviate_tpu.monitoring import quality
 from weaviate_tpu.monitoring.costmodel import (
     TIER_EXACT,
+    TIER_PQ_ADC4,
     TIER_PQ_CODES,
     TIER_PQ_RESCORE,
     DispatchShape,
@@ -112,12 +113,17 @@ from weaviate_tpu.parallel.mesh_search import (
     mesh_grow_pairs,
     mesh_insert_step,
     mesh_search_ivf_step,
+    mesh_search_pq4_step,
     mesh_search_pq_step,
     mesh_search_step,
     mesh_write_pairs_step,
     mesh_write_rows_step,
+    replicated,
     shard_spec,
 )
+from weaviate_tpu.compress.pq import pack_codes4 as pq_pack_codes4
+from weaviate_tpu.config.config import (PQ4_FUNNEL_C_BUCKETS,
+                                        PQ4_FUNNEL_RESCORE_BUCKETS)
 
 _MIN_LOC = 1024       # minimum slab rows per chip (power of two, mult of 32)
 _FLUSH_CHUNK = 8192   # staged rows that trigger a flush
@@ -155,8 +161,8 @@ class MeshSnapshot:
         "gen", "dim", "n_dev", "n_loc", "counts", "counts_dev", "n_total",
         "live", "store", "sq_norms", "tombs", "zero_words", "slot_to_doc",
         "slot_to_doc_dev", "host_tombs", "allow_token", "compressed", "pq",
-        "codes", "recon_norms", "host_vecs", "ivf_centroids", "ivf_buckets",
-        "ivf_meta",
+        "codes", "recon_norms", "pq4", "codes4", "recon_norms4", "opq_rot",
+        "host_vecs", "ivf_centroids", "ivf_buckets", "ivf_meta",
     )
 
     def __init__(self, gen: int, idx: "MeshVectorIndex"):
@@ -185,6 +191,12 @@ class MeshSnapshot:
         self.pq = idx._pq
         self.codes = idx._codes
         self.recon_norms = idx._recon_norms
+        # the 4-bit ladder rung (COW like every other slab: writes bind
+        # NEW sharded arrays, this snapshot keeps the ones it was born with)
+        self.pq4 = idx._pq4
+        self.codes4 = idx._codes4
+        self.recon_norms4 = idx._recon_norms4
+        self.opq_rot = idx._opq_rot_dev
         self.host_vecs = idx._host_vecs
         self.ivf_centroids = idx._ivf_centroids
         self.ivf_buckets = idx._ivf_buckets
@@ -284,8 +296,14 @@ class MeshVectorIndex(VectorIndex):
         self._pq = None
         self._codes = None          # sharded [n_dev * n_loc, M]
         self._recon_norms = None    # sharded [n_dev * n_loc] f32
+        self._pq4 = None            # the 4-bit rung's quantizer (16 cents)
+        self._codes4 = None         # sharded [n_dev * n_loc, M/2] uint8
+        self._recon_norms4 = None   # sharded [n_dev * n_loc] f32
+        self._opq_rot_dev = None    # replicated [D, D] f32 (shared OPQ)
         self._host_vecs = None      # np [cap, D] f32 (compressed mode only)
         self._pq_path = os.path.join(shard_path, "pq.npz") if shard_path else ""
+        self._pq4_path = (os.path.join(shard_path, "pq4.npz")
+                          if shard_path else "")
         self._restoring = False
         self._gmin_broken = False  # fused mesh kernel failed: use the scan
         # identity token for the per-allowList packed-words cache
@@ -352,6 +370,9 @@ class MeshVectorIndex(VectorIndex):
                           ("slot_to_doc", self._s2d_dev),
                           ("pq_codes", self._codes),
                           ("recon_norms", self._recon_norms),
+                          ("pq4_codes", self._codes4),
+                          ("pq4_norms", self._recon_norms4),
+                          ("opq_rot", self._opq_rot_dev),
                           ("ivf_centroids", self._ivf_centroids),
                           ("ivf_buckets", self._ivf_buckets),
                           ("allow_words", self._zero_words)):
@@ -394,6 +415,11 @@ class MeshVectorIndex(VectorIndex):
             self._codes = jax.device_put(
                 jnp.zeros((cap, self._pq.segments), self._pq.code_dtype), sh2)
             self._recon_norms = jax.device_put(jnp.zeros((cap,), jnp.float32), sh1)
+            if self._pq4 is not None:
+                self._codes4 = jax.device_put(
+                    jnp.zeros((cap, self._pq4.segments // 2), jnp.uint8), sh2)
+                self._recon_norms4 = jax.device_put(
+                    jnp.zeros((cap,), jnp.float32), sh1)
             self._host_vecs = np.zeros((cap, dim), np.float32)
         self._stamp_memory()
 
@@ -412,6 +438,10 @@ class MeshVectorIndex(VectorIndex):
         if self.compressed:
             self._codes = mesh_grow_2d(self._codes, new_loc, self.mesh)
             self._recon_norms = mesh_grow_1d(self._recon_norms, new_loc, self.mesh)
+            if self._codes4 is not None:
+                self._codes4 = mesh_grow_2d(self._codes4, new_loc, self.mesh)
+                self._recon_norms4 = mesh_grow_1d(
+                    self._recon_norms4, new_loc, self.mesh)
             hv = np.zeros((self.n_dev * new_loc, self.dim), np.float32)
             for s in range(self.n_dev):
                 hv[s * new_loc : s * new_loc + old_loc] = self._host_vecs[
@@ -708,6 +738,25 @@ class MeshVectorIndex(VectorIndex):
                     jnp.asarray(takes),
                     self.mesh,
                 )
+                if self._pq4 is not None:
+                    # encode-on-write parity for the 4-bit rung: the same
+                    # rows land packed two-codes-per-byte
+                    c4 = self._pq4.encode(chunks.reshape(-1, self.dim))
+                    p4 = pq_pack_codes4(c4).reshape(
+                        self.n_dev, c, self._pq4.segments // 2)
+                    n4 = self._pq4.recon_sq_norms(c4).reshape(
+                        self.n_dev, c).astype(np.float32)
+                    self._codes4, self._recon_norms4 = mesh_write_rows_step(
+                        self._codes4,
+                        self._recon_norms4,
+                        jax.device_put(jnp.asarray(p4),
+                                       shard_spec(self.mesh, None, None)),
+                        jax.device_put(jnp.asarray(n4),
+                                       shard_spec(self.mesh, None)),
+                        jnp.asarray(offsets),
+                        jnp.asarray(takes),
+                        self.mesh,
+                    )
             for s in range(self.n_dev):
                 take = len(taken[s])
                 if not take:
@@ -765,6 +814,41 @@ class MeshVectorIndex(VectorIndex):
         pq.fit(host[occupied])
         self._enable_pq(pq, host, save=True)
 
+    def _obtain_pq4(self, pq, vecs_n: np.ndarray):
+        """The 4-bit rung's quantizer: prefer the persisted pq4.npz during
+        restore (deterministic across restarts, skips the kmeans fit); any
+        rejected/unreadable file only costs a refit with the pinned
+        rotation, never the shard (the pq.npz rejection idiom)."""
+        from weaviate_tpu.compress.pq import ProductQuantizer
+
+        if self._restoring and self._pq4_path and os.path.exists(self._pq4_path):
+            try:
+                pq4 = ProductQuantizer.load(self._pq4_path)
+                if pq4.segments == pq.segments and pq4.centroids == 16:
+                    return pq4
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "persisted pq4.npz does not match the pq config "
+                    "(segments %d vs %d, centroids %d); refitting",
+                    pq4.segments, pq.segments, pq4.centroids)
+            except Exception as e:  # noqa: BLE001 — refit beats a dead shard
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "could not load persisted pq4.npz (%s); refitting", e)
+        pq4 = ProductQuantizer(
+            dim=self.dim,
+            segments=pq.segments,
+            centroids=16,
+            metric=self.metric,
+            encoder=vi.PQ_ENCODER_KMEANS,
+            distribution=self.config.pq.encoder.distribution,
+            rotation=vi.PQ_ROTATION_NONE,
+        )
+        pq4.fit(vecs_n, rotation_matrix=pq.rotation_matrix)
+        return pq4
+
     def _enable_pq(self, pq, host: np.ndarray, save: bool) -> None:
         """Shard codes + ||recon||^2 over the mesh. Dead/padding rows encode
         garbage but are masked by tombs/high-water in the kernel. The store
@@ -779,6 +863,31 @@ class MeshVectorIndex(VectorIndex):
         self._pq = pq
         self._codes = jax.device_put(jnp.asarray(codes), shard_spec(self.mesh, None))
         self._recon_norms = jax.device_put(jnp.asarray(norms), shard_spec(self.mesh))
+        if int(getattr(self.config.pq, "bits", 8)) == 4:
+            # the 4-bit rung: a second 16-centroid quantizer fit in the
+            # SAME rotated space (the 8-bit fit's OPQ matrix is pinned, so
+            # Procrustes runs once and both ladders rank identically under
+            # rotation) — per-chip funnel scans its packed slab at M/2
+            # bytes/row, stage 2 re-ranks against these very 8-bit codes
+            occupied = (self._slot_to_doc >= 0) & ~self._host_tombs
+            pq4 = self._obtain_pq4(pq, host[occupied])
+            codes4 = pq4.encode(host)
+            packed4 = pq_pack_codes4(codes4)
+            norms4 = pq4.recon_sq_norms(codes4).astype(np.float32)
+            self._pq4 = pq4
+            self._codes4 = jax.device_put(
+                jnp.asarray(packed4), shard_spec(self.mesh, None))
+            self._recon_norms4 = jax.device_put(
+                jnp.asarray(norms4), shard_spec(self.mesh))
+            self._opq_rot_dev = (
+                jax.device_put(jnp.asarray(pq4.rotation_matrix, jnp.float32),
+                               replicated(self.mesh))
+                if pq4.rotation_matrix is not None else None)
+        else:
+            self._pq4 = None
+            self._codes4 = None
+            self._recon_norms4 = None
+            self._opq_rot_dev = None
         self._host_vecs = np.array(host, dtype=np.float32)
         if self.dtype == jnp.float32:
             self.dtype = jnp.bfloat16
@@ -794,6 +903,8 @@ class MeshVectorIndex(VectorIndex):
         self._mark_staged()
         if save and self._pq_path:
             pq.save(self._pq_path)
+        if save and self._pq4_path and self._pq4 is not None:
+            self._pq4.save(self._pq4_path)
         led = memory.get_ledger()
         if led is not None:
             led.note_write(
@@ -1174,6 +1285,25 @@ class MeshVectorIndex(VectorIndex):
             eff = nlist if nxt <= eff else nxt
         return eff
 
+    def _funnel_budgets(self, k: int, n: int):
+        """Controller-guarded funnel budgets, mesh-shaped: same ladder
+        caps as the single-chip index (index/tpu.py _funnel_budgets), but
+        planned against the PER-SHARD slab (n = n_loc) — each chip funnels
+        its own rows, so the whole-mesh candidate pool is n_dev x rg4*16.
+        The no-starvation floors mirror _rescore_r: the controller may
+        only cut work, never break top-k coverage."""
+        from weaviate_tpu.ops import pq4 as pq4_ops
+
+        c_top = PQ4_FUNNEL_C_BUCKETS[-1]
+        rc_top = PQ4_FUNNEL_RESCORE_BUCKETS[-1]
+        c_cap = controller.funnel_c_cap(c_top)
+        rc_cap = controller.funnel_rescore_cap(rc_top)
+        if c_cap < 4 * k:
+            c_cap = c_top
+        if rc_cap < 2 * k:
+            rc_cap = rc_top
+        return pq4_ops.plan_funnel(k, n, c_cap, rc_cap)
+
     # -- search dispatch (two-phase: enqueue on the snapshot, fetch later) ---
 
     def dispatch_tier(self, snap: MeshSnapshot,
@@ -1182,6 +1312,8 @@ class MeshVectorIndex(VectorIndex):
         attribution). The mesh has no gather tier — small filtered reads
         still run the full sharded scan."""
         if snap.compressed:
+            if snap.codes4 is not None and snap.pq4 is not None:
+                return TIER_PQ_ADC4
             return TIER_PQ_RESCORE if self.config.pq.rescore else TIER_PQ_CODES
         return TIER_EXACT
 
@@ -1213,7 +1345,42 @@ class MeshVectorIndex(VectorIndex):
         if snap.compressed:
             rescore = self.config.pq.rescore
             packed_dev = None
-            if not rescore:
+            funnel_budgets = None
+            if snap.codes4 is not None and snap.pq4 is not None:
+                # the 4-bit rung: per-chip three-stage funnel (nibble scan
+                # -> 8-bit ADC re-rank -> exact rescore against the chip's
+                # own store slab), budgets recall-guarded per shard
+                from weaviate_tpu.ops import pq4 as pq4_ops
+                from weaviate_tpu.ops import pq_gmin
+
+                rg4, rc = self._funnel_budgets(kk, snap.n_loc)
+                if rc >= kk:
+                    _, flat_cb8 = pq_gmin.cached_cb_constants(self)
+                    packed_dev = mesh_search_pq4_step(
+                        snap.codes4,
+                        snap.codes,
+                        snap.recon_norms4,
+                        snap.recon_norms,
+                        snap.tombs,
+                        snap.counts_dev,
+                        words,
+                        snap.pq4._dev_codebook(),
+                        flat_cb8,
+                        snap.store,
+                        jnp.asarray(q),
+                        snap.pq4.rotation_dev(),
+                        snap.slot_to_doc_dev,
+                        kk,
+                        self.metric,
+                        use_allow,
+                        rg4,
+                        rc,
+                        exact,
+                        fused,
+                        self.mesh,
+                    )
+                    funnel_budgets = (rg4, rc)
+            if packed_dev is None and not rescore:
                 # codes-only tier: try the fused per-shard ADC kernel
                 # (mesh twin of the single-chip pq_gmin dispatch)
                 packed_dev = self._pq_gmin_step_or_none(
@@ -1246,13 +1413,31 @@ class MeshVectorIndex(VectorIndex):
                     self.mesh,
                 )
             if t_enq0:
-                shape = DispatchShape(
-                    TIER_PQ_RESCORE if rescore else TIER_PQ_CODES,
-                    n=snap.n_total, dim=snap.dim, batch=b,
-                    batch_padded=q.shape[0],
-                    bytes_per_row=(snap.dim * snap.store.dtype.itemsize
-                                   if rescore else snap.pq.segments),
-                    k=int(kk), ndev=snap.n_dev)
+                if funnel_budgets is not None:
+                    rg4_s, rc_s = funnel_budgets
+                    shape = DispatchShape(
+                        TIER_PQ_ADC4, n=snap.n_total, dim=snap.dim, batch=b,
+                        batch_padded=q.shape[0],
+                        bytes_per_row=snap.pq4.segments // 2,
+                        k=int(kk), ndev=snap.n_dev,
+                        extra={
+                            # per-shard budgets x n_dev: whole-dispatch
+                            # survivor counts (bytes() attributes stages
+                            # 2/3 per batch row, costmodel.py)
+                            "funnel_c": rg4_s * 16 * snap.n_dev,
+                            "funnel_rescore": rc_s * snap.n_dev,
+                            "funnel_stage2_bytes_per_row": snap.pq.segments,
+                            "funnel_stage3_bytes_per_row":
+                                snap.dim * snap.store.dtype.itemsize,
+                        })
+                else:
+                    shape = DispatchShape(
+                        TIER_PQ_RESCORE if rescore else TIER_PQ_CODES,
+                        n=snap.n_total, dim=snap.dim, batch=b,
+                        batch_padded=q.shape[0],
+                        bytes_per_row=(snap.dim * snap.store.dtype.itemsize
+                                       if rescore else snap.pq.segments),
+                        k=int(kk), ndev=snap.n_dev)
         else:
             top_p = self._ivf_plan(snap, kk)
             if top_p is not None:

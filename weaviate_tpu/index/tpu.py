@@ -91,6 +91,8 @@ from weaviate_tpu.testing import faults, sanitizers
 # config's env-bool parser so FUSED_DISPATCH_ENABLED reads the same truth
 # table with or without an App
 from weaviate_tpu.config.config import (IVF_TOP_P_BUCKETS, IvfConfig,
+                                        PQ4_FUNNEL_C_BUCKETS,
+                                        PQ4_FUNNEL_RESCORE_BUCKETS,
                                         RESCORE_R_BUCKETS, ivf_from_env)
 from weaviate_tpu.config.config import _bool as _env_bool
 # the partition-pruned scan plane (ROADMAP item 3): k-means/PCA training
@@ -1201,6 +1203,7 @@ class IndexSnapshot:
                  "tombs", "slot_to_doc", "slot_to_doc_dev", "host_tombs",
                  "allow_token", "compressed", "pq", "codes", "recon_norms",
                  "rescore_dev", "rescore_sq_norms", "host_vecs",
+                 "pq4", "codes4", "recon_norms4", "opq_rot",
                  "ivf_centroids", "ivf_buckets", "ivf_pca_proj",
                  "ivf_pca_rows", "ivf_meta")
 
@@ -1224,6 +1227,13 @@ class IndexSnapshot:
         self.rescore_dev = idx._rescore_dev
         self.rescore_sq_norms = idx._rescore_sq_norms
         self.host_vecs = idx._host_vecs
+        # 4-bit funnel ladder: nibble-packed codes + their recon norms +
+        # the shared OPQ rotation, pinned exactly like the 8-bit slabs —
+        # a re-compress mid-dispatch serves this snapshot's ladder
+        self.pq4 = idx._pq4
+        self.codes4 = idx._codes4
+        self.recon_norms4 = idx._recon_norms4
+        self.opq_rot = idx._opq_rot_dev
         # the IVF scan plane's device slabs ride the snapshot exactly
         # like the store: a recluster/compact replaces the arrays
         # wholesale (non-donating), so an in-flight dispatch pinning
@@ -1322,6 +1332,15 @@ class TpuVectorIndex(VectorIndex):
         self._recon_norms = None            # device f32 [capacity] ||recon||^2
         self._host_vecs: Optional[np.ndarray] = None  # np [capacity, D] f32
         self._pq_path = os.path.join(shard_path, "pq.npz")
+        # 4-bit funnel ladder (pq.bits=4): a SECOND quantizer with 16
+        # centroids per segment sharing the 8-bit quantizer's OPQ rotation,
+        # its nibble-packed codes [cap, M/2] uint8, recon norms, and the
+        # rotation as its own device slab (applied to queries at dispatch)
+        self._pq4 = None                    # ProductQuantizer (centroids=16)
+        self._codes4 = None                 # device [capacity, M/2] uint8
+        self._recon_norms4 = None           # device f32 [capacity]
+        self._opq_rot_dev = None            # device f32 [D, D] (or None)
+        self._pq4_path = os.path.join(shard_path, "pq4.npz")
         self._restoring = False
         # flips true on a Mosaic compile failure of the fused gmin kernel;
         # searches then stay on the lax.scan kernel permanently
@@ -1335,6 +1354,18 @@ class TpuVectorIndex(VectorIndex):
 
         self._pqg_state = KernelState()
         self._pqg_cb = None  # (pq identity, cb_chunks dev, flat_cb dev)
+        # separate failure domain + codebook-constant cache for the 4-bit
+        # funnel kernel family (ops/pq4.py): a Mosaic failure of the 4-bit
+        # scan must not poison the 8-bit paths, and vice versa
+        self._pq4_state = KernelState()
+        self._pq4_cb = None  # (pq4 identity, cb4 chunks dev, dense cb4 dev)
+        # per-stage funnel survivor accounting for health()["pq"], updated
+        # per funnel dispatch under a leaf lock (lock_hierarchy level 45 —
+        # nothing ever nests inside it)
+        self._pq4_lock = sanitizers.register_lock(
+            threading.Lock(), "index.tpu.pq4")
+        self._pq4_stats = {"dispatches": 0, "stage1_rows": 0,
+                           "stage2_survivors": 0, "stage3_survivors": 0}
         # per-store-generation [ncols, G*D] rescore-block layouts (see
         # gmin_scan.build_rescore_blocks): keyed by the exact device array
         # object — every write replaces the store array with a fresh copy
@@ -1476,6 +1507,10 @@ class TpuVectorIndex(VectorIndex):
                         self._rescore_sq_norms = _grow_1d(
                             self._rescore_sq_norms, cap, jnp.float32(0))
                 self._recon_norms = _grow_1d(self._recon_norms, cap, jnp.float32(0))
+                if self._codes4 is not None:
+                    self._codes4 = _grow_store(self._codes4, cap)
+                    self._recon_norms4 = _grow_1d(
+                        self._recon_norms4, cap, jnp.float32(0))
             else:
                 self._store = _grow_store(self._store, cap)
                 self._sq_norms = _grow_1d(self._sq_norms, cap, jnp.float32(0))
@@ -1521,6 +1556,18 @@ class TpuVectorIndex(VectorIndex):
                     jnp.asarray(self._pq.recon_sq_norms(codes)),
                     start + off,
                 )
+                if self._pq4 is not None:
+                    from weaviate_tpu.compress import pq as pq_mod
+
+                    codes4 = self._pq4.encode(chunk)  # [_CHUNK, M] 0..15
+                    self._codes4 = _write_rows(
+                        self._codes4,
+                        jnp.asarray(pq_mod.pack_codes4(codes4)),
+                        start + off)
+                    self._recon_norms4 = _write_norms(
+                        self._recon_norms4,
+                        jnp.asarray(self._pq4.recon_sq_norms(codes4)),
+                        start + off)
                 if self._rescore_dev is not None:
                     self._rescore_dev = _write_rows(
                         self._rescore_dev, jnp.asarray(chunk, jnp.bfloat16), start + off
@@ -2041,6 +2088,9 @@ class TpuVectorIndex(VectorIndex):
                           ("slot_to_doc", self._s2d_dev),
                           ("pq_codes", self._codes),
                           ("recon_norms", self._recon_norms),
+                          ("pq4_codes", self._codes4),
+                          ("pq4_norms", self._recon_norms4),
+                          ("opq_rot", self._opq_rot_dev),
                           ("rescore_store", self._rescore_dev),
                           ("rescore_sq_norms", self._rescore_sq_norms),
                           ("ivf_centroids", self._ivf_centroids),
@@ -2081,6 +2131,8 @@ class TpuVectorIndex(VectorIndex):
         if self.compressed:
             return (memory.array_bytes(self._codes)
                     + memory.array_bytes(self._recon_norms)
+                    + memory.array_bytes(self._codes4)
+                    + memory.array_bytes(self._recon_norms4)
                     + memory.array_bytes(self._rescore_dev)
                     + memory.array_bytes(self._rescore_sq_norms)
                     + memory.array_bytes(self._s2d_dev) + ivf)
@@ -2204,7 +2256,51 @@ class TpuVectorIndex(VectorIndex):
         pq.fit(vecs)
         self._enable_pq(pq, vecs, save=True)
 
-    def _enable_pq(self, pq, vecs_n: np.ndarray, save: bool) -> None:
+    def _fit_pq4(self, pq, vecs_n: np.ndarray):
+        """Fit the funnel's 4-bit sub-quantizer: same segment count as the
+        8-bit quantizer, 16 centroids per segment, ranked in the SAME
+        rotated space (the 8-bit quantizer's OPQ rotation is pinned, not
+        re-learned — both ladders of the funnel then agree on geometry and
+        queries rotate once per dispatch)."""
+        from weaviate_tpu.compress.pq import ProductQuantizer
+
+        pq4 = ProductQuantizer(
+            dim=self.dim,
+            segments=pq.segments,
+            centroids=16,
+            metric=self.metric,
+            encoder=vi.PQ_ENCODER_KMEANS,
+            distribution=self.config.pq.encoder.distribution,
+            rotation=vi.PQ_ROTATION_NONE,
+        )
+        pq4.fit(vecs_n, rotation_matrix=pq.rotation_matrix)
+        return pq4
+
+    def _obtain_pq4(self, pq, vecs_n: np.ndarray):
+        """The funnel quantizer for _enable_pq: a restore prefers the
+        persisted pq4.npz (deterministic across restarts, skips the kmeans
+        refit); anything else — fresh compress, missing/stale/corrupt file
+        — fits from scratch with the pinned rotation. A rejected pq4.npz
+        only costs the refit, never the shard."""
+        if self._restoring and os.path.exists(self._pq4_path):
+            from weaviate_tpu.compress.pq import ProductQuantizer
+
+            try:
+                pq4 = ProductQuantizer.load(self._pq4_path)
+                if pq4.segments == pq.segments and pq4.centroids == 16:
+                    return pq4
+            except Exception as e:  # noqa: BLE001 — refit is always safe
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "persisted pq4 codebook rejected (%s: %s); refitting",
+                    type(e).__name__, e)
+        return self._fit_pq4(pq, vecs_n)
+
+    def _enable_pq(self, pq, vecs_n: np.ndarray, save: bool,
+                   pq4=None) -> None:
+        from weaviate_tpu.compress import pq as pq_mod
+
         t0 = time.perf_counter()
         codes = pq.encode(vecs_n)  # [n, M]
         full = np.zeros((self.capacity, pq.segments), dtype=pq.code_dtype)
@@ -2240,6 +2336,40 @@ class TpuVectorIndex(VectorIndex):
         else:
             self._rescore_dev = None
             self._rescore_sq_norms = None
+        # 4-bit funnel ladder (pq.bits=4): a SECOND 16-centroid quantizer
+        # fit in the 8-bit quantizer's rotated space (its OPQ rotation is
+        # PINNED via fit(rotation_matrix=...), so the Procrustes
+        # alternation runs once per compress, not once per bit depth) —
+        # nibble-packed codes halve the code bytes again and serve as the
+        # funnel's stage-1 scan plane, with the 8-bit codes as stage 2
+        if self.config.pq.bits == 4:
+            if pq4 is None:
+                pq4 = self._obtain_pq4(pq, vecs_n)
+            codes4 = pq4.encode(vecs_n)  # [n, M] values 0..15
+            packed = pq_mod.pack_codes4(codes4)  # [n, M/2]
+            full4 = np.zeros((self.capacity, pq4.segments // 2), np.uint8)
+            full4[: self.n] = packed
+            self._codes4 = jax.device_put(jnp.asarray(full4), self.device)
+            self._recon_norms4 = jax.device_put(
+                jnp.asarray(np.concatenate([
+                    pq4.recon_sq_norms(codes4),
+                    np.zeros(self.capacity - self.n, np.float32),
+                ])),
+                self.device,
+            )
+            self._opq_rot_dev = (
+                jax.device_put(
+                    jnp.asarray(pq4.rotation_matrix, jnp.float32),
+                    self.device)
+                if pq4.rotation_matrix is not None else None)
+            self._pq4 = pq4
+            self._pq4_cb = None
+        else:
+            self._pq4 = None
+            self._codes4 = None
+            self._recon_norms4 = None
+            self._opq_rot_dev = None
+            self._pq4_cb = None
         self._store = None
         self._sq_norms = None
         self._pq = pq
@@ -2248,6 +2378,8 @@ class TpuVectorIndex(VectorIndex):
             self.config.pq.enabled = True
         if save and self._log is not None:
             pq.save(self._pq_path)
+            if self._pq4 is not None:
+                self._pq4.save(self._pq4_path)
         self._staged_gen += 1
         self._mark_staged()
         led = memory.get_ledger()
@@ -2509,6 +2641,104 @@ class TpuVectorIndex(VectorIndex):
             self._pqg_state, key, thunk,
             "fused pq codes kernel", component="index.tpu.pq_gmin")
 
+    def _funnel_budgets(self, k: int, n: int) -> tuple[int, int]:
+        """(rg4 stage-1 groups, rc stage-2 survivors) for a funnel whose
+        scan plane holds n rows — the SLAB capacity on the full-store
+        tier (dead slots mask to inf; the group-column count plan_funnel
+        clamps against is slab-derived), the probed candidate count on
+        the IVF tier. The two caps are the controller's recall-guarded
+        budgets (serving/controller.py), single-sourced from the
+        config.PQ4_FUNNEL_*_BUCKETS ladders exactly like rescore_r_cap —
+        bucket values in, so the jit shapes plan_funnel emits stay
+        bounded. The same no-starvation floor as _rescore_r: a cap too
+        shallow for this query's k lapses to the static max (the
+        controller may only cut work, never break coverage)."""
+        from weaviate_tpu.ops import pq4 as pq4_ops
+
+        c_top = PQ4_FUNNEL_C_BUCKETS[-1]
+        rc_top = PQ4_FUNNEL_RESCORE_BUCKETS[-1]
+        c_cap = controller.funnel_c_cap(c_top)
+        rc_cap = controller.funnel_rescore_cap(rc_top)
+        if c_cap < 4 * k:
+            c_cap = c_top
+        if rc_cap < 2 * k:
+            rc_cap = rc_top
+        return pq4_ops.plan_funnel(k, n, c_cap, rc_cap)
+
+    def _pq4_funnel_packed_or_none(self, snap: IndexSnapshot, q: np.ndarray,
+                                   b: int, k: int, allow_list, s2d=None):
+        """Run the three-stage 4-bit funnel (ops/pq4.py), or None for the
+        8-bit fallback paths. Its own failure domain (self._pq4_state) and
+        per-shape validation, like the other fused kernels — but unlike
+        eligible_rg, Pallas ineligibility here only downgrades STAGE 1 to
+        the traceable byte-LUT scan; the funnel itself still serves."""
+        from weaviate_tpu.ops import gmin_scan, pq_gmin
+        from weaviate_tpu.ops import pq4 as pq4_ops
+
+        if snap.codes4 is None or snap.pq4 is None:
+            return None
+        if self.metric not in (vi.DISTANCE_L2, vi.DISTANCE_DOT,
+                               vi.DISTANCE_COSINE):
+            return None
+        kk = min(max(k, 1), snap.live)
+        ncols = snap.capacity // gmin_scan.G
+        active_g = max(1, -(-snap.n // ncols))
+        mb = snap.pq4.segments // 2
+        # budgets plan against the SLAB (capacity), not live n: the scan
+        # plane's group-columns are capacity-derived, and on a sparse slab
+        # the live rows spread across up to min(n, ncols) columns — a
+        # live-n clamp would keep far fewer columns than actually carry
+        # data (dead slots already score inf, so capacity never
+        # over-scans)
+        rg4, rc = self._funnel_budgets(kk, snap.capacity)
+        if rc < kk:
+            return None  # candidate set too small to cover k: 8-bit paths
+        bq = q.shape[0]
+        use_pallas = pq4_ops.pallas_eligible(
+            self._pq4_state, self.metric, bq, ncols, snap.dim, mb, active_g,
+            component="index.tpu.pq4")
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        exact = bool(getattr(self.config, "exact_topk", False))
+        use_allow = allow_list is not None
+        words = (self._allow_words(snap, allow_list) if use_allow
+                 else jnp.zeros((snap.capacity // 32,), jnp.uint32))
+        cb4_chunks, cb4_dense = pq4_ops.cached_cb4_constants(self, snap.pq4)
+        _cb8_chunks, flat_cb8 = pq_gmin.cached_cb_constants(self, snap.pq)
+        codes8_blk = self._gen_blocks(snap.codes, pq_gmin.build_codes_blocks)
+        key = (bq, kk, rg4, rc, active_g, snap.capacity, mb, use_allow,
+               use_pallas, s2d is not None)
+
+        def thunk():
+            args = (snap.codes4, snap.codes, snap.recon_norms4,
+                    snap.recon_norms, snap.tombs, snap.n, jnp.asarray(q),
+                    cb4_chunks, cb4_dense, flat_cb8, snap.rescore_dev, words)
+            statics = dict(use_allow=use_allow, k=kk, metric=self.metric,
+                           rg4=rg4, rc=rc, active_g=active_g,
+                           use_pallas=use_pallas, interpret=interpret,
+                           exact=exact, rot=snap.opq_rot,
+                           codes8_blk=codes8_blk)
+            if s2d is not None:
+                return pq4_ops.search_pq4_funnel_fused(*args, s2d, **statics)
+            return pq4_ops.search_pq4_funnel(*args, **statics)
+
+        packed = gmin_scan.guarded_kernel_call(
+            self._pq4_state, key, thunk,
+            "pq4 funnel kernel", component="index.tpu.pq4")
+        if packed is not None:
+            # per-stage survivor accounting (health()["pq"]["funnel"]):
+            # a leaf lock, four integer adds — nothing nests inside it
+            with self._pq4_lock:
+                st = self._pq4_stats
+                st["dispatches"] += 1
+                # survivor counts are LIVE rows, so the funnel reads
+                # monotone even on a sparse slab where the slot budgets
+                # (rg4*G, rc) exceed the data they can keep
+                st["stage1_rows"] += int(snap.n)
+                st["stage2_survivors"] += min(rg4 * gmin_scan.G,
+                                              int(snap.n))
+                st["stage3_survivors"] += min(rc, int(snap.n))
+        return packed
+
     def _rescore_r(self, k: int, n: int) -> int:
         """Fast-scan candidate depth: 0 disables (exactTopK config or
         non-matmul metrics); otherwise 4k clamped to [32, r_max] —
@@ -2694,16 +2924,40 @@ class TpuVectorIndex(VectorIndex):
             if t_enq0:
                 rescore = (self.config.pq.rescore
                            and snap.rescore_dev is not None)
-                shape = costmodel.DispatchShape(
-                    costmodel.TIER_PQ_RESCORE if rescore
-                    else costmodel.TIER_PQ_CODES,
-                    n=snap.n, dim=snap.dim, batch=b,
-                    batch_padded=q.shape[0],
-                    # rescore scans the bf16 copy (2·D); codes-only reads
-                    # the uint8 codes (M = segments bytes per row)
-                    bytes_per_row=(2 * snap.dim if rescore
-                                   else snap.pq.segments),
-                    k=int(k_eff))
+                funnel = (snap.codes4 is not None
+                          and self.metric in (vi.DISTANCE_L2,
+                                              vi.DISTANCE_DOT,
+                                              vi.DISTANCE_COSINE))
+                if funnel:
+                    # the 4-bit funnel tier: stage 1 reads M/2 packed
+                    # bytes per scanned row; the re-ranking stages are
+                    # attributed in extra (C/c rows at M and 2·D bytes)
+                    # — a mid-dispatch refusal re-labels this below
+                    rg4_s, rc_s = self._funnel_budgets(
+                        int(k_eff), snap.capacity)
+                    shape = costmodel.DispatchShape(
+                        costmodel.TIER_PQ_ADC4,
+                        n=snap.n, dim=snap.dim, batch=b,
+                        batch_padded=q.shape[0],
+                        bytes_per_row=snap.pq4.segments // 2,
+                        k=int(k_eff),
+                        extra={"funnel_c": rg4_s * 16,
+                               "funnel_rescore": rc_s,
+                               "funnel_stage2_bytes_per_row":
+                                   snap.pq.segments,
+                               "funnel_stage3_bytes_per_row":
+                                   (2 * snap.dim if rescore else 0)})
+                else:
+                    shape = costmodel.DispatchShape(
+                        costmodel.TIER_PQ_RESCORE if rescore
+                        else costmodel.TIER_PQ_CODES,
+                        n=snap.n, dim=snap.dim, batch=b,
+                        batch_padded=q.shape[0],
+                        # rescore scans the bf16 copy (2·D); codes-only
+                        # reads the uint8 codes (M = segments bytes/row)
+                        bytes_per_row=(2 * snap.dim if rescore
+                                       else snap.pq.segments),
+                        k=int(k_eff))
             fin = self._dispatch_full_pq(snap, q, b, k_eff, allow_list,
                                          shape, s2d)
         else:
@@ -2811,6 +3065,9 @@ class TpuVectorIndex(VectorIndex):
                 and len(allow_list) < self.config.flat_search_cutoff:
             return costmodel.TIER_GATHER
         if snap.compressed:
+            if snap.codes4 is not None and self.metric in (
+                    vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+                return costmodel.TIER_PQ_ADC4
             if self.config.pq.rescore and snap.rescore_dev is not None:
                 return costmodel.TIER_PQ_RESCORE
             return costmodel.TIER_PQ_CODES
@@ -2878,6 +3135,10 @@ class TpuVectorIndex(VectorIndex):
         if not snap.compressed:
             tier = costmodel.TIER_EXACT
             bpr = snap.dim * snap.store.dtype.itemsize
+        elif snap.codes4 is not None and self.metric in (
+                vi.DISTANCE_L2, vi.DISTANCE_DOT, vi.DISTANCE_COSINE):
+            tier = costmodel.TIER_PQ_ADC4
+            bpr = snap.pq4.segments // 2
         elif rescore:
             tier = costmodel.TIER_PQ_RESCORE
             bpr = 2 * snap.dim
@@ -2919,6 +3180,76 @@ class TpuVectorIndex(VectorIndex):
                 steps2 *= 2
         rescore = (snap.compressed and self.config.pq.rescore
                    and snap.rescore_dev is not None)
+        funnel4 = (snap.codes4 is not None and snap.pq4 is not None
+                   and self.metric in (vi.DISTANCE_L2, vi.DISTANCE_DOT,
+                                       vi.DISTANCE_COSINE))
+        if funnel4:
+            # probed three-stage funnel (ops/pq4.search_ivf_pq4): grouped
+            # 4-bit byte-LUT cut -> exact 8-bit ADC of the survivors ->
+            # bf16 rescore — the funnel budgets bound stages 1/2 over the
+            # probed candidate set exactly as over the full store
+            from weaviate_tpu.ops import pq4 as pq4_ops
+
+            r_cand = top_p * cap_p
+            rg4, rc = self._funnel_budgets(kk, r_cand)
+            c1 = min(rg4 * 16, r_cand)
+            # stage-2 chunking over the c1 survivors: pow2 steps under the
+            # shared element budget, stopped early if a further halving
+            # would stop dividing c1 (the _regroup contract)
+            steps2_4 = 1
+            while (steps2_4 * 2 <= c1 and c1 % (steps2_4 * 2) == 0
+                   and (q.shape[0] * (c1 // steps2_4) * snap.dim)
+                   > (1 << 21)):
+                steps2_4 *= 2
+            if rc >= kk and c1 >= rc:
+                statics4 = (kk, self.metric, use_allow, top_p, c1, rc,
+                            exact, gp, steps2_4)
+                args4 = (snap.codes4, snap.codes, snap.recon_norms4,
+                         snap.recon_norms, snap.tombs, snap.n,
+                         jnp.asarray(q), words, snap.pq4._dev_codebook(),
+                         snap.pq._dev_codebook(), snap.ivf_centroids,
+                         snap.ivf_buckets, snap.opq_rot, snap.rescore_dev)
+                if s2d is not None:
+                    packed_dev = pq4_ops.search_ivf_pq4_fused(
+                        *args4, s2d, *statics4)
+                else:
+                    packed_dev = pq4_ops.search_ivf_pq4(*args4, *statics4)
+                with self._ivf_lock:
+                    st = self._ivf_stats
+                    st["dispatches"] += 1
+                    st["probed_rows"] += top_p * cap_p
+                    st["base_rows"] += int(snap.n)
+                with self._pq4_lock:
+                    st = self._pq4_stats
+                    st["dispatches"] += 1
+                    st["stage1_rows"] += r_cand
+                    st["stage2_survivors"] += min(c1, r_cand)
+                    st["stage3_survivors"] += min(rc, r_cand)
+                if s2d is not None:
+                    return self._finalize_fused(packed_dev, shape, b)
+                slot_to_doc = snap.slot_to_doc
+
+                def finalize4():
+                    packed = _fetch_packed(packed_dev, shape)
+                    top, idx = _unpack(packed)
+                    top = top[:b]
+                    idx = idx[:b]
+                    t0 = time.perf_counter() if shape is not None else 0.0
+                    ids = np.where(idx >= 0,
+                                   slot_to_doc[np.clip(idx, 0, None)], -1)
+                    if shape is not None:
+                        shape.translate_ms = \
+                            (time.perf_counter() - t0) * 1000.0
+                    return ids.astype(np.uint64), top.astype(np.float32)
+
+                return finalize4
+            if shape is not None and shape.tier == costmodel.TIER_PQ_ADC4:
+                # budgets can't cover this k over the probed set: the
+                # 8-bit IVF tier serves — re-label (no phantom traffic)
+                shape.tier = (costmodel.TIER_PQ_RESCORE if rescore
+                              else costmodel.TIER_PQ_CODES)
+                shape.bytes_per_row = (2 * snap.dim if rescore
+                                       else snap.pq.segments)
         statics = (kk, self.metric, use_allow, top_p, pre_c, exact, gp,
                    steps2)
         if not snap.compressed or rescore:
@@ -3057,6 +3388,40 @@ class TpuVectorIndex(VectorIndex):
         from weaviate_tpu.compress.pq import build_lut
 
         pqc = self.config.pq
+        # 4-bit funnel tier first (pq.bits=4): the stage-1 scan reads M/2
+        # bytes per row — less HBM than the bf16 copy (2D) or even the
+        # 8-bit codes (M) — and the two re-ranking stages restore recall.
+        # A broken/ineligible funnel falls through to the 8-bit paths
+        # below (the codes and rescore slabs both still exist).
+        packed4 = self._pq4_funnel_packed_or_none(snap, q, b, k, allow_list,
+                                                  s2d)
+        if packed4 is not None:
+            if s2d is not None:
+                return self._finalize_fused(packed4, shape, b, k)
+            slot_to_doc = snap.slot_to_doc
+
+            def finalize4():
+                packed = _fetch_packed(packed4, shape)
+                top, slots = _unpack(packed)
+                top, slots = top[:b], slots[:b]
+                t0 = time.perf_counter() if shape is not None else 0.0
+                ids = np.where(slots >= 0,
+                               slot_to_doc[np.clip(slots, 0, None)], -1)
+                if shape is not None:
+                    shape.translate_ms = (time.perf_counter() - t0) * 1000.0
+                return (ids[:, :k].astype(np.uint64),
+                        top[:, :k].astype(np.float32))
+
+            return finalize4
+        if shape is not None and shape.tier == costmodel.TIER_PQ_ADC4:
+            # the funnel refused mid-dispatch (broken kernel / shallow
+            # budgets): re-label the shape for the tier that actually
+            # serves, so /debug/perf carries no phantom 4-bit traffic
+            rescore_fb = pqc.rescore and snap.rescore_dev is not None
+            shape.tier = (costmodel.TIER_PQ_RESCORE if rescore_fb
+                          else costmodel.TIER_PQ_CODES)
+            shape.bytes_per_row = (2 * snap.dim if rescore_fb
+                                   else snap.pq.segments)
         rescore = pqc.rescore and snap.rescore_dev is not None
         if rescore:
             allow_words = (self._allow_words(snap, allow_list)
@@ -3539,7 +3904,34 @@ class TpuVectorIndex(VectorIndex):
                 "rescore": bool(self.config.pq.rescore
                                 and self._rescore_dev is not None),
                 "code_dtype": str(getattr(pq, "code_dtype", "")),
+                # quantization-ladder state (the /debug/index satellite):
+                # which bit depth serves, whether an OPQ rotation is
+                # pinned, the controller-capped funnel budgets, and the
+                # per-stage survivor accounting (racy leaf-lock counters,
+                # same contract as the IVF probe stats)
+                "bits": 4 if self._codes4 is not None else 8,
+                "opq": self._opq_rot_dev is not None,
             }
+            if self._codes4 is not None and self._pq4 is not None:
+                k_ref = 10  # reference depth for the budget readout
+                rg4, rc = self._funnel_budgets(k_ref, max(self.capacity, 1))
+                with self._pq4_lock:
+                    st = dict(self._pq4_stats)
+                d = max(st["dispatches"], 1)
+                out["pq"]["funnel"] = {
+                    "stage1_c": rg4 * 16,
+                    "stage2_rescore": rc,
+                    "c_cap": controller.funnel_c_cap(
+                        PQ4_FUNNEL_C_BUCKETS[-1]),
+                    "rescore_cap": controller.funnel_rescore_cap(
+                        PQ4_FUNNEL_RESCORE_BUCKETS[-1]),
+                    "dispatches": st["dispatches"],
+                    "mean_stage1_rows": round(st["stage1_rows"] / d, 1),
+                    "mean_stage2_survivors": round(
+                        st["stage2_survivors"] / d, 1),
+                    "mean_stage3_survivors": round(
+                        st["stage3_survivors"] / d, 1),
+                }
         return out
 
     def search_by_vector(
@@ -3645,14 +4037,21 @@ class TpuVectorIndex(VectorIndex):
             # packed-words cache keyed on the old mapping (same n/capacity
             # possible after re-adds) must never be served again
             self._allow_token = object()
-            # rebuild device state (uncompressed rebuild, then re-encode)
-            pq, was_compressed = self._pq, self.compressed
+            # rebuild device state (uncompressed rebuild, then re-encode);
+            # the pq4 quantizer rides along with the 8-bit one so the
+            # post-rebuild re-encode preserves BOTH ladders' codebooks
+            pq, pq4, was_compressed = self._pq, self._pq4, self.compressed
             self.compressed = False
             self._pq = None
             self._codes = None
             self._rescore_dev = None
             self._rescore_sq_norms = None
             self._recon_norms = None
+            self._pq4 = None
+            self._codes4 = None
+            self._recon_norms4 = None
+            self._opq_rot_dev = None
+            self._pq4_cb = None
             self._host_vecs = None
             self.dim = None
             self.capacity = 0
@@ -3683,7 +4082,7 @@ class TpuVectorIndex(VectorIndex):
                 self._restoring = prev_restoring
             if was_compressed and self.n > 0:
                 fresh = np.asarray(self._store[: self.n], dtype=np.float32)  # graftlint: disable=JGL008 compact is a stop-the-world rebuild: the lock must cover it and the materialized store IS the rebuild's input
-                self._enable_pq(pq, fresh, save=False)
+                self._enable_pq(pq, fresh, save=False, pq4=pq4)
             # recluster on the compacted slot space (fresh k-means — the
             # densified layout is a different distribution than the
             # tombstone-riddled one); publish so readers see it
@@ -3732,13 +4131,19 @@ class TpuVectorIndex(VectorIndex):
             self._rescore_dev = None
             self._rescore_sq_norms = None
             self._recon_norms = None
+            self._pq4 = None
+            self._codes4 = None
+            self._recon_norms4 = None
+            self._opq_rot_dev = None
+            self._pq4_cb = None
             self._host_vecs = None
             self._staged_gen += 1
             self._publish_snapshot()
-            try:
-                os.remove(self._pq_path)
-            except FileNotFoundError:
-                pass
+            for path in (self._pq_path, self._pq4_path):
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
 
     def shutdown(self) -> None:
         with self._lock:
@@ -3749,6 +4154,7 @@ class TpuVectorIndex(VectorIndex):
 
     def list_files(self) -> list[str]:
         files = [self._log.path] if self._log is not None else []
-        if os.path.exists(self._pq_path):
-            files.append(self._pq_path)
+        for path in (self._pq_path, self._pq4_path):
+            if os.path.exists(path):
+                files.append(path)
         return files

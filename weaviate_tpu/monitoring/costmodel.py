@@ -78,6 +78,7 @@ def detect_backend() -> str:
 TIER_EXACT = "exact_scan"            # full f32 (or bf16-store) scan
 TIER_PQ_RESCORE = "pq_rescore_bf16"  # PQ with rescore: scans the bf16 copy
 TIER_PQ_CODES = "pq_codes"           # codes-only ADC (gmin / recon / LUT)
+TIER_PQ_ADC4 = "pq_adc4"             # 4-bit funnel: nibble scan + re-rank
 TIER_GATHER = "gather"               # small-allowList gathered row scoring
 TIER_BM25_MATMUL = "bm25_matmul"     # dense-row keyword batch matmul
 
@@ -176,8 +177,20 @@ class DispatchShape:
         return int(round(2.0 * self.batch * self.n * self.dim))
 
     def bytes(self) -> int:
-        """Store bytes read from HBM for the whole dispatch."""
-        return int(round(self.n * self.bytes_per_row))
+        """Store bytes read from HBM for the whole dispatch. On the
+        pq_adc4 tier `bytes_per_row` covers only the stage-1 nibble scan
+        (M/2 per scanned row); the re-rank stages gather per QUERY, not
+        per row, so their traffic rides ``extra`` — funnel_c x the 8-bit
+        code row for stage 2, funnel_rescore x the bf16 row for stage 3
+        — and is added here per batch row."""
+        total = self.n * self.bytes_per_row
+        if self.extra and self.tier == TIER_PQ_ADC4:
+            total += self.batch * (
+                self.extra.get("funnel_c", 0)
+                * self.extra.get("funnel_stage2_bytes_per_row", 0)
+                + self.extra.get("funnel_rescore", 0)
+                * self.extra.get("funnel_stage3_bytes_per_row", 0))
+        return int(round(total))
 
     def hop_ms(self) -> float:
         """The host hop between the device fetch and hydration — finalize

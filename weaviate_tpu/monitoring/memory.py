@@ -80,7 +80,11 @@ DEVICE_COMPONENTS = ("store", "sq_norms", "tombs", "slot_to_doc",
                      # ops/ivf.py): k-means centroids, padded partition
                      # buckets, PCA projection + per-slot low-dim rows
                      "ivf_centroids", "ivf_buckets", "ivf_pca_proj",
-                     "ivf_pca_rows")
+                     "ivf_pca_rows",
+                     # the 4-bit Quick-ADC ladder (index/tpu.py +
+                     # ops/pq4.py): packed two-codes-per-byte slab, its
+                     # reconstruction norms, and the shared OPQ rotation
+                     "pq4_codes", "pq4_norms", "opq_rot")
 HOST_COMPONENTS = ("slot_to_doc", "host_tombs", "host_vecs",
                    "pending_rows", "breaker_rows", "auditor_rows",
                    "allow_cache", "stage_buffers",

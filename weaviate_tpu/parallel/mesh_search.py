@@ -473,6 +473,64 @@ def mesh_search_ivf_step(
       s2d)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "use_allow", "rg4", "rc", "exact",
+                     "fused", "mesh"),
+)
+def mesh_search_pq4_step(
+    codes4, codes8, recon_norms4, recon_norms8, tombs, n_per_shard,
+    allow_words, codebook4, flat_cb8, rescore_store, queries, rot, s2d,
+    k, metric, use_allow, rg4, rc, exact, fused, mesh,
+):
+    """The 4-bit Quick-ADC funnel, mesh-sharded: each chip runs the SAME
+    three-stage funnel the single-chip index uses (ops/pq4.pq4_funnel_topk
+    — byte-LUT nibble scan -> exact 8-bit ADC of the top rg4*G survivors
+    -> exact rescore of the top rc against the chip's own store slab, the
+    per-chip stage-3 source) over its own packed uint8 slab, and the
+    cross-chip merge all_gathers k (exact dist, global-row) pairs over ICI
+    and reselects, exactly like the other mesh search kernels. Stage-3
+    distances are exact f32, so the merge is exact.
+
+    codes4:       [n_dev * n_loc, M/2] uint8 sharded — packed nibble pairs
+    codes8:       [n_dev * n_loc, M] uint8 sharded — the 8-bit ladder rung
+    recon_norms4/8: [n_dev * n_loc] f32 sharded (per-quantizer ||recon||^2)
+    codebook4:    [M, 16, ds] f32 replicated
+    flat_cb8:     [M * C, ds] bf16 replicated (pq_gmin.cached_cb_constants)
+    rescore_store:[n_dev * n_loc, D] sharded — the resident bf16 store
+    rot:          [D, D] f32 replicated OPQ rotation (or None)
+    rg4/rc are PER-SHARD budgets (each chip funnels its own slab).
+    The in-graph traceable stage 1 is used on every chip — the Pallas
+    nibble kernel has no shard_map story yet, and the byte LUT is already
+    one gather per packed byte."""
+    from weaviate_tpu.ops import pq4 as pq4_ops
+
+    n_dev = mesh.devices.size
+    n_loc = codes4.shape[0] // n_dev
+
+    def shard_fn(c4_l, c8_l, n4_l, n8_l, tombs_l, n_all, allow_l, cb4, fcb8,
+                 rs_l, q, r, s2d_l):
+        my = jax.lax.axis_index(SHARD_AXIS)
+        n_mine = n_all[my]
+        d_top, i_top = pq4_ops.pq4_funnel_topk(
+            c4_l, c8_l, n4_l, n8_l, tombs_l, n_mine, q, None, cb4, fcb8,
+            rs_l, allow_l, use_allow, k, metric, rg4, rc,
+            use_pallas=False, interpret=False, exact=exact, rot=r)
+        return _merge_local(d_top, i_top, s2d_l, my, n_loc, k, fused)
+
+    return shard_map_compat(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS, None), P(SHARD_AXIS, None), P(SHARD_AXIS),
+            P(SHARD_AXIS), P(SHARD_AXIS), P(), P(SHARD_AXIS), P(), P(),
+            P(SHARD_AXIS, None), P(), P(), P(SHARD_AXIS, None),
+        ),
+        out_specs=P(),
+    )(codes4, codes8, recon_norms4, recon_norms8, tombs, n_per_shard,
+      allow_words, codebook4, flat_cb8, rescore_store, queries, rot, s2d)
+
+
 # NOTE on donation: the write kernels below deliberately do NOT donate
 # their input slabs. Published MeshSnapshot objects pin the previous
 # arrays for in-flight lock-free readers (docs/concurrency.md, snapshot

@@ -75,7 +75,10 @@ from collections import deque
 from typing import Optional
 
 from weaviate_tpu.config import ControllerConfig
-from weaviate_tpu.config.config import IVF_TOP_P_BUCKETS, RESCORE_R_BUCKETS
+from weaviate_tpu.config.config import (IVF_TOP_P_BUCKETS,
+                                        PQ4_FUNNEL_C_BUCKETS,
+                                        PQ4_FUNNEL_RESCORE_BUCKETS,
+                                        RESCORE_R_BUCKETS)
 from weaviate_tpu.monitoring import incidents
 from weaviate_tpu.testing import faults, sanitizers
 
@@ -99,6 +102,13 @@ R_BUCKETS = RESCORE_R_BUCKETS
 # probe count applies unchanged.
 P_BUCKETS = IVF_TOP_P_BUCKETS
 
+# the 4-bit funnel's two stage budgets (config.PQ4_FUNNEL_*_BUCKETS —
+# the same one-source-of-truth discipline again: index/tpu.py
+# _funnel_budgets snaps both jit statics to these tables). Top bucket =
+# "controller inactive": the funnel's built-in maxima apply.
+FC_BUCKETS = PQ4_FUNNEL_C_BUCKETS
+FR_BUCKETS = PQ4_FUNNEL_RESCORE_BUCKETS
+
 # brownout ladder stages (stage 0 = normal serving)
 STAGE_NORMAL = 0
 STAGE_MARGIN = 1      # tighten admission margins (shed earlier)
@@ -116,9 +126,11 @@ KNOB_RETRY_SCALE = "retry_after_scale"
 KNOB_RESCORE_CAP = "rescore_r_cap"
 KNOB_RATE_SCALE = "rate_scale"
 KNOB_IVF_TOP_P = "ivf_top_p"
+KNOB_FUNNEL_C = "funnel_c_cap"
+KNOB_FUNNEL_RESCORE = "funnel_rescore_cap"
 KNOB_NAMES = (KNOB_WINDOW_S, KNOB_MARGIN, KNOB_CAP_SCALE,
               KNOB_RETRY_SCALE, KNOB_RESCORE_CAP, KNOB_RATE_SCALE,
-              KNOB_IVF_TOP_P)
+              KNOB_IVF_TOP_P, KNOB_FUNNEL_C, KNOB_FUNNEL_RESCORE)
 
 
 def _snap_bucket(value: float, buckets=R_BUCKETS) -> int:
@@ -235,6 +247,8 @@ class ControlPlane:
             KNOB_RESCORE_CAP: float(R_BUCKETS[-1]),
             KNOB_RATE_SCALE: 1.0,
             KNOB_IVF_TOP_P: float(P_BUCKETS[-1]),
+            KNOB_FUNNEL_C: float(FC_BUCKETS[-1]),
+            KNOB_FUNNEL_RESCORE: float(FR_BUCKETS[-1]),
         }
         self._depth_default = (coalescer._depth if coalescer is not None
                                else 1)
@@ -249,6 +263,9 @@ class ControlPlane:
             KNOB_RESCORE_CAP: (float(R_BUCKETS[0]), float(R_BUCKETS[-1])),
             KNOB_RATE_SCALE: (0.25, 1.0),
             KNOB_IVF_TOP_P: (float(P_BUCKETS[0]), float(P_BUCKETS[-1])),
+            KNOB_FUNNEL_C: (float(FC_BUCKETS[0]), float(FC_BUCKETS[-1])),
+            KNOB_FUNNEL_RESCORE: (float(FR_BUCKETS[0]),
+                                  float(FR_BUCKETS[-1])),
         }
         # token buckets (controller 4); rate 0 = quota off
         self.rate_buckets = _TokenBuckets(
@@ -267,6 +284,13 @@ class ControlPlane:
         # probe-count cap (top = inactive)
         self._p_idx = len(P_BUCKETS) - 1
         self._p_hold = 0
+        # the third and fourth recall-guarded budgets (the 4-bit funnel's
+        # stage-C and stage-c depths, index/tpu.py _funnel_budgets):
+        # indices into FC_/FR_BUCKETS (top = inactive)
+        self._fc_idx = len(FC_BUCKETS) - 1
+        self._fc_hold = 0
+        self._fr_idx = len(FR_BUCKETS) - 1
+        self._fr_hold = 0
         # lane-controller state: hysteresis counts CONSECUTIVE qualifying
         # ticks in ONE direction — the paired _dir resets the counter when
         # the qualifying branch flips, so mixed evidence never actuates
@@ -313,6 +337,10 @@ class ControlPlane:
             v = float(_snap_bucket(v))
         elif name == KNOB_IVF_TOP_P:
             v = float(_snap_bucket(v, P_BUCKETS))
+        elif name == KNOB_FUNNEL_C:
+            v = float(_snap_bucket(v, FC_BUCKETS))
+        elif name == KNOB_FUNNEL_RESCORE:
+            v = float(_snap_bucket(v, FR_BUCKETS))
         prev = self._read(name, self._defaults[name])
         now = time.monotonic()
         with self._lock:
@@ -593,10 +621,23 @@ class ControlPlane:
             # keeps the held value alive only while this thread ticks, so
             # a stalled/dead plane still fail-statics at the readers.
             self._r_hold = 0
+            self._fc_hold = 0
+            self._fr_hold = 0
             return
+        ewma = self._sense_recall()
         self._r_idx, self._r_hold = self._ladder_step(
-            KNOB_RESCORE_CAP, R_BUCKETS, self._r_idx, self._r_hold,
-            self._sense_recall())
+            KNOB_RESCORE_CAP, R_BUCKETS, self._r_idx, self._r_hold, ewma)
+        # The funnel's two stage budgets ride the same ladder with the
+        # same paused-gate semantics as the rescore cap: both caps only
+        # ever CUT device work (index/tpu.py floors them against k and
+        # falls back to the built-in maxima when a cut would starve
+        # top-k), so restoring to maximum mid-brownout would multiply
+        # stage-2/3 re-rank work exactly while the SLO burns.
+        self._fc_idx, self._fc_hold = self._ladder_step(
+            KNOB_FUNNEL_C, FC_BUCKETS, self._fc_idx, self._fc_hold, ewma)
+        self._fr_idx, self._fr_hold = self._ladder_step(
+            KNOB_FUNNEL_RESCORE, FR_BUCKETS, self._fr_idx, self._fr_hold,
+            ewma)
 
     def _tick_ivf_budget(self) -> None:
         """The SECOND recall-guarded budget (ROADMAP item 3/4): the IVF
@@ -733,7 +774,10 @@ class ControlPlane:
         self._stage_clean_ticks = 0
         self._r_idx = len(R_BUCKETS) - 1
         self._p_idx = len(P_BUCKETS) - 1
+        self._fc_idx = len(FC_BUCKETS) - 1
+        self._fr_idx = len(FR_BUCKETS) - 1
         self._r_hold = self._p_hold = self._win_hold = self._depth_hold = 0
+        self._fc_hold = self._fr_hold = 0
         self._win_dir = self._depth_dir = 0
         incidents.emit("controller_revert", scope="serving",
                        reason=reason, knobs=sorted(had))
@@ -790,6 +834,8 @@ class ControlPlane:
                 "budget": {"enabled": self.cfg.budget_enabled,
                            "rescore_r_cap": R_BUCKETS[self._r_idx],
                            "ivf_top_p_cap": P_BUCKETS[self._p_idx],
+                           "funnel_c_cap": FC_BUCKETS[self._fc_idx],
+                           "funnel_rescore_cap": FR_BUCKETS[self._fr_idx],
                            "recall_floor": self.cfg.recall_floor,
                            "recall_ewma_min": self._sense_recall()},
                 "lanes": {"enabled": self.cfg.lanes_enabled,
@@ -962,6 +1008,31 @@ def ivf_top_p_cap(default: int) -> int:
     if p is None:
         return default
     return min(int(p._read(KNOB_IVF_TOP_P, default)), int(default))
+
+
+def funnel_c_cap(default: int) -> int:
+    """Cap on the 4-bit funnel's stage-1 survivor count C (index/tpu.py
+    ``_funnel_budgets``) — the third recall-guarded budget, stepping the
+    FC_BUCKETS ladder with the rescore cap's pause semantics (a silenced
+    meter holds the last vouched-for value; every cut is journaled via
+    ``_set_knob``). Never exceeds `default` — the budget may only cut,
+    and the index floors the result against k so a cut can narrow the
+    funnel but never starve top-k."""
+    p = _plane
+    if p is None:
+        return default
+    return min(int(p._read(KNOB_FUNNEL_C, default)), int(default))
+
+
+def funnel_rescore_cap(default: int) -> int:
+    """Cap on the 4-bit funnel's stage-3 exact-rescore depth c
+    (index/tpu.py ``_funnel_budgets``) — the fourth recall-guarded
+    budget, same FR_BUCKETS ladder discipline as ``funnel_c_cap``.
+    Never exceeds `default`."""
+    p = _plane
+    if p is None:
+        return default
+    return min(int(p._read(KNOB_FUNNEL_RESCORE, default)), int(default))
 
 
 def take_rate_token(tenant: Optional[str]) -> Optional[float]:
